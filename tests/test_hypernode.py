@@ -1,0 +1,67 @@
+"""HyperNode tree / LCA / ICI distance (reference: hyper_node_info_test.go)."""
+
+from volcano_tpu.api.hypernode import VIRTUAL_ROOT, HyperNode, HyperNodesInfo
+
+
+def build_two_pod_topology():
+    """2 DCN pods, each with 2 ICI slices of 2 hosts:
+
+        tier2: pod0 (slice00 slice01)   pod1 (slice10 slice11)
+        tier1: slice00={n0,n1} slice01={n2,n3} slice10={n4,n5} slice11={n6,n7}
+    """
+    nodes = [f"n{i}" for i in range(8)]
+    hns = [
+        HyperNode.of_nodes("slice00", 1, ["n0", "n1"]),
+        HyperNode.of_nodes("slice01", 1, ["n2", "n3"]),
+        HyperNode.of_nodes("slice10", 1, ["n4", "n5"]),
+        HyperNode.of_nodes("slice11", 1, ["n6", "n7"]),
+        HyperNode.of_children("pod0", 2, ["slice00", "slice01"]),
+        HyperNode.of_children("pod1", 2, ["slice10", "slice11"]),
+    ]
+    return HyperNodesInfo(hns, nodes), nodes
+
+
+def test_tree_structure_and_real_nodes():
+    info, nodes = build_two_pod_topology()
+    assert info.tiers == [1, 2]
+    assert info.real_nodes("pod0") == {"n0", "n1", "n2", "n3"}
+    assert info.real_nodes("slice11") == {"n6", "n7"}
+    assert info.real_nodes(VIRTUAL_ROOT) == set(nodes)
+    assert info.members["slice00"].parent == "pod0"
+    assert info.members["pod1"].parent == VIRTUAL_ROOT
+
+
+def test_lca():
+    info, _ = build_two_pod_topology()
+    assert info.lca("slice00", "slice01") == "pod0"
+    assert info.lca("slice00", "slice11") == VIRTUAL_ROOT
+    assert info.lca("slice10", "pod1") == "pod1"
+
+
+def test_ici_distance_between_nodes():
+    info, _ = build_two_pod_topology()
+    # same slice: tier 1 (full ICI bandwidth)
+    assert info.lca_tier_of_nodes("n0", "n1") == 1
+    # same pod, different slice: tier 2 (DCN within pod)
+    assert info.lca_tier_of_nodes("n0", "n2") == 2
+    # different pods: virtual root tier (3)
+    assert info.lca_tier_of_nodes("n0", "n6") == 3
+
+
+def test_hypernodes_covering():
+    info, _ = build_two_pod_topology()
+    cover = info.hypernodes_covering({"n0", "n1"})
+    assert cover[0] == "slice00"          # tightest first
+    assert "pod0" in cover
+    assert info.hypernodes_covering({"n0", "n4"}) == []  # only root covers
+
+
+def test_regex_members_and_uncovered_nodes():
+    hns = [HyperNode(name="sl", tier=1,
+                     members=[__import__("volcano_tpu.api.hypernode",
+                                         fromlist=["HyperNodeMember"])
+                              .HyperNodeMember(kind="Node", regex=r"n[01]")])]
+    info = HyperNodesInfo(hns, ["n0", "n1", "stray"])
+    assert info.real_nodes("sl") == {"n0", "n1"}
+    assert info.leaf_of_node("stray") is None
+    assert "stray" in info.real_nodes(VIRTUAL_ROOT)

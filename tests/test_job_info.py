@@ -1,0 +1,95 @@
+"""JobInfo/TaskInfo/SubJobInfo gang accounting (reference: job_info_test.go)."""
+
+from volcano_tpu.api.job_info import JobInfo, SubJobInfo, TaskInfo
+from volcano_tpu.api.pod import make_pod
+from volcano_tpu.api.podgroup import PodGroup, SubGroupPolicy
+from volcano_tpu.api.types import SUBGROUP_LABEL, TaskStatus
+
+
+def mk_job(min_member=3, min_task_member=None, subgroups=()):
+    pg = PodGroup(name="job1", min_member=min_member,
+                  min_task_member=dict(min_task_member or {}),
+                  sub_group_policies=list(subgroups))
+    return JobInfo(uid="j1", podgroup=pg)
+
+
+def mk_task(name, status=TaskStatus.PENDING, cpu="1", spec="worker",
+            labels=None, priority=0):
+    pod = make_pod(name, requests={"cpu": cpu}, phase=status,
+                   labels=labels, priority=priority)
+    pod.task_spec = spec
+    return TaskInfo(pod, job_uid="j1")
+
+
+def test_add_remove_task_accounting():
+    job = mk_job()
+    t = mk_task("p0")
+    job.add_task(t)
+    assert job.total_request.milli_cpu == 1000
+    assert len(job.tasks_in_status(TaskStatus.PENDING)) == 1
+    job.remove_task(t)
+    assert job.total_request.is_empty()
+    assert not job.tasks
+
+
+def test_ready_and_pipelined_counting():
+    job = mk_job(min_member=3)
+    for i, st in enumerate([TaskStatus.RUNNING, TaskStatus.ALLOCATED,
+                            TaskStatus.PIPELINED, TaskStatus.PENDING]):
+        job.add_task(mk_task(f"p{i}", status=st))
+    assert job.ready_task_num() == 2
+    assert job.waiting_task_num() == 1
+    assert not job.is_ready()
+    assert job.is_pipelined()          # 2 ready + 1 pipelined >= 3
+    assert job.is_starving()           # 4 valid >= 3 but not ready
+
+
+def test_update_task_status_moves_index():
+    job = mk_job(min_member=1)
+    t = mk_task("p0")
+    job.add_task(t)
+    job.update_task_status(t, TaskStatus.ALLOCATED)
+    assert job.ready_task_num() == 1
+    assert not job.tasks_in_status(TaskStatus.PENDING)
+    assert job.is_ready()
+
+
+def test_task_min_available():
+    job = mk_job(min_member=2, min_task_member={"ps": 1, "worker": 2})
+    job.add_task(mk_task("ps0", spec="ps", status=TaskStatus.RUNNING))
+    job.add_task(mk_task("w0", spec="worker", status=TaskStatus.RUNNING))
+    assert not job.check_task_min_available_ready()   # worker has 1 of 2
+    job.add_task(mk_task("w1", spec="worker", status=TaskStatus.ALLOCATED))
+    assert job.check_task_min_available_ready()
+    assert job.check_task_min_available()
+
+
+def test_subjob_gang():
+    sg = SubGroupPolicy(name="sliceA", min_member=2)
+    job = mk_job(min_member=4, subgroups=[sg])
+    for i in range(2):
+        job.add_task(mk_task(f"a{i}", status=TaskStatus.ALLOCATED,
+                             labels={SUBGROUP_LABEL: "sliceA"}))
+    job.add_task(mk_task("b0", status=TaskStatus.PENDING))
+    sub = job.sub_jobs["sliceA"]
+    assert sub.ready_task_num() == 2 and sub.is_ready()
+    root = job.sub_jobs[""]
+    assert len(root.tasks) == 1
+
+
+def test_clone_is_deep_for_tasks():
+    job = mk_job(min_member=1)
+    t = mk_task("p0")
+    job.add_task(t)
+    c = job.clone()
+    c.update_task_status(list(c.tasks.values())[0], TaskStatus.ALLOCATED)
+    assert t.status is TaskStatus.PENDING
+    assert job.ready_task_num() == 0 and c.ready_task_num() == 1
+
+
+def test_min_request_uses_cheapest_tasks():
+    job = mk_job(min_member=2)
+    job.add_task(mk_task("big", cpu="4"))
+    job.add_task(mk_task("s1", cpu="1"))
+    job.add_task(mk_task("s2", cpu="1"))
+    assert job.min_request().milli_cpu == 2000

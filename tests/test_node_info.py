@@ -1,0 +1,100 @@
+"""NodeInfo accounting invariants (reference: node_info_test.go)."""
+
+import pytest
+
+from volcano_tpu.api.job_info import TaskInfo
+from volcano_tpu.api.node_info import Node, NodeInfo
+from volcano_tpu.api.pod import make_pod
+from volcano_tpu.api.resource import CPU, TPU
+from volcano_tpu.api.types import (
+    TPU_COORDS_LABEL,
+    TPU_SLICE_LABEL,
+    TPU_WORKER_ID_LABEL,
+    TaskStatus,
+)
+
+
+def mk_node(name="n0", cpu="8", tpu=4, labels=None):
+    return NodeInfo(Node(name=name, labels=dict(labels or {}),
+                         allocatable={"cpu": cpu, TPU: tpu}))
+
+
+def mk_task(name, cpu="1", tpu=0, status=TaskStatus.PENDING):
+    req = {"cpu": cpu}
+    if tpu:
+        req[TPU] = tpu
+    return TaskInfo(make_pod(name, requests=req, phase=status))
+
+
+def test_add_remove_task_balances():
+    ni = mk_node()
+    t = mk_task("p0", cpu="2", tpu=4, status=TaskStatus.RUNNING)
+    ni.add_task(t)
+    assert ni.idle.get(CPU) == 6000 and ni.idle.tpu == 0
+    assert ni.used.tpu == 4
+    ni.remove_task(t)
+    assert ni.idle.equal(ni.allocatable) and ni.used.is_empty()
+
+
+def test_overcommit_rejected_for_scheduler_placements():
+    ni = mk_node(cpu="1")
+    with pytest.raises(ValueError):
+        ni.add_task(mk_task("p0", cpu="2", status=TaskStatus.ALLOCATED))
+
+
+def test_replayed_running_pod_clamps_instead_of_crashing():
+    # Cache rebuild: node allocatable shrank under an existing pod; the
+    # node must absorb it (idle clamped at 0), not abort construction.
+    ni = mk_node(cpu="1")
+    ni.add_task(mk_task("p0", cpu="2", status=TaskStatus.RUNNING))
+    assert ni.idle.get(CPU) == 0
+    assert ni.used.get(CPU) == 2000
+
+
+def test_node_holds_clone_so_job_mutation_cannot_desync():
+    ni = mk_node(cpu="8")
+    t = mk_task("p", cpu="2", status=TaskStatus.PIPELINED)
+    ni.add_task(t)
+    # Job-side mutation of the caller's object must not affect node copy.
+    t.status = TaskStatus.ALLOCATED
+    ni.remove_task(t)
+    assert ni.pipelined.is_empty()
+    assert ni.idle.get(CPU) == 8000 and ni.used.is_empty()
+
+
+def test_future_idle_with_releasing_and_pipelined():
+    ni = mk_node(cpu="8")
+    running = mk_task("r", cpu="4", status=TaskStatus.RUNNING)
+    ni.add_task(running)
+    ni.update_task_status(running, TaskStatus.RELEASING)
+    assert ni.idle.get(CPU) == 4000
+    assert ni.future_idle().get(CPU) == 8000
+
+    ni.add_task(mk_task("pipe", cpu="3", status=TaskStatus.PIPELINED))
+    assert ni.future_idle().get(CPU) == 5000
+    # pipelined doesn't consume idle now
+    assert ni.idle.get(CPU) == 4000
+
+
+def test_status_transition_pipelined_to_bound():
+    ni = mk_node(cpu="8")
+    t = mk_task("p", cpu="2", status=TaskStatus.PIPELINED)
+    ni.add_task(t)
+    ni.update_task_status(t, TaskStatus.BOUND)
+    assert ni.idle.get(CPU) == 6000 and ni.pipelined.is_empty()
+
+
+def test_tpu_identity_from_labels():
+    ni = mk_node(labels={TPU_SLICE_LABEL: "slice-a",
+                         TPU_WORKER_ID_LABEL: "7",
+                         TPU_COORDS_LABEL: "1,2,0"})
+    assert ni.tpu_slice == "slice-a"
+    assert ni.tpu_worker_id == 7
+    assert ni.ici_coords == (1, 2, 0)
+
+
+def test_clone_independent_accounting():
+    ni = mk_node()
+    c = ni.clone()
+    c.add_task(mk_task("p", cpu="1", status=TaskStatus.RUNNING))
+    assert ni.idle.get(CPU) == 8000 and c.idle.get(CPU) == 7000

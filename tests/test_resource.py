@@ -1,0 +1,89 @@
+"""Resource vector semantics (reference: resource_info_test.go)."""
+
+import pytest
+
+from volcano_tpu.api.resource import CPU, MEMORY, TPU, Resource, parse_quantity
+
+
+def test_from_resource_list_parses_quantities():
+    r = Resource.from_resource_list({"cpu": "250m", "memory": "1Gi",
+                                     TPU: 4})
+    assert r.milli_cpu == 250
+    assert r.memory == 2**30
+    assert r.tpu == 4
+
+
+def test_cpu_cores_to_millicores():
+    assert Resource.from_resource_list({"cpu": 2}).milli_cpu == 2000
+    assert Resource.from_resource_list({"cpu": "1.5"}).milli_cpu == 1500
+
+
+def test_parse_quantity_units():
+    assert parse_quantity("4Gi") == 4 * 2**30
+    assert parse_quantity("1k") == 1000
+    assert parse_quantity(7) == 7.0
+
+
+def test_add_sub():
+    a = Resource({CPU: 1000, MEMORY: 100, TPU: 8})
+    b = Resource({CPU: 400, TPU: 4})
+    a.add(b)
+    assert a.get(CPU) == 1400 and a.tpu == 12
+    a.sub(b)
+    assert a.get(CPU) == 1000 and a.tpu == 8
+
+
+def test_sub_underflow_raises():
+    a = Resource({CPU: 100})
+    with pytest.raises(ValueError):
+        a.sub(Resource({CPU: 200}))
+    # unchecked clamps
+    a.sub_unchecked(Resource({CPU: 200}))
+    assert a.get(CPU) == 0
+
+
+def test_less_equal_default_zero():
+    small = Resource({CPU: 100, TPU: 1})
+    big = Resource({CPU: 200, TPU: 4})
+    assert small.less_equal(big)
+    assert not big.less_equal(small)
+    # missing dimension in other => treated as zero
+    assert not Resource({TPU: 1}).less_equal(Resource({CPU: 100}))
+
+
+def test_less_equal_default_infinity_for_capability():
+    req = Resource({CPU: 100, TPU: 8})
+    cap = Resource({CPU: 200})  # TPU dim unset => unlimited
+    assert req.less_equal(cap, zero="defaultInfinity")
+    assert not req.less_equal(cap, zero="defaultZero")
+
+
+def test_fit_delta_and_diff():
+    idle = Resource({CPU: 100, TPU: 2})
+    req = Resource({CPU: 300, TPU: 2})
+    missing = idle.fit_delta(req)
+    assert missing.get(CPU) == 200 and missing.tpu == 0
+
+    inc, dec = Resource({CPU: 100}).diff(Resource({CPU: 40, TPU: 4}))
+    assert inc.get(CPU) == 60 and dec.tpu == 4
+
+
+def test_set_max_and_min_dim():
+    a = Resource({CPU: 100, TPU: 8})
+    a.set_max(Resource({CPU: 300, MEMORY: 10}))
+    assert a.get(CPU) == 300 and a.get(MEMORY) == 10 and a.tpu == 8
+    a.min_dim(Resource({CPU: 200, TPU: 8}))
+    assert a.get(CPU) == 200 and a.get(MEMORY) == 0
+
+
+def test_empty_and_clone_independent():
+    assert Resource().is_empty()
+    a = Resource({TPU: 4})
+    b = a.clone()
+    b.add(Resource({TPU: 4}))
+    assert a.tpu == 4 and b.tpu == 8
+
+
+def test_equality():
+    assert Resource({CPU: 100}) == Resource({CPU: 100.05})
+    assert Resource({CPU: 100}) != Resource({CPU: 101})

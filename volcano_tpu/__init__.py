@@ -1,0 +1,30 @@
+"""volcano-tpu: a TPU-native batch scheduling framework.
+
+A ground-up rebuild of the capabilities of volcano-sh/volcano (gang
+scheduling, queue fair-share, topology-aware placement, job lifecycle
+controllers, admission, CLI, node agent) designed TPU-first:
+
+- TPU slices are atomic ICI-mesh resources (``google.com/tpu`` chips),
+  not shareable GPU fractions.
+- Network topology is the ICI x/y/z mesh + DCN tiers, scored by ICI hop
+  distance rather than NCCL ring/tree distance.
+- Job plugins bootstrap JAX/XLA workloads (``TPU_WORKER_ID``,
+  ``TPU_WORKER_HOSTNAMES``, ``coordinator_address``) instead of
+  ``MASTER_ADDR``/``NCCL_*``.
+- The validation workload layer (``volcano_tpu.workloads``) is pure
+  JAX/pjit/pallas: sharded training steps over a ``jax.sharding.Mesh``.
+
+Layer map (mirrors SURVEY.md §1 for the reference):
+  api/          object model: Resource, JobInfo, NodeInfo, QueueInfo, ...
+  cache/        cluster cache + snapshot + bind/evict queues
+  framework/    Session, Statement, plugin registry
+  actions/      enqueue, allocate, backfill, preempt, reclaim, gang*
+  plugins/      gang, drf, proportion, capacity, predicates, topology, ...
+  controllers/  job, podgroup, queue, jobflow, cronjob, hypernode, ...
+  webhooks/     admission validate/mutate
+  workloads/    JAX training stack scheduled by the framework
+  cli/          vtpctl
+  agent/        node agent (chip inventory, oversubscription)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,32 @@
+"""Scheduler object model (reference: pkg/scheduler/api)."""
+
+from volcano_tpu.api.resource import Resource, CPU, MEMORY, PODS, TPU
+from volcano_tpu.api.types import (
+    TaskStatus,
+    PodGroupPhase,
+    QueueState,
+    JobPhase,
+    ALIVE_TASK_STATUSES,
+    ALLOCATED_TASK_STATUSES,
+    occupied,
+)
+from volcano_tpu.api.pod import Pod
+from volcano_tpu.api.job_info import TaskInfo, JobInfo, SubJobInfo
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.queue_info import QueueInfo
+from volcano_tpu.api.podgroup import PodGroup, NetworkTopologySpec, SubGroupPolicy
+from volcano_tpu.api.queue import Queue
+from volcano_tpu.api.vcjob import VCJob, TaskSpec, LifecyclePolicy
+from volcano_tpu.api.hypernode import HyperNode, HyperNodeInfo, HyperNodesInfo
+from volcano_tpu.api.fit_error import FitError, FitErrors, Status, StatusCode
+
+__all__ = [
+    "Resource", "CPU", "MEMORY", "PODS", "TPU",
+    "TaskStatus", "PodGroupPhase", "QueueState", "JobPhase",
+    "ALIVE_TASK_STATUSES", "ALLOCATED_TASK_STATUSES", "occupied",
+    "Pod", "TaskInfo", "JobInfo", "SubJobInfo", "NodeInfo", "QueueInfo",
+    "PodGroup", "NetworkTopologySpec", "SubGroupPolicy", "Queue",
+    "VCJob", "TaskSpec", "LifecyclePolicy",
+    "HyperNode", "HyperNodeInfo", "HyperNodesInfo",
+    "FitError", "FitErrors", "Status", "StatusCode",
+]

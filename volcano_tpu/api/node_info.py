@@ -1,0 +1,192 @@
+"""NodeInfo — per-node scheduling state.
+
+Reference parity: pkg/scheduler/api/node_info.go:52-101 (Idle / Used /
+Releasing / Pipelined accounting, FutureIdle, oversubscription, task
+add/remove/status transitions, taints).  TPU-first addition: each node
+carries its TPU slice membership + ICI coordinates so the device layer
+and topology plugin can do mesh math without re-parsing labels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, TYPE_CHECKING
+
+from volcano_tpu.api.pod import Taint
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import (
+    TPU_COORDS_LABEL,
+    TPU_SLICE_LABEL,
+    TPU_TOPOLOGY_LABEL,
+    TPU_WORKER_ID_LABEL,
+    TaskStatus,
+)
+
+if TYPE_CHECKING:
+    from volcano_tpu.api.job_info import TaskInfo
+
+
+@dataclass
+class Node:
+    """Cluster node object (corev1.Node analogue)."""
+
+    name: str
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    allocatable: Dict[str, object] = field(default_factory=dict)
+    capacity: Dict[str, object] = field(default_factory=dict)
+    taints: List[Taint] = field(default_factory=list)
+    ready: bool = True
+    unschedulable: bool = False
+
+
+class NodeInfo:
+    """Scheduler-side view of one node with resource accounting.
+
+    Invariant maintained across task transitions:
+      allocatable == idle + used            (used includes releasing)
+      futureIdle() == idle + releasing - pipelined
+    """
+
+    def __init__(self, node: Optional[Node] = None, name: str = ""):
+        self.node: Optional[Node] = node
+        self.name: str = node.name if node else name
+        self.allocatable = (Resource.from_resource_list(node.allocatable)
+                            if node else Resource())
+        self.capability = (Resource.from_resource_list(node.capacity or node.allocatable)
+                           if node else Resource())
+        self.idle = self.allocatable.clone()
+        self.used = Resource()
+        self.releasing = Resource()
+        self.pipelined = Resource()
+        self.oversubscription = Resource()
+        self.tasks: Dict[str, "TaskInfo"] = {}
+        # Conflict-aware binder optimistic-concurrency token
+        # (reference api/node_info.go:100 BindGeneration).
+        self.bind_generation: int = 0
+        self.others: Dict[str, object] = {}   # device registry payloads
+
+    # -- TPU identity --------------------------------------------------
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        return self.node.labels if self.node else {}
+
+    @property
+    def tpu_slice(self) -> str:
+        return self.labels.get(TPU_SLICE_LABEL, "")
+
+    @property
+    def tpu_topology(self) -> str:
+        return self.labels.get(TPU_TOPOLOGY_LABEL, "")
+
+    @property
+    def tpu_worker_id(self) -> int:
+        try:
+            return int(self.labels.get(TPU_WORKER_ID_LABEL, "-1"))
+        except ValueError:
+            return -1
+
+    @property
+    def ici_coords(self) -> Optional[tuple]:
+        raw = self.labels.get(TPU_COORDS_LABEL)
+        if not raw:
+            return None
+        try:
+            return tuple(int(x) for x in raw.split(","))
+        except ValueError:
+            return None
+
+    # -- state --------------------------------------------------------
+
+    @property
+    def ready(self) -> bool:
+        return bool(self.node and self.node.ready and not self.node.unschedulable)
+
+    @property
+    def taints(self) -> List[Taint]:
+        return self.node.taints if self.node else []
+
+    def future_idle(self) -> Resource:
+        """Resources available after in-flight releases complete, minus
+        resources already promised to pipelined tasks."""
+        return (self.idle.clone().add(self.releasing)
+                .sub_unchecked(self.pipelined))
+
+    # -- task accounting ----------------------------------------------
+
+    def add_task(self, task: "TaskInfo"):
+        """Account *task* onto this node.
+
+        The node stores a CLONE of the task so later job-side status
+        mutations cannot desync node accounting (reference node_info.go
+        AddTask "Node will hold a copy of task").  Scheduler-initiated
+        placements (ALLOCATED/BINDING) must fit exactly and raise on
+        overflow; replayed pods (RUNNING/BOUND observed from the
+        cluster) clamp instead so cache rebuild survives a node whose
+        allocatable shrank under existing pods.
+        """
+        if task.uid in self.tasks:
+            raise KeyError(f"task {task.key} already on node {self.name}")
+        req = task.resreq
+        if task.status is TaskStatus.RELEASING:
+            self.releasing.add(req)
+            self.idle.sub_unchecked(req)
+            self.used.add(req)
+        elif task.status is TaskStatus.PIPELINED:
+            self.pipelined.add(req)
+        elif task.occupies_resources():
+            if task.status in (TaskStatus.ALLOCATED, TaskStatus.BINDING) \
+                    and not req.less_equal(self.idle):
+                raise ValueError(
+                    f"node {self.name} has insufficient idle "
+                    f"{self.idle} for task {task.key} requiring {req}")
+            self.idle.sub_unchecked(req)
+            self.used.add(req)
+        task.node_name = self.name
+        self.tasks[task.uid] = task.clone()
+
+    def remove_task(self, task: "TaskInfo"):
+        existing = self.tasks.pop(task.uid, None)
+        if existing is None:
+            return
+        req = existing.resreq
+        if existing.status is TaskStatus.RELEASING:
+            self.releasing.sub_unchecked(req)
+            self.idle.add(req)
+            self.used.sub_unchecked(req)
+        elif existing.status is TaskStatus.PIPELINED:
+            self.pipelined.sub_unchecked(req)
+        elif existing.occupies_resources():
+            self.idle.add(req)
+            self.used.sub_unchecked(req)
+
+    def update_task_status(self, task: "TaskInfo", status: TaskStatus):
+        """Remove+re-add under the new status to keep accounting exact.
+
+        Dispatches the removal on the node's OWN copy of the task (whose
+        status may lag the caller's), then re-adds under *status*.
+        """
+        self.remove_task(task)
+        task.status = status
+        self.add_task(task)
+
+    def clone(self) -> "NodeInfo":
+        c = NodeInfo.__new__(NodeInfo)
+        c.node = self.node
+        c.name = self.name
+        c.allocatable = self.allocatable.clone()
+        c.capability = self.capability.clone()
+        c.idle = self.idle.clone()
+        c.used = self.used.clone()
+        c.releasing = self.releasing.clone()
+        c.pipelined = self.pipelined.clone()
+        c.oversubscription = self.oversubscription.clone()
+        c.tasks = dict(self.tasks)
+        c.bind_generation = self.bind_generation
+        c.others = dict(self.others)
+        return c
+
+    def __repr__(self):
+        return (f"NodeInfo({self.name}, idle={self.idle}, used={self.used}, "
+                f"tasks={len(self.tasks)})")

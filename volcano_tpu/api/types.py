@@ -1,0 +1,150 @@
+"""Core enums and constants.
+
+Reference parity: pkg/scheduler/api/types.go (TaskStatus & helpers),
+scheduling/v1beta1 PodGroupPhase, batch/v1alpha1 JobPhase, bus/v1alpha1
+actions/events.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class TaskStatus(enum.Enum):
+    """Lifecycle status of a task (pod) as the scheduler sees it."""
+
+    PENDING = "Pending"        # waiting to be scheduled
+    ALLOCATED = "Allocated"    # resources assigned in-session, not bound
+    PIPELINED = "Pipelined"    # assigned onto releasing resources
+    BINDING = "Binding"        # bind RPC in flight
+    BOUND = "Bound"            # bound to a node, not yet running
+    RUNNING = "Running"
+    RELEASING = "Releasing"    # being evicted / deleted
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+# Statuses that hold (or will hold) node resources, mirroring
+# types.go AllocatedStatus().
+ALLOCATED_TASK_STATUSES = frozenset({
+    TaskStatus.ALLOCATED, TaskStatus.BINDING, TaskStatus.BOUND,
+    TaskStatus.RUNNING,
+})
+
+# Statuses counted as "ready" for gang readiness (reference
+# job_info.go ReadyTaskNum): holding resources or already succeeded.
+READY_TASK_STATUSES = frozenset({
+    TaskStatus.BOUND, TaskStatus.BINDING, TaskStatus.RUNNING,
+    TaskStatus.ALLOCATED, TaskStatus.SUCCEEDED,
+})
+
+# Statuses counted as "alive" for gang accounting.
+ALIVE_TASK_STATUSES = frozenset({
+    TaskStatus.PENDING, TaskStatus.ALLOCATED, TaskStatus.PIPELINED,
+    TaskStatus.BINDING, TaskStatus.BOUND, TaskStatus.RUNNING,
+})
+
+
+def occupied(status: TaskStatus) -> bool:
+    """Does a task in this status occupy cluster resources now or soon?"""
+    return status in ALLOCATED_TASK_STATUSES or status is TaskStatus.RELEASING
+
+
+class PodGroupPhase(enum.Enum):
+    """scheduling/v1beta1 PodGroup phase machine."""
+
+    PENDING = "Pending"      # created, not admitted by a queue
+    INQUEUE = "Inqueue"      # admitted — allocate may consider it
+    RUNNING = "Running"      # minMember tasks running
+    UNKNOWN = "Unknown"      # partially running, gang broken
+    COMPLETED = "Completed"
+
+
+class PodGroupConditionType(enum.Enum):
+    SCHEDULED = "Scheduled"
+    UNSCHEDULABLE = "Unschedulable"
+
+
+class QueueState(enum.Enum):
+    OPEN = "Open"
+    CLOSED = "Closed"
+    CLOSING = "Closing"
+    UNKNOWN = "Unknown"
+
+
+class JobPhase(enum.Enum):
+    """batch/v1alpha1 vcjob phase machine (8 states, state/factory.go)."""
+
+    PENDING = "Pending"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    COMPLETING = "Completing"
+    TERMINATING = "Terminating"
+    ABORTING = "Aborting"
+    ABORTED = "Aborted"
+    COMPLETED = "Completed"
+    FAILED = "Failed"
+
+
+class JobEvent(enum.Enum):
+    """Pod/job events that lifecycle policies match on (bus/v1alpha1)."""
+
+    ANY = "*"
+    POD_FAILED = "PodFailed"
+    POD_EVICTED = "PodEvicted"
+    POD_PENDING = "PodPending"
+    POD_RUNNING = "PodRunning"
+    TASK_COMPLETED = "TaskCompleted"
+    TASK_FAILED = "TaskFailed"
+    JOB_UNKNOWN = "Unknown"
+    OUT_OF_SYNC = "OutOfSync"
+    COMMAND_ISSUED = "CommandIssued"
+    JOB_UPDATED = "JobUpdated"
+
+
+class JobAction(enum.Enum):
+    """Actions a lifecycle policy may trigger (bus/v1alpha1/actions.go)."""
+
+    ABORT_JOB = "AbortJob"
+    RESTART_JOB = "RestartJob"
+    RESTART_TASK = "RestartTask"
+    RESTART_POD = "RestartPod"
+    TERMINATE_JOB = "TerminateJob"
+    COMPLETE_JOB = "CompleteJob"
+    RESUME_JOB = "ResumeJob"
+    SYNC_JOB = "SyncJob"
+    ENQUEUE_JOB = "EnqueueJob"
+    SYNC_QUEUE = "SyncQueue"
+    OPEN_QUEUE = "OpenQueue"
+    CLOSE_QUEUE = "CloseQueue"
+
+
+class NetworkTopologyMode(enum.Enum):
+    """Job networkTopology.mode (batch/v1alpha1 job.go:54-126)."""
+
+    HARD = "hard"   # must fit within highestTierAllowed
+    SOFT = "soft"   # prefer low tiers, allow spill
+
+
+# Well-known annotations / labels (TPU-native namespace).
+GROUP_NAME_ANNOTATION = "scheduling.volcano-tpu.io/group-name"
+QUEUE_NAME_ANNOTATION = "scheduling.volcano-tpu.io/queue-name"
+PREEMPTABLE_ANNOTATION = "volcano-tpu.io/preemptable"
+REVOCABLE_ZONE_ANNOTATION = "volcano-tpu.io/revocable-zone"
+JOB_NAME_LABEL = "volcano-tpu.io/job-name"
+JOB_NAMESPACE_LABEL = "volcano-tpu.io/job-namespace"
+TASK_SPEC_LABEL = "volcano-tpu.io/task-spec"
+TASK_INDEX_LABEL = "volcano-tpu.io/task-index"
+SUBGROUP_LABEL = "volcano-tpu.io/subgroup-name"
+NODEGROUP_LABEL = "volcano-tpu.io/nodegroup-name"
+
+# GKE-style TPU node labels consumed by the tpu device layer and the
+# hypernode discoverer (SURVEY.md §5 "TPU-native equivalent").
+TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"   # e.g. tpu-v5-lite-podslice
+TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"          # e.g. 16x16
+TPU_SLICE_LABEL = "cloud.google.com/gke-tpu-slice"                # slice name/id
+TPU_WORKER_ID_LABEL = "cloud.google.com/gke-tpu-worker-id"        # host index in slice
+TPU_COORDS_LABEL = "volcano-tpu.io/ici-coords"                    # "x,y,z" of host in mesh
+
+DEFAULT_QUEUE = "default"
