@@ -1,0 +1,144 @@
+"""End-to-end slice: gang scheduling through enqueue+allocate+backfill.
+
+Mirrors the reference's allocate_test.go / uthelper-driven action tests.
+"""
+
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.pod import Taint, make_pod
+from volcano_tpu.api.queue import Queue
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.types import PodGroupPhase
+from volcano_tpu.uthelper import TestContext, gang_job
+
+
+def nodes(n, cpu="8", tpu=0, prefix="n"):
+    alloc = {"cpu": cpu, "pods": 110}
+    if tpu:
+        alloc[TPU] = tpu
+    return [Node(name=f"{prefix}{i}", allocatable=alloc) for i in range(n)]
+
+
+def test_gang_job_schedules_when_it_fits():
+    """3-task vcjob with minAvailable=3 gang-schedules onto fake nodes
+    (BASELINE.json config #1)."""
+    pg, pods = gang_job("job1", replicas=3, requests={"cpu": 1})
+    ctx = TestContext(nodes=nodes(3), podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(3)
+    ctx.expect_podgroup_phase("default/job1", PodGroupPhase.RUNNING)
+
+
+def test_gang_all_or_nothing():
+    """minAvailable=3 but cluster only fits 2 -> nothing binds."""
+    pg, pods = gang_job("job1", replicas=3, requests={"cpu": 6})
+    ctx = TestContext(nodes=nodes(2), podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(0)
+    pg2 = ctx.cluster.podgroups["default/job1"]
+    assert any(c.type == "Unschedulable" for c in pg2.conditions) or \
+        pg2.phase is PodGroupPhase.PENDING
+
+
+def test_partial_gang_min_available_subset():
+    """replicas=4, minAvailable=2, room for 2 -> 2 bind."""
+    pg, pods = gang_job("job1", replicas=4, min_available=2,
+                        requests={"cpu": 6})
+    ctx = TestContext(nodes=nodes(2), podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(2)
+
+
+def test_tpu_resource_dimension_gates_fit():
+    """Tasks requesting google.com/tpu only fit TPU nodes."""
+    pg, pods = gang_job("tpujob", replicas=2,
+                        requests={"cpu": 1, TPU: 4})
+    cluster_nodes = nodes(2, tpu=4, prefix="tpu") + nodes(2, prefix="cpu")
+    ctx = TestContext(nodes=cluster_nodes, podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(2)
+    for _, node_name in ctx.cluster.binds:
+        assert node_name.startswith("tpu")
+
+
+def test_enqueue_gates_oversized_jobs():
+    """A job larger than cluster capacity never leaves Pending."""
+    pg, pods = gang_job("big", replicas=4, requests={"cpu": 100})
+    ctx = TestContext(nodes=nodes(2), podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(0)
+    ctx.expect_podgroup_phase("default/big", PodGroupPhase.PENDING)
+
+
+def test_taints_respected():
+    tainted = Node(name="bad", allocatable={"cpu": 8},
+                   taints=[Taint(key="dedicated", value="x",
+                                 effect="NoSchedule")])
+    ok = Node(name="good", allocatable={"cpu": 8})
+    pg, pods = gang_job("j", replicas=1, requests={"cpu": 1})
+    ctx = TestContext(nodes=[tainted, ok], podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind("default/j-0", "good")
+
+
+def test_node_selector_respected():
+    n0 = Node(name="n0", allocatable={"cpu": 8}, labels={"zone": "a"})
+    n1 = Node(name="n1", allocatable={"cpu": 8}, labels={"zone": "b"})
+    pg, pods = gang_job("j", replicas=1, requests={"cpu": 1})
+    pods[0].node_selector = {"zone": "b"}
+    ctx = TestContext(nodes=[n0, n1], podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind("default/j-0", "n1")
+
+
+def test_backfill_binds_best_effort_pods():
+    pg, pods = gang_job("be", replicas=2, requests={})
+    ctx = TestContext(nodes=nodes(1), podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(2)
+
+
+def test_priority_order_between_jobs():
+    """Higher-priority job wins the scarce node."""
+    from volcano_tpu.cache.cluster import PriorityClass
+    pg_hi, pods_hi = gang_job("hi", replicas=1, requests={"cpu": 6},
+                              priority_class="high")
+    pg_lo, pods_lo = gang_job("lo", replicas=1, requests={"cpu": 6})
+    ctx = TestContext(
+        nodes=nodes(1), podgroups=[pg_lo, pg_hi], pods=pods_lo + pods_hi,
+        priority_classes=[PriorityClass(name="high", value=1000)])
+    ctx.run()
+    ctx.expect_bind("default/hi-0")
+    assert "default/lo-0" not in ctx.bind_map
+
+
+def test_two_queue_weighted_share():
+    """2-queue proportional share: heavier queue fits its whole job,
+    both queues make progress (BASELINE.json config #4 precursor)."""
+    q_a = Queue(name="qa", weight=3)
+    q_b = Queue(name="qb", weight=1)
+    pg_a, pods_a = gang_job("ja", queue="qa", replicas=3,
+                            min_available=1, requests={"cpu": 2})
+    pg_b, pods_b = gang_job("jb", queue="qb", replicas=3,
+                            min_available=1, requests={"cpu": 2})
+    ctx = TestContext(nodes=nodes(1, cpu="8"), queues=[q_a, q_b],
+                      podgroups=[pg_a, pg_b], pods=pods_a + pods_b)
+    ctx.run()
+    binds = ctx.bind_map
+    a_bound = sum(1 for k in binds if k.startswith("default/ja"))
+    b_bound = sum(1 for k in binds if k.startswith("default/jb"))
+    assert a_bound == 3          # deserved 6 cpu -> all 3 tasks
+    assert b_bound == 1          # deserved 2 cpu -> 1 task
+
+
+def test_multiple_cycles_converge():
+    """Second cycle sees Bound pods as occupying and schedules the rest."""
+    pg1, pods1 = gang_job("j1", replicas=2, requests={"cpu": 4})
+    pg2, pods2 = gang_job("j2", replicas=2, requests={"cpu": 4})
+    ctx = TestContext(nodes=nodes(2), podgroups=[pg1, pg2],
+                      pods=pods1 + pods2)
+    ctx.run()
+    first = len(ctx.cluster.binds)
+    ctx.cluster.tick()  # Bound -> Running
+    ctx.run()
+    assert len(ctx.cluster.binds) == 4
+    assert first == 4 or first == 2

@@ -1,0 +1,109 @@
+"""Session/Statement transaction semantics (reference: statement_test.go)."""
+
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.framework.framework import open_session
+from volcano_tpu.uthelper import TestContext, gang_job
+
+
+def make_session(replicas=2, cpu_per_node="8", node_count=2, requests=None):
+    pg, pods = gang_job("j", replicas=replicas,
+                        requests=requests or {"cpu": 2})
+    ctx = TestContext(
+        nodes=[Node(name=f"n{i}", allocatable={"cpu": cpu_per_node})
+               for i in range(node_count)],
+        podgroups=[pg], pods=pods)
+    ssn = open_session(ctx.cache, ctx.conf)
+    return ctx, ssn
+
+
+def test_statement_discard_restores_everything():
+    ctx, ssn = make_session()
+    job = next(iter(ssn.jobs.values()))
+    stmt = ssn.statement()
+    tasks = job.tasks_in_status(TaskStatus.PENDING)
+    for t, n in zip(tasks, ssn.nodes.values()):
+        stmt.allocate(t, n)
+    assert job.ready_task_num() == 2
+    used_before_discard = {n.name: n.used.get("cpu")
+                           for n in ssn.nodes.values()}
+    assert any(v > 0 for v in used_before_discard.values())
+
+    stmt.discard()
+    assert job.ready_task_num() == 0
+    assert all(n.used.is_empty() for n in ssn.nodes.values())
+    assert all(t.status is TaskStatus.PENDING for t in job.tasks.values())
+    assert not ctx.cluster.binds
+
+
+def test_statement_commit_dispatches_binds():
+    ctx, ssn = make_session()
+    job = next(iter(ssn.jobs.values()))
+    stmt = ssn.statement()
+    for t, n in zip(job.tasks_in_status(TaskStatus.PENDING),
+                    ssn.nodes.values()):
+        stmt.allocate(t, n)
+    stmt.commit()
+    assert ssn.cache.flush_binds() == 2
+    assert len(ctx.cluster.binds) == 2
+    # bind generation bumped for conflict detection
+    assert all(n.bind_generation == 1 for n in ssn.nodes.values())
+
+
+def test_statement_save_discard_recover_roundtrip():
+    """The topology dry-run pattern: save ops, discard, recover."""
+    ctx, ssn = make_session()
+    job = next(iter(ssn.jobs.values()))
+    stmt = ssn.statement()
+    tasks = job.tasks_in_status(TaskStatus.PENDING)
+    for t, n in zip(tasks, ssn.nodes.values()):
+        stmt.allocate(t, n)
+    saved = stmt.save_operations()
+    stmt.discard()
+    assert job.ready_task_num() == 0
+
+    stmt2 = ssn.statement()
+    stmt2.recover_operations(saved)
+    assert job.ready_task_num() == 2
+    placements = {t.node_name for t in job.tasks.values()}
+    assert placements == {"n0", "n1"}
+
+
+def test_evict_and_unevict():
+    pg, pods = gang_job("j", replicas=2, requests={"cpu": 2},
+                        running_on=["n0", "n1"])
+    ctx = TestContext(
+        nodes=[Node(name=f"n{i}", allocatable={"cpu": 8}) for i in range(2)],
+        podgroups=[pg], pods=pods)
+    ssn = open_session(ctx.cache, ctx.conf)
+    job = next(iter(ssn.jobs.values()))
+    victim = next(iter(job.tasks.values()))
+    node = ssn.nodes[victim.node_name]
+    idle_before = node.idle.get("cpu")
+
+    stmt = ssn.statement()
+    stmt.evict(victim, "test")
+    assert victim.status is TaskStatus.RELEASING
+    # releasing resources show in future_idle, not idle
+    assert node.idle.get("cpu") == idle_before
+    assert node.future_idle().get("cpu") == idle_before + 2000
+
+    stmt.discard()
+    assert victim.status is TaskStatus.RUNNING
+    assert node.future_idle().get("cpu") == idle_before
+    assert not ctx.cluster.evictions
+
+
+def test_event_handlers_fire_on_allocate_and_deallocate():
+    ctx, ssn = make_session()
+    seen = []
+    from volcano_tpu.framework.session import EventHandler
+    ssn.add_event_handler(EventHandler(
+        allocate_fn=lambda e: seen.append(("alloc", e.task.name)),
+        deallocate_fn=lambda e: seen.append(("dealloc", e.task.name))))
+    job = next(iter(ssn.jobs.values()))
+    t = job.tasks_in_status(TaskStatus.PENDING)[0]
+    stmt = ssn.statement()
+    stmt.allocate(t, ssn.nodes["n0"])
+    stmt.discard()
+    assert ("alloc", t.name) in seen and ("dealloc", t.name) in seen

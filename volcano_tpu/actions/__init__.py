@@ -1,0 +1,8 @@
+"""Scheduling actions (reference: pkg/scheduler/actions/factory.go).
+
+Importing this package registers every action.
+"""
+
+import volcano_tpu.actions.enqueue   # noqa: F401
+import volcano_tpu.actions.allocate  # noqa: F401
+import volcano_tpu.actions.backfill  # noqa: F401

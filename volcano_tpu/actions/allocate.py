@@ -1,0 +1,158 @@
+"""Allocate action — the scheduler's hot loop.
+
+Reference parity: actions/allocate/allocate.go:122-981.  Nested
+priority queues (queue -> job -> task); per-task predicate + score;
+statement-buffered placement committed only when the gang is ready
+(or left pipelined when it can become ready on releasing resources);
+hard-topology jobs dry-run across hypernode candidate domains and the
+best-scoring domain is recovered (allocate.go:370-463).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from volcano_tpu.api.fit_error import FitError, FitErrors
+from volcano_tpu.api.job_info import JobInfo, TaskInfo
+from volcano_tpu.api.types import PodGroupPhase, TaskStatus
+from volcano_tpu.framework.plugins import Action, register_action
+from volcano_tpu.util import PriorityQueue
+
+from volcano_tpu.actions.util import (
+    predicate_nodes,
+    prioritize_nodes,
+    split_by_fit,
+)
+
+log = logging.getLogger(__name__)
+
+
+class AllocateAction(Action):
+    name = "allocate"
+
+    def execute(self, ssn) -> None:
+        enqueue_configured = "enqueue" in ssn.conf.actions
+
+        jobs_per_queue: Dict[str, PriorityQueue] = {}
+        for job in ssn.jobs.values():
+            if not self._job_eligible(ssn, job, enqueue_configured):
+                continue
+            queue = ssn.queues.get(job.queue)
+            if queue is None or not queue.is_open():
+                continue
+            jobs_per_queue.setdefault(
+                queue.name, PriorityQueue(ssn.job_order_fn)).push(job)
+
+        queues = PriorityQueue(ssn.queue_order_fn,
+                               (ssn.queues[qn] for qn in jobs_per_queue))
+        while not queues.empty():
+            queue = queues.pop()
+            if ssn.overused(queue):
+                log.debug("queue %s overused, skipping", queue.name)
+                continue
+            jobs = jobs_per_queue[queue.name]
+            if jobs.empty():
+                continue
+            job = jobs.pop()
+            self._allocate_job(ssn, queue, job)
+            queues.push(queue)
+
+    @staticmethod
+    def _job_eligible(ssn, job: JobInfo, enqueue_configured: bool) -> bool:
+        if not job.tasks_in_status(TaskStatus.PENDING):
+            return False
+        result = ssn.job_valid(job)
+        if result is not None:
+            ssn.set_job_pending_reason(job, result[0], result[1])
+            return False
+        if job.podgroup is not None and enqueue_configured and \
+                job.podgroup.phase is PodGroupPhase.PENDING:
+            # not admitted by enqueue yet (allocate.go:153-164)
+            return False
+        return True
+
+    def _allocate_job(self, ssn, queue, job: JobInfo) -> None:
+        if job.is_hard_topology() and ssn.hypernodes is not None and \
+                len(ssn.hypernodes.members) > 1:
+            from volcano_tpu.actions.topology_alloc import allocate_for_topology_job
+            allocate_for_topology_job(ssn, queue, job)
+            return
+
+        stmt = ssn.statement()
+        self._allocate_tasks(ssn, queue, job, stmt,
+                             list(ssn.nodes.values()))
+        self._finish(ssn, job, stmt)
+
+    def _finish(self, ssn, job: JobInfo, stmt) -> None:
+        if ssn.job_ready(job):
+            stmt.commit()
+        elif ssn.job_pipelined(job):
+            # keep reservations in-session; pods wait on releasing nodes
+            pass
+        else:
+            stmt.discard()
+            if job.fit_errors:
+                errs = FitErrors()
+                errs.set_error(job.fit_error())
+                job.job_fit_errors = errs
+            ssn.set_job_pending_reason(
+                job, "Unschedulable",
+                job.fit_error() or
+                f"job {job.key} not ready: {job.ready_task_num()}/"
+                f"{job.min_available} tasks allocatable")
+
+    @staticmethod
+    def _allocate_tasks(ssn, queue, job: JobInfo, stmt,
+                        candidate_nodes, record_errors: bool = True) -> int:
+        """Try to place every pending non-best-effort task of *job* onto
+        *candidate_nodes*.  Returns number placed."""
+        tasks = PriorityQueue(ssn.task_order_fn)
+        for task in job.tasks_in_status(TaskStatus.PENDING):
+            if not task.best_effort:
+                tasks.push(task)
+
+        placed = 0
+        failed_specs = set()
+        for task in tasks:
+            if task.task_spec in failed_specs:
+                # identical spec already failed everywhere this round
+                # (fit-error memoization, allocate.go TaskHasFitErrors)
+                continue
+            if not ssn.allocatable(queue, task):
+                # skip just this task: a smaller sibling may still fit the
+                # queue's share (allocate.go:744-747 uses continue)
+                log.debug("queue %s quota exhausted for task %s",
+                          queue.name, task.key)
+                continue
+
+            status = ssn.pre_predicate(task)
+            if status is not None:
+                if record_errors:
+                    job.record_fit_error(task, "",
+                                         FitError(task, None,
+                                                  statuses=[status]))
+                failed_specs.add(task.task_spec)
+                continue
+
+            fit_nodes = predicate_nodes(ssn, task, candidate_nodes,
+                                        record_errors)
+            idle_fit, future_fit = split_by_fit(task, fit_nodes)
+
+            node = prioritize_nodes(ssn, task, idle_fit)
+            if node is not None:
+                stmt.allocate(task, node)
+                placed += 1
+                continue
+            node = prioritize_nodes(ssn, task, future_fit)
+            if node is not None:
+                stmt.pipeline(task, node)
+                placed += 1
+                continue
+
+            if record_errors and not fit_nodes:
+                failed_specs.add(task.task_spec)
+        return placed
+
+
+register_action(AllocateAction())

@@ -1,0 +1,44 @@
+"""Backfill action — place BestEffort (no-request) tasks.
+
+Reference parity: actions/backfill/backfill.go.  Best-effort tasks
+don't consume accounted resources, so each is bound individually as
+soon as any predicate-passing node exists — no gang gating.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from volcano_tpu.api.types import PodGroupPhase, TaskStatus
+from volcano_tpu.framework.plugins import Action, register_action
+
+from volcano_tpu.actions.util import predicate_nodes, prioritize_nodes
+
+log = logging.getLogger(__name__)
+
+
+class BackfillAction(Action):
+    name = "backfill"
+
+    def execute(self, ssn) -> None:
+        for job in ssn.jobs.values():
+            if job.podgroup is not None and \
+                    job.podgroup.phase is PodGroupPhase.PENDING and \
+                    "enqueue" in ssn.conf.actions:
+                continue
+            if ssn.job_valid(job) is not None:
+                continue
+            for task in job.tasks_in_status(TaskStatus.PENDING):
+                if not task.best_effort:
+                    continue
+                nodes = predicate_nodes(ssn, task,
+                                        list(ssn.nodes.values()))
+                node = prioritize_nodes(ssn, task, nodes)
+                if node is None:
+                    continue
+                stmt = ssn.statement()
+                stmt.allocate(task, node)
+                stmt.commit()
+
+
+register_action(BackfillAction())

@@ -1,0 +1,93 @@
+"""Hard network-topology allocation: gradient search over hypernodes.
+
+Reference parity: actions/allocate/allocate.go:370-463 (per-gradient,
+per-hypernode dry-run with Statement discard/recover, committing the
+best domain) + network-topology-aware gradient production.
+
+TPU semantics: gradients are tier buckets ordered by ICI closeness —
+tier 1 (single ICI slice) first, then DCN tiers up to the job's
+highestTierAllowed.  Within a tier, domains are ordered by the
+HyperNodeOrder plugin score (binpack over slices by default).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from volcano_tpu.api.job_info import JobInfo
+
+log = logging.getLogger(__name__)
+
+
+def candidate_domains(ssn, job: JobInfo) -> List[List[str]]:
+    """Tier-bucketed candidate hypernode domains (the 'gradients'),
+    closest tier first, best-scored first within a tier."""
+    nt = job.network_topology
+    max_tier = nt.highest_tier_allowed if nt else max(
+        ssn.hypernodes.tiers, default=1)
+    gradients = []
+    for tier in ssn.hypernodes.tiers:
+        if tier > max_tier:
+            break
+        names = [h.name for h in ssn.hypernodes.at_tier(tier) if h.nodes]
+        if not names:
+            continue
+        scores = ssn.hyper_node_order(job, names)
+        names.sort(key=lambda n: (-scores.get(n, 0.0), n))
+        gradients.append(names)
+    return gradients
+
+
+def allocate_for_topology_job(ssn, queue, job: JobInfo) -> bool:
+    """Dry-run the job into candidate domains, commit the first tier
+    containing a domain that makes the gang ready (preferring the
+    highest-scored domain inside that tier)."""
+    from volcano_tpu.actions.allocate import AllocateAction
+
+    # Nomination fast path: gangpreempt pinned a domain last cycle.
+    nominated = {sub.nominated_hypernode
+                 for sub in job.sub_jobs.values() if sub.nominated_hypernode}
+    gradients = candidate_domains(ssn, job)
+    if nominated:
+        gradients.insert(0, sorted(nominated))
+
+    for gradient in gradients:
+        best_ops = None
+        best_domain: Optional[str] = None
+        for domain_name in gradient:
+            info = ssn.hypernodes.members.get(domain_name)
+            if info is None:
+                continue
+            nodes = [ssn.nodes[n] for n in info.nodes if n in ssn.nodes]
+            if not nodes:
+                continue
+            stmt = ssn.statement()
+            AllocateAction._allocate_tasks(ssn, queue, job, stmt, nodes,
+                                           record_errors=False)
+            if ssn.job_ready(job):
+                ops = stmt.save_operations()
+                stmt.discard()
+                best_ops, best_domain = ops, domain_name
+                break  # domains pre-sorted best-first inside the tier
+            stmt.discard()
+
+        if best_ops is not None:
+            stmt = ssn.statement()
+            stmt.recover_operations(best_ops)
+            for sub in job.sub_jobs.values():
+                sub.allocated_hypernode = best_domain
+                sub.nominated_hypernode = ""
+            stmt.commit()
+            log.debug("topology job %s committed into domain %s",
+                      job.key, best_domain)
+            return True
+
+    # clear stale nominations that failed validation (allocate.go:595-717)
+    for sub in job.sub_jobs.values():
+        sub.nominated_hypernode = ""
+    ssn.set_job_pending_reason(
+        job, "Unschedulable",
+        f"no hypernode domain within tier {job.network_topology.highest_tier_allowed} "
+        f"can hold job {job.key} (minAvailable={job.min_available})")
+    return False

@@ -1,0 +1,63 @@
+"""Shared action helpers: predicate sweep + node selection.
+
+Reference parity: pkg/scheduler/util/predicate_helper.go (parallel
+predicate over nodes with fit-error collection) and
+actions/allocate/allocate.go:879-949 (idle vs future-idle gradients,
+prioritizeNodes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from volcano_tpu.api.fit_error import FitError
+from volcano_tpu.api.job_info import JobInfo, TaskInfo
+from volcano_tpu.api.node_info import NodeInfo
+
+
+def predicate_nodes(ssn, task: TaskInfo, nodes: List[NodeInfo],
+                    record_errors: bool = True) -> List[NodeInfo]:
+    """Return nodes passing all predicate plugins for *task*."""
+    job = ssn.jobs.get(task.job)
+    fits = []
+    for node in nodes:
+        status = ssn.predicate(task, node)
+        if status is None:
+            fits.append(node)
+        elif record_errors and job is not None:
+            job.record_fit_error(task, node.name,
+                                 FitError(task, node, statuses=[status]))
+    return fits
+
+
+def split_by_fit(task: TaskInfo, nodes: List[NodeInfo]
+                 ) -> Tuple[List[NodeInfo], List[NodeInfo]]:
+    """Split candidates into (fits idle now, fits only future idle).
+
+    The second group drives pipelining onto releasing resources
+    (allocate.go idle/future-idle gradients).
+    """
+    idle_fit, future_fit = [], []
+    for node in nodes:
+        if task.init_resreq.less_equal(node.idle):
+            idle_fit.append(node)
+        elif task.init_resreq.less_equal(node.future_idle()):
+            future_fit.append(node)
+    return idle_fit, future_fit
+
+
+def prioritize_nodes(ssn, task: TaskInfo,
+                     nodes: List[NodeInfo]) -> Optional[NodeInfo]:
+    """Score candidates (BatchNodeOrder + NodeOrder) and return the best."""
+    if not nodes:
+        return None
+    if len(nodes) == 1:
+        return nodes[0]
+    scores: Dict[str, float] = ssn.batch_node_order(task, nodes)
+    best, best_score = None, None
+    for node in nodes:
+        s = scores.get(node.name, 0.0) + ssn.node_order(task, node)
+        if best_score is None or s > best_score or \
+                (s == best_score and node.name < best.name):
+            best, best_score = node, s
+    return best

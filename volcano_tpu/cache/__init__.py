@@ -1,0 +1,8 @@
+"""Scheduler cache layer (reference: pkg/scheduler/cache)."""
+
+from volcano_tpu.cache.cluster import Cluster, ClusterSnapshot
+from volcano_tpu.cache.fake_cluster import FakeCluster
+from volcano_tpu.cache.cache import SchedulerCache, Snapshot
+
+__all__ = ["Cluster", "ClusterSnapshot", "FakeCluster", "SchedulerCache",
+           "Snapshot"]

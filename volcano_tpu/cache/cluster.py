@@ -1,0 +1,67 @@
+"""Cluster backend interface.
+
+The scheduler talks to the cluster only through this interface
+(reference: the k8s clientset + informers behind pkg/scheduler/cache).
+Implementations: FakeCluster (tests/benchmarks — the KWOK analogue);
+a real deployment would back this with an apiserver client.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from volcano_tpu.api.hypernode import HyperNode
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.pod import Pod
+from volcano_tpu.api.podgroup import PodGroup
+from volcano_tpu.api.queue import Queue
+
+
+@dataclass
+class PriorityClass:
+    name: str
+    value: int = 0
+    preemption_policy: str = "PreemptLowerPriority"  # or "Never"
+
+
+@dataclass
+class ClusterSnapshot:
+    """Raw cluster objects as of one point in time."""
+
+    pods: List[Pod] = field(default_factory=list)
+    nodes: List[Node] = field(default_factory=list)
+    podgroups: List[PodGroup] = field(default_factory=list)
+    queues: List[Queue] = field(default_factory=list)
+    hypernodes: List[HyperNode] = field(default_factory=list)
+    priority_classes: List[PriorityClass] = field(default_factory=list)
+
+
+class Cluster(abc.ABC):
+    """Minimal apiserver surface the scheduler needs."""
+
+    @abc.abstractmethod
+    def list_all(self) -> ClusterSnapshot:
+        """Return the current cluster objects (read-only view)."""
+
+    @abc.abstractmethod
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
+        """POST pods/binding analogue.  Raises on conflict/missing."""
+
+    @abc.abstractmethod
+    def evict_pod(self, namespace: str, name: str, reason: str = "") -> None:
+        """Graceful eviction: mark pod terminating; the 'kubelet' side
+        completes deletion asynchronously (FakeCluster does it on tick)."""
+
+    @abc.abstractmethod
+    def nominate_pod(self, namespace: str, name: str, node_name: str) -> None:
+        """Persist status.nominatedNodeName for a pipelined pod."""
+
+    @abc.abstractmethod
+    def update_podgroup_status(self, pg: PodGroup) -> None:
+        """Flush PodGroup phase/conditions."""
+
+    @abc.abstractmethod
+    def record_event(self, obj_key: str, reason: str, message: str) -> None:
+        """Event recorder analogue."""

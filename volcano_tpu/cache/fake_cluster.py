@@ -1,0 +1,174 @@
+"""In-process fake cluster — the apiserver + kubelet stand-in.
+
+Plays the role the reference fills with Kind+KWOK fake nodes
+(benchmark/scripts/create-kwok-nodes.sh) and with the mock cache in unit
+tests (pkg/scheduler/cache/cache_mock.go): holds the CRD objects,
+accepts binds/evictions, and simulates pod lifecycle transitions so
+controllers and the scheduler can be exercised end-to-end with zero real
+machines.  Thread-safe: the scheduler loop and controllers may share it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional, Tuple
+
+from volcano_tpu.api.hypernode import HyperNode
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.pod import Pod
+from volcano_tpu.api.podgroup import PodGroup
+from volcano_tpu.api.queue import Queue
+from volcano_tpu.api.types import DEFAULT_QUEUE, TaskStatus
+from volcano_tpu.cache.cluster import Cluster, ClusterSnapshot, PriorityClass
+
+
+class FakeCluster(Cluster):
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.pods: Dict[str, Pod] = {}            # key: ns/name
+        self.nodes: Dict[str, Node] = {}
+        self.podgroups: Dict[str, PodGroup] = {}  # key: ns/name
+        self.queues: Dict[str, Queue] = {DEFAULT_QUEUE: Queue(name=DEFAULT_QUEUE)}
+        self.hypernodes: Dict[str, HyperNode] = {}
+        self.priority_classes: Dict[str, PriorityClass] = {}
+        self.events: List[Tuple[str, str, str]] = []
+        self.binds: List[Tuple[str, str]] = []    # (pod key, node) history
+        self.evictions: List[str] = []
+        # watchers notified on any mutation (controllers use this)
+        self._watchers: List[Callable[[str, object], None]] = []
+
+    # -- mutation helpers (the "kubectl" surface) ----------------------
+
+    def add_node(self, node: Node):
+        with self._lock:
+            self.nodes[node.name] = node
+        self._notify("node", node)
+
+    def remove_node(self, name: str):
+        with self._lock:
+            node = self.nodes.pop(name, None)
+        if node:
+            self._notify("node_deleted", node)
+
+    def add_pod(self, pod: Pod):
+        with self._lock:
+            self.pods[pod.key] = pod
+        self._notify("pod", pod)
+
+    def delete_pod(self, key: str):
+        with self._lock:
+            pod = self.pods.pop(key, None)
+        if pod:
+            self._notify("pod_deleted", pod)
+
+    def add_podgroup(self, pg: PodGroup):
+        with self._lock:
+            self.podgroups[pg.key] = pg
+        self._notify("podgroup", pg)
+
+    def delete_podgroup(self, key: str):
+        with self._lock:
+            pg = self.podgroups.pop(key, None)
+        if pg:
+            self._notify("podgroup_deleted", pg)
+
+    def add_queue(self, queue: Queue):
+        with self._lock:
+            self.queues[queue.name] = queue
+        self._notify("queue", queue)
+
+    def add_hypernode(self, hn: HyperNode):
+        with self._lock:
+            self.hypernodes[hn.name] = hn
+        self._notify("hypernode", hn)
+
+    def add_priority_class(self, pc: PriorityClass):
+        with self._lock:
+            self.priority_classes[pc.name] = pc
+
+    def watch(self, fn: Callable[[str, object], None]):
+        self._watchers.append(fn)
+
+    def _notify(self, kind: str, obj: object):
+        for w in self._watchers:
+            w(kind, obj)
+
+    # -- Cluster interface --------------------------------------------
+
+    def list_all(self) -> ClusterSnapshot:
+        with self._lock:
+            return ClusterSnapshot(
+                pods=list(self.pods.values()),
+                nodes=list(self.nodes.values()),
+                podgroups=list(self.podgroups.values()),
+                queues=list(self.queues.values()),
+                hypernodes=list(self.hypernodes.values()),
+                priority_classes=list(self.priority_classes.values()),
+            )
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
+        key = f"{namespace}/{name}"
+        with self._lock:
+            pod = self.pods.get(key)
+            if pod is None:
+                raise KeyError(f"bind: pod {key} not found")
+            if pod.node_name and pod.node_name != node_name:
+                raise ValueError(
+                    f"bind conflict: pod {key} already on {pod.node_name}")
+            if node_name not in self.nodes:
+                raise KeyError(f"bind: node {node_name} not found")
+            pod.node_name = node_name
+            pod.phase = TaskStatus.BOUND
+            self.binds.append((key, node_name))
+        self._notify("pod", pod)
+
+    def evict_pod(self, namespace: str, name: str, reason: str = "") -> None:
+        key = f"{namespace}/{name}"
+        with self._lock:
+            pod = self.pods.get(key)
+            if pod is None:
+                return
+            pod.phase = TaskStatus.RELEASING
+            pod.status_message = reason
+            self.evictions.append(key)
+        self._notify("pod", pod)
+
+    def nominate_pod(self, namespace: str, name: str, node_name: str) -> None:
+        with self._lock:
+            pod = self.pods.get(f"{namespace}/{name}")
+            if pod is not None:
+                pod.nominated_node = node_name
+
+    def update_podgroup_status(self, pg: PodGroup) -> None:
+        with self._lock:
+            self.podgroups[pg.key] = pg
+        self._notify("podgroup", pg)
+
+    def record_event(self, obj_key: str, reason: str, message: str) -> None:
+        self.events.append((obj_key, reason, message))
+
+    # -- kubelet simulation -------------------------------------------
+
+    def tick(self):
+        """Advance simulated pod lifecycle one step:
+        Bound -> Running; Releasing -> deleted."""
+        with self._lock:
+            to_delete = []
+            for key, pod in self.pods.items():
+                if pod.phase is TaskStatus.BOUND:
+                    pod.phase = TaskStatus.RUNNING
+                elif pod.phase is TaskStatus.RELEASING:
+                    to_delete.append(key)
+        for key in to_delete:
+            self.delete_pod(key)
+
+    def complete_pod(self, key: str, succeeded: bool = True):
+        with self._lock:
+            pod = self.pods.get(key)
+            if pod:
+                pod.phase = (TaskStatus.SUCCEEDED if succeeded
+                             else TaskStatus.FAILED)
+        if pod:
+            self._notify("pod", pod)

@@ -1,0 +1,34 @@
+"""OpenSession / CloseSession (reference: framework/framework.go:34,63)."""
+
+from __future__ import annotations
+
+import logging
+
+from volcano_tpu.conf import SchedulerConf
+from volcano_tpu.framework import job_updater
+from volcano_tpu.framework.plugins import get_plugin_builder
+from volcano_tpu.framework.session import Session
+
+log = logging.getLogger(__name__)
+
+
+def open_session(cache, conf: SchedulerConf) -> Session:
+    snapshot = cache.snapshot()
+    ssn = Session(cache, snapshot, conf)
+    for tier in conf.tiers:
+        for opt in tier.plugins:
+            builder = get_plugin_builder(opt.name)
+            if builder is None:
+                log.warning("unknown plugin %s (skipped)", opt.name)
+                continue
+            plugin = builder(opt.arguments)
+            ssn.plugins[opt.name] = plugin
+            plugin.on_session_open(ssn)
+    return ssn
+
+
+def close_session(ssn: Session) -> None:
+    for plugin in reversed(list(ssn.plugins.values())):
+        plugin.on_session_close(ssn)
+    job_updater.update_job_statuses(ssn)
+    ssn.cache.flush_binds()

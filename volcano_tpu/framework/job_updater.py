@@ -1,0 +1,45 @@
+"""Flush dirty PodGroup statuses at session close.
+
+Reference parity: pkg/scheduler/framework/job_updater.go +
+PodGroupOldState diffing (session.go:77-79).
+"""
+
+from __future__ import annotations
+
+from volcano_tpu.api.types import PodGroupPhase, TaskStatus
+
+
+def update_job_statuses(ssn) -> int:
+    """Recompute + push PodGroup status for jobs dirtied this session."""
+    updated = 0
+    for uid in ssn.dirty_jobs:
+        job = ssn.jobs.get(uid)
+        if job is None or job.podgroup is None:
+            continue
+        pg = job.podgroup
+        pg.running = len(job.task_status_index.get(TaskStatus.RUNNING, {})) + \
+            len(job.task_status_index.get(TaskStatus.BOUND, {})) + \
+            len(job.task_status_index.get(TaskStatus.BINDING, {}))
+        pg.succeeded = len(job.task_status_index.get(TaskStatus.SUCCEEDED, {}))
+        pg.failed = len(job.task_status_index.get(TaskStatus.FAILED, {}))
+
+        new_phase = _next_phase(job, pg)
+        pg.phase = new_phase
+        ssn.cache.update_podgroup_status(pg)
+        updated += 1
+    return updated
+
+
+def _next_phase(job, pg) -> PodGroupPhase:
+    total = len(job.tasks)
+    if total and pg.succeeded >= (job.min_available if job.min_available else total) \
+            and pg.running == 0 and not job.tasks_in_status(TaskStatus.PENDING):
+        return PodGroupPhase.COMPLETED
+    if pg.phase in (PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING,
+                    PodGroupPhase.UNKNOWN):
+        if job.ready_task_num() >= job.min_available:
+            return PodGroupPhase.RUNNING
+        if pg.phase is PodGroupPhase.RUNNING and pg.running < job.min_available:
+            # gang broken under a running group
+            return PodGroupPhase.UNKNOWN
+    return pg.phase

@@ -1,0 +1,436 @@
+"""Session — one scheduling cycle's working state + extension points.
+
+Reference parity: pkg/scheduler/framework/session.go:66-165 and
+session_plugins.go (the ~40 extension-point registries with tiered
+dispatch).  Dispatch semantics preserved:
+
+- order fns: first tier/plugin giving a non-zero comparison wins.
+- jobReady / allocatable / preemptive: AND across all enabled plugins.
+- overused: OR.
+- jobPipelined / jobStarving / jobEnqueueable: tiered PERMIT/REJECT/
+  ABSTAIN voting (any reject in a tier => False; any permit => True and
+  stop; all abstain => next tier).
+- preemptable / reclaimable / unifiedEvictable: per-tier intersection of
+  victim sets; a tier that voted with an empty intersection rejects.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional, Set
+
+from volcano_tpu.api.fit_error import FitErrors
+from volcano_tpu.api.job_info import JobInfo, TaskInfo
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.queue_info import QueueInfo
+from volcano_tpu.api.types import PodGroupPhase, TaskStatus
+from volcano_tpu.conf import SchedulerConf, Tier
+from volcano_tpu.util import PriorityQueue
+
+# vote values for tiered voting points
+PERMIT = 1
+ABSTAIN = 0
+REJECT = -1
+
+
+class EventHandler:
+    """Allocate/deallocate bookkeeping hooks (drf, proportion, ...)."""
+
+    __slots__ = ("allocate_fn", "deallocate_fn")
+
+    def __init__(self, allocate_fn=None, deallocate_fn=None):
+        self.allocate_fn = allocate_fn
+        self.deallocate_fn = deallocate_fn
+
+
+class Event:
+    __slots__ = ("task",)
+
+    def __init__(self, task: TaskInfo):
+        self.task = task
+
+
+class Session:
+    def __init__(self, cache, snapshot, conf: SchedulerConf):
+        self.uid = uuid.uuid4().hex[:12]
+        self.cache = cache
+        self.conf = conf
+        self.tiers: List[Tier] = conf.tiers
+
+        self.jobs: Dict[str, JobInfo] = snapshot.jobs
+        self.nodes: Dict[str, NodeInfo] = snapshot.nodes
+        self.queues: Dict[str, QueueInfo] = snapshot.queues
+        self.hypernodes = snapshot.hypernodes
+        self.priority_classes = snapshot.priority_classes
+        self.total_resource = snapshot.total_resource()
+
+        self.plugins: Dict[str, object] = {}
+
+        # extension-point registries: point -> plugin name -> fn
+        self._fns: Dict[str, Dict[str, Callable]] = defaultdict(dict)
+        self.event_handlers: List[EventHandler] = []
+
+        # PodGroup phases dirtied this session, flushed by job_updater.
+        self.dirty_jobs: Set[str] = set()
+
+        # gangpreempt nominations made this session (job uid -> subjob
+        # name -> hypernode), consumed by allocate next cycle.
+        self.nominations: Dict[str, Dict[str, str]] = {}
+
+        self._recover_allocated_hypernodes()
+
+    # -- setup ---------------------------------------------------------
+
+    def _recover_allocated_hypernodes(self):
+        """Crash recovery: rebuild each subjob's AllocatedHyperNode from
+        the nodes its running tasks already sit on (session.go:361-444)."""
+        if not self.hypernodes:
+            return
+        for job in self.jobs.values():
+            for sub in job.sub_jobs.values():
+                if sub.allocated_hypernode:
+                    continue
+                placed = {t.node_name for t in sub.tasks.values()
+                          if t.node_name and t.occupies_resources()}
+                if not placed:
+                    continue
+                covering = self.hypernodes.hypernodes_covering(placed)
+                if covering:
+                    sub.allocated_hypernode = covering[0]
+
+    # -- registration (called by plugins in on_session_open) -----------
+
+    def add_fn(self, point: str, plugin: str, fn: Callable):
+        self._fns[point][plugin] = fn
+
+    def add_event_handler(self, handler: EventHandler):
+        self.event_handlers.append(handler)
+
+    # sugar mirroring the reference's AddXxxFn methods
+    def add_job_order_fn(self, p, fn):        self.add_fn("jobOrder", p, fn)
+    def add_queue_order_fn(self, p, fn):      self.add_fn("queueOrder", p, fn)
+    def add_victim_queue_order_fn(self, p, fn): self.add_fn("victimQueueOrder", p, fn)
+    def add_task_order_fn(self, p, fn):       self.add_fn("taskOrder", p, fn)
+    def add_sub_job_order_fn(self, p, fn):    self.add_fn("subJobOrder", p, fn)
+    def add_job_ready_fn(self, p, fn):        self.add_fn("jobReady", p, fn)
+    def add_sub_job_ready_fn(self, p, fn):    self.add_fn("subJobReady", p, fn)
+    def add_job_pipelined_fn(self, p, fn):    self.add_fn("jobPipelined", p, fn)
+    def add_sub_job_pipelined_fn(self, p, fn): self.add_fn("subJobPipelined", p, fn)
+    def add_job_valid_fn(self, p, fn):        self.add_fn("jobValid", p, fn)
+    def add_job_enqueueable_fn(self, p, fn):  self.add_fn("jobEnqueueable", p, fn)
+    def add_job_enqueued_fn(self, p, fn):     self.add_fn("jobEnqueued", p, fn)
+    def add_job_starving_fn(self, p, fn):     self.add_fn("jobStarving", p, fn)
+    def add_pre_predicate_fn(self, p, fn):    self.add_fn("prePredicate", p, fn)
+    def add_predicate_fn(self, p, fn):        self.add_fn("predicate", p, fn)
+    def add_node_order_fn(self, p, fn):       self.add_fn("nodeOrder", p, fn)
+    def add_batch_node_order_fn(self, p, fn): self.add_fn("batchNodeOrder", p, fn)
+    def add_hyper_node_order_fn(self, p, fn): self.add_fn("hyperNodeOrder", p, fn)
+    def add_allocatable_fn(self, p, fn):      self.add_fn("allocatable", p, fn)
+    def add_overused_fn(self, p, fn):         self.add_fn("overused", p, fn)
+    def add_preemptive_fn(self, p, fn):       self.add_fn("preemptive", p, fn)
+    def add_preemptable_fn(self, p, fn):      self.add_fn("preemptable", p, fn)
+    def add_reclaimable_fn(self, p, fn):      self.add_fn("reclaimable", p, fn)
+    def add_unified_evictable_fn(self, p, fn): self.add_fn("unifiedEvictable", p, fn)
+    def add_victim_tasks_fn(self, p, fn):     self.add_fn("victimTasks", p, fn)
+
+    # -- tier-walking dispatch helpers ---------------------------------
+
+    def _enabled_fns(self, point: str):
+        """Yield (plugin_option, fn) honoring tier order + enable flags."""
+        fns = self._fns.get(point)
+        if not fns:
+            return
+        for tier in self.tiers:
+            tier_fns = []
+            for opt in tier.plugins:
+                fn = fns.get(opt.name)
+                if fn is not None and opt.is_enabled(point):
+                    tier_fns.append((opt, fn))
+            if tier_fns:
+                yield tier_fns
+
+    def _compare(self, point: str, a, b) -> int:
+        for tier_fns in self._enabled_fns(point):
+            for _, fn in tier_fns:
+                r = fn(a, b)
+                if r != 0:
+                    return r
+        return 0
+
+    def _vote(self, point: str, *args, default: bool = True) -> bool:
+        """PERMIT/REJECT/ABSTAIN tiered voting."""
+        voted = False
+        for tier_fns in self._enabled_fns(point):
+            has_permit = False
+            for _, fn in tier_fns:
+                v = fn(*args)
+                if v == REJECT or v is False:
+                    return False
+                if v == PERMIT or v is True:
+                    has_permit = True
+                voted = True
+            if has_permit:
+                return True
+        return default if not voted else True
+
+    def _all(self, point: str, *args, default: bool = True) -> bool:
+        any_fn = False
+        for tier_fns in self._enabled_fns(point):
+            for _, fn in tier_fns:
+                any_fn = True
+                if not fn(*args):
+                    return False
+        return True if any_fn else default
+
+    def _any(self, point: str, *args, default: bool = False) -> bool:
+        any_fn = False
+        for tier_fns in self._enabled_fns(point):
+            for _, fn in tier_fns:
+                any_fn = True
+                if fn(*args):
+                    return True
+        return False if any_fn else default
+
+    def _victim_intersection(self, point: str, ctx, candidates:
+                             List[TaskInfo]) -> List[TaskInfo]:
+        """Per-tier victim-set intersection (Preemptable/Reclaimable
+        semantics: every voting plugin must agree a victim is evictable)."""
+        victims = None
+        for tier_fns in self._enabled_fns(point):
+            tier_victims: Optional[Set[str]] = None
+            for _, fn in tier_fns:
+                res = fn(ctx, candidates)
+                if res is None:
+                    continue
+                uids = {t.uid for t in res}
+                tier_victims = uids if tier_victims is None \
+                    else tier_victims & uids
+            if tier_victims is None:
+                continue
+            victims = tier_victims if victims is None else victims & tier_victims
+            if not victims:
+                return []
+        if victims is None:
+            return []
+        return [t for t in candidates if t.uid in victims]
+
+    # -- public dispatchers (mirror session_plugins.go) ----------------
+
+    def job_order_fn(self, a: JobInfo, b: JobInfo) -> bool:
+        r = self._compare("jobOrder", a, b)
+        if r != 0:
+            return r < 0
+        if a.creation_time != b.creation_time:
+            return a.creation_time < b.creation_time
+        return a.uid < b.uid
+
+    def queue_order_fn(self, a: QueueInfo, b: QueueInfo) -> bool:
+        r = self._compare("queueOrder", a, b)
+        if r != 0:
+            return r < 0
+        return a.queue.creation_time < b.queue.creation_time
+
+    def victim_queue_order_fn(self, a: QueueInfo, b: QueueInfo) -> bool:
+        r = self._compare("victimQueueOrder", a, b)
+        if r != 0:
+            return r < 0
+        return not self.queue_order_fn(a, b)
+
+    def task_order_fn(self, a: TaskInfo, b: TaskInfo) -> bool:
+        r = self._compare("taskOrder", a, b)
+        if r != 0:
+            return r < 0
+        return a.uid < b.uid
+
+    def sub_job_order_fn(self, a, b) -> bool:
+        r = self._compare("subJobOrder", a, b)
+        if r != 0:
+            return r < 0
+        return a.name < b.name
+
+    def job_ready(self, job: JobInfo) -> bool:
+        return self._all("jobReady", job, default=True)
+
+    def job_pipelined(self, job: JobInfo) -> bool:
+        return self._vote("jobPipelined", job, default=True)
+
+    def job_starving(self, job: JobInfo) -> bool:
+        return self._vote("jobStarving", job, default=False)
+
+    def job_valid(self, job: JobInfo):
+        """Returns None if valid, else (reason, message)."""
+        for tier_fns in self._enabled_fns("jobValid"):
+            for _, fn in tier_fns:
+                result = fn(job)
+                if result is not None:
+                    return result
+        return None
+
+    def job_enqueueable(self, job: JobInfo) -> bool:
+        return self._vote("jobEnqueueable", job, default=True)
+
+    def job_enqueued(self, job: JobInfo):
+        for tier_fns in self._enabled_fns("jobEnqueued"):
+            for _, fn in tier_fns:
+                fn(job)
+
+    def pre_predicate(self, task: TaskInfo):
+        """Raise FitError-carrying exception or return list of Status."""
+        for tier_fns in self._enabled_fns("prePredicate"):
+            for _, fn in tier_fns:
+                st = fn(task)
+                if st is not None and not st.ok:
+                    return st
+        return None
+
+    def predicate(self, task: TaskInfo, node: NodeInfo):
+        """Returns None if task fits node, else a non-ok Status."""
+        for tier_fns in self._enabled_fns("predicate"):
+            for _, fn in tier_fns:
+                st = fn(task, node)
+                if st is not None and not st.ok:
+                    return st
+        return None
+
+    def node_order(self, task: TaskInfo, node: NodeInfo) -> float:
+        score = 0.0
+        for tier_fns in self._enabled_fns("nodeOrder"):
+            for _, fn in tier_fns:
+                score += fn(task, node)
+        return score
+
+    def batch_node_order(self, task: TaskInfo,
+                         nodes: List[NodeInfo]) -> Dict[str, float]:
+        scores: Dict[str, float] = defaultdict(float)
+        for tier_fns in self._enabled_fns("batchNodeOrder"):
+            for _, fn in tier_fns:
+                for name, s in fn(task, nodes).items():
+                    scores[name] += s
+        return scores
+
+    def hyper_node_order(self, job: JobInfo,
+                         candidates: List[str]) -> Dict[str, float]:
+        scores: Dict[str, float] = defaultdict(float)
+        for tier_fns in self._enabled_fns("hyperNodeOrder"):
+            for _, fn in tier_fns:
+                for name, s in fn(job, candidates).items():
+                    scores[name] += s
+        return scores
+
+    def allocatable(self, queue: QueueInfo, task: TaskInfo) -> bool:
+        return self._all("allocatable", queue, task, default=True)
+
+    def overused(self, queue: QueueInfo) -> bool:
+        return self._any("overused", queue, default=False)
+
+    def preemptive(self, queue: QueueInfo, task: TaskInfo) -> bool:
+        return self._all("preemptive", queue, task, default=True)
+
+    def preemptable(self, preemptor: TaskInfo,
+                    candidates: List[TaskInfo]) -> List[TaskInfo]:
+        return self._victim_intersection("preemptable", preemptor, candidates)
+
+    def reclaimable(self, reclaimer: TaskInfo,
+                    candidates: List[TaskInfo]) -> List[TaskInfo]:
+        return self._victim_intersection("reclaimable", reclaimer, candidates)
+
+    def unified_evictable(self, ctx,
+                          candidates: List[TaskInfo]) -> List[TaskInfo]:
+        return self._victim_intersection("unifiedEvictable", ctx, candidates)
+
+    def victim_tasks(self) -> List[TaskInfo]:
+        victims: Dict[str, TaskInfo] = {}
+        for tier_fns in self._enabled_fns("victimTasks"):
+            for _, fn in tier_fns:
+                for t in fn():
+                    victims[t.uid] = t
+        return list(victims.values())
+
+    # -- state mutation primitives (Session.Allocate/Pipeline/Evict) ---
+
+    def allocate(self, task: TaskInfo, node: NodeInfo):
+        """Assign task to node with resources consumed now."""
+        job = self.jobs[task.job]
+        task.node_name = node.name
+        if task.uid in node.tasks:
+            node.update_task_status(task, TaskStatus.ALLOCATED)
+            job.update_task_status(task, TaskStatus.ALLOCATED)
+        else:
+            job.update_task_status(task, TaskStatus.ALLOCATED)
+            node.add_task(task)
+        self.dirty_jobs.add(job.uid)
+        for h in self.event_handlers:
+            if h.allocate_fn:
+                h.allocate_fn(Event(task))
+
+    def pipeline(self, task: TaskInfo, node: NodeInfo):
+        """Assign task onto resources that are still being released."""
+        job = self.jobs[task.job]
+        task.node_name = node.name
+        job.update_task_status(task, TaskStatus.PIPELINED)
+        node.add_task(task)
+        self.dirty_jobs.add(job.uid)
+        for h in self.event_handlers:
+            if h.allocate_fn:
+                h.allocate_fn(Event(task))
+
+    def evict(self, task: TaskInfo, reason: str = ""):
+        """Mark a running task as releasing (in-session view)."""
+        job = self.jobs[task.job]
+        job.update_task_status(task, TaskStatus.RELEASING)
+        node = self.nodes.get(task.node_name)
+        if node is not None:
+            node.update_task_status(task, TaskStatus.RELEASING)
+        self.dirty_jobs.add(job.uid)
+        for h in self.event_handlers:
+            if h.deallocate_fn:
+                h.deallocate_fn(Event(task))
+
+    def deallocate(self, task: TaskInfo):
+        """Undo an in-session allocate/pipeline (statement discard)."""
+        job = self.jobs[task.job]
+        node = self.nodes.get(task.node_name)
+        if node is not None:
+            node.remove_task(task)
+        job.update_task_status(task, TaskStatus.PENDING)
+        task.node_name = ""
+        for h in self.event_handlers:
+            if h.deallocate_fn:
+                h.deallocate_fn(Event(task))
+
+    def unevict(self, task: TaskInfo,
+                prev_status: Optional[TaskStatus] = None):
+        """Undo an in-session evict: restore the pre-evict status."""
+        restore = prev_status or TaskStatus.RUNNING
+        job = self.jobs[task.job]
+        job.update_task_status(task, restore)
+        node = self.nodes.get(task.node_name)
+        if node is not None:
+            node.update_task_status(task, restore)
+        for h in self.event_handlers:
+            if h.allocate_fn:
+                h.allocate_fn(Event(task))
+
+    # -- misc ----------------------------------------------------------
+
+    def statement(self):
+        from volcano_tpu.framework.statement import Statement
+        return Statement(self)
+
+    def pending_jobs(self) -> List[JobInfo]:
+        return [j for j in self.jobs.values()
+                if j.podgroup is None
+                or j.podgroup.phase in (PodGroupPhase.PENDING,)]
+
+    def set_job_pending_reason(self, job: JobInfo, reason: str, message: str):
+        if job.podgroup is None:
+            return
+        from volcano_tpu.api.podgroup import PodGroupCondition
+        job.podgroup.conditions = [
+            c for c in job.podgroup.conditions if c.type != "Unschedulable"]
+        job.podgroup.conditions.append(PodGroupCondition(
+            type="Unschedulable", status="True", reason=reason,
+            message=message, transition_id=self.uid))
+        self.dirty_jobs.add(job.uid)

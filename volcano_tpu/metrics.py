@@ -1,0 +1,73 @@
+"""Prometheus-style metrics registry (reference: pkg/scheduler/metrics).
+
+Zero-dependency: counters, gauges and summary histograms kept in-process
+with a text exposition dump, so the benchmark harness and tests can
+assert on scheduling latencies the same way the reference scrapes
+e2e_scheduling_latency_milliseconds.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+_lock = threading.Lock()
+_observations: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], List[float]] = \
+    defaultdict(list)
+_counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = \
+    defaultdict(float)
+
+
+def _key(name: str, labels: dict) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
+    return name, tuple(sorted(labels.items()))
+
+
+def observe(name: str, value: float, **labels):
+    with _lock:
+        _observations[_key(name, labels)].append(value)
+
+
+def inc(name: str, value: float = 1.0, **labels):
+    with _lock:
+        _counters[_key(name, labels)] += value
+
+
+def get_observations(name: str, **labels) -> List[float]:
+    with _lock:
+        return list(_observations.get(_key(name, labels), []))
+
+
+def get_counter(name: str, **labels) -> float:
+    with _lock:
+        return _counters.get(_key(name, labels), 0.0)
+
+
+def quantile(name: str, q: float, **labels) -> float:
+    obs = sorted(get_observations(name, **labels))
+    if not obs:
+        return 0.0
+    idx = min(len(obs) - 1, int(q * len(obs)))
+    return obs[idx]
+
+
+def reset():
+    with _lock:
+        _observations.clear()
+        _counters.clear()
+
+
+def dump() -> str:
+    """Prometheus text exposition."""
+    lines = []
+    with _lock:
+        for (name, labels), value in sorted(_counters.items()):
+            lbl = ",".join(f'{k}="{v}"' for k, v in labels)
+            lines.append(f"{name}{{{lbl}}} {value}" if lbl
+                         else f"{name} {value}")
+        for (name, labels), obs in sorted(_observations.items()):
+            lbl = ",".join(f'{k}="{v}"' for k, v in labels)
+            suffix = f"{{{lbl}}}" if lbl else ""
+            lines.append(f"{name}_count{suffix} {len(obs)}")
+            lines.append(f"{name}_sum{suffix} {sum(obs)}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,15 @@
+"""Scheduling plugins (reference: pkg/scheduler/plugins, 24 registered).
+
+Importing this package registers every plugin builder
+(reference: plugins/factory.go).
+"""
+
+import volcano_tpu.plugins.gang          # noqa: F401
+import volcano_tpu.plugins.priority      # noqa: F401
+import volcano_tpu.plugins.conformance   # noqa: F401
+import volcano_tpu.plugins.drf           # noqa: F401
+import volcano_tpu.plugins.proportion    # noqa: F401
+import volcano_tpu.plugins.overcommit    # noqa: F401
+import volcano_tpu.plugins.predicates    # noqa: F401
+import volcano_tpu.plugins.nodeorder     # noqa: F401
+import volcano_tpu.plugins.binpack       # noqa: F401

@@ -1,0 +1,48 @@
+"""Binpack plugin — prefer filling nodes to reduce fragmentation.
+
+Reference parity: plugins/binpack/binpack.go:193.  On TPU clusters this
+keeps partial slices packed so whole slices stay free for gang jobs —
+give the google.com/tpu dimension a high weight in arguments:
+  binpack.weight: 10
+  binpack.resources: "cpu, memory, google.com/tpu"
+  binpack.resources.google.com/tpu: 20
+"""
+
+from __future__ import annotations
+
+from volcano_tpu.api.job_info import TaskInfo
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.resource import CPU, MEMORY, MIN_RESOURCE, TPU
+from volcano_tpu.framework.plugins import Plugin, register_plugin
+
+MAX_SCORE = 100.0
+
+
+@register_plugin("binpack")
+class BinpackPlugin(Plugin):
+    name = "binpack"
+
+    def __init__(self, arguments=None):
+        super().__init__(arguments)
+        self.weight = float(self.arguments.get("binpack.weight", 1))
+        self.dim_weights = {CPU: 1.0, MEMORY: 1.0, TPU: 5.0}
+        for key, val in self.arguments.items():
+            if key.startswith("binpack.resources."):
+                self.dim_weights[key[len("binpack.resources."):]] = float(val)
+
+    def on_session_open(self, ssn):
+        ssn.add_node_order_fn(self.name, self._score)
+
+    def _score(self, task: TaskInfo, node: NodeInfo) -> float:
+        total, weight_sum = 0.0, 0.0
+        for dim, req in task.resreq.res.items():
+            alloc = node.allocatable.get(dim)
+            if alloc < MIN_RESOURCE or req < MIN_RESOURCE:
+                continue
+            w = self.dim_weights.get(dim, 1.0)
+            used = node.used.get(dim)
+            total += w * ((used + req) / alloc)
+            weight_sum += w
+        if weight_sum == 0:
+            return 0.0
+        return self.weight * MAX_SCORE * total / weight_sum

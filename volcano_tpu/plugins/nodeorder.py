@@ -1,0 +1,48 @@
+"""Nodeorder plugin — node scoring.
+
+Reference parity: plugins/nodeorder/nodeorder.go:191,197 (leastalloc,
+mostalloc, balancedalloc scorers with weights).
+"""
+
+from __future__ import annotations
+
+from volcano_tpu.api.job_info import TaskInfo
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.resource import MIN_RESOURCE
+from volcano_tpu.framework.plugins import Plugin, register_plugin
+
+MAX_SCORE = 100.0
+
+
+@register_plugin("nodeorder")
+class NodeOrderPlugin(Plugin):
+    name = "nodeorder"
+
+    def __init__(self, arguments=None):
+        super().__init__(arguments)
+        self.least_weight = float(self.arguments.get("leastrequested.weight", 1))
+        self.most_weight = float(self.arguments.get("mostrequested.weight", 0))
+        self.balanced_weight = float(self.arguments.get(
+            "balancedresource.weight", 1))
+
+    def on_session_open(self, ssn):
+        ssn.add_node_order_fn(self.name, self._score)
+
+    def _score(self, task: TaskInfo, node: NodeInfo) -> float:
+        score = 0.0
+        fracs = []
+        for dim, alloc in node.allocatable.res.items():
+            if alloc < MIN_RESOURCE:
+                continue
+            used = node.used.get(dim) + task.resreq.get(dim)
+            frac = min(1.0, used / alloc)
+            fracs.append(frac)
+            if self.least_weight:
+                score += self.least_weight * MAX_SCORE * (1.0 - frac)
+            if self.most_weight:
+                score += self.most_weight * MAX_SCORE * frac
+        if self.balanced_weight and len(fracs) > 1:
+            mean = sum(fracs) / len(fracs)
+            variance = sum((f - mean) ** 2 for f in fracs) / len(fracs)
+            score += self.balanced_weight * MAX_SCORE * (1.0 - variance)
+        return score

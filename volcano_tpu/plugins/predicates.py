@@ -1,0 +1,85 @@
+"""Predicates plugin — node feasibility filters.
+
+Reference parity: plugins/predicates/predicates.go:212-388 (wraps
+upstream nodeaffinity / tainttoleration / nodeports / podtopologyspread
+filters).  Rebuilt natively: node readiness, nodeSelector, simplified
+nodeAffinity terms, taints/tolerations, pod-count capacity, port
+conflicts.
+"""
+
+from __future__ import annotations
+
+from volcano_tpu.api.fit_error import Status, StatusCode, unschedulable
+from volcano_tpu.api.job_info import TaskInfo
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.resource import PODS
+from volcano_tpu.framework.plugins import Plugin, register_plugin
+
+
+@register_plugin("predicates")
+class PredicatesPlugin(Plugin):
+    name = "predicates"
+
+    def on_session_open(self, ssn):
+        ssn.add_pre_predicate_fn(self.name, self._pre_predicate)
+        ssn.add_predicate_fn(self.name, self._predicate)
+
+    @staticmethod
+    def _pre_predicate(task: TaskInfo):
+        if task.pod.scheduling_gates:
+            return unschedulable(
+                f"pod has unresolved scheduling gates "
+                f"{task.pod.scheduling_gates}", "predicates",
+                resolvable=False)
+        return None
+
+    @staticmethod
+    def _predicate(task: TaskInfo, node: NodeInfo):
+        if not node.ready:
+            return unschedulable("node is not ready", "predicates",
+                                 resolvable=False)
+
+        pod = task.pod
+
+        # nodeSelector
+        for k, v in pod.node_selector.items():
+            if node.labels.get(k) != v:
+                return unschedulable(
+                    "node(s) didn't match Pod's node selector",
+                    "predicates", resolvable=False)
+
+        # simplified nodeAffinity: OR over terms, AND within a term
+        if pod.affinity_node_terms:
+            matched = any(
+                all(node.labels.get(k) in vals for k, vals in term.items())
+                for term in pod.affinity_node_terms)
+            if not matched:
+                return unschedulable(
+                    "node(s) didn't match Pod's node affinity",
+                    "predicates", resolvable=False)
+
+        # taints/tolerations
+        for taint in node.taints:
+            if taint.effect == "PreferNoSchedule":
+                continue
+            if not any(tol.tolerates(taint) for tol in pod.tolerations):
+                return unschedulable(
+                    f"node(s) had untolerated taint {{{taint.key}: "
+                    f"{taint.value}}}", "predicates", resolvable=False)
+
+        # pod-count capacity
+        cap = node.capability.get(PODS)
+        if cap and len(node.tasks) >= cap:
+            return unschedulable("node(s) had too many pods", "predicates")
+
+        # host-port conflicts
+        ports = {p for c in pod.containers for p in c.ports}
+        if ports:
+            for other in node.tasks.values():
+                other_ports = {p for c in other.pod.containers
+                               for p in c.ports}
+                if ports & other_ports:
+                    return unschedulable(
+                        "node(s) didn't have free ports", "predicates")
+
+        return None

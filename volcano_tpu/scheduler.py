@@ -1,0 +1,89 @@
+"""Scheduler main loop.
+
+Reference parity: pkg/scheduler/scheduler.go:71-245 (NewScheduler, Run,
+runOnce, conf hot-reload via file watching).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Optional
+
+from volcano_tpu.cache.cache import SchedulerCache
+from volcano_tpu.cache.cluster import Cluster
+from volcano_tpu.conf import SchedulerConf, load_conf
+from volcano_tpu.framework.framework import close_session, open_session
+from volcano_tpu.framework.plugins import get_action
+from volcano_tpu import metrics
+
+log = logging.getLogger(__name__)
+
+DEFAULT_SCHEDULE_PERIOD = 1.0
+
+
+class Scheduler:
+    def __init__(self, cluster: Cluster, conf=None,
+                 conf_path: Optional[str] = None,
+                 schedule_period: float = DEFAULT_SCHEDULE_PERIOD,
+                 scheduler_name: str = "volcano-tpu"):
+        self.cluster = cluster
+        self.cache = SchedulerCache(cluster, scheduler_name)
+        self.conf_path = conf_path
+        self._conf_mtime = 0.0
+        self.conf: SchedulerConf = self._load(conf)
+        self.schedule_period = schedule_period
+        self._stop = threading.Event()
+        self.cycles = 0
+
+    def _load(self, conf) -> SchedulerConf:
+        if self.conf_path and os.path.exists(self.conf_path):
+            self._conf_mtime = os.path.getmtime(self.conf_path)
+            with open(self.conf_path) as f:
+                return load_conf(f.read())
+        return load_conf(conf)
+
+    def _maybe_reload_conf(self):
+        """Hot reload on file change (scheduler.go:219-245)."""
+        if not self.conf_path or not os.path.exists(self.conf_path):
+            return
+        mtime = os.path.getmtime(self.conf_path)
+        if mtime != self._conf_mtime:
+            log.info("scheduler conf changed, reloading")
+            self._conf_mtime = mtime
+            with open(self.conf_path) as f:
+                self.conf = load_conf(f.read())
+
+    def run_once(self):
+        """One scheduling cycle (scheduler.go runOnce)."""
+        self._maybe_reload_conf()
+        start = time.perf_counter()
+        ssn = open_session(self.cache, self.conf)
+        try:
+            for name in self.conf.actions:
+                action = get_action(name)
+                if action is None:
+                    log.warning("unknown action %s (skipped)", name)
+                    continue
+                t0 = time.perf_counter()
+                action.execute(ssn)
+                metrics.observe("action_latency_seconds",
+                                time.perf_counter() - t0, action=name)
+        finally:
+            close_session(ssn)
+        self.cycles += 1
+        metrics.observe("e2e_scheduling_latency_seconds",
+                        time.perf_counter() - start)
+        return ssn
+
+    def run(self, max_cycles: Optional[int] = None):
+        while not self._stop.is_set():
+            self.run_once()
+            if max_cycles is not None and self.cycles >= max_cycles:
+                break
+            self._stop.wait(self.schedule_period)
+
+    def stop(self):
+        self._stop.set()

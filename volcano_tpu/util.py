@@ -1,0 +1,76 @@
+"""Shared utilities: comparator-based priority queues, helpers.
+
+Reference parity: pkg/scheduler/util/priority_queue.go.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Callable, Generic, Iterable, List, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class PriorityQueue(Generic[T]):
+    """Heap ordered by a less(a, b) comparator (True => a pops first).
+
+    Insertion order breaks ties so scheduling is deterministic.
+    """
+
+    def __init__(self, less: Callable[[T, T], bool],
+                 items: Iterable[T] = ()):
+        self._less = less
+        self._counter = itertools.count()
+        self._heap: List["_Entry[T]"] = []
+        for it in items:
+            self.push(it)
+
+    def push(self, item: T):
+        heapq.heappush(self._heap, _Entry(item, next(self._counter), self._less))
+
+    def pop(self) -> T:
+        return heapq.heappop(self._heap).item
+
+    def peek(self) -> T:
+        return self._heap[0].item
+
+    def empty(self) -> bool:
+        return not self._heap
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def __iter__(self):
+        """Drain-iterate in priority order (consumes the queue)."""
+        while not self.empty():
+            yield self.pop()
+
+
+class _Entry(Generic[T]):
+    __slots__ = ("item", "seq", "less")
+
+    def __init__(self, item: T, seq: int, less: Callable[[T, T], bool]):
+        self.item = item
+        self.seq = seq
+        self.less = less
+
+    def __lt__(self, other: "_Entry[T]") -> bool:
+        if self.less(self.item, other.item):
+            return True
+        if self.less(other.item, self.item):
+            return False
+        return self.seq < other.seq
+
+
+def chain_comparators(fns: List[Callable[[T, T], int]]) -> Callable[[T, T], bool]:
+    """Compose tiered compare fns (negative => a first) into a less()."""
+    def less(a: T, b: T) -> bool:
+        for fn in fns:
+            r = fn(a, b)
+            if r < 0:
+                return True
+            if r > 0:
+                return False
+        return False
+    return less
