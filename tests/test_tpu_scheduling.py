@@ -1,0 +1,162 @@
+"""TPU device + topology-aware gang scheduling end-to-end.
+
+These are the BASELINE.json config #5 scenarios: network-topology-aware
+gang on multi-host TPU slices.
+"""
+
+from volcano_tpu.api.hypernode import VIRTUAL_ROOT
+from volcano_tpu.api.podgroup import NetworkTopologySpec, SubGroupPolicy
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.types import (
+    SUBGROUP_LABEL,
+    NetworkTopologyMode,
+    PodGroupPhase,
+)
+from volcano_tpu.cache.cache import SchedulerCache
+from volcano_tpu.simulator import make_tpu_cluster
+from volcano_tpu.uthelper import TestContext, gang_job
+
+
+def tpu_ctx(slices, podgroups=(), pods=(), conf=None, **kwargs):
+    cluster = make_tpu_cluster(slices, **kwargs)
+    ctx = TestContext.__new__(TestContext)
+    ctx.cluster = cluster
+    for pg in podgroups:
+        cluster.add_podgroup(pg)
+    for p in pods:
+        cluster.add_pod(p)
+    from volcano_tpu.conf import load_conf
+    ctx.conf = load_conf(conf or {
+        "actions": "enqueue, allocate, backfill",
+        "tiers": [
+            {"plugins": [{"name": "priority"}, {"name": "gang"},
+                         {"name": "conformance"}]},
+            {"plugins": [{"name": "overcommit"}, {"name": "drf"},
+                         {"name": "predicates"}, {"name": "proportion"},
+                         {"name": "nodeorder"}, {"name": "binpack"},
+                         {"name": "deviceshare"},
+                         {"name": "network-topology-aware"}]},
+        ]})
+    ctx.cache = SchedulerCache(cluster)
+    ctx.last_session = None
+    return ctx
+
+
+def test_hypernode_discovery_builds_slice_tree():
+    cluster = make_tpu_cluster([("sa", "v5e-16"), ("sb", "v5e-16")])
+    assert set(cluster.hypernodes) == {"sa", "sb", "dcn-0"}
+    assert cluster.hypernodes["sa"].tier == 1
+    assert cluster.hypernodes["dcn-0"].tier == 2
+    assert len(cluster.hypernodes["sa"].members) == 4  # 4 hosts
+
+
+def test_hard_topology_job_lands_in_one_slice():
+    """8-host gang with hard tier-1 topology must not straddle slices."""
+    pg, pods = gang_job(
+        "train", replicas=4, requests={"cpu": 8, TPU: 4},
+        network_topology=NetworkTopologySpec(
+            mode=NetworkTopologyMode.HARD, highest_tier_allowed=1))
+    ctx = tpu_ctx([("sa", "v5e-16"), ("sb", "v5e-16")],
+                  podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(4)
+    slices_used = {node.rsplit("-w", 1)[0] for _, node in ctx.cluster.binds}
+    assert len(slices_used) == 1
+
+
+def test_hard_topology_rejects_when_no_slice_fits():
+    """5 whole-host tasks cannot fit a 4-host slice at tier 1."""
+    pg, pods = gang_job(
+        "train", replicas=5, requests={"cpu": 8, TPU: 4},
+        network_topology=NetworkTopologySpec(
+            mode=NetworkTopologyMode.HARD, highest_tier_allowed=1))
+    ctx = tpu_ctx([("sa", "v5e-16"), ("sb", "v5e-16")],
+                  podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(0)
+    pg2 = ctx.cluster.podgroups["default/train"]
+    assert any("hypernode domain" in c.message for c in pg2.conditions)
+
+
+def test_hard_topology_tier2_spans_slices():
+    """Same 5-host job at highestTierAllowed=2 may span slices over DCN."""
+    pg, pods = gang_job(
+        "train", replicas=5, requests={"cpu": 8, TPU: 4},
+        network_topology=NetworkTopologySpec(
+            mode=NetworkTopologyMode.HARD, highest_tier_allowed=2))
+    ctx = tpu_ctx([("sa", "v5e-16"), ("sb", "v5e-16")],
+                  podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(5)
+
+
+def test_multi_slice_job_subgroups_get_own_slices():
+    """Two subgroups (DP replicas), each an ICI-local gang of 4 hosts ->
+    each subgroup fills its own slice."""
+    subgroups = [
+        SubGroupPolicy(name="rep0", min_member=4,
+                       network_topology=NetworkTopologySpec(
+                           NetworkTopologyMode.HARD, 1)),
+        SubGroupPolicy(name="rep1", min_member=4,
+                       network_topology=NetworkTopologySpec(
+                           NetworkTopologyMode.HARD, 1)),
+    ]
+    pg, pods = gang_job(
+        "multislice", replicas=8, requests={"cpu": 8, TPU: 4},
+        sub_group_policies=subgroups,
+        labels_per_pod=lambda i: {SUBGROUP_LABEL: f"rep{i // 4}"})
+    ctx = tpu_ctx([("sa", "v5e-16"), ("sb", "v5e-16")],
+                  podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(8)
+    by_slice = {}
+    for pod_key, node in ctx.cluster.binds:
+        by_slice.setdefault(node.rsplit("-w", 1)[0], set()).add(pod_key)
+    assert len(by_slice) == 2
+    for members in by_slice.values():
+        assert len(members) == 4
+        # a slice must hold exactly one subgroup, never a mix
+        subgroup_ids = {int(k.rsplit("-", 1)[1]) // 4 for k in members}
+        assert len(subgroup_ids) == 1, f"subgroup straddles slices: {members}"
+
+
+def test_whole_host_request_enforced_on_multihost_slice():
+    """Requesting 2 chips on a multi-host slice is rejected by the tpu
+    device filter (must take the whole host: 4)."""
+    pg, pods = gang_job("bad", replicas=1, requests={"cpu": 1, TPU: 2})
+    ctx = tpu_ctx([("sa", "v5e-16")], podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(0)
+
+
+def test_subhost_chips_allowed_on_single_host_slice():
+    pg, pods = gang_job("small", replicas=2, requests={"cpu": 1, TPU: 2})
+    ctx = tpu_ctx([("tiny", "v5e-4")], podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(2)  # two 2-chip pods pack one 4-chip host
+
+
+def test_v5e_256_gang_allocation():
+    """Full 64-host v5e-256 gang lands entirely in the slice."""
+    pg, pods = gang_job(
+        "big", replicas=64, requests={"cpu": 8, TPU: 4},
+        network_topology=NetworkTopologySpec(
+            mode=NetworkTopologyMode.HARD, highest_tier_allowed=1))
+    ctx = tpu_ctx([("giant", "v5e-256"), ("spare", "v5e-16")],
+                  podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(64)
+    assert all(n.startswith("giant") for _, n in ctx.cluster.binds)
+    ctx.expect_podgroup_phase("default/big", PodGroupPhase.RUNNING)
+
+
+def test_soft_topology_prefers_colocation():
+    """Soft topology: no hard constraint, but batch node order pulls
+    tasks of the job toward one slice."""
+    pg, pods = gang_job("soft", replicas=4, requests={"cpu": 8, TPU: 4})
+    ctx = tpu_ctx([("sa", "v5e-16"), ("sb", "v5e-16")],
+                  podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(4)
+    slices_used = {n.rsplit("-w", 1)[0] for _, n in ctx.cluster.binds}
+    assert len(slices_used) == 1

@@ -1,0 +1,54 @@
+"""ICI mesh math + TPU device semantics."""
+
+import pytest
+
+from volcano_tpu.api.devices.tpu.topology import (
+    SliceTopology, chips_in, diameter, host_coords, host_grid,
+    ici_distance, parse_topology, slice_for,
+)
+
+
+def test_parse_topology():
+    assert parse_topology("16x16") == (16, 16)
+    assert parse_topology("4x4x8") == (4, 4, 8)
+    assert parse_topology("") == ()
+    assert parse_topology("axb") == ()
+
+
+def test_v5e_256_shape():
+    s = slice_for("s", "v5e-256")
+    assert s.num_chips == 256
+    assert s.chips_per_host == 4
+    assert s.num_hosts == 64
+    assert s.is_multi_host
+    assert host_grid(s.topology) == (8, 8)
+
+
+def test_v5p_3d_shape():
+    s = slice_for("s", "v5p-256")
+    assert s.topology == (4, 8, 8)
+    assert s.num_chips == 256
+    assert s.num_hosts == 64
+    assert host_grid(s.topology) == (2, 4, 8)
+
+
+def test_host_coords_row_major_and_distance():
+    topo = (4, 4)  # v5e-16: host grid 2x2
+    assert host_coords(0, topo) == (0, 0)
+    assert host_coords(1, topo) == (0, 1)
+    assert host_coords(2, topo) == (1, 0)
+    assert host_coords(3, topo) == (1, 1)
+    assert ici_distance((0, 0), (1, 1)) == 2
+    # torus wraparound halves long hops
+    assert ici_distance((0,), (3,), torus=(4,)) == 1
+
+
+def test_diameter():
+    assert diameter((16, 16)) == 14  # 8x8 host mesh
+    assert diameter((4, 4)) == 2
+
+
+def test_single_host_slice():
+    s = slice_for("s", "v5e-4")
+    assert not s.is_multi_host
+    assert s.num_hosts == 1

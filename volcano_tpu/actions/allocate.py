@@ -73,7 +73,7 @@ class AllocateAction(Action):
         return True
 
     def _allocate_job(self, ssn, queue, job: JobInfo) -> None:
-        if job.is_hard_topology() and ssn.hypernodes is not None and \
+        if job.has_topology_constraint() and ssn.hypernodes is not None and \
                 len(ssn.hypernodes.members) > 1:
             from volcano_tpu.actions.topology_alloc import allocate_for_topology_job
             allocate_for_topology_job(ssn, queue, job)
@@ -104,13 +104,18 @@ class AllocateAction(Action):
 
     @staticmethod
     def _allocate_tasks(ssn, queue, job: JobInfo, stmt,
-                        candidate_nodes, record_errors: bool = True) -> int:
+                        candidate_nodes, record_errors: bool = True,
+                        task_filter=None) -> int:
         """Try to place every pending non-best-effort task of *job* onto
-        *candidate_nodes*.  Returns number placed."""
+        *candidate_nodes* (optionally restricted by *task_filter*).
+        Returns number placed."""
         tasks = PriorityQueue(ssn.task_order_fn)
         for task in job.tasks_in_status(TaskStatus.PENDING):
-            if not task.best_effort:
-                tasks.push(task)
+            if task.best_effort:
+                continue
+            if task_filter is not None and not task_filter(task):
+                continue
+            tasks.push(task)
 
         placed = 0
         failed_specs = set()

@@ -2,12 +2,16 @@
 
 Reference parity: actions/allocate/allocate.go:370-463 (per-gradient,
 per-hypernode dry-run with Statement discard/recover, committing the
-best domain) + network-topology-aware gradient production.
+best domain) + network-topology-aware gradient production + subgroup
+domains (SubJobInfo.AllocatedHyperNode, sub_job_info.go:40).
 
 TPU semantics: gradients are tier buckets ordered by ICI closeness —
 tier 1 (single ICI slice) first, then DCN tiers up to the job's
 highestTierAllowed.  Within a tier, domains are ordered by the
-HyperNodeOrder plugin score (binpack over slices by default).
+HyperNodeOrder plugin score (slice binpack + job affinity).  A job with
+subGroupPolicies places each subgroup in its own domain (e.g. one ICI
+slice per data-parallel replica) using statement savepoints, so a
+multi-slice training job gets per-slice gang placement in one pass.
 """
 
 from __future__ import annotations
@@ -15,17 +19,20 @@ from __future__ import annotations
 import logging
 from typing import List, Optional
 
-from volcano_tpu.api.job_info import JobInfo
+from volcano_tpu.api.job_info import JobInfo, SubJobInfo
+from volcano_tpu.api.types import TaskStatus
 
 log = logging.getLogger(__name__)
 
 
-def candidate_domains(ssn, job: JobInfo) -> List[List[str]]:
+def candidate_domains(ssn, job: JobInfo,
+                      max_tier: Optional[int] = None) -> List[List[str]]:
     """Tier-bucketed candidate hypernode domains (the 'gradients'),
     closest tier first, best-scored first within a tier."""
-    nt = job.network_topology
-    max_tier = nt.highest_tier_allowed if nt else max(
-        ssn.hypernodes.tiers, default=1)
+    if max_tier is None:
+        nt = job.network_topology
+        max_tier = nt.highest_tier_allowed if nt else max(
+            ssn.hypernodes.tiers, default=1)
     gradients = []
     for tier in ssn.hypernodes.tiers:
         if tier > max_tier:
@@ -40,9 +47,23 @@ def candidate_domains(ssn, job: JobInfo) -> List[List[str]]:
 
 
 def allocate_for_topology_job(ssn, queue, job: JobInfo) -> bool:
-    """Dry-run the job into candidate domains, commit the first tier
-    containing a domain that makes the gang ready (preferring the
-    highest-scored domain inside that tier)."""
+    sub_jobs = [s for s in job.sub_jobs.values()
+                if s.name and s.min_member > 0]
+    if sub_jobs:
+        return _allocate_per_subjob(ssn, queue, job, sub_jobs)
+    return _allocate_whole_job(ssn, queue, job)
+
+
+def _domain_nodes(ssn, domain_name: str):
+    info = ssn.hypernodes.members.get(domain_name)
+    if info is None:
+        return []
+    return [ssn.nodes[n] for n in info.nodes if n in ssn.nodes]
+
+
+def _allocate_whole_job(ssn, queue, job: JobInfo) -> bool:
+    """Dry-run the whole job into candidate domains; commit the first
+    (tier-closest, best-scored) domain where the gang becomes ready."""
     from volcano_tpu.actions.allocate import AllocateAction
 
     # Nomination fast path: gangpreempt pinned a domain last cycle.
@@ -53,41 +74,107 @@ def allocate_for_topology_job(ssn, queue, job: JobInfo) -> bool:
         gradients.insert(0, sorted(nominated))
 
     for gradient in gradients:
-        best_ops = None
-        best_domain: Optional[str] = None
         for domain_name in gradient:
-            info = ssn.hypernodes.members.get(domain_name)
-            if info is None:
-                continue
-            nodes = [ssn.nodes[n] for n in info.nodes if n in ssn.nodes]
+            nodes = _domain_nodes(ssn, domain_name)
             if not nodes:
                 continue
             stmt = ssn.statement()
             AllocateAction._allocate_tasks(ssn, queue, job, stmt, nodes,
                                            record_errors=False)
             if ssn.job_ready(job):
-                ops = stmt.save_operations()
-                stmt.discard()
-                best_ops, best_domain = ops, domain_name
-                break  # domains pre-sorted best-first inside the tier
+                for sub in job.sub_jobs.values():
+                    sub.allocated_hypernode = domain_name
+                    sub.nominated_hypernode = ""
+                stmt.commit()
+                log.debug("topology job %s committed into domain %s",
+                          job.key, domain_name)
+                return True
             stmt.discard()
 
-        if best_ops is not None:
-            stmt = ssn.statement()
-            stmt.recover_operations(best_ops)
-            for sub in job.sub_jobs.values():
-                sub.allocated_hypernode = best_domain
-                sub.nominated_hypernode = ""
-            stmt.commit()
-            log.debug("topology job %s committed into domain %s",
-                      job.key, best_domain)
-            return True
+    return _fail(ssn, job)
 
+
+def _allocate_per_subjob(ssn, queue, job: JobInfo,
+                         sub_jobs: List[SubJobInfo]) -> bool:
+    """Place each subgroup into its own hypernode domain (its topology
+    constraint, falling back to the job's), all within one statement
+    with per-subgroup savepoints."""
+    from volcano_tpu.actions.allocate import AllocateAction
+
+    stmt = ssn.statement()
+    chosen = {}
+    # name order for determinism, SubJobOrder plugins take precedence
+    ordered = sorted(sorted(sub_jobs, key=lambda s: s.name),
+                     key=_cmp_key(ssn))
+
+    for sub in ordered:
+        if not any(t.status is TaskStatus.PENDING and not t.best_effort
+                   for t in sub.tasks.values()):
+            continue  # nothing to place; keep its allocated_hypernode
+        nt = sub.network_topology or job.network_topology
+        max_tier = nt.highest_tier_allowed if nt else None
+        placed = False
+        gradients = candidate_domains(ssn, job, max_tier=max_tier)
+        if sub.nominated_hypernode:
+            gradients.insert(0, [sub.nominated_hypernode])
+        for gradient in gradients:
+            for domain_name in gradient:
+                nodes = _domain_nodes(ssn, domain_name)
+                if not nodes:
+                    continue
+                mark = len(stmt.operations)
+                AllocateAction._allocate_tasks(
+                    ssn, queue, job, stmt, nodes, record_errors=False,
+                    task_filter=lambda t, s=sub: t.sub_job == s.name)
+                if sub.is_ready() or sub.is_pipelined():
+                    chosen[sub.name] = domain_name
+                    placed = True
+                    break
+                stmt.rollback_to(mark)
+            if placed:
+                break
+        if not placed:
+            stmt.discard()
+            return _fail(ssn, job, subjob=sub.name)
+
+    # remaining tasks (no subgroup) may go anywhere in the cluster
+    AllocateAction._allocate_tasks(
+        ssn, queue, job, stmt, list(ssn.nodes.values()),
+        record_errors=False, task_filter=lambda t: not t.sub_job)
+
+    if ssn.job_ready(job):
+        for sub in job.sub_jobs.values():
+            if sub.name in chosen:
+                sub.allocated_hypernode = chosen[sub.name]
+                sub.nominated_hypernode = ""
+        stmt.commit()
+        log.debug("multi-slice job %s committed: %s", job.key, chosen)
+        return True
+    stmt.discard()
+    return _fail(ssn, job)
+
+
+def _cmp_key(ssn):
+    import functools
+
+    def cmp(a, b):
+        if ssn.sub_job_order_fn(a, b):
+            return -1
+        if ssn.sub_job_order_fn(b, a):
+            return 1
+        return 0
+    return functools.cmp_to_key(cmp)
+
+
+def _fail(ssn, job: JobInfo, subjob: str = "") -> bool:
     # clear stale nominations that failed validation (allocate.go:595-717)
     for sub in job.sub_jobs.values():
         sub.nominated_hypernode = ""
+    nt = job.network_topology
+    where = f"subgroup {subjob} of " if subjob else ""
     ssn.set_job_pending_reason(
         job, "Unschedulable",
-        f"no hypernode domain within tier {job.network_topology.highest_tier_allowed} "
-        f"can hold job {job.key} (minAvailable={job.min_available})")
+        f"no hypernode domain within tier "
+        f"{nt.highest_tier_allowed if nt else '?'} can hold {where}job "
+        f"{job.key} (minAvailable={job.min_available})")
     return False

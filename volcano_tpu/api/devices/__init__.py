@@ -1,0 +1,36 @@
+"""Pluggable device layer (reference: pkg/scheduler/api/shared_device_pool.go).
+
+A Devices implementation owns the per-node accounting for one device
+class.  The deviceshare plugin bridges these into predicate + score
+callbacks.  TPU is the first-class device here (reference ships
+nvidia vGPU/gpushare + Ascend NPU; the TPU model replaces GPU
+memory/core sharing with atomic slice-membership semantics).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional
+
+from volcano_tpu.api.fit_error import Status
+
+
+class Devices(abc.ABC):
+    """Per-node device state (shared_device_pool.go:33 Devices iface)."""
+
+    name = "device"
+
+    @abc.abstractmethod
+    def has_device_request(self, task) -> bool:
+        """Does this task ask for this device class?"""
+
+    @abc.abstractmethod
+    def filter_node(self, task) -> Optional[Status]:
+        """None if the node can serve the task's device request."""
+
+    @abc.abstractmethod
+    def score_node(self, task) -> float:
+        """Device-aware node score (higher is better)."""
+
+
+from volcano_tpu.api.devices.tpu.device_info import TPUDevices  # noqa: E402,F401

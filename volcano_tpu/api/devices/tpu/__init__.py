@@ -1,0 +1,9 @@
+"""TPU slice device model."""
+
+from volcano_tpu.api.devices.tpu.topology import (
+    SliceTopology, parse_topology, chips_in, ici_distance,
+)
+from volcano_tpu.api.devices.tpu.device_info import TPUDevices
+
+__all__ = ["SliceTopology", "parse_topology", "chips_in", "ici_distance",
+           "TPUDevices"]

@@ -1,0 +1,92 @@
+"""TPU device accounting for one node.
+
+Reference parity: pkg/scheduler/api/devices/nvidia/vgpu/device_info.go
+(GPUDevices implementing the Devices interface) — rebuilt for TPU
+semantics: chips are NOT shareable or partitionable at schedule time;
+a host in a multi-host slice must be consumed whole (all its chips by
+one pod) because the XLA runtime owns the full ICI mesh; single-host
+slices may pack multiple small-chip pods only when the accelerator
+supports sub-host granularity (1, 2, or 4 chips on v5e 1-host slices).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from volcano_tpu.api.devices import Devices
+from volcano_tpu.api.fit_error import Status, unschedulable
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.devices.tpu.topology import SliceTopology, parse_topology
+
+log = logging.getLogger(__name__)
+
+_VALID_SUBHOST_CHIPS = {1, 2, 4, 8}
+
+
+class TPUDevices(Devices):
+    name = "tpu"
+
+    def __init__(self, node_info):
+        self.node = node_info
+        self.slice_name = node_info.tpu_slice
+        self.accelerator = node_info.labels.get(
+            "cloud.google.com/gke-tpu-accelerator", "")
+        self.topology = parse_topology(node_info.tpu_topology)
+        self.worker_id = node_info.tpu_worker_id
+        self.slice = SliceTopology(self.slice_name, self.accelerator,
+                                   self.topology) if self.topology else None
+        self.chips_total = node_info.allocatable.get(TPU)
+
+    @property
+    def chips_free(self) -> float:
+        return self.node.idle.get(TPU)
+
+    @property
+    def is_tpu_node(self) -> bool:
+        return self.chips_total > 0
+
+    def has_device_request(self, task) -> bool:
+        return task.resreq.get(TPU) > 0
+
+    def filter_node(self, task) -> Optional[Status]:
+        req = task.resreq.get(TPU)
+        if req <= 0:
+            return None
+        if not self.is_tpu_node:
+            return unschedulable("node has no TPU chips", "tpu",
+                                 resolvable=False)
+        if self.slice and self.slice.is_multi_host:
+            # multi-host slice: a pod takes a whole host's chips —
+            # the XLA runtime on each worker drives all local chips.
+            if req != self.slice.chips_per_host:
+                return unschedulable(
+                    f"multi-host TPU slice requires whole-host requests "
+                    f"of {self.slice.chips_per_host} chips, got {req:g}",
+                    "tpu", resolvable=False)
+            if self.chips_free < req:
+                return unschedulable(
+                    "TPU host already occupied", "tpu")
+        else:
+            if req not in _VALID_SUBHOST_CHIPS:
+                return unschedulable(
+                    f"invalid TPU chip request {req:g} "
+                    f"(must be one of {sorted(_VALID_SUBHOST_CHIPS)})",
+                    "tpu", resolvable=False)
+            if req > self.chips_total:
+                return unschedulable(
+                    f"node has only {self.chips_total:g} TPU chips",
+                    "tpu", resolvable=False)
+            if req > self.chips_free:
+                return unschedulable("not enough free TPU chips", "tpu")
+        return None
+
+    def score_node(self, task) -> float:
+        """Pack partially-used single-host slices first so whole hosts
+        (and whole slices) stay free for gang jobs."""
+        req = task.resreq.get(TPU)
+        if req <= 0 or not self.is_tpu_node:
+            return 0.0
+        used_frac = 1.0 - (self.chips_free / self.chips_total
+                           if self.chips_total else 0.0)
+        return 100.0 * used_frac

@@ -1,0 +1,146 @@
+"""ICI mesh topology math for Cloud TPU slices.
+
+This replaces the reference's NCCL ring/tree distance model
+(plugins/deviceshare + network-topology-aware hypernode binpack) with
+the physical model of a TPU pod: chips sit on a 2D (v5e/v6e) or 3D
+(v4/v5p) ICI mesh/torus; a *slice* is a rectangular sub-mesh carved out
+of a pod, provisioned as one node pool where every host carries a fixed
+number of chips (4 for the generations modeled here).  Placement quality
+is ICI hop distance — hosts in one slice talk over ICI, different
+slices only over DCN.
+
+Accelerator naming follows GKE (`cloud.google.com/gke-tpu-accelerator`),
+e.g. tpu-v5-lite-podslice with topology "16x16" = v5e-256.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# chips per host by accelerator family (GKE podslice machine shapes)
+CHIPS_PER_HOST: Dict[str, int] = {
+    "tpu-v4-podslice": 4,
+    "tpu-v5-lite-podslice": 4,   # v5e
+    "tpu-v5p-slice": 4,
+    "tpu-v6e-slice": 4,
+    "": 4,
+}
+
+# how one host's chips are laid out inside the chip mesh
+# (v5e: 2x2 plane; 3D families: 2x2x1 brick)
+HOST_SHAPE_2D = (2, 2)
+HOST_SHAPE_3D = (2, 2, 1)
+
+
+def parse_topology(s: str) -> Tuple[int, ...]:
+    """Parse "16x16" or "4x4x8" into a dims tuple."""
+    if not s:
+        return ()
+    try:
+        dims = tuple(int(p) for p in s.lower().split("x"))
+    except ValueError:
+        return ()
+    return dims if all(d > 0 for d in dims) else ()
+
+
+def chips_in(topology: Sequence[int]) -> int:
+    n = 1
+    for d in topology:
+        n *= d
+    return n if topology else 0
+
+
+def host_grid(topology: Sequence[int]) -> Tuple[int, ...]:
+    """Host-granularity grid dims for a chip topology."""
+    shape = HOST_SHAPE_3D if len(topology) == 3 else HOST_SHAPE_2D
+    return tuple(max(1, t // s) for t, s in zip(topology, shape))
+
+
+def hosts_in(topology: Sequence[int]) -> int:
+    return chips_in(host_grid(topology))
+
+
+def host_coords(worker_id: int, topology: Sequence[int]) -> Tuple[int, ...]:
+    """Row-major host coordinates in the host grid for a worker index."""
+    grid = host_grid(topology)
+    coords = []
+    rem = worker_id
+    for d in reversed(grid):
+        coords.append(rem % d)
+        rem //= d
+    return tuple(reversed(coords))
+
+
+def ici_distance(a: Sequence[int], b: Sequence[int],
+                 torus: Optional[Sequence[int]] = None) -> int:
+    """Manhattan ICI hop distance between host coords; wraparound links
+    if *torus* gives the grid dims (v4/v5p tori)."""
+    dist = 0
+    for i, (x, y) in enumerate(zip(a, b)):
+        d = abs(x - y)
+        if torus is not None and i < len(torus) and torus[i] > 0:
+            d = min(d, torus[i] - d)
+        dist += d
+    return dist
+
+
+@dataclass(frozen=True)
+class SliceTopology:
+    """Static identity of one provisioned slice."""
+
+    name: str
+    accelerator: str = "tpu-v5-lite-podslice"
+    topology: Tuple[int, ...] = (4, 4)
+
+    @property
+    def chips_per_host(self) -> int:
+        return CHIPS_PER_HOST.get(self.accelerator, 4)
+
+    @property
+    def num_chips(self) -> int:
+        return chips_in(self.topology)
+
+    @property
+    def num_hosts(self) -> int:
+        return max(1, self.num_chips // self.chips_per_host)
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+    def host_coords(self, worker_id: int) -> Tuple[int, ...]:
+        return host_coords(worker_id, self.topology)
+
+    def mesh_axes(self) -> Tuple[int, ...]:
+        """Device mesh shape a JAX workload would use across this slice:
+        (hosts, chips_per_host) flattened to the chip topology."""
+        return self.topology
+
+    def worker_distance(self, a: int, b: int) -> int:
+        return ici_distance(self.host_coords(a), self.host_coords(b),
+                            torus=host_grid(self.topology)
+                            if len(self.topology) == 3 else None)
+
+
+def diameter(topology: Sequence[int]) -> int:
+    """Max host-to-host ICI distance within a slice (mesh assumption)."""
+    grid = host_grid(topology)
+    return sum(d - 1 for d in grid)
+
+
+# Well-known slice shapes by common name (subset for tests/benchmarks).
+WELL_KNOWN = {
+    "v5e-4": SliceTopology("", "tpu-v5-lite-podslice", (2, 2)),
+    "v5e-16": SliceTopology("", "tpu-v5-lite-podslice", (4, 4)),
+    "v5e-64": SliceTopology("", "tpu-v5-lite-podslice", (8, 8)),
+    "v5e-256": SliceTopology("", "tpu-v5-lite-podslice", (16, 16)),
+    "v5p-128": SliceTopology("", "tpu-v5p-slice", (4, 4, 8)),
+    "v5p-256": SliceTopology("", "tpu-v5p-slice", (4, 8, 8)),
+    "v5p-1024": SliceTopology("", "tpu-v5p-slice", (8, 8, 16)),
+}
+
+
+def slice_for(name: str, kind: str) -> SliceTopology:
+    base = WELL_KNOWN[kind]
+    return SliceTopology(name, base.accelerator, base.topology)

@@ -97,6 +97,7 @@ class HyperNodesInfo:
                  node_labels: Optional[Dict[str, Dict[str, str]]] = None):
         self.members: Dict[str, HyperNodeInfo] = {}
         self.node_to_leaf: Dict[str, str] = {}   # real node -> tier-1 hypernode
+        self._lca_tier_cache: Dict[tuple, int] = {}
         real = list(real_nodes)
         node_labels = node_labels or {}
 
@@ -211,19 +212,30 @@ class HyperNodesInfo:
                 return cur
         return None
 
-    def lca_tier_of_nodes(self, node_a: str, node_b: str) -> int:
-        """Tier of the LCA of the leaf hypernodes containing two real
-        nodes — the ICI/DCN 'distance' between them.  Nodes in the same
-        tier-1 hypernode (same ICI slice) score tier 1; anything
-        unresolvable scores the virtual-root tier."""
-        la, lb = self.node_to_leaf.get(node_a), self.node_to_leaf.get(node_b)
+    def lca_tier_of_leaves(self, la: Optional[str],
+                           lb: Optional[str]) -> int:
+        """Memoized LCA tier between two leaf hypernodes (None = outside
+        the tree, scoring the virtual-root tier)."""
         root_tier = self.members[VIRTUAL_ROOT].tier
         if la is None or lb is None:
             return root_tier
         if la == lb:
             return self.members[la].tier
-        lca = self.lca(la, lb)
-        return self.members[lca].tier if lca else root_tier
+        key = (la, lb) if la < lb else (lb, la)
+        cached = self._lca_tier_cache.get(key)
+        if cached is None:
+            lca = self.lca(la, lb)
+            cached = self.members[lca].tier if lca else root_tier
+            self._lca_tier_cache[key] = cached
+        return cached
+
+    def lca_tier_of_nodes(self, node_a: str, node_b: str) -> int:
+        """Tier of the LCA of the leaf hypernodes containing two real
+        nodes — the ICI/DCN 'distance' between them.  Nodes in the same
+        tier-1 hypernode (same ICI slice) score tier 1; anything
+        unresolvable scores the virtual-root tier."""
+        return self.lca_tier_of_leaves(self.node_to_leaf.get(node_a),
+                                       self.node_to_leaf.get(node_b))
 
     def hypernodes_covering(self, nodes: Set[str]) -> List[str]:
         """All hypernodes whose real-node set covers *nodes*, sorted by

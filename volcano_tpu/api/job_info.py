@@ -203,6 +203,18 @@ class JobInfo:
         from volcano_tpu.api.types import NetworkTopologyMode
         return nt is not None and nt.mode == NetworkTopologyMode.HARD
 
+    def has_topology_constraint(self) -> bool:
+        """Job-level hard topology OR any subgroup with hard topology —
+        either routes allocation through the topology-domain search."""
+        from volcano_tpu.api.types import NetworkTopologyMode
+        if self.is_hard_topology():
+            return True
+        return any(
+            sub.network_topology is not None
+            and sub.network_topology.mode == NetworkTopologyMode.HARD
+            and sub.min_member > 0
+            for sub in self.sub_jobs.values())
+
     @property
     def min_resources(self) -> Resource:
         if self.podgroup and self.podgroup.min_resources:

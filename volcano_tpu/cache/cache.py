@@ -41,6 +41,14 @@ def register_device(name: str, factory: Callable[[NodeInfo], object]):
     REGISTERED_DEVICES[name] = factory
 
 
+# TPU is first-class: registered at module load so the very first
+# snapshot of a fresh process already carries device state (a lazy
+# plugin-import side effect would run AFTER the first snapshot).
+from volcano_tpu.api.devices.tpu.device_info import TPUDevices  # noqa: E402
+
+register_device("tpu", TPUDevices)
+
+
 class Snapshot:
     """One session's consistent view of the cluster."""
 

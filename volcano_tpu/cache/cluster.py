@@ -65,3 +65,21 @@ class Cluster(abc.ABC):
     @abc.abstractmethod
     def record_event(self, obj_key: str, reason: str, message: str) -> None:
         """Event recorder analogue."""
+
+    # -- controller surface -------------------------------------------
+
+    @abc.abstractmethod
+    def watch(self, fn) -> None:
+        """Register fn(kind, obj) for object change notifications."""
+
+    @abc.abstractmethod
+    def unwatch(self, fn) -> None:
+        """Detach a watcher registered with watch()."""
+
+    @abc.abstractmethod
+    def add_hypernode(self, hn: HyperNode) -> None:
+        """Create/update a HyperNode CR (discovery controller)."""
+
+    @abc.abstractmethod
+    def delete_hypernode(self, name: str) -> None:
+        """Delete a HyperNode CR."""

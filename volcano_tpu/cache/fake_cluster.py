@@ -84,12 +84,24 @@ class FakeCluster(Cluster):
             self.hypernodes[hn.name] = hn
         self._notify("hypernode", hn)
 
+    def delete_hypernode(self, name: str):
+        with self._lock:
+            hn = self.hypernodes.pop(name, None)
+        if hn:
+            self._notify("hypernode_deleted", hn)
+
     def add_priority_class(self, pc: PriorityClass):
         with self._lock:
             self.priority_classes[pc.name] = pc
 
     def watch(self, fn: Callable[[str, object], None]):
         self._watchers.append(fn)
+
+    def unwatch(self, fn: Callable[[str, object], None]):
+        try:
+            self._watchers.remove(fn)
+        except ValueError:
+            pass
 
     def _notify(self, kind: str, obj: object):
         for w in self._watchers:

@@ -1,0 +1,13 @@
+"""Controllers (reference: pkg/controllers).
+
+Each controller reconciles one CRD family against the Cluster.  The
+controller manager runs them on a shared loop (see
+volcano_tpu.controllers.framework).
+"""
+
+from volcano_tpu.controllers.framework import (
+    Controller, ControllerManager, register_controller, CONTROLLERS,
+)
+
+__all__ = ["Controller", "ControllerManager", "register_controller",
+           "CONTROLLERS"]

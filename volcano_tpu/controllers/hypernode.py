@@ -1,0 +1,96 @@
+"""HyperNode auto-discovery controller.
+
+Reference parity: pkg/controllers/hypernode (pluggable discovery.Manager
+with label/UFM providers).  The TPU-native discoverer reads GKE-style
+TPU node labels instead of an InfiniBand fabric manager
+(SURVEY.md §5 "TPU-native equivalent"):
+
+- tier 1: one HyperNode per TPU slice
+  (`cloud.google.com/gke-tpu-slice` label groups its hosts)
+- tier 2: one HyperNode per DCN pod/zone
+  (`volcano-tpu.io/dcn-pod`, falling back to
+  `topology.kubernetes.io/zone`) grouping the slices within it
+- non-TPU nodes and unlabeled nodes stay outside the tree (the
+  session's virtual root still covers them)
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import defaultdict
+from typing import Dict, List
+
+from volcano_tpu.api.hypernode import HyperNode
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.types import TPU_SLICE_LABEL
+from volcano_tpu.controllers.framework import Controller, register_controller
+
+log = logging.getLogger(__name__)
+
+DCN_POD_LABEL = "volcano-tpu.io/dcn-pod"
+ZONE_LABEL = "topology.kubernetes.io/zone"
+
+
+class LabelDiscoverer:
+    """Builds the desired HyperNode set from node labels."""
+
+    def discover(self, nodes: List[Node]) -> List[HyperNode]:
+        slices: Dict[str, List[str]] = defaultdict(list)
+        slice_pod: Dict[str, str] = {}
+        for node in nodes:
+            slice_name = node.labels.get(TPU_SLICE_LABEL)
+            if not slice_name:
+                continue
+            slices[slice_name].append(node.name)
+            pod = node.labels.get(DCN_POD_LABEL) or \
+                node.labels.get(ZONE_LABEL)
+            if pod:
+                slice_pod[slice_name] = pod
+
+        out: List[HyperNode] = []
+        pods: Dict[str, List[str]] = defaultdict(list)
+        for slice_name, members in sorted(slices.items()):
+            out.append(HyperNode.of_nodes(slice_name, 1, sorted(members),
+                                          tier_name="ici-slice"))
+            pod = slice_pod.get(slice_name)
+            if pod:
+                pods[pod].append(slice_name)
+        for pod, children in sorted(pods.items()):
+            out.append(HyperNode.of_children(pod, 2, sorted(children),
+                                             tier_name="dcn-pod"))
+        return out
+
+
+@register_controller("hypernode")
+class HyperNodeController(Controller):
+    name = "hypernode"
+
+    def __init__(self, discoverer=None):
+        self.discoverer = discoverer or LabelDiscoverer()
+
+    def sync(self) -> None:
+        snap = self.cluster.list_all()
+        desired = {hn.name: hn for hn in self.discoverer.discover(snap.nodes)}
+        existing = {hn.name: hn for hn in snap.hypernodes}
+
+        for name, hn in desired.items():
+            cur = existing.get(name)
+            if cur is None or _differs(cur, hn):
+                self.cluster.add_hypernode(hn)
+                log.debug("hypernode %s reconciled (tier %d, %d members)",
+                          name, hn.tier, len(hn.members))
+        # only GC hypernodes this controller owns (tier names we emit)
+        for name, hn in existing.items():
+            if name not in desired and hn.tier_name in ("ici-slice",
+                                                        "dcn-pod"):
+                self.cluster.delete_hypernode(name)
+
+    def on_event(self, kind: str, obj):
+        if kind in ("node", "node_deleted"):
+            self.sync()
+
+
+def _differs(a: HyperNode, b: HyperNode) -> bool:
+    members_a = sorted((m.kind, m.exact) for m in a.members)
+    members_b = sorted((m.kind, m.exact) for m in b.members)
+    return a.tier != b.tier or members_a != members_b

@@ -78,12 +78,18 @@ class Statement:
 
     def discard(self) -> None:
         """Roll back every recorded op in reverse order."""
-        for op in reversed(self.operations):
+        self.rollback_to(0)
+
+    def rollback_to(self, mark: int) -> None:
+        """Undo ops recorded after savepoint *mark* (= len(operations)
+        at save time) — lets per-subjob domain trials roll back without
+        losing earlier subjobs' placements."""
+        while len(self.operations) > mark:
+            op = self.operations.pop()
             if op.kind in (ALLOCATE, PIPELINE):
                 self.ssn.deallocate(op.task)
             elif op.kind == EVICT:
                 self.ssn.unevict(op.task, op.prev_status)
-        self.operations = []
 
     # -- dry-run support (topology domain search) ----------------------
 

@@ -13,3 +13,5 @@ import volcano_tpu.plugins.overcommit    # noqa: F401
 import volcano_tpu.plugins.predicates    # noqa: F401
 import volcano_tpu.plugins.nodeorder     # noqa: F401
 import volcano_tpu.plugins.binpack       # noqa: F401
+import volcano_tpu.plugins.deviceshare   # noqa: F401
+import volcano_tpu.plugins.topology      # noqa: F401

@@ -1,0 +1,43 @@
+"""Deviceshare plugin — bridges the device layer into filter + score.
+
+Reference parity: plugins/deviceshare/deviceshare.go:233-285 (Predicate
++ NodeOrder over api.Devices).  Importing this module registers the TPU
+device factory with the cache so every NodeInfo gets enriched.
+"""
+
+from __future__ import annotations
+
+from volcano_tpu.api.job_info import TaskInfo
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.framework.plugins import Plugin, register_plugin
+
+
+@register_plugin("deviceshare")
+class DeviceSharePlugin(Plugin):
+    name = "deviceshare"
+
+    def __init__(self, arguments=None):
+        super().__init__(arguments)
+        self.tpu_weight = float(self.arguments.get("deviceshare.tpu.weight", 1))
+
+    def on_session_open(self, ssn):
+        ssn.add_predicate_fn(self.name, self._predicate)
+        ssn.add_node_order_fn(self.name, self._score)
+
+    @staticmethod
+    def _predicate(task: TaskInfo, node: NodeInfo):
+        for dev in node.others.values():
+            if hasattr(dev, "has_device_request") and \
+                    dev.has_device_request(task):
+                status = dev.filter_node(task)
+                if status is not None:
+                    return status
+        return None
+
+    def _score(self, task: TaskInfo, node: NodeInfo) -> float:
+        total = 0.0
+        for dev in node.others.values():
+            if hasattr(dev, "has_device_request") and \
+                    dev.has_device_request(task):
+                total += self.tpu_weight * dev.score_node(task)
+        return total

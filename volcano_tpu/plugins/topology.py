@@ -1,0 +1,130 @@
+"""Network-topology-aware plugin — ICI/DCN locality scoring.
+
+Reference parity: plugins/network-topology-aware/
+network_topology_aware.go:40-62,285-327 (hypernode gradient scoring by
+tier + binpack weights; BatchNodeOrder pulling a job's tasks together).
+
+TPU semantics: tier 1 = one ICI slice (full mesh bandwidth), higher
+tiers = DCN hops.  Domain score prefers (a) the tightest tier that
+fits, (b) domains where the job already has tasks, (c) packed domains —
+keeping whole slices free for future gangs.  Node score pulls a job's
+remaining tasks toward the slice already hosting its placed tasks.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List
+
+from volcano_tpu.api.job_info import JobInfo, TaskInfo
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.framework.plugins import Plugin, register_plugin
+
+MAX_SCORE = 100.0
+
+
+@register_plugin("network-topology-aware")
+class NetworkTopologyAwarePlugin(Plugin):
+    name = "network-topology-aware"
+
+    def __init__(self, arguments=None):
+        super().__init__(arguments)
+        self.weight = float(self.arguments.get("weight", 1))
+        self.binpack_weight = float(
+            self.arguments.get("hypernode.binpack.weight", 1))
+        self.affinity_weight = float(
+            self.arguments.get("hypernode.affinity.weight", 2))
+
+    def on_session_open(self, ssn):
+        self.ssn = ssn
+        ssn.add_hyper_node_order_fn(self.name, self._hyper_node_order)
+        ssn.add_batch_node_order_fn(self.name, self._batch_node_order)
+
+    # -- domain scoring (for topology_alloc gradients) -----------------
+
+    def _hyper_node_order(self, job: JobInfo,
+                          candidates: List[str]) -> Dict[str, float]:
+        ssn = self.ssn
+        hns = ssn.hypernodes
+        scores: Dict[str, float] = {}
+        if hns is None:
+            return scores
+        max_tier = max(hns.tiers, default=1)
+
+        placed_nodes = {t.node_name for t in job.tasks.values()
+                        if t.node_name and t.occupies_resources()}
+        allocated_domains = {sub.allocated_hypernode
+                             for sub in job.sub_jobs.values()
+                             if sub.allocated_hypernode}
+
+        for name in candidates:
+            info = hns.members.get(name)
+            if info is None:
+                continue
+            score = 0.0
+            # tighter tier preferred
+            if max_tier > 1:
+                score += MAX_SCORE * (max_tier - info.tier) / (max_tier - 1)
+            # affinity: job already present in this domain
+            if name in allocated_domains or (placed_nodes & info.nodes):
+                score += self.affinity_weight * MAX_SCORE
+            # binpack: prefer domains already in use (keep empty slices whole)
+            score += self.binpack_weight * MAX_SCORE * \
+                self._domain_used_fraction(info)
+            scores[name] = self.weight * score
+        return scores
+
+    def _domain_used_fraction(self, info) -> float:
+        ssn = self.ssn
+        total = used = 0.0
+        for node_name in info.nodes:
+            node = ssn.nodes.get(node_name)
+            if node is None:
+                continue
+            # never mix units: a TPU host is measured in chips, a
+            # CPU-only host in millicores
+            cap = node.allocatable.get(TPU)
+            if cap > 0:
+                use = node.used.get(TPU)
+            else:
+                cap = node.allocatable.milli_cpu
+                use = node.used.milli_cpu
+            total += cap
+            used += use
+        return (used / total) if total else 0.0
+
+    # -- node scoring (keep the gang ICI-close) ------------------------
+
+    def _batch_node_order(self, task: TaskInfo,
+                          nodes: List[NodeInfo]) -> Dict[str, float]:
+        ssn = self.ssn
+        hns = ssn.hypernodes
+        scores: Dict[str, float] = {}
+        if hns is None:
+            return scores
+        job = ssn.jobs.get(task.job)
+        if job is None:
+            return scores
+        placed = [t.node_name for t in job.tasks.values()
+                  if t.node_name and t.occupies_resources()]
+        if not placed:
+            return scores
+        max_tier = max(hns.tiers, default=1) + 1
+        # group placed peers by their leaf hypernode: the LCA tier is a
+        # function of leaf pairs only, so cost drops from O(nodes x
+        # placed) to O(nodes x distinct leaves) with memoized pairs
+        placed_leaves = Counter(hns.leaf_of_node(p) for p in placed)
+
+        for node in nodes:
+            node_leaf = hns.leaf_of_node(node.name)
+            total_tier = 0.0
+            for leaf, count in placed_leaves.items():
+                total_tier += count * hns.lca_tier_of_leaves(node_leaf, leaf)
+            mean_tier = total_tier / len(placed)
+            if max_tier > 1:
+                closeness = (max_tier - mean_tier) / (max_tier - 1)
+            else:
+                closeness = 1.0
+            scores[node.name] = self.weight * MAX_SCORE * closeness
+        return scores

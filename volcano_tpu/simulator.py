@@ -1,0 +1,81 @@
+"""Simulated TPU cluster provisioning — the KWOK-analogue harness.
+
+Reference parity: benchmark/scripts/create-kwok-nodes.sh +
+create-hypernodes.sh (fake nodes + synthetic rack/spine topologies).
+Here fake nodes are fake TPU slice hosts: correct GKE-style labels,
+chips-per-host allocatable, worker ids and ICI coordinates, grouped
+into DCN pods — so gang + topology scheduling is exercised at
+hundreds-of-hosts scale with zero real machines (SURVEY.md §4.4).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.pod import Taint
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.types import (
+    TPU_COORDS_LABEL,
+    TPU_SLICE_LABEL,
+    TPU_TOPOLOGY_LABEL,
+    TPU_WORKER_ID_LABEL,
+)
+from volcano_tpu.api.devices.tpu.topology import SliceTopology, slice_for
+from volcano_tpu.cache.fake_cluster import FakeCluster
+from volcano_tpu.controllers.hypernode import DCN_POD_LABEL
+
+ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+
+
+def slice_nodes(slice_topo: SliceTopology, dcn_pod: str = "",
+                cpu_per_host: int = 112, mem_gi: int = 192) -> List[Node]:
+    """Materialize one slice as its host nodes with full TPU labels."""
+    nodes = []
+    for worker in range(slice_topo.num_hosts):
+        coords = slice_topo.host_coords(worker)
+        labels = {
+            TPU_SLICE_LABEL: slice_topo.name,
+            TPU_TOPOLOGY_LABEL: "x".join(str(d) for d in slice_topo.topology),
+            TPU_WORKER_ID_LABEL: str(worker),
+            TPU_COORDS_LABEL: ",".join(str(c) for c in coords),
+            ACCELERATOR_LABEL: slice_topo.accelerator,
+        }
+        if dcn_pod:
+            labels[DCN_POD_LABEL] = dcn_pod
+        nodes.append(Node(
+            name=f"{slice_topo.name}-w{worker}",
+            labels=labels,
+            allocatable={"cpu": cpu_per_host, "memory": f"{mem_gi}Gi",
+                         TPU: slice_topo.chips_per_host, "pods": 110},
+        ))
+    return nodes
+
+
+def make_tpu_cluster(
+        slices: Sequence[Tuple[str, str]],
+        dcn_pods: Optional[Dict[str, str]] = None,
+        extra_nodes: Sequence[Node] = (),
+        discover_topology: bool = True) -> FakeCluster:
+    """Build a FakeCluster of TPU slices.
+
+    slices: [(slice_name, kind)] with kind from topology.WELL_KNOWN
+    (e.g. ("slice-a", "v5e-256")).  dcn_pods maps slice name -> DCN pod
+    name (defaults to one shared pod "dcn-0").  When discover_topology,
+    the hypernode controller runs once so the topology tree exists.
+    """
+    cluster = FakeCluster()
+    for name, kind in slices:
+        topo = slice_for(name, kind)
+        pod = (dcn_pods or {}).get(name, "dcn-0")
+        for node in slice_nodes(topo, dcn_pod=pod):
+            cluster.add_node(node)
+    for node in extra_nodes:
+        cluster.add_node(node)
+
+    if discover_topology:
+        from volcano_tpu.controllers.hypernode import HyperNodeController
+        ctrl = HyperNodeController()
+        ctrl.initialize(cluster)
+        ctrl.sync()
+    return cluster
