@@ -1,0 +1,126 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Headline metric (BASELINE.md): p50 gang-allocate latency for a
+256-host vcjob onto a simulated TPU slice (driver target < 2s), plus
+chip utilization under 2-queue contention (target >= 0.95) in the same
+line.  vs_baseline = target_latency / measured_p50 (>1 beats target).
+
+Mirrors the reference's benchmark/ KWOK harness: fake slice hosts,
+real scheduler, wall-clock latency of the full scheduling cycle
+(snapshot -> enqueue -> allocate -> bind flush).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+
+
+BENCH_CONF = {
+    "actions": "enqueue, allocate, backfill",
+    "tiers": [
+        {"plugins": [{"name": "priority"}, {"name": "gang"},
+                     {"name": "conformance"}]},
+        {"plugins": [{"name": "overcommit"}, {"name": "drf"},
+                     {"name": "predicates"}, {"name": "proportion"},
+                     {"name": "nodeorder"}, {"name": "binpack"},
+                     {"name": "deviceshare"},
+                     {"name": "network-topology-aware"}]},
+    ],
+}
+
+TARGET_P50_S = 2.0
+TRIALS = 12
+
+
+def bench_gang_allocate_latency() -> float:
+    """p50 wall-clock of one full cycle placing a 256-host gang onto a
+    v5p-1024 slice (256 hosts x 4 chips) amid competing slices."""
+    from volcano_tpu.api.podgroup import NetworkTopologySpec
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.api.types import NetworkTopologyMode
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.simulator import make_tpu_cluster
+    from volcano_tpu.uthelper import gang_job
+
+    latencies = []
+    for trial in range(TRIALS):
+        cluster = make_tpu_cluster([
+            ("target", "v5p-1024"),     # 256 hosts
+            ("noise-a", "v5e-256"),     # 64 hosts
+            ("noise-b", "v5e-64"),      # 16 hosts
+        ])
+        pg, pods = gang_job(
+            f"train-{trial}", replicas=256, requests={"cpu": 8, TPU: 4},
+            network_topology=NetworkTopologySpec(
+                NetworkTopologyMode.HARD, 1))
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+        sched = Scheduler(cluster, conf=BENCH_CONF, schedule_period=0)
+        t0 = time.perf_counter()
+        sched.run_once()
+        dt = time.perf_counter() - t0
+        assert len(cluster.binds) == 256, \
+            f"gang did not fully place: {len(cluster.binds)}/256"
+        latencies.append(dt)
+    return statistics.median(latencies)
+
+
+def bench_utilization_under_contention() -> float:
+    """Two queues (3:1) flooding a 2-slice cluster with gang jobs sized
+    to their shares; steady-state chip utilization after 4 cycles."""
+    from volcano_tpu.api.queue import Queue
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.simulator import make_tpu_cluster
+    from volcano_tpu.uthelper import gang_job
+    from volcano_tpu.api.types import TaskStatus
+
+    cluster = make_tpu_cluster([("sa", "v5e-64"), ("sb", "v5e-64")])
+    total_chips = 2 * 64  # 2 slices x 16 hosts x 4 chips
+    cluster.add_queue(Queue(name="prod", weight=3))
+    cluster.add_queue(Queue(name="dev", weight=1))
+    # prod: 6 jobs x 4 hosts; dev: 6 jobs x 2 hosts -> demand 144 chips
+    # over 128 available => sustained contention
+    jobs = [("prod", 4, 6), ("dev", 2, 6)]
+    for queue, hosts, count in jobs:
+        for i in range(count):
+            pg, pods = gang_job(f"{queue}-j{i}", queue=queue,
+                                replicas=hosts,
+                                requests={"cpu": 8, TPU: 4})
+            cluster.add_podgroup(pg)
+            for p in pods:
+                cluster.add_pod(p)
+
+    sched = Scheduler(cluster, conf=BENCH_CONF, schedule_period=0)
+    for _ in range(4):
+        sched.run_once()
+        cluster.tick()
+
+    used = sum(
+        p.resource_requests().get(TPU) for p in cluster.pods.values()
+        if p.node_name and p.phase in (TaskStatus.RUNNING, TaskStatus.BOUND))
+    return used / total_chips
+
+
+def main():
+    p50 = bench_gang_allocate_latency()
+    utilization = bench_utilization_under_contention()
+    print(json.dumps({
+        "metric": "p50_gang_allocate_latency_256host_v5p1024",
+        "value": round(p50, 4),
+        "unit": "s",
+        "vs_baseline": round(TARGET_P50_S / p50, 2),
+        "extra": {
+            "chip_utilization_under_contention": round(utilization, 4),
+            "utilization_target": 0.95,
+            "trials": TRIALS,
+            "cluster_hosts": 256 + 64 + 16,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,11 +2,23 @@ import os
 import sys
 
 # Workload tests run on a virtual 8-device CPU mesh; must be set before
-# jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# jax is imported anywhere in the test process.  Force cpu even when the
+# environment points JAX at a real accelerator (JAX_PLATFORMS=axon) —
+# multi-device sharding tests need 8 virtual devices, not 1 real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image's sitecustomize force-registers the axon TPU platform via
+# jax.config, which overrides the env var — override it back before any
+# backend initialization.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # pragma: no cover - jax-less environments
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -89,6 +89,10 @@ def _allocate_whole_job(ssn, queue, job: JobInfo) -> bool:
                 log.debug("topology job %s committed into domain %s",
                           job.key, domain_name)
                 return True
+            if ssn.job_pipelined(job):
+                # gang becomes ready once this domain's releasing
+                # resources free up — keep the reservations in-session
+                return True
             stmt.discard()
 
     return _fail(ssn, job)
@@ -108,15 +112,19 @@ def _allocate_per_subjob(ssn, queue, job: JobInfo,
                      key=_cmp_key(ssn))
 
     for sub in ordered:
-        if not any(t.status is TaskStatus.PENDING and not t.best_effort
-                   for t in sub.tasks.values()):
+        pending = [t for t in sub.tasks.values()
+                   if t.status is TaskStatus.PENDING and not t.best_effort]
+        if not pending:
             continue  # nothing to place; keep its allocated_hypernode
         nt = sub.network_topology or job.network_topology
         max_tier = nt.highest_tier_allowed if nt else None
         placed = False
         gradients = candidate_domains(ssn, job, max_tier=max_tier)
-        if sub.nominated_hypernode:
-            gradients.insert(0, [sub.nominated_hypernode])
+        # sticky placement: an already-allocated subgroup scales up in
+        # its own domain first; nominations next
+        for pinned in (sub.nominated_hypernode, sub.allocated_hypernode):
+            if pinned:
+                gradients.insert(0, [pinned])
         for gradient in gradients:
             for domain_name in gradient:
                 nodes = _domain_nodes(ssn, domain_name)
@@ -126,7 +134,10 @@ def _allocate_per_subjob(ssn, queue, job: JobInfo,
                 AllocateAction._allocate_tasks(
                     ssn, queue, job, stmt, nodes, record_errors=False,
                     task_filter=lambda t, s=sub: t.sub_job == s.name)
-                if sub.is_ready() or sub.is_pipelined():
+                # a domain counts only if it actually took new tasks
+                # (a satisfied gang floor must not claim a full domain)
+                if len(stmt.operations) > mark and \
+                        (sub.is_ready() or sub.is_pipelined()):
                     chosen[sub.name] = domain_name
                     placed = True
                     break
@@ -134,21 +145,29 @@ def _allocate_per_subjob(ssn, queue, job: JobInfo,
             if placed:
                 break
         if not placed:
+            if sub.is_ready():
+                continue  # floor already met; extras wait for capacity
             stmt.discard()
             return _fail(ssn, job, subjob=sub.name)
 
-    # remaining tasks (no subgroup) may go anywhere in the cluster
+    # tasks outside any policed subgroup may go anywhere in the cluster
+    policed = {s.name for s in sub_jobs}
     AllocateAction._allocate_tasks(
         ssn, queue, job, stmt, list(ssn.nodes.values()),
-        record_errors=False, task_filter=lambda t: not t.sub_job)
+        record_errors=False, task_filter=lambda t: t.sub_job not in policed)
 
     if ssn.job_ready(job):
         for sub in job.sub_jobs.values():
-            if sub.name in chosen:
+            if sub.name in chosen and not sub.allocated_hypernode:
                 sub.allocated_hypernode = chosen[sub.name]
+            if sub.name in chosen:
                 sub.nominated_hypernode = ""
         stmt.commit()
         log.debug("multi-slice job %s committed: %s", job.key, chosen)
+        return True
+    if ssn.job_pipelined(job):
+        # keep in-session reservations on releasing resources, exactly
+        # like the non-topology path (allocate.py _finish)
         return True
     stmt.discard()
     return _fail(ssn, job)

@@ -9,5 +9,9 @@ from volcano_tpu.controllers.framework import (
     Controller, ControllerManager, register_controller, CONTROLLERS,
 )
 
+# import controller modules so their @register_controller side effects
+# run (reference: controller registry blank imports)
+import volcano_tpu.controllers.hypernode  # noqa: E402,F401
+
 __all__ = ["Controller", "ControllerManager", "register_controller",
            "CONTROLLERS"]

@@ -76,23 +76,24 @@ class NetworkTopologyAwarePlugin(Plugin):
         return scores
 
     def _domain_used_fraction(self, info) -> float:
+        """Mean per-node used fraction — each node contributes its own
+        unit-consistent fraction (chips for TPU hosts, millicores for
+        CPU hosts) so mixed domains aren't dominated by one unit."""
         ssn = self.ssn
-        total = used = 0.0
+        fracs = []
         for node_name in info.nodes:
             node = ssn.nodes.get(node_name)
             if node is None:
                 continue
-            # never mix units: a TPU host is measured in chips, a
-            # CPU-only host in millicores
             cap = node.allocatable.get(TPU)
             if cap > 0:
                 use = node.used.get(TPU)
             else:
                 cap = node.allocatable.milli_cpu
                 use = node.used.milli_cpu
-            total += cap
-            used += use
-        return (used / total) if total else 0.0
+            if cap > 0:
+                fracs.append(min(1.0, use / cap))
+        return sum(fracs) / len(fracs) if fracs else 0.0
 
     # -- node scoring (keep the gang ICI-close) ------------------------
 

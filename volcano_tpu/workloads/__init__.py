@@ -1,0 +1,14 @@
+"""JAX validation workloads — what this framework schedules.
+
+The reference's job plugins bootstrap pytorch DDP / Horovod workers
+(MASTER_ADDR, hostfiles); the TPU-native equivalent bootstraps JAX
+processes from the env the jax job plugin injects and runs pjit/XLA
+training steps over a `jax.sharding.Mesh`.  This package is the
+workload side of that contract: a flagship transformer LM with
+dp/fsdp/tp/sp parallelism (ring attention for sequence parallel), used
+by e2e tests, `__graft_entry__.py`, and benchmarks.
+
+Reference parity note: volcano has no in-repo compute (SURVEY.md §2.12);
+this package corresponds to its e2e workload images
+(test/e2e/jobseq/pytorch_plugin.go etc.), built TPU-first.
+"""

@@ -1,0 +1,66 @@
+"""JAX distributed bootstrap from scheduler-injected environment.
+
+The jax job plugin (volcano_tpu.controllers.job.plugins.jax_plugin)
+injects into every worker pod:
+
+    TPU_WORKER_ID        - this worker's index within the slice
+    TPU_WORKER_HOSTNAMES - comma-separated worker hostnames
+    COORDINATOR_ADDRESS  - host:port of process 0 for jax.distributed
+    NUM_PROCESSES        - total process count
+
+This module is the consumer side (reference contract analogue:
+pytorch plugin's MASTER_ADDR/RANK/WORLD_SIZE, pytorch.go:46-52).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional
+
+ENV_WORKER_ID = "TPU_WORKER_ID"
+ENV_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
+ENV_COORDINATOR = "COORDINATOR_ADDRESS"
+ENV_NUM_PROCESSES = "NUM_PROCESSES"
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclass
+class BootstrapInfo:
+    process_id: int = 0
+    num_processes: int = 1
+    coordinator_address: str = ""
+    hostnames: Optional[List[str]] = None
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+
+def from_env(environ=None) -> BootstrapInfo:
+    env = os.environ if environ is None else environ
+    hostnames = [h for h in env.get(ENV_HOSTNAMES, "").split(",") if h]
+    num = int(env.get(ENV_NUM_PROCESSES, len(hostnames) or 1))
+    coordinator = env.get(ENV_COORDINATOR, "")
+    if not coordinator and hostnames:
+        coordinator = f"{hostnames[0]}:{DEFAULT_COORDINATOR_PORT}"
+    return BootstrapInfo(
+        process_id=int(env.get(ENV_WORKER_ID, 0)),
+        num_processes=num,
+        coordinator_address=coordinator,
+        hostnames=hostnames or None,
+    )
+
+
+def initialize(environ=None) -> BootstrapInfo:
+    """Call jax.distributed.initialize from the injected env (no-op for
+    single-process)."""
+    info = from_env(environ)
+    if info.is_distributed:
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=info.coordinator_address,
+            num_processes=info.num_processes,
+            process_id=info.process_id,
+        )
+    return info

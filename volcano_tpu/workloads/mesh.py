@@ -1,0 +1,83 @@
+"""Device mesh construction for the workload layer.
+
+Axes follow the scaling-book convention:
+  dp    - data parallel (pure replication of params, sharded batch)
+  fsdp  - fully-sharded data parallel (params sharded, batch sharded)
+  tp    - tensor parallel (params + activations sharded on hidden dims)
+  sp    - sequence/context parallel (ring attention over seq dim)
+
+On a real slice, axis order maps the fastest-communicating axes (tp,
+sp) onto ICI-adjacent devices; dp/fsdp ride the outer mesh dims (and
+DCN for multi-slice).  jax.make_mesh handles physical device ordering.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh
+
+AXES = ("dp", "fsdp", "tp", "sp")
+
+
+def choose_axis_sizes(n_devices: int,
+                      tp: Optional[int] = None,
+                      sp: Optional[int] = None,
+                      fsdp: Optional[int] = None) -> Dict[str, int]:
+    """Pick a sensible 4-axis factorization of n_devices.
+
+    Defaults: tp up to 4 (intra-host ICI), sp up to 2 when devices
+    remain, rest into fsdp; dp=1 (fsdp subsumes it) unless forced.
+    """
+    remaining = n_devices
+
+    def take(want, pow2_only=False):
+        nonlocal remaining
+        size = 1
+        candidates = [want] if want else []
+        if pow2_only:
+            # model dims (heads, hidden) are powers of two; tp/sp must
+            # divide them, so restrict auto-picked sizes to powers of 2
+            candidates += [c for c in (4, 2, 1) if c <= remaining]
+        else:
+            candidates += list(range(remaining, 0, -1))
+        for cand in candidates:
+            if cand and remaining % cand == 0:
+                size = cand
+                break
+        remaining //= size
+        return size
+
+    tp_size = take(tp, pow2_only=tp is None)
+    sp_size = take(sp if sp is not None
+                   else (2 if remaining % 2 == 0 else 1))
+    # fsdp shards parameter dims, so it too must divide power-of-two
+    # model dims: absorb every remaining factor of 2; any awkward odd
+    # factor lands on dp, which only shards the batch (whose size the
+    # caller controls).
+    if fsdp is not None:
+        fsdp_size = take(fsdp)
+    else:
+        fsdp_size = 1
+        while remaining % 2 == 0:
+            fsdp_size *= 2
+            remaining //= 2
+    dp_size = remaining  # whatever is left
+    return {"dp": dp_size, "fsdp": fsdp_size, "tp": tp_size, "sp": sp_size}
+
+
+def make_mesh(axis_sizes: Optional[Dict[str, int]] = None,
+              devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if axis_sizes is None:
+        axis_sizes = choose_axis_sizes(len(devices))
+    shape = tuple(axis_sizes.get(a, 1) for a in AXES)
+    total = 1
+    for s in shape:
+        total *= s
+    if total != len(devices):
+        raise ValueError(f"axis sizes {axis_sizes} != {len(devices)} devices")
+    import numpy as np
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, AXES)

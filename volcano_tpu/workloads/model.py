@@ -1,0 +1,195 @@
+"""Flagship validation workload: decoder-only transformer LM, pure JAX.
+
+TPU-first design choices:
+- all heavy math is batched matmul (MXU-friendly), bfloat16 activations
+  with float32 accumulation knobs via cfg.dtype;
+- params are a flat pytree with a sharding rule per leaf so pjit can
+  lay them out over a ("dp","fsdp","tp","sp") mesh (megatron-style
+  column/row splits for attention and SwiGLU MLP);
+- sequence parallelism via ring attention (shard_map over the sp axis);
+- optional jax.checkpoint (remat) per block to trade FLOPs for HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from volcano_tpu.workloads.ring_attention import (
+    local_causal_attention,
+    ring_attention,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    d_ff: int = 1408
+    max_seq: int = 2048
+    dtype: Any = jnp.bfloat16
+    use_ring_attention: bool = False
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def tiny_config(**overrides) -> ModelConfig:
+    base = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                d_ff=128, max_seq=128, dtype=jnp.float32, remat=False)
+    base.update(overrides)
+    return ModelConfig(**base)
+
+
+# -- parameters -------------------------------------------------------
+
+def init_params(rng, cfg: ModelConfig) -> Dict[str, Any]:
+    keys = jax.random.split(rng, 2 + cfg.n_layers)
+    scale = cfg.d_model ** -0.5
+    params: Dict[str, Any] = {
+        "embed": jax.random.normal(keys[0], (cfg.vocab_size, cfg.d_model)) * scale,
+        "final_norm": jnp.ones((cfg.d_model,)),
+        "head": jax.random.normal(keys[1], (cfg.d_model, cfg.vocab_size)) * scale,
+        "blocks": [],
+    }
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[2 + i], 7)
+        d, f = cfg.d_model, cfg.d_ff
+        params["blocks"].append({
+            "attn_norm": jnp.ones((d,)),
+            "wq": jax.random.normal(k[0], (d, d)) * scale,
+            "wk": jax.random.normal(k[1], (d, d)) * scale,
+            "wv": jax.random.normal(k[2], (d, d)) * scale,
+            "wo": jax.random.normal(k[3], (d, d)) * scale,
+            "mlp_norm": jnp.ones((d,)),
+            "w_gate": jax.random.normal(k[4], (d, f)) * scale,
+            "w_up": jax.random.normal(k[5], (d, f)) * scale,
+            "w_down": jax.random.normal(k[6], (f, d)) * (f ** -0.5),
+        })
+    return params
+
+
+_PARAM_SPECS = {
+    "embed": P("tp", "fsdp"),
+    "final_norm": P(None),
+    "head": P("fsdp", "tp"),
+    "attn_norm": P(None),
+    "wq": P("fsdp", "tp"),
+    "wk": P("fsdp", "tp"),
+    "wv": P("fsdp", "tp"),
+    "wo": P("tp", "fsdp"),
+    "mlp_norm": P(None),
+    "w_gate": P("fsdp", "tp"),
+    "w_up": P("fsdp", "tp"),
+    "w_down": P("tp", "fsdp"),
+}
+
+
+def param_specs(params) -> Any:
+    """PartitionSpec pytree matching init_params' structure."""
+    def spec_of(path, _leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        return _PARAM_SPECS.get(name, P(None))
+    return jax.tree_util.tree_map_with_path(spec_of, params)
+
+
+def param_shardings(params, mesh: Mesh):
+    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                        param_specs(params))
+
+
+# -- forward ----------------------------------------------------------
+
+def _rms_norm(x, scale, eps=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
+
+
+def _rotary(x, positions):
+    """Rotary position embedding; x: [b, t, h, d]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.exp(-jnp.arange(0, half, dtype=jnp.float32)
+                    * (jnp.log(10000.0) / half))
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # b,t,1,half
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def _attention(x, blk, cfg: ModelConfig, positions, mesh: Optional[Mesh]):
+    b, t, d = x.shape
+    q = (x @ blk["wq"].astype(x.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    k = (x @ blk["wk"].astype(x.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    v = (x @ blk["wv"].astype(x.dtype)).reshape(b, t, cfg.n_heads, cfg.head_dim)
+    q = _rotary(q, positions)
+    k = _rotary(k, positions)
+
+    if cfg.use_ring_attention and mesh is not None and \
+            mesh.shape.get("sp", 1) > 1:
+        attn = jax.shard_map(
+            functools.partial(ring_attention, axis_name="sp"),
+            mesh=mesh,
+            in_specs=(P(("dp", "fsdp"), "sp", "tp", None),) * 3,
+            out_specs=P(("dp", "fsdp"), "sp", "tp", None),
+            check_vma=False,
+        )
+        o = attn(q, k, v)
+    else:
+        o = local_causal_attention(q, k, v)
+    return o.reshape(b, t, d) @ blk["wo"].astype(x.dtype)
+
+
+def _mlp(x, blk):
+    gate = jax.nn.silu(x @ blk["w_gate"].astype(x.dtype))
+    up = x @ blk["w_up"].astype(x.dtype)
+    return (gate * up) @ blk["w_down"].astype(x.dtype)
+
+
+def _block(x, blk, cfg: ModelConfig, positions, mesh):
+    x = x + _attention(_rms_norm(x, blk["attn_norm"]), blk, cfg,
+                       positions, mesh)
+    x = x + _mlp(_rms_norm(x, blk["mlp_norm"]), blk)
+    return x
+
+
+def forward(params, tokens, cfg: ModelConfig,
+            mesh: Optional[Mesh] = None) -> jnp.ndarray:
+    """tokens [b, t] -> logits [b, t, vocab]."""
+    b, t = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+
+    block_fn = _block
+    if cfg.remat:
+        block_fn = jax.checkpoint(
+            _block, static_argnums=(2, 4),
+            policy=jax.checkpoint_policies.nothing_saveable)
+    for blk in params["blocks"]:
+        x = block_fn(x, blk, cfg, positions, mesh)
+
+    x = _rms_norm(x, params["final_norm"])
+    return (x @ params["head"].astype(cfg.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: ModelConfig,
+            mesh: Optional[Mesh] = None) -> jnp.ndarray:
+    """Next-token cross entropy; batch: {"tokens": [b, t]}."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens, cfg, mesh)
+    targets = jnp.roll(tokens, -1, axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # last position predicts the rolled-around token: mask it out
+    mask = jnp.ones_like(nll).at[:, -1].set(0.0)
+    return jnp.sum(nll * mask) / jnp.sum(mask)

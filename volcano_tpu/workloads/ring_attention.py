@@ -1,0 +1,98 @@
+"""Ring attention — sequence-parallel causal attention over ICI.
+
+Blockwise attention (flash-style online softmax) where each device in
+the `sp` mesh axis holds one sequence block of Q/K/V; K/V blocks rotate
+around the ring via `ppermute` so every Q block eventually sees every
+K/V block while only ever holding 1/sp of the sequence in memory.
+After sp steps the ring returns K/V to their owners.
+
+This is the TPU-native long-context mechanism (papers: Liu et al. ring
+attention; see PAPERS.md): the per-hop transfer rides neighbor ICI
+links, overlapping with the local attention compute.
+
+Used inside shard_map with specs like
+  q,k,v: P(("dp","fsdp"), "sp", "tp", None)   # [batch, seq, heads, dh]
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, q_start, k_start, scale, causal):
+    """One (q-block x kv-block) attention contribution.
+
+    q: [b, tq, h, d]; k,v: [b, tk, h, d].  Returns (scores-based
+    partials) o_partial [b, tq, h, d], row max m [b, tq, h], row sum l.
+    """
+    s = jnp.einsum("bqhd,bkhd->bqhk", q, k) * scale  # [b, tq, h, tk]
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        q_pos = q_start + jnp.arange(tq)[:, None]
+        k_pos = k_start + jnp.arange(tk)[None, :]
+        mask = (q_pos >= k_pos)[None, :, None, :]
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)                           # [b, tq, h]
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)           # fully-masked rows
+    l = jnp.sum(p, axis=-1)                           # [b, tq, h]
+    o = jnp.einsum("bqhk,bkhd->bqhd", p, v)
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True):
+    """Causal ring attention inside shard_map.
+
+    q, k, v: [b, t_local, h, d] — the local sequence block.
+    Returns [b, t_local, h, d].
+    """
+    sp = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+
+    b, t, h, d = q.shape
+    o_acc = jnp.zeros_like(q, dtype=jnp.float32)
+    m_acc = jnp.full((b, t, h), NEG_INF, dtype=jnp.float32)
+    l_acc = jnp.zeros((b, t, h), dtype=jnp.float32)
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def body(i, carry):
+        o_acc, m_acc, l_acc, k_blk, v_blk = carry
+        # after i rotations we hold the block originally on (my_idx - i)
+        kv_idx = (my_idx - i) % sp
+        o, m, l = _block_attn(q, k_blk, v_blk,
+                              q_start=my_idx * t_local,
+                              k_start=kv_idx * t_local,
+                              scale=scale, causal=causal)
+        m_new = jnp.maximum(m_acc, m)
+        corr = jnp.exp(m_acc - m_new)
+        p_corr = jnp.exp(m - m_new)
+        l_new = l_acc * corr + l * p_corr
+        o_new = (o_acc * corr[..., None]
+                 + o.astype(jnp.float32) * p_corr[..., None])
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return o_new, m_new, l_new, k_nxt, v_nxt
+
+    o_acc, m_acc, l_acc, _, _ = lax.fori_loop(
+        0, sp, body, (o_acc, m_acc, l_acc, k, v))
+    safe_l = jnp.where(l_acc == 0.0, 1.0, l_acc)
+    return (o_acc / safe_l[..., None]).astype(q.dtype)
+
+
+def local_causal_attention(q, k, v):
+    """Plain causal attention (no sequence parallelism)."""
+    o, m, l = _block_attn(q, k, v, 0, 0,
+                          1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype),
+                          causal=True)
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return (o / safe_l[..., None]).astype(q.dtype)
