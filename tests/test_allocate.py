@@ -61,8 +61,12 @@ def test_tpu_resource_dimension_gates_fit():
 
 
 def test_enqueue_gates_oversized_jobs():
-    """A job larger than cluster capacity never leaves Pending."""
+    """A job whose declared minResources exceed cluster capacity never
+    leaves Pending (jobs without minResources always admit, matching
+    the reference's 'MinResources == nil => Permit')."""
+    from volcano_tpu.api.resource import Resource
     pg, pods = gang_job("big", replicas=4, requests={"cpu": 100})
+    pg.min_resources = Resource({"cpu": 400_000})
     ctx = TestContext(nodes=nodes(2), podgroups=[pg], pods=pods)
     ctx.run()
     ctx.expect_bind_num(0)

@@ -41,7 +41,11 @@ def test_ready_and_pipelined_counting():
     assert job.waiting_task_num() == 1
     assert not job.is_ready()
     assert job.is_pipelined()          # 2 ready + 1 pipelined >= 3
-    assert job.is_starving()           # 4 valid >= 3 but not ready
+    # pipelined reservations count against starvation (job_info.go:1210)
+    assert not job.is_starving()
+    job.update_task_status(job.tasks_in_status(TaskStatus.PIPELINED)[0],
+                           TaskStatus.PENDING)
+    assert job.is_starving()           # 2 ready + 0 waiting < 3
 
 
 def test_update_task_status_moves_index():

@@ -1,0 +1,215 @@
+"""Preempt / reclaim / capacity / gangpreempt scenarios.
+
+Mirrors reference preempt_test.go / reclaim_test.go /
+gangpreempt_test.go via the uthelper harness.
+"""
+
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.podgroup import NetworkTopologySpec
+from volcano_tpu.api.queue import Queue
+from volcano_tpu.api.resource import Resource, TPU
+from volcano_tpu.api.types import (
+    NetworkTopologyMode,
+    PodGroupPhase,
+    TaskStatus,
+)
+from volcano_tpu.cache.cluster import PriorityClass
+from volcano_tpu.simulator import make_tpu_cluster
+from volcano_tpu.uthelper import TestContext, gang_job
+
+PREEMPT_CONF = {
+    "actions": "enqueue, allocate, preempt, backfill",
+    "tiers": [
+        {"plugins": [{"name": "priority"}, {"name": "gang"},
+                     {"name": "conformance"}]},
+        {"plugins": [{"name": "drf"}, {"name": "predicates"},
+                     {"name": "proportion"}, {"name": "nodeorder"}]},
+    ],
+}
+
+RECLAIM_CONF = {
+    "actions": "enqueue, allocate, reclaim, backfill",
+    "tiers": [
+        {"plugins": [{"name": "priority"}, {"name": "gang"},
+                     {"name": "conformance"}]},
+        {"plugins": [{"name": "drf"}, {"name": "predicates"},
+                     {"name": "proportion"}, {"name": "nodeorder"}]},
+    ],
+}
+
+
+def nodes(n, cpu="8"):
+    return [Node(name=f"n{i}", allocatable={"cpu": cpu, "pods": 110})
+            for i in range(n)]
+
+
+def test_high_priority_job_preempts_low():
+    """Cluster full of low-priority work; starving high-priority job
+    evicts victims and pipelines onto them."""
+    pg_lo, pods_lo = gang_job("lo", replicas=4, min_available=2,
+                              requests={"cpu": 4},
+                              running_on=["n0", "n1"],
+                              pg_phase=PodGroupPhase.RUNNING)
+    pg_hi, pods_hi = gang_job("hi", replicas=1, requests={"cpu": 4},
+                              priority_class="high",
+                              pg_phase=PodGroupPhase.INQUEUE)
+    ctx = TestContext(nodes=nodes(2), podgroups=[pg_lo, pg_hi],
+                      pods=pods_lo + pods_hi, conf=PREEMPT_CONF,
+                      priority_classes=[PriorityClass("high", 1000)])
+    ctx.run()
+    ctx.expect_evict_num(1)
+    assert ctx.cluster.evictions[0].startswith("default/lo")
+
+
+def test_preempt_respects_gang_floor_of_victim():
+    """Victim job has exactly minAvailable tasks -> gang guard vetoes."""
+    pg_lo, pods_lo = gang_job("lo", replicas=2, min_available=2,
+                              requests={"cpu": 4},
+                              running_on=["n0", "n1"],
+                              pg_phase=PodGroupPhase.RUNNING)
+    pg_hi, pods_hi = gang_job("hi", replicas=1, requests={"cpu": 4},
+                              priority_class="high",
+                              pg_phase=PodGroupPhase.INQUEUE)
+    ctx = TestContext(nodes=nodes(2), podgroups=[pg_lo, pg_hi],
+                      pods=pods_lo + pods_hi, conf=PREEMPT_CONF,
+                      priority_classes=[PriorityClass("high", 1000)])
+    ctx.run()
+    ctx.expect_evict_num(0)
+
+
+def test_preempt_never_touches_critical_pods():
+    pg_lo, pods_lo = gang_job("lo", namespace="kube-system", replicas=4,
+                              min_available=2, requests={"cpu": 4},
+                              running_on=["n0", "n1"],
+                              pg_phase=PodGroupPhase.RUNNING)
+    pg_hi, pods_hi = gang_job("hi", replicas=1, requests={"cpu": 4},
+                              priority_class="high",
+                              pg_phase=PodGroupPhase.INQUEUE)
+    ctx = TestContext(nodes=nodes(2), podgroups=[pg_lo, pg_hi],
+                      pods=pods_lo + pods_hi, conf=PREEMPT_CONF,
+                      priority_classes=[PriorityClass("high", 1000)])
+    ctx.run()
+    ctx.expect_evict_num(0)
+
+
+def test_reclaim_across_queues():
+    """Queue B (weight 1) holds the whole cluster; queue A (weight 3)
+    arrives starving -> reclaim evicts B's surplus."""
+    q_a, q_b = Queue(name="qa", weight=3), Queue(name="qb", weight=1)
+    pg_b, pods_b = gang_job("jb", queue="qb", replicas=4, min_available=1,
+                            requests={"cpu": 4},
+                            running_on=["n0", "n1"],
+                            pg_phase=PodGroupPhase.RUNNING)
+    pg_a, pods_a = gang_job("ja", queue="qa", replicas=2, min_available=2,
+                            requests={"cpu": 4},
+                            pg_phase=PodGroupPhase.INQUEUE)
+    ctx = TestContext(nodes=nodes(2), queues=[q_a, q_b],
+                      podgroups=[pg_b, pg_a], pods=pods_b + pods_a,
+                      conf=RECLAIM_CONF)
+    ctx.run()
+    assert len(ctx.cluster.evictions) >= 1
+    assert all(k.startswith("default/jb") for k in ctx.cluster.evictions)
+    # after the kubelet finishes the eviction, the starving job lands
+    ctx.cluster.tick()   # releasing -> deleted
+    ctx.cluster.tick()
+    ctx.run()
+    assert sum(1 for k, _ in ctx.cluster.binds
+               if k.startswith("default/ja")) == 2
+
+
+def test_reclaim_respects_unreclaimable_queue():
+    q_a = Queue(name="qa", weight=3)
+    q_b = Queue(name="qb", weight=1, reclaimable=False)
+    pg_b, pods_b = gang_job("jb", queue="qb", replicas=4, min_available=1,
+                            requests={"cpu": 4},
+                            running_on=["n0", "n1"],
+                            pg_phase=PodGroupPhase.RUNNING)
+    pg_a, pods_a = gang_job("ja", queue="qa", replicas=2,
+                            requests={"cpu": 4},
+                            pg_phase=PodGroupPhase.INQUEUE)
+    ctx = TestContext(nodes=nodes(2), queues=[q_a, q_b],
+                      podgroups=[pg_b, pg_a], pods=pods_b + pods_a,
+                      conf=RECLAIM_CONF)
+    ctx.run()
+    ctx.expect_evict_num(0)
+
+
+def test_capacity_hierarchical_queues():
+    """Children capped by parent deserved: eng (16 cpu) splits into
+    ml (12) + web (4); web cannot exceed 4 even with cluster idle."""
+    conf = {
+        "actions": "enqueue, allocate, backfill",
+        "tiers": [
+            {"plugins": [{"name": "priority"}, {"name": "gang"}]},
+            {"plugins": [{"name": "predicates"}, {"name": "capacity"},
+                         {"name": "nodeorder"}]},
+        ],
+    }
+    eng = Queue(name="eng", deserved=Resource({"cpu": 16000}))
+    ml = Queue(name="ml", parent="eng", deserved=Resource({"cpu": 12000}))
+    web = Queue(name="web", parent="eng", deserved=Resource({"cpu": 4000}))
+    pg_w, pods_w = gang_job("wj", queue="web", replicas=4, min_available=1,
+                            requests={"cpu": 2})
+    ctx = TestContext(nodes=nodes(4, cpu="8"), queues=[eng, ml, web],
+                      podgroups=[pg_w], pods=pods_w, conf=conf)
+    ctx.run()
+    ctx.expect_bind_num(2)  # 4 cpu deserved / 2 cpu per task
+
+
+def test_gangpreempt_nomination_two_cycle_handshake():
+    """Hard-topology gang evicts a low-priority gang from a slice in
+    cycle 1 (nomination pinned), lands there in a later cycle."""
+    conf = {
+        "actions": "enqueue, allocate, gangpreempt, backfill",
+        "tiers": [
+            {"plugins": [{"name": "priority"}, {"name": "gang"},
+                         {"name": "conformance"}]},
+            {"plugins": [{"name": "predicates"}, {"name": "proportion"},
+                         {"name": "nodeorder"}, {"name": "deviceshare"},
+                         {"name": "network-topology-aware"}]},
+        ],
+    }
+    cluster = make_tpu_cluster([("sa", "v5e-16"), ("sb", "v5e-16")])
+    # fill BOTH slices with low-priority elastic gangs
+    for s in ("sa", "sb"):
+        pg, pods = gang_job(f"filler-{s}", replicas=4, min_available=1,
+                            requests={"cpu": 8, TPU: 4},
+                            running_on=[f"{s}-w{i}" for i in range(4)],
+                            pg_phase=PodGroupPhase.RUNNING)
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+    pg_hi, pods_hi = gang_job(
+        "train", replicas=4, requests={"cpu": 8, TPU: 4},
+        priority_class="high",
+        network_topology=NetworkTopologySpec(NetworkTopologyMode.HARD, 1),
+        pg_phase=PodGroupPhase.INQUEUE)
+    cluster.add_podgroup(pg_hi)
+    for p in pods_hi:
+        cluster.add_pod(p)
+    cluster.add_priority_class(PriorityClass("high", 1000))
+
+    ctx = TestContext.__new__(TestContext)
+    ctx.cluster = cluster
+    from volcano_tpu.conf import load_conf
+    from volcano_tpu.cache.cache import SchedulerCache
+    ctx.conf = load_conf(conf)
+    ctx.cache = SchedulerCache(cluster)
+    ctx.last_session = None
+
+    ctx.run()
+    # cycle 1: evictions fired (3 surplus tasks of one filler gang =
+    # safe bundle, or the whole gang), nomination annotation pinned
+    assert len(cluster.evictions) >= 3
+    from volcano_tpu.api.types import NOMINATED_HYPERNODES_ANNOTATION
+    assert NOMINATED_HYPERNODES_ANNOTATION in \
+        cluster.podgroups["default/train"].annotations
+
+    # kubelet finishes evictions; next cycle the gang lands in the
+    # nominated slice
+    cluster.tick()
+    cluster.tick()
+    ctx.run()
+    train_binds = {n for k, n in cluster.binds if k.startswith("default/train")}
+    assert len(train_binds) == 4
+    assert len({n.rsplit("-w", 1)[0] for n in train_binds}) == 1

@@ -3,6 +3,10 @@
 Importing this package registers every action.
 """
 
-import volcano_tpu.actions.enqueue   # noqa: F401
-import volcano_tpu.actions.allocate  # noqa: F401
-import volcano_tpu.actions.backfill  # noqa: F401
+import volcano_tpu.actions.enqueue      # noqa: F401
+import volcano_tpu.actions.allocate     # noqa: F401
+import volcano_tpu.actions.backfill     # noqa: F401
+import volcano_tpu.actions.preempt      # noqa: F401
+import volcano_tpu.actions.reclaim      # noqa: F401
+import volcano_tpu.actions.gangpreempt  # noqa: F401
+import volcano_tpu.actions.shuffle      # noqa: F401

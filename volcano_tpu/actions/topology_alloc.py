@@ -85,6 +85,7 @@ def _allocate_whole_job(ssn, queue, job: JobInfo) -> bool:
                 for sub in job.sub_jobs.values():
                     sub.allocated_hypernode = domain_name
                     sub.nominated_hypernode = ""
+                job.persist_nominations()
                 stmt.commit()
                 log.debug("topology job %s committed into domain %s",
                           job.key, domain_name)
@@ -162,6 +163,7 @@ def _allocate_per_subjob(ssn, queue, job: JobInfo,
                 sub.allocated_hypernode = chosen[sub.name]
             if sub.name in chosen:
                 sub.nominated_hypernode = ""
+        job.persist_nominations()
         stmt.commit()
         log.debug("multi-slice job %s committed: %s", job.key, chosen)
         return True
@@ -189,6 +191,7 @@ def _fail(ssn, job: JobInfo, subjob: str = "") -> bool:
     # clear stale nominations that failed validation (allocate.go:595-717)
     for sub in job.sub_jobs.values():
         sub.nominated_hypernode = ""
+    job.persist_nominations()
     nt = job.network_topology
     where = f"subgroup {subjob} of " if subjob else ""
     ssn.set_job_pending_reason(

@@ -15,6 +15,17 @@ from volcano_tpu.api.job_info import JobInfo, TaskInfo
 from volcano_tpu.api.node_info import NodeInfo
 
 
+def victim_sort_key(ssn):
+    """Cheapest eviction first: lowest job priority, then lowest task
+    priority, then smallest request — shared by per-node victim
+    selection and bundle ordering so the two policies cannot drift."""
+    def key(t: TaskInfo):
+        job = ssn.jobs.get(t.job)
+        jp = job.priority if job else 0
+        return (jp, t.priority, sum(t.resreq.res.values()))
+    return key
+
+
 def predicate_nodes(ssn, task: TaskInfo, nodes: List[NodeInfo],
                     record_errors: bool = True) -> List[NodeInfo]:
     """Return nodes passing all predicate plugins for *task*."""

@@ -43,6 +43,13 @@ class TPUDevices(Devices):
         return self.node.idle.get(TPU)
 
     @property
+    def chips_free_future(self) -> float:
+        """Free chips once in-flight releases complete — the filter
+        must not veto placements that pipeline onto releasing hosts
+        (preempt/reclaim victims)."""
+        return self.node.future_idle().get(TPU)
+
+    @property
     def is_tpu_node(self) -> bool:
         return self.chips_total > 0
 
@@ -64,7 +71,7 @@ class TPUDevices(Devices):
                     f"multi-host TPU slice requires whole-host requests "
                     f"of {self.slice.chips_per_host} chips, got {req:g}",
                     "tpu", resolvable=False)
-            if self.chips_free < req:
+            if self.chips_free_future < req:
                 return unschedulable(
                     "TPU host already occupied", "tpu")
         else:
@@ -77,7 +84,7 @@ class TPUDevices(Devices):
                 return unschedulable(
                     f"node has only {self.chips_total:g} TPU chips",
                     "tpu", resolvable=False)
-            if req > self.chips_free:
+            if req > self.chips_free_future:
                 return unschedulable("not enough free TPU chips", "tpu")
         return None
 

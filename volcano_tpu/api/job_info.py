@@ -182,11 +182,49 @@ class JobInfo:
             for sg in podgroup.sub_group_policies:
                 self.sub_jobs[sg.name] = SubJobInfo(
                     sg.name, sg.min_member, sg.network_topology)
+            self._recover_nominations(podgroup)
 
         self.total_request = Resource()
         self.fit_errors: Dict[str, FitErrors] = {}   # per-task-uid node errors
         self.job_fit_errors: Optional[FitErrors] = None
         self.scheduling_start = 0.0
+
+    def _recover_nominations(self, podgroup: PodGroup):
+        """Rehydrate gangpreempt's domain nominations from the PodGroup
+        annotation (they must survive snapshot rebuilds between the
+        evict cycle and the allocate cycle that consumes them)."""
+        import json
+        from volcano_tpu.api.types import NOMINATED_HYPERNODES_ANNOTATION
+        raw = podgroup.annotations.get(NOMINATED_HYPERNODES_ANNOTATION)
+        if not raw:
+            return
+        try:
+            nominations = json.loads(raw)
+        except ValueError:
+            return
+        for sub_name, domain in nominations.items():
+            sub = self.sub_jobs.get(sub_name)
+            if sub is None:
+                sub = SubJobInfo(sub_name, 0)
+                self.sub_jobs[sub_name] = sub
+            sub.nominated_hypernode = domain
+
+    def persist_nominations(self):
+        """Write current nominations back into the PodGroup annotation
+        (empty mapping removes it)."""
+        import json
+        from volcano_tpu.api.types import NOMINATED_HYPERNODES_ANNOTATION
+        if self.podgroup is None:
+            return
+        nominations = {name: sub.nominated_hypernode
+                       for name, sub in self.sub_jobs.items()
+                       if sub.nominated_hypernode}
+        if nominations:
+            self.podgroup.annotations[NOMINATED_HYPERNODES_ANNOTATION] = \
+                json.dumps(nominations, sort_keys=True)
+        else:
+            self.podgroup.annotations.pop(
+                NOMINATED_HYPERNODES_ANNOTATION, None)
 
     # -- spec accessors ------------------------------------------------
 
@@ -220,6 +258,13 @@ class JobInfo:
         if self.podgroup and self.podgroup.min_resources:
             return self.podgroup.min_resources.clone()
         return Resource()
+
+    @property
+    def has_min_resources(self) -> bool:
+        """Did the user declare spec.minResources?  Admission gates only
+        apply to jobs that did (reference: 'MinResources == nil =>
+        Permit' in overcommit/proportion/capacity enqueue fns)."""
+        return bool(self.podgroup and self.podgroup.min_resources)
 
     # -- task management ----------------------------------------------
 
@@ -276,14 +321,22 @@ class JobInfo:
                    if t.best_effort)
 
     def is_ready(self) -> bool:
-        return self.ready_task_num() >= self.min_available
+        """ready + pending-best-effort >= minAvailable (job_info.go:1202
+        — best-effort tasks always place via backfill, so they count
+        toward the floor)."""
+        return (self.ready_task_num() + self.pending_best_effort_task_num()
+                >= self.min_available)
 
     def is_pipelined(self) -> bool:
         return (self.ready_task_num() + self.waiting_task_num()
-                >= self.min_available)
+                + self.pending_best_effort_task_num() >= self.min_available)
 
     def is_starving(self) -> bool:
-        return not self.is_ready() and self.valid_task_num() >= self.min_available
+        """waiting + ready < minAvailable (job_info.go:1210): a job with
+        enough pipelined reservations is no longer starving — stops
+        preempt/reclaim from over-evicting past the gang floor."""
+        return (self.ready_task_num() + self.waiting_task_num()
+                < self.min_available)
 
     def check_task_min_available(self) -> bool:
         """Per-task-spec minima (minTaskMember) are satisfiable by alive

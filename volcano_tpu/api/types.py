@@ -147,4 +147,9 @@ TPU_SLICE_LABEL = "cloud.google.com/gke-tpu-slice"                # slice name/i
 TPU_WORKER_ID_LABEL = "cloud.google.com/gke-tpu-worker-id"        # host index in slice
 TPU_COORDS_LABEL = "volcano-tpu.io/ici-coords"                    # "x,y,z" of host in mesh
 
+# PodGroup annotation carrying gangpreempt's domain nominations across
+# sessions: JSON {subgroup-name: hypernode-name} ("" = whole job).
+NOMINATED_HYPERNODES_ANNOTATION = \
+    "scheduling.volcano-tpu.io/nominated-hypernodes"
+
 DEFAULT_QUEUE = "default"

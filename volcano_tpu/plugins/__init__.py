@@ -15,3 +15,4 @@ import volcano_tpu.plugins.nodeorder     # noqa: F401
 import volcano_tpu.plugins.binpack       # noqa: F401
 import volcano_tpu.plugins.deviceshare   # noqa: F401
 import volcano_tpu.plugins.topology      # noqa: F401
+import volcano_tpu.plugins.capacity      # noqa: F401

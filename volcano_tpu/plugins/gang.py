@@ -31,8 +31,11 @@ class GangPlugin(Plugin):
                                lambda ctx, cands: self._gang_guard(ssn, cands))
         ssn.add_reclaimable_fn(self.name,
                                lambda ctx, cands: self._gang_guard(ssn, cands))
+        # Gang-aware (bundle-based) eviction manages MinAvailable via the
+        # safe/whole bundle split itself, so UnifiedEvictable permits all
+        # candidates (gang.go:133-137) — unlike the per-task guard above.
         ssn.add_unified_evictable_fn(self.name,
-                                     lambda ctx, cands: self._gang_guard(ssn, cands))
+                                     lambda ctx, cands: list(cands))
 
     @staticmethod
     def _job_valid(job: JobInfo):

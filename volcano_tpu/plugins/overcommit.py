@@ -31,16 +31,21 @@ class OvercommitPlugin(Plugin):
         for job in ssn.jobs.values():
             used.add(job.allocated())
             if job.podgroup and job.podgroup.phase is PodGroupPhase.INQUEUE \
-                    and not job.is_ready():
+                    and not job.is_ready() and job.has_min_resources:
                 self.inqueue.add(job.min_request())
         self.idle = total.sub_unchecked(used)
         ssn.add_job_enqueueable_fn(self.name, self._job_enqueueable)
         ssn.add_job_enqueued_fn(self.name, self._job_enqueued)
 
     def _job_enqueueable(self, job: JobInfo) -> int:
+        if not job.has_min_resources:
+            # no declared minResources => always admit; the gang floor
+            # is enforced at allocate time (overcommit.go:117-121)
+            return PERMIT
         future = self.inqueue.clone().add(job.min_request())
         return PERMIT if future.less_equal(self.idle, zero="defaultInfinity") \
             else REJECT
 
     def _job_enqueued(self, job: JobInfo):
-        self.inqueue.add(job.min_request())
+        if job.has_min_resources:
+            self.inqueue.add(job.min_request())

@@ -79,7 +79,7 @@ class ProportionPlugin(Plugin):
             attr.request.add(job.total_request)
             attr.allocated.add(job.allocated())
             if job.podgroup and job.podgroup.phase is PodGroupPhase.INQUEUE \
-                    and not job.is_ready():
+                    and not job.is_ready() and job.has_min_resources:
                 attr.inqueue.add(job.min_request())
 
         self._compute_deserved(total)
@@ -163,8 +163,9 @@ class ProportionPlugin(Plugin):
         return self.attrs[queue.name].share() >= 1.0 - 1e-9
 
     def _preemptive(self, queue: QueueInfo, task: TaskInfo) -> bool:
-        """May this queue still take resources via preemption?"""
-        return not self._overused(queue)
+        """May this queue still absorb *task* via reclaim?  Same
+        deserved-share math as allocatable (proportion.go:385-388)."""
+        return self._allocatable(queue, task)
 
     def _reclaimable(self, ssn):
         def fn(ctx, candidates: List[TaskInfo]):
@@ -199,6 +200,8 @@ class ProportionPlugin(Plugin):
         attr = self.attrs.get(job.queue)
         if attr is None:
             return ABSTAIN
+        if not job.has_min_resources:
+            return PERMIT  # proportion.go:421-424
         min_req = job.min_request()
         future = attr.allocated.clone().add(attr.inqueue).add(min_req)
         if future.less_equal_with_dimensions(attr.real_capability,
@@ -207,6 +210,8 @@ class ProportionPlugin(Plugin):
         return REJECT
 
     def _job_enqueued(self, job: JobInfo):
+        if not job.has_min_resources:
+            return  # proportion.go:443
         attr = self.attrs.get(job.queue)
         if attr is not None:
             attr.inqueue.add(job.min_request())
