@@ -1,0 +1,251 @@
+"""Job controller lifecycle + job plugins + webhooks.
+
+Mirrors the reference's job_controller_test.go + e2e jobseq plugin env
+contracts (pytorch_plugin.go, tensorflow_plugin.go) with the jax plugin
+as the TPU-native star.
+"""
+
+import json
+
+import pytest
+
+from volcano_tpu.api.pod import Container, Pod
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.types import (
+    JobAction,
+    JobEvent,
+    JobPhase,
+    PodGroupPhase,
+    TaskStatus,
+)
+from volcano_tpu.api.vcjob import LifecyclePolicy, TaskSpec, VCJob
+from volcano_tpu.cache.fake_cluster import FakeCluster
+from volcano_tpu.controllers import ControllerManager
+from volcano_tpu.controllers.job.controller import JobController
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.simulator import make_tpu_cluster
+from volcano_tpu.webhooks import AdmissionError, default_admission
+
+
+def mk_cluster(slices=(("sa", "v5e-16"),)):
+    cluster = make_tpu_cluster(list(slices))
+    cluster.admission = default_admission()
+    return cluster
+
+
+def mk_job(name="train", tasks=None, plugins=None, **kwargs):
+    tasks = tasks or [TaskSpec(
+        name="worker", replicas=4,
+        template=Pod(name="t", containers=[
+            Container(requests={"cpu": 4, TPU: 4})]))]
+    return VCJob(name=name, tasks=tasks, min_available=kwargs.pop(
+        "min_available", sum(t.replicas for t in tasks)),
+        plugins=dict(plugins or {}), **kwargs)
+
+
+def run_all(cluster, mgr, sched, cycles=3):
+    for _ in range(cycles):
+        mgr.sync_all()
+        sched.run_once()
+        cluster.tick()
+
+
+def test_vcjob_end_to_end_lifecycle():
+    """vcjob -> webhook admit -> controller materializes pods+podgroup ->
+    scheduler gang-binds -> controller tracks Running -> completion."""
+    cluster = mk_cluster()
+    mgr = ControllerManager(cluster, enabled=["job", "queue",
+                                              "garbagecollector"])
+    sched = Scheduler(cluster, schedule_period=0)
+    job = cluster.add_vcjob(mk_job(plugins={"env": [], "svc": [],
+                                            "jax": []}))
+
+    run_all(cluster, mgr, sched)
+    job = cluster.vcjobs[job.key]
+    assert job.phase is JobPhase.RUNNING
+    assert len(cluster.binds) == 4
+    assert cluster.podgroups[job.key].phase is PodGroupPhase.RUNNING
+
+    # all pods succeed -> job completes
+    for pod in list(cluster.pods.values()):
+        if pod.owner == job.uid:
+            cluster.complete_pod(pod.key)
+    mgr.sync_all()
+    mgr.sync_all()
+    assert cluster.vcjobs[job.key].phase is JobPhase.COMPLETED
+
+
+def test_jax_plugin_env_contract():
+    """Every worker pod gets the JAX bootstrap env the workloads'
+    bootstrap module consumes."""
+    cluster = mk_cluster()
+    mgr = ControllerManager(cluster, enabled=["job"])
+    job = cluster.add_vcjob(mk_job(plugins={"jax": [], "svc": []}))
+    mgr.sync_all()
+
+    workers = [p for p in cluster.pods.values() if p.owner == job.uid]
+    assert len(workers) == 4
+    for pod in workers:
+        env = pod.containers[0].env
+        assert env["TPU_WORKER_ID"] == str(pod.task_index)
+        assert env["NUM_PROCESSES"] == "4"
+        hostnames = env["TPU_WORKER_HOSTNAMES"].split(",")
+        assert len(hostnames) == 4
+        assert env["COORDINATOR_ADDRESS"] == f"{hostnames[0]}:8476"
+        # TPU toleration injected for chip-requesting pods
+        assert any(t.key == TPU for t in pod.tolerations)
+
+    # the workloads bootstrap parses exactly this env
+    from volcano_tpu.workloads.bootstrap import from_env
+    env = workers[2].containers[0].env
+    info = from_env(env)
+    assert info.process_id == workers[2].task_index
+    assert info.num_processes == 4
+
+
+def test_pytorch_plugin_env_contract():
+    cluster = mk_cluster()
+    mgr = ControllerManager(cluster, enabled=["job"])
+    tasks = [
+        TaskSpec(name="master", replicas=1,
+                 template=Pod(name="t", containers=[Container()])),
+        TaskSpec(name="worker", replicas=2,
+                 template=Pod(name="t", containers=[Container()])),
+    ]
+    job = cluster.add_vcjob(mk_job(name="ddp", tasks=tasks,
+                                   plugins={"pytorch": []}))
+    mgr.sync_all()
+    pods = {p.name: p for p in cluster.pods.values() if p.owner == job.uid}
+    master_env = pods["ddp-master-0"].containers[0].env
+    assert master_env["RANK"] == "0"
+    assert master_env["WORLD_SIZE"] == "3"
+    worker_env = pods["ddp-worker-1"].containers[0].env
+    assert worker_env["RANK"] == "2"
+    assert worker_env["MASTER_ADDR"].startswith("ddp-master-0.")
+
+
+def test_tensorflow_plugin_tf_config():
+    cluster = mk_cluster()
+    mgr = ControllerManager(cluster, enabled=["job"])
+    tasks = [
+        TaskSpec(name="ps", replicas=1,
+                 template=Pod(name="t", containers=[Container()])),
+        TaskSpec(name="worker", replicas=2,
+                 template=Pod(name="t", containers=[Container()])),
+    ]
+    job = cluster.add_vcjob(mk_job(name="tfjob", tasks=tasks,
+                                   plugins={"tensorflow": []}))
+    mgr.sync_all()
+    pods = {p.name: p for p in cluster.pods.values() if p.owner == job.uid}
+    cfg = json.loads(pods["tfjob-worker-1"].containers[0].env["TF_CONFIG"])
+    assert cfg["task"] == {"type": "worker", "index": 1}
+    assert len(cfg["cluster"]["worker"]) == 2
+    assert len(cfg["cluster"]["ps"]) == 1
+
+
+def test_mpi_plugin_creates_hostfile_and_ssh_secret():
+    cluster = mk_cluster()
+    mgr = ControllerManager(cluster, enabled=["job"])
+    tasks = [
+        TaskSpec(name="master", replicas=1,
+                 template=Pod(name="t", containers=[Container()])),
+        TaskSpec(name="worker", replicas=2,
+                 template=Pod(name="t", containers=[Container()])),
+    ]
+    job = cluster.add_vcjob(mk_job(name="horovod", tasks=tasks,
+                                   plugins={"mpi": [], "ssh": [],
+                                            "svc": []}))
+    mgr.sync_all()
+    assert "default/horovod-ssh" in cluster.secrets
+    hostfile = cluster.config_maps["default/horovod-mpi-hostfile"]["hostfile"]
+    assert hostfile.count("slots=1") == 2
+    assert "default/horovod" in cluster.services
+
+
+def test_restart_policy_on_pod_failure():
+    cluster = mk_cluster()
+    mgr = ControllerManager(cluster, enabled=["job"])
+    sched = Scheduler(cluster, schedule_period=0)
+    job = mk_job(policies=[LifecyclePolicy(action=JobAction.RESTART_JOB,
+                                           event=JobEvent.POD_FAILED)],
+                 max_retry=2)
+    job = cluster.add_vcjob(job)
+    run_all(cluster, mgr, sched)
+    assert cluster.vcjobs[job.key].phase is JobPhase.RUNNING
+
+    victim = next(p for p in cluster.pods.values() if p.owner == job.uid)
+    cluster.complete_pod(victim.key, succeeded=False)
+    mgr.sync_all()   # policy fires -> Restarting, old pods deleted
+    j = cluster.vcjobs[job.key]
+    assert j.phase in (JobPhase.RESTARTING, JobPhase.PENDING)
+    assert j.retry_count == 1
+    run_all(cluster, mgr, sched, cycles=4)
+    j = cluster.vcjobs[job.key]
+    assert j.phase is JobPhase.RUNNING
+    assert all(p.labels["volcano-tpu.io/job-version"] == "1"
+               for p in cluster.pods.values() if p.owner == j.uid)
+
+
+def test_abort_policy():
+    cluster = mk_cluster()
+    mgr = ControllerManager(cluster, enabled=["job"])
+    sched = Scheduler(cluster, schedule_period=0)
+    job = cluster.add_vcjob(
+        mk_job(policies=[LifecyclePolicy(action=JobAction.ABORT_JOB,
+                                         event=JobEvent.POD_FAILED)]))
+    run_all(cluster, mgr, sched)
+    victim = next(p for p in cluster.pods.values() if p.owner == job.uid)
+    cluster.complete_pod(victim.key, succeeded=False)
+    mgr.sync_all()
+    mgr.sync_all()
+    assert cluster.vcjobs[job.key].phase is JobPhase.ABORTED
+    assert not [p for p in cluster.pods.values() if p.owner == job.uid]
+
+
+def test_garbage_collector_ttl():
+    cluster = mk_cluster()
+    mgr = ControllerManager(cluster, enabled=["job", "garbagecollector"])
+    job = cluster.add_vcjob(mk_job(ttl_seconds_after_finished=0))
+    mgr.sync_all()
+    for pod in list(cluster.pods.values()):
+        if pod.owner == job.uid:
+            cluster.complete_pod(pod.key)
+    mgr.sync_all()  # -> completed
+    mgr.sync_all()  # gc removes
+    assert job.key not in cluster.vcjobs
+
+
+def test_webhook_rejects_bad_jobs():
+    cluster = mk_cluster()
+    with pytest.raises(AdmissionError, match="minAvailable"):
+        cluster.add_vcjob(mk_job(min_available=99))
+    with pytest.raises(AdmissionError, match="duplicate"):
+        cluster.add_vcjob(mk_job(tasks=[
+            TaskSpec(name="a", replicas=1), TaskSpec(name="a", replicas=1)]))
+    with pytest.raises(AdmissionError, match="unknown job plugin"):
+        cluster.add_vcjob(mk_job(plugins={"nosuch": []}))
+    with pytest.raises(AdmissionError, match="queue"):
+        cluster.add_vcjob(mk_job(queue="ghost"))
+
+
+def test_webhook_mutates_defaults():
+    cluster = mk_cluster()
+    job = VCJob(name="defaulted", min_available=0,
+                tasks=[TaskSpec(name="", replicas=2)])
+    job = cluster.add_vcjob(job)
+    assert job.tasks[0].name == "task-0"
+    assert job.min_available == 2
+    assert job.queue == "default"
+
+
+def test_podgroup_controller_wraps_bare_pods():
+    from volcano_tpu.api.pod import make_pod
+    cluster = FakeCluster()
+    mgr = ControllerManager(cluster, enabled=["podgroup"])
+    pod = make_pod("loner", requests={"cpu": 1})
+    cluster.add_pod(pod)
+    mgr.sync_all()
+    from volcano_tpu.api.types import GROUP_NAME_ANNOTATION
+    group = pod.annotations[GROUP_NAME_ANNOTATION]
+    assert f"default/{group}" in cluster.podgroups
+    assert cluster.podgroups[f"default/{group}"].min_member == 1

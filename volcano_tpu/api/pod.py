@@ -81,6 +81,7 @@ class Pod:
     preemptable: bool = True
 
     phase: TaskStatus = TaskStatus.PENDING
+    exit_code: Optional[int] = None   # main container exit, when terminated
     status_message: str = ""
     nominated_node: str = ""
     owner: str = ""                          # vcjob uid that owns this pod

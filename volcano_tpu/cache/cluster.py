@@ -36,10 +36,17 @@ class ClusterSnapshot:
     queues: List[Queue] = field(default_factory=list)
     hypernodes: List[HyperNode] = field(default_factory=list)
     priority_classes: List[PriorityClass] = field(default_factory=list)
+    vcjobs: List[object] = field(default_factory=list)  # VCJob
 
 
 class Cluster(abc.ABC):
-    """Minimal apiserver surface the scheduler needs."""
+    """The apiserver surface the scheduler AND controllers need.
+
+    Implementations must also expose live mapping views used by
+    controllers and plugins:
+      pods / podgroups / queues / hypernodes / vcjobs  (key -> object)
+      services / config_maps / secrets                 (plugin artifacts)
+    """
 
     @abc.abstractmethod
     def list_all(self) -> ClusterSnapshot:
@@ -83,3 +90,32 @@ class Cluster(abc.ABC):
     @abc.abstractmethod
     def delete_hypernode(self, name: str) -> None:
         """Delete a HyperNode CR."""
+
+    @abc.abstractmethod
+    def add_pod(self, pod: Pod) -> None:
+        """Create a pod (job controller materialization)."""
+
+    @abc.abstractmethod
+    def delete_pod(self, key: str) -> None:
+        """Force-delete a pod by ns/name key."""
+
+    @abc.abstractmethod
+    def add_podgroup(self, pg: PodGroup) -> None:
+        """Create a PodGroup CR."""
+
+    @abc.abstractmethod
+    def delete_podgroup(self, key: str) -> None:
+        """Delete a PodGroup CR."""
+
+    @abc.abstractmethod
+    def add_vcjob(self, job):
+        """Create a vcjob, applying the admission chain; returns the
+        (possibly mutated) stored object or raises AdmissionError."""
+
+    @abc.abstractmethod
+    def update_vcjob(self, job) -> None:
+        """Persist vcjob spec/status changes."""
+
+    @abc.abstractmethod
+    def delete_vcjob(self, key: str) -> None:
+        """Delete a vcjob by ns/name key."""

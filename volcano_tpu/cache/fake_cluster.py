@@ -25,7 +25,7 @@ from volcano_tpu.cache.cluster import Cluster, ClusterSnapshot, PriorityClass
 
 
 class FakeCluster(Cluster):
-    def __init__(self):
+    def __init__(self, admission=None):
         self._lock = threading.RLock()
         self.pods: Dict[str, Pod] = {}            # key: ns/name
         self.nodes: Dict[str, Node] = {}
@@ -33,9 +33,15 @@ class FakeCluster(Cluster):
         self.queues: Dict[str, Queue] = {DEFAULT_QUEUE: Queue(name=DEFAULT_QUEUE)}
         self.hypernodes: Dict[str, HyperNode] = {}
         self.priority_classes: Dict[str, PriorityClass] = {}
+        self.vcjobs: Dict[str, object] = {}       # key: ns/name -> VCJob
+        self.services: Dict[str, dict] = {}       # svc plugin artifacts
+        self.config_maps: Dict[str, dict] = {}
+        self.secrets: Dict[str, dict] = {}
         self.events: List[Tuple[str, str, str]] = []
         self.binds: List[Tuple[str, str]] = []    # (pod key, node) history
         self.evictions: List[str] = []
+        # admission chain applied on vcjob/queue create (webhooks)
+        self.admission = admission
         # watchers notified on any mutation (controllers use this)
         self._watchers: List[Callable[[str, object], None]] = []
 
@@ -84,6 +90,29 @@ class FakeCluster(Cluster):
             self.hypernodes[hn.name] = hn
         self._notify("hypernode", hn)
 
+    # -- vcjobs (admission-gated like the apiserver webhook path) ------
+
+    def add_vcjob(self, job):
+        """Create a vcjob; the admission chain (webhooks) mutates then
+        validates — a rejection raises before anything is stored."""
+        if self.admission is not None:
+            job = self.admission.admit_job(job, self)
+        with self._lock:
+            self.vcjobs[job.key] = job
+        self._notify("vcjob", job)
+        return job
+
+    def update_vcjob(self, job):
+        with self._lock:
+            self.vcjobs[job.key] = job
+        self._notify("vcjob", job)
+
+    def delete_vcjob(self, key: str):
+        with self._lock:
+            job = self.vcjobs.pop(key, None)
+        if job:
+            self._notify("vcjob_deleted", job)
+
     def delete_hypernode(self, name: str):
         with self._lock:
             hn = self.hypernodes.pop(name, None)
@@ -118,6 +147,7 @@ class FakeCluster(Cluster):
                 queues=list(self.queues.values()),
                 hypernodes=list(self.hypernodes.values()),
                 priority_classes=list(self.priority_classes.values()),
+                vcjobs=list(self.vcjobs.values()),
             )
 
     def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
@@ -176,11 +206,14 @@ class FakeCluster(Cluster):
         for key in to_delete:
             self.delete_pod(key)
 
-    def complete_pod(self, key: str, succeeded: bool = True):
+    def complete_pod(self, key: str, succeeded: bool = True,
+                     exit_code=None):
         with self._lock:
             pod = self.pods.get(key)
             if pod:
                 pod.phase = (TaskStatus.SUCCEEDED if succeeded
                              else TaskStatus.FAILED)
+                pod.exit_code = (exit_code if exit_code is not None
+                                 else (0 if succeeded else 1))
         if pod:
             self._notify("pod", pod)

@@ -11,7 +11,11 @@ from volcano_tpu.controllers.framework import (
 
 # import controller modules so their @register_controller side effects
 # run (reference: controller registry blank imports)
-import volcano_tpu.controllers.hypernode  # noqa: E402,F401
+import volcano_tpu.controllers.hypernode         # noqa: E402,F401
+import volcano_tpu.controllers.job.controller    # noqa: E402,F401
+import volcano_tpu.controllers.podgroup          # noqa: E402,F401
+import volcano_tpu.controllers.queue             # noqa: E402,F401
+import volcano_tpu.controllers.garbagecollector  # noqa: E402,F401
 
 __all__ = ["Controller", "ControllerManager", "register_controller",
            "CONTROLLERS"]

@@ -1,0 +1,308 @@
+"""Job controller — vcjob -> PodGroup + pods, 8-phase state machine.
+
+Reference parity: pkg/controllers/job (state machine state/factory.go:
+87-110; syncJob pod materialization job_controller_actions.go:348;
+killJob :68; lifecycle policies job_controller.go:415-542).  Rebuilt
+reconciler-style: each sync pass materializes desired pods, folds pod
+status into the job phase, and applies lifecycle policies to observed
+pod failures.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from volcano_tpu.api.pod import Pod
+from volcano_tpu.api.podgroup import PodGroup, SubGroupPolicy
+from volcano_tpu.api.types import (
+    GROUP_NAME_ANNOTATION,
+    JOB_NAME_LABEL,
+    SUBGROUP_LABEL,
+    TASK_INDEX_LABEL,
+    TASK_SPEC_LABEL,
+    JobAction,
+    JobEvent,
+    JobPhase,
+    PodGroupPhase,
+    TaskStatus,
+)
+from volcano_tpu.api.vcjob import VCJob
+from volcano_tpu.controllers.framework import Controller, register_controller
+from volcano_tpu.controllers.job.plugins import get_job_plugin
+
+log = logging.getLogger(__name__)
+
+VERSION_LABEL = "volcano-tpu.io/job-version"
+
+TERMINAL_PHASES = (JobPhase.COMPLETED, JobPhase.FAILED, JobPhase.ABORTED)
+
+
+@register_controller("job")
+class JobController(Controller):
+    name = "job"
+
+    def sync(self) -> None:
+        snap = self.cluster.list_all()
+        pods_by_job: Dict[str, List[Pod]] = defaultdict(list)
+        for pod in snap.pods:
+            if pod.owner:
+                pods_by_job[pod.owner].append(pod)
+        for job in snap.vcjobs:
+            try:
+                self.sync_job(job, pods_by_job.get(job.uid, []))
+            except Exception:  # noqa: BLE001
+                log.exception("sync of job %s failed", job.key)
+
+    def on_event(self, kind: str, obj):
+        if kind == "vcjob_deleted":
+            self._on_job_delete(obj)
+
+    @staticmethod
+    def _count(pods: List[Pod]) -> Dict[TaskStatus, int]:
+        counts: Dict[TaskStatus, int] = defaultdict(int)
+        for p in pods:
+            counts[p.phase] += 1
+        return counts
+
+    # -- reconcile ----------------------------------------------------
+
+    def sync_job(self, job: VCJob, pods: List[Pod]) -> None:
+        if job.phase in TERMINAL_PHASES:
+            return
+
+        self._ensure_podgroup(job)
+        self._run_job_add_plugins(job)
+
+        # fold observed pod state
+        counts = self._count(pods)
+        job.pending = counts[TaskStatus.PENDING] + counts[TaskStatus.BOUND] \
+            + counts[TaskStatus.BINDING]
+        job.running = counts[TaskStatus.RUNNING]
+        job.succeeded = counts[TaskStatus.SUCCEEDED]
+        job.failed = counts[TaskStatus.FAILED]
+        job.terminating = counts[TaskStatus.RELEASING]
+
+        # lifecycle policies react to failures before materialization
+        if job.phase in (JobPhase.PENDING, JobPhase.RUNNING):
+            for pod in pods:
+                if pod.phase is TaskStatus.FAILED and \
+                        not pod.annotations.get("vc-policy-handled"):
+                    pod.annotations["vc-policy-handled"] = "true"
+                    self._apply_policy(job, pod, JobEvent.POD_FAILED)
+                    if job.phase in TERMINAL_PHASES or \
+                            job.phase is JobPhase.RESTARTING:
+                        break
+
+        handler = {
+            JobPhase.PENDING: self._sync_active,
+            JobPhase.RUNNING: self._sync_active,
+            JobPhase.RESTARTING: self._sync_restarting,
+            JobPhase.COMPLETING: self._sync_completing,
+            JobPhase.TERMINATING: self._sync_terminating,
+            JobPhase.ABORTING: self._sync_aborting,
+        }.get(job.phase)
+        if handler:
+            handler(job, pods)
+        self.cluster.update_vcjob(job)
+
+    def _sync_active(self, job: VCJob, pods: List[Pod]) -> None:
+        self._materialize_pods(job, pods)
+
+        min_success = job.min_success or job.total_replicas()
+        alive = job.pending + job.running + job.terminating
+        if job.succeeded >= min_success:
+            self._transition(job, JobPhase.COMPLETING,
+                             f"{job.succeeded} tasks succeeded")
+            self._sync_completing(job, pods)
+            return
+        if alive == 0 and job.failed > 0 and \
+                job.succeeded + job.failed >= job.total_replicas():
+            self._transition(job, JobPhase.FAILED,
+                             f"{job.failed} tasks failed")
+            return
+        if job.phase is JobPhase.PENDING and \
+                job.running >= job.min_available:
+            self._transition(job, JobPhase.RUNNING, "min available running")
+
+    def _sync_restarting(self, job: VCJob, pods: List[Pod]) -> None:
+        # delete every pod of the previous version, then start over
+        stale = [p for p in pods
+                 if p.labels.get(VERSION_LABEL) != str(job.version)]
+        for pod in stale:
+            self.cluster.delete_pod(pod.key)
+        if not stale:
+            self._transition(job, JobPhase.PENDING,
+                             f"restarted (attempt {job.retry_count})")
+            pg = self.cluster.podgroups.get(f"{job.namespace}/{job.name}")
+            if pg is not None:
+                pg.phase = PodGroupPhase.PENDING
+                self.cluster.update_podgroup_status(pg)
+
+    def _sync_completing(self, job: VCJob, pods: List[Pod]) -> None:
+        remaining = [p for p in pods if not p.is_terminated()]
+        for pod in remaining:
+            self.cluster.delete_pod(pod.key)
+        if not remaining:
+            self._transition(job, JobPhase.COMPLETED, "job completed")
+            job.finish_time = time.time()
+
+    def _sync_terminating(self, job: VCJob, pods: List[Pod]) -> None:
+        remaining = [p for p in pods if not p.is_terminated()]
+        for pod in remaining:
+            self.cluster.delete_pod(pod.key)
+        if not remaining:
+            self._transition(job, JobPhase.FAILED, "job terminated")
+            job.finish_time = time.time()
+
+    def _sync_aborting(self, job: VCJob, pods: List[Pod]) -> None:
+        # abort retains nothing (state/factory.go retain-phase sets)
+        for pod in pods:
+            self.cluster.delete_pod(pod.key)
+        if not pods:
+            self._transition(job, JobPhase.ABORTED, "job aborted")
+            job.finish_time = time.time()
+
+    # -- materialization ----------------------------------------------
+
+    def _ensure_podgroup(self, job: VCJob) -> None:
+        key = f"{job.namespace}/{job.name}"
+        if key in self.cluster.podgroups:
+            return
+        sub_groups = []
+        seen = set()
+        for spec in job.tasks:
+            if spec.subgroup and spec.subgroup not in seen:
+                seen.add(spec.subgroup)
+                sub_groups.append(SubGroupPolicy(
+                    name=spec.subgroup,
+                    min_member=spec.min_available or spec.replicas,
+                    network_topology=None))
+        pg = PodGroup(
+            name=job.name, namespace=job.namespace,
+            min_member=job.min_available,
+            min_task_member={t.name: t.min_available for t in job.tasks
+                             if t.min_available is not None},
+            queue=job.queue,
+            priority_class=job.priority_class,
+            network_topology=job.network_topology,
+            sub_group_policies=sub_groups,
+        )
+        self.cluster.add_podgroup(pg)
+        job.controlled_resources["podgroup"] = pg.key
+
+    def _run_job_add_plugins(self, job: VCJob) -> None:
+        if job.controlled_resources.get("plugins-applied"):
+            return
+        for name, args in job.plugins.items():
+            plugin = get_job_plugin(name, args)
+            if plugin is None:
+                log.warning("job %s references unknown plugin %s",
+                            job.key, name)
+                continue
+            plugin.on_job_add(job, self.cluster)
+        job.controlled_resources["plugins-applied"] = "true"
+
+    def _materialize_pods(self, job: VCJob, pods: List[Pod]) -> None:
+        existing = {p.name: p for p in pods}
+        desired = {}
+        for spec in job.tasks:
+            for i in range(spec.replicas):
+                desired[f"{job.name}-{spec.name}-{i}"] = (spec, i)
+
+        # scale down: delete pods not desired anymore
+        for name, pod in existing.items():
+            if name not in desired and not pod.is_terminated():
+                self.cluster.delete_pod(pod.key)
+
+        for name, (spec, index) in desired.items():
+            if name in existing:
+                continue
+            self.cluster.add_pod(self._build_pod(job, spec, index, name))
+
+    def _build_pod(self, job: VCJob, spec, index: int, name: str) -> Pod:
+        template = spec.template_pod()
+        pod = template.clone()
+        pod.name = name
+        pod.namespace = job.namespace
+        from volcano_tpu.api.pod import new_uid
+        pod.uid = new_uid()
+        pod.owner = job.uid
+        pod.task_spec = spec.name
+        pod.task_index = index
+        pod.scheduler_name = job.scheduler_name
+        pod.phase = TaskStatus.PENDING
+        pod.node_name = ""
+        pod.annotations[GROUP_NAME_ANNOTATION] = job.name
+        pod.labels[JOB_NAME_LABEL] = job.name
+        pod.labels[TASK_SPEC_LABEL] = spec.name
+        pod.labels[TASK_INDEX_LABEL] = str(index)
+        pod.labels[VERSION_LABEL] = str(job.version)
+        if spec.subgroup:
+            pod.labels[SUBGROUP_LABEL] = spec.subgroup
+        if job.priority_class:
+            pod.priority_class = job.priority_class
+        for plugin_name, args in job.plugins.items():
+            plugin = get_job_plugin(plugin_name, args)
+            if plugin is not None:
+                plugin.on_pod_create(pod, job)
+        return pod
+
+    # -- lifecycle policies -------------------------------------------
+
+    def _apply_policy(self, job: VCJob, pod: Pod, event: JobEvent) -> None:
+        spec = job.task_by_name(pod.task_spec)
+        policies = (spec.policies if spec and spec.policies
+                    else job.policies)
+        action = None
+        for policy in policies:
+            if policy.matches(event, exit_code=pod.exit_code):
+                action = policy.action
+                break
+        if action is None:
+            return
+        log.info("job %s: pod %s %s -> %s", job.key, pod.name,
+                 event.value, action.value)
+        self.cluster.record_event(job.key, "PolicyTriggered",
+                                  f"{pod.name} {event.value} -> {action.value}")
+        if action is JobAction.RESTART_JOB:
+            if job.retry_count >= job.max_retry:
+                self._transition(job, JobPhase.FAILED,
+                                 f"maxRetry ({job.max_retry}) exceeded")
+                return
+            job.retry_count += 1
+            job.version += 1
+            self._transition(job, JobPhase.RESTARTING, "policy: restart")
+        elif action in (JobAction.RESTART_TASK, JobAction.RESTART_POD):
+            self.cluster.delete_pod(pod.key)
+        elif action is JobAction.ABORT_JOB:
+            self._transition(job, JobPhase.ABORTING, "policy: abort")
+        elif action is JobAction.TERMINATE_JOB:
+            self._transition(job, JobPhase.TERMINATING, "policy: terminate")
+        elif action is JobAction.COMPLETE_JOB:
+            self._transition(job, JobPhase.COMPLETING, "policy: complete")
+
+    def _transition(self, job: VCJob, phase: JobPhase, message: str) -> None:
+        if job.phase is phase:
+            return
+        log.debug("job %s: %s -> %s (%s)", job.key, job.phase.value,
+                  phase.value, message)
+        job.phase = phase
+        job.state_message = message
+        if phase in TERMINAL_PHASES and job.finish_time is None:
+            job.finish_time = time.time()
+        from volcano_tpu.api.vcjob import JobCondition
+        job.conditions.append(JobCondition(status=phase))
+        self.cluster.update_vcjob(job)
+
+    def _on_job_delete(self, job: VCJob) -> None:
+        for name, args in job.plugins.items():
+            plugin = get_job_plugin(name, args)
+            if plugin is not None:
+                plugin.on_job_delete(job, self.cluster)
+        for pod in list(self.cluster.pods.values()):
+            if pod.owner == job.uid:
+                self.cluster.delete_pod(pod.key)
+        self.cluster.delete_podgroup(f"{job.namespace}/{job.name}")

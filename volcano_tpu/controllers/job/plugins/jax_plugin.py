@@ -1,0 +1,68 @@
+"""jax plugin — TPU/JAX process-grid bootstrap.
+
+The TPU-native replacement for the reference's pytorch plugin
+(plugins/distributed-framework/pytorch/pytorch.go:46-52 emits
+MASTER_ADDR/RANK/WORLD_SIZE): every worker pod gets
+
+    TPU_WORKER_ID        - its index within the worker task group
+    TPU_WORKER_HOSTNAMES - all worker hostnames, comma separated
+    COORDINATOR_ADDRESS  - worker 0 host:port for jax.distributed
+    NUM_PROCESSES        - worker replica count
+
+plus the `google.com/tpu` toleration GKE puts on TPU node pools, so no
+ssh, no hostfile and no NCCL vars are needed — jax.distributed and the
+TPU runtime self-assemble the mesh (consumed by
+volcano_tpu.workloads.bootstrap).
+"""
+
+from __future__ import annotations
+
+from volcano_tpu.api.pod import Toleration
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.controllers.job.plugins import JobPlugin, register_job_plugin
+from volcano_tpu.controllers.job.plugins.util import set_env, task_hostnames
+
+DEFAULT_PORT = 8476
+
+
+@register_job_plugin("jax")
+class JaxPlugin(JobPlugin):
+    name = "jax"
+
+    def __init__(self, arguments=None):
+        super().__init__(arguments)
+        self.port = DEFAULT_PORT
+        self.worker_task = ""
+        for arg in self.arguments:
+            if arg.startswith("--port="):
+                self.port = int(arg.split("=", 1)[1])
+            elif arg.startswith("--worker-task="):
+                self.worker_task = arg.split("=", 1)[1]
+
+    def _worker_task_name(self, job) -> str:
+        if self.worker_task:
+            return self.worker_task
+        for spec in job.tasks:
+            if spec.name in ("worker", "workers"):
+                return spec.name
+        return job.tasks[0].name if job.tasks else ""
+
+    def on_pod_create(self, pod, job):
+        worker_task = self._worker_task_name(job)
+        hostnames = task_hostnames(job, worker_task)
+        if not hostnames:
+            return
+        set_env(pod, "TPU_WORKER_HOSTNAMES", ",".join(hostnames))
+        set_env(pod, "COORDINATOR_ADDRESS",
+                f"{hostnames[0]}:{self.port}")
+        set_env(pod, "NUM_PROCESSES", str(len(hostnames)))
+        if pod.task_spec == worker_task:
+            set_env(pod, "TPU_WORKER_ID", str(pod.task_index))
+
+        # ride GKE TPU node-pool taints without user boilerplate
+        requests_tpu = any(
+            float(c.requests.get(TPU, 0) or 0) > 0 for c in pod.containers)
+        if requests_tpu and not any(t.key == TPU for t in pod.tolerations):
+            pod.tolerations.append(
+                Toleration(key=TPU, operator="Exists",
+                           effect="NoSchedule"))

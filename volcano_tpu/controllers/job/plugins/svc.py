@@ -1,0 +1,44 @@
+"""svc plugin — headless service + hosts config (reference: plugins/svc).
+
+Creates the job's headless Service record and a hosts ConfigMap listing
+every task replica's stable hostname, and injects VC_<TASK>_HOSTS /
+VC_<TASK>_NUM env into each pod.
+"""
+
+from __future__ import annotations
+
+from volcano_tpu.api.types import JOB_NAME_LABEL
+from volcano_tpu.controllers.job.plugins import JobPlugin, register_job_plugin
+from volcano_tpu.controllers.job.plugins.util import (
+    all_hostnames,
+    set_env,
+    task_hostnames,
+)
+
+
+@register_job_plugin("svc")
+class SvcPlugin(JobPlugin):
+    name = "svc"
+
+    def on_job_add(self, job, cluster):
+        key = f"{job.namespace}/{job.name}"
+        cluster.services[key] = {
+            "name": job.name, "namespace": job.namespace,
+            "headless": True, "selector": {JOB_NAME_LABEL: job.name},
+        }
+        hosts = {f"{spec.name}.host": "\n".join(task_hostnames(job, spec.name))
+                 for spec in job.tasks}
+        cluster.config_maps[f"{key}-svc"] = hosts
+
+    def on_job_delete(self, job, cluster):
+        key = f"{job.namespace}/{job.name}"
+        cluster.services.pop(key, None)
+        cluster.config_maps.pop(f"{key}-svc", None)
+
+    def on_pod_create(self, pod, job):
+        for spec in job.tasks:
+            env_name = spec.name.upper().replace("-", "_")
+            set_env(pod, f"VC_{env_name}_HOSTS",
+                    ",".join(task_hostnames(job, spec.name)))
+            set_env(pod, f"VC_{env_name}_NUM", str(spec.replicas))
+        pod.labels.setdefault(JOB_NAME_LABEL, job.name)

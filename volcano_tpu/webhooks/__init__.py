@@ -1,0 +1,7 @@
+"""Admission webhooks (reference: pkg/webhooks)."""
+
+from volcano_tpu.webhooks.admission import (
+    AdmissionChain, AdmissionError, default_admission,
+)
+
+__all__ = ["AdmissionChain", "AdmissionError", "default_admission"]

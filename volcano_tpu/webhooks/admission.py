@@ -1,0 +1,171 @@
+"""Admission chain: mutate then validate on object create.
+
+Reference parity: pkg/webhooks (router/admission.go paths
+/jobs/{validate,mutate}, /queues/..., /podgroups/..., /hypernodes/
+validate).  Standalone equivalent: the cluster applies this chain on
+create — a rejection raises AdmissionError before anything persists.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from volcano_tpu.api.types import DEFAULT_QUEUE, JobEvent
+from volcano_tpu.api.vcjob import VCJob
+
+DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+MAX_NAME_LEN = 63
+
+
+class AdmissionError(ValueError):
+    """Raised when a webhook rejects an object."""
+
+
+# -- jobs -------------------------------------------------------------
+
+def mutate_job(job: VCJob) -> VCJob:
+    """Defaulting (reference admission/jobs/mutate): queue, task names,
+    minAvailable, task minAvailable, scheduler name."""
+    if not job.queue:
+        job.queue = DEFAULT_QUEUE
+    if not job.scheduler_name:
+        job.scheduler_name = "volcano-tpu"
+    for i, task in enumerate(job.tasks):
+        if not task.name:
+            task.name = f"task-{i}"
+        if task.min_available is None:
+            task.min_available = task.replicas
+    if job.min_available <= 0:
+        job.min_available = job.total_replicas()
+    return job
+
+
+def validate_job(job: VCJob, cluster=None) -> None:
+    """Spec sanity (reference admission/jobs/validate)."""
+    from volcano_tpu.controllers.job.plugins import job_plugin_exists
+
+    if not DNS1123.match(job.name) or len(job.name) > MAX_NAME_LEN:
+        raise AdmissionError(
+            f"job name {job.name!r} must be a DNS-1123 label "
+            f"(<= {MAX_NAME_LEN} chars)")
+    if not job.tasks:
+        raise AdmissionError("job must declare at least one task")
+    names = [t.name for t in job.tasks]
+    if len(set(names)) != len(names):
+        raise AdmissionError(f"duplicate task names: {names}")
+    total = 0
+    for task in job.tasks:
+        if not DNS1123.match(task.name):
+            raise AdmissionError(f"task name {task.name!r} invalid")
+        if task.replicas < 0:
+            raise AdmissionError(f"task {task.name}: replicas < 0")
+        if task.min_available is not None and \
+                task.min_available > task.replicas:
+            raise AdmissionError(
+                f"task {task.name}: minAvailable {task.min_available} > "
+                f"replicas {task.replicas}")
+        total += task.replicas
+        if task.depends_on:
+            for dep in task.depends_on.name:
+                if dep not in names:
+                    raise AdmissionError(
+                        f"task {task.name} dependsOn unknown task {dep}")
+    if job.min_available < 0:
+        raise AdmissionError("minAvailable must be >= 0")
+    if job.min_available > total:
+        raise AdmissionError(
+            f"minAvailable {job.min_available} > total replicas {total}")
+    if job.min_success is not None and job.min_success > total:
+        raise AdmissionError(
+            f"minSuccess {job.min_success} > total replicas {total}")
+    if job.max_retry < 0:
+        raise AdmissionError("maxRetry must be >= 0")
+    for plugin_name in job.plugins:
+        if not job_plugin_exists(plugin_name):
+            raise AdmissionError(f"unknown job plugin {plugin_name!r}")
+    for policy in job.policies:
+        if policy.event is None and not policy.events and \
+                policy.exit_code is None:
+            raise AdmissionError("policy must set event(s) or exitCode")
+        if policy.exit_code == 0:
+            raise AdmissionError("policy exitCode 0 is not allowed")
+    if job.network_topology is not None and \
+            job.network_topology.highest_tier_allowed < 1:
+        raise AdmissionError("networkTopology.highestTierAllowed must be >= 1")
+    if cluster is not None and job.queue:
+        if job.queue not in cluster.queues:
+            raise AdmissionError(f"queue {job.queue!r} does not exist")
+        if not cluster.queues[job.queue].is_open():
+            raise AdmissionError(f"queue {job.queue!r} is not open")
+
+
+# -- queues -----------------------------------------------------------
+
+def validate_queue(queue, cluster=None) -> None:
+    if not DNS1123.match(queue.name):
+        raise AdmissionError(f"queue name {queue.name!r} invalid")
+    if queue.weight <= 0:
+        raise AdmissionError("queue weight must be > 0")
+    if cluster is not None and queue.parent:
+        if queue.parent not in cluster.queues:
+            raise AdmissionError(
+                f"parent queue {queue.parent!r} does not exist")
+        # reject hierarchy cycles
+        seen = {queue.name}
+        cur = queue.parent
+        while cur:
+            if cur in seen:
+                raise AdmissionError(
+                    f"queue hierarchy cycle through {cur!r}")
+            seen.add(cur)
+            parent = cluster.queues.get(cur)
+            cur = parent.parent if parent else ""
+
+
+# -- podgroups / hypernodes -------------------------------------------
+
+def validate_podgroup(pg) -> None:
+    if pg.min_member < 0:
+        raise AdmissionError("minMember must be >= 0")
+    if pg.min_task_member:
+        for name, n in pg.min_task_member.items():
+            if n < 0:
+                raise AdmissionError(f"minTaskMember[{name}] must be >= 0")
+
+
+def validate_hypernode(hn) -> None:
+    if hn.tier < 1:
+        raise AdmissionError("hypernode tier must be >= 1")
+    if not hn.members:
+        raise AdmissionError("hypernode must have members")
+    for m in hn.members:
+        if m.kind not in ("Node", "HyperNode"):
+            raise AdmissionError(f"invalid member kind {m.kind!r}")
+        if not (m.exact or m.regex or m.labels):
+            raise AdmissionError("member selector must be set")
+
+
+class AdmissionChain:
+    """The webhook pipeline a Cluster applies on create."""
+
+    def admit_job(self, job: VCJob, cluster=None) -> VCJob:
+        job = mutate_job(job)
+        validate_job(job, cluster)
+        return job
+
+    def admit_queue(self, queue, cluster=None):
+        validate_queue(queue, cluster)
+        return queue
+
+    def admit_podgroup(self, pg, cluster=None):
+        validate_podgroup(pg)
+        return pg
+
+    def admit_hypernode(self, hn, cluster=None):
+        validate_hypernode(hn)
+        return hn
+
+
+def default_admission() -> AdmissionChain:
+    return AdmissionChain()
