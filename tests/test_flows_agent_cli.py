@@ -1,0 +1,175 @@
+"""JobFlow DAGs, cronjobs, node agent, cache dumper, CLI."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+from volcano_tpu.api.jobflow import Flow, FlowDependsOn, JobFlow, \
+    JobFlowPhase, JobTemplate
+from volcano_tpu.api.pod import Container, Pod
+from volcano_tpu.api.types import JobPhase, TaskStatus
+from volcano_tpu.api.vcjob import TaskSpec, VCJob
+from volcano_tpu.controllers import ControllerManager
+from volcano_tpu.controllers.cronjob import CronJob, cron_matches
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.simulator import make_tpu_cluster
+from volcano_tpu.webhooks import default_admission
+
+
+def template(name, replicas=1):
+    return JobTemplate(name=name, job=VCJob(
+        name=name, min_available=replicas,
+        tasks=[TaskSpec(name="w", replicas=replicas,
+                        template=Pod(name="t", containers=[
+                            Container(requests={"cpu": 1})]))]))
+
+
+def mk_stack():
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    cluster.admission = default_admission()
+    mgr = ControllerManager(cluster, enabled=[
+        "job", "jobflow", "cronjob", "garbagecollector"])
+    sched = Scheduler(cluster, schedule_period=0)
+    return cluster, mgr, sched
+
+
+def pump(cluster, mgr, sched, n=3):
+    for _ in range(n):
+        mgr.sync_all()
+        sched.run_once()
+        cluster.tick()
+
+
+def test_jobflow_dag_executes_in_dependency_order():
+    cluster, mgr, sched = mk_stack()
+    cluster.jobtemplates = {"default/prep": template("prep"),
+                            "default/train": template("train"),
+                            "default/eval": template("eval")}
+    flow = JobFlow(name="pipeline", flows=[
+        Flow(name="prep"),
+        Flow(name="train", depends_on=FlowDependsOn(targets=["prep"])),
+        Flow(name="eval", depends_on=FlowDependsOn(targets=["train"])),
+    ])
+    cluster.jobflows = {flow.key: flow}
+
+    pump(cluster, mgr, sched)
+    assert "default/pipeline-prep" in cluster.vcjobs
+    assert "default/pipeline-train" not in cluster.vcjobs  # dep not done
+
+    # finish prep -> train deploys; finish train -> eval deploys
+    for pod in list(cluster.pods.values()):
+        if pod.name.startswith("pipeline-prep"):
+            cluster.complete_pod(pod.key)
+    pump(cluster, mgr, sched)
+    assert "default/pipeline-train" in cluster.vcjobs
+    for pod in list(cluster.pods.values()):
+        if pod.name.startswith("pipeline-train") and not pod.is_terminated():
+            cluster.complete_pod(pod.key)
+    pump(cluster, mgr, sched)
+    assert "default/pipeline-eval" in cluster.vcjobs
+    for pod in list(cluster.pods.values()):
+        if pod.name.startswith("pipeline-eval") and not pod.is_terminated():
+            cluster.complete_pod(pod.key)
+    pump(cluster, mgr, sched)
+    assert cluster.jobflows[flow.key].phase is JobFlowPhase.SUCCEED
+
+
+def test_cron_matcher():
+    # 2026-07-28 is a Tuesday
+    ts = time.mktime((2026, 7, 28, 3, 15, 0, 0, 0, -1))
+    assert cron_matches("15 3 * * *", ts)
+    assert cron_matches("*/5 * * * *", ts)
+    assert not cron_matches("16 3 * * *", ts)
+    assert cron_matches("* * 28 7 *", ts)
+    assert cron_matches("* * * * 2", ts)      # Tuesday
+    assert not cron_matches("* * * * 0", ts)  # not Sunday
+    assert cron_matches("0-30 * * * *", ts)
+
+
+def test_cronjob_fires_and_respects_forbid():
+    cluster, mgr, sched = mk_stack()
+    cron = CronJob(name="nightly", schedule="* * * * *",
+                   concurrency_policy="Forbid",
+                   job_template=template("nightly").job)
+    cluster.cronjobs = {cron.key: cron}
+    ctrl = next(c for c in mgr.controllers if c.name == "cronjob")
+    now = time.time()
+    ctrl.sync_cron(cron, now)
+    assert len(cron.active_jobs) == 1
+    # same minute: no double fire; next minute with active job: Forbid
+    ctrl.sync_cron(cron, now + 1)
+    ctrl.sync_cron(cron, now + 61)
+    assert len(cron.active_jobs) == 1
+
+
+def test_node_agent_reports_and_cordons_unhealthy_tpu():
+    from volcano_tpu.agent import FakeUsageProvider, NodeAgent
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    provider = FakeUsageProvider()
+    provider.set("sa-w0", cpu_fraction=0.5, tpu_chips_detected=4,
+                 tpu_chips_healthy=3)   # one sick chip
+    agent = NodeAgent(cluster, "sa-w0", provider)
+    agent.sync()
+    node = cluster.nodes["sa-w0"]
+    assert node.unschedulable is True
+    assert node.labels["volcano-tpu.io/tpu-healthy"] == "false"
+    assert node.annotations["volcano-tpu.io/tpu-chips"] == "3/4"
+    # chip recovers -> uncordon
+    provider.set("sa-w0", cpu_fraction=0.5, tpu_chips_detected=4,
+                 tpu_chips_healthy=4)
+    agent.sync()
+    assert cluster.nodes["sa-w0"].unschedulable is False
+
+
+def test_node_agent_oversubscription_and_pressure_eviction():
+    from volcano_tpu.agent import FakeUsageProvider, NodeAgent
+    from volcano_tpu.api.pod import make_pod
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    be_pod = make_pod("be", node_name="sa-w1", phase=TaskStatus.RUNNING,
+                      annotations={"volcano-tpu.io/qos-level": "BE"})
+    cluster.add_pod(be_pod)
+    provider = FakeUsageProvider()
+    provider.set("sa-w1", cpu_fraction=0.98, tpu_chips_detected=4,
+                 tpu_chips_healthy=4)
+    NodeAgent(cluster, "sa-w1", provider).sync()
+    assert "default/be" in cluster.evictions
+    assert cluster.nodes["sa-w1"].annotations[
+        "oversubscription.volcano-tpu.io/cpu-millis"] == "0"
+
+
+def test_cache_dumper(tmp_path):
+    from volcano_tpu.dumper import Dumper
+    cluster, mgr, sched = mk_stack()
+    cluster.add_vcjob(template("dumpme").job)
+    pump(cluster, mgr, sched, n=2)
+    path = str(tmp_path / "dump.json")
+    out = Dumper(sched, path).dump()
+    data = json.loads(open(out).read())
+    assert "default/dumpme" in data["jobs"]
+    assert len(data["nodes"]) == 4
+
+
+def test_cli_end_to_end(tmp_path):
+    state = str(tmp_path / "cluster.pkl")
+    env = dict(os.environ, PYTHONPATH=os.getcwd())
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "volcano_tpu.cli.vtpctl",
+             "--state", state, *args],
+            capture_output=True, text=True, env=env, check=True).stdout
+
+    run("init", "--slices", "sa=v5e-16")
+    run("queue", "create", "-N", "research", "--weight", "3")
+    run("job", "run", "-N", "train", "--replicas", "4", "--tpu", "4",
+        "--cpu", "4", "--queue", "research", "--plugins", "jax,svc")
+    run("tick", "--cycles", "3")
+    listing = run("job", "list")
+    assert "train" in listing and "Running" in listing
+    view = json.loads(run("job", "view", "-N", "train"))
+    assert view["status"]["running"] == 4
+    assert all(p["node"].startswith("sa-") for p in view["pods"])
+    queues = run("vqueues")
+    assert "research" in queues

@@ -1,0 +1,231 @@
+"""The long tail of policy plugins: sla/pdb/cdp/tdm/nodegroup/usage/
+resourcequota/task-topology/resource-strategy-fit/numaaware/extender/
+rescheduling + shuffle."""
+
+import json
+import time
+
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.queue import Queue
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.types import (
+    NODEGROUP_LABEL,
+    PodGroupPhase,
+    REVOCABLE_ZONE_ANNOTATION,
+)
+from volcano_tpu.uthelper import TestContext, gang_job
+
+
+def conf_with(*plugin_specs, actions="enqueue, allocate, backfill"):
+    plugins = [{"name": "gang"}, {"name": "predicates"},
+               {"name": "nodeorder"}]
+    plugins += [p if isinstance(p, dict) else {"name": p}
+                for p in plugin_specs]
+    return {"actions": actions, "tiers": [{"plugins": plugins}]}
+
+
+def nodes(n, cpu="8", labels=None, annotations=None):
+    return [Node(name=f"n{i}", allocatable={"cpu": cpu, "pods": 110},
+                 labels=dict(labels or {}),
+                 annotations=dict(annotations or {}))
+            for i in range(n)]
+
+
+def test_sla_breached_job_jumps_admission():
+    pg, pods = gang_job("waiting", replicas=1, requests={"cpu": 1})
+    pg.creation_time = time.time() - 3600
+    pg.annotations["sla.volcano-tpu.io/waiting-time"] = "60"
+    ctx = TestContext(nodes=nodes(1), podgroups=[pg], pods=pods,
+                      conf=conf_with("sla"))
+    ctx.run()
+    ctx.expect_bind_num(1)
+
+
+def test_pdb_blocks_eviction_below_min_available():
+    from volcano_tpu.cache.cluster import PriorityClass
+    pg_lo, pods_lo = gang_job("lo", replicas=2, min_available=1,
+                              requests={"cpu": 4},
+                              running_on=["n0", "n1"],
+                              pg_phase=PodGroupPhase.RUNNING)
+    for p in pods_lo:
+        p.annotations["volcano-tpu.io/disruption-group"] = "db"
+        p.annotations["volcano-tpu.io/min-available"] = "2"
+    pg_hi, pods_hi = gang_job("hi", replicas=1, requests={"cpu": 4},
+                              priority_class="high",
+                              pg_phase=PodGroupPhase.INQUEUE)
+    ctx = TestContext(
+        nodes=nodes(2), podgroups=[pg_lo, pg_hi],
+        pods=pods_lo + pods_hi,
+        priority_classes=[PriorityClass("high", 1000)],
+        conf=conf_with("priority", "pdb",
+                       actions="enqueue, allocate, preempt"))
+    ctx.run()
+    ctx.expect_evict_num(0)  # PDB floor (2) vetoes the eviction
+
+
+def test_cdp_shields_fresh_pods():
+    from volcano_tpu.cache.cluster import PriorityClass
+    pg_lo, pods_lo = gang_job("lo", replicas=2, min_available=1,
+                              requests={"cpu": 4},
+                              running_on=["n0", "n1"],
+                              pg_phase=PodGroupPhase.RUNNING)
+    for p in pods_lo:
+        p.annotations["volcano-tpu.io/start-time"] = str(time.time())
+    pg_hi, pods_hi = gang_job("hi", replicas=1, requests={"cpu": 4},
+                              priority_class="high",
+                              pg_phase=PodGroupPhase.INQUEUE)
+    ctx = TestContext(
+        nodes=nodes(2), podgroups=[pg_lo, pg_hi],
+        pods=pods_lo + pods_hi,
+        priority_classes=[PriorityClass("high", 1000)],
+        conf=conf_with("priority", "cdp",
+                       actions="enqueue, allocate, preempt"))
+    ctx.run()
+    ctx.expect_evict_num(0)  # still cooling down
+
+
+def test_tdm_revocable_node_gating_and_shuffle():
+    revocable = Node(name="rev0", allocatable={"cpu": 8},
+                     labels={"volcano-tpu.io/revocable-zone": "night"})
+    pg, pods = gang_job("batch", replicas=1, requests={"cpu": 1})
+    pods[0].annotations[REVOCABLE_ZONE_ANNOTATION] = "night"
+    conf = conf_with({"name": "tdm", "arguments":
+                      {"tdm.revocable-zone.night": "*"}})
+    ctx = TestContext(nodes=[revocable], podgroups=[pg], pods=pods,
+                      conf=conf)
+    ctx.run()
+    ctx.expect_bind("default/batch-0", "rev0")
+
+    # non-revocable pod cannot use the revocable node
+    pg2, pods2 = gang_job("normal", replicas=1, requests={"cpu": 1})
+    ctx2 = TestContext(nodes=[revocable], podgroups=[pg2], pods=pods2,
+                       conf=conf)
+    ctx2.run()
+    ctx2.expect_bind_num(0)
+
+    # window closed -> shuffle evicts the revocable pod
+    pg3, pods3 = gang_job("evictme", replicas=1, min_available=0,
+                          requests={"cpu": 1}, running_on=["rev0"],
+                          pg_phase=PodGroupPhase.RUNNING)
+    pods3[0].annotations[REVOCABLE_ZONE_ANNOTATION] = "night"
+    conf3 = conf_with({"name": "tdm", "arguments":
+                       {"tdm.revocable-zone.night": "23:59-23:59"}},
+                      actions="shuffle")
+    ctx3 = TestContext(nodes=[revocable], podgroups=[pg3], pods=pods3,
+                       conf=conf3)
+    ctx3.run(["shuffle"])
+    ctx3.expect_evict_num(1)
+
+
+def test_nodegroup_affinity():
+    q = Queue(name="mlq")
+    q.annotations["nodegroup.volcano-tpu.io/affinity"] = "ml-nodes"
+    cluster_nodes = nodes(1, labels={NODEGROUP_LABEL: "ml-nodes"}) + \
+        [Node(name="other", allocatable={"cpu": 8},
+              labels={NODEGROUP_LABEL: "web"})]
+    pg, pods = gang_job("mljob", queue="mlq", replicas=1,
+                        requests={"cpu": 1})
+    ctx = TestContext(nodes=cluster_nodes, queues=[q], podgroups=[pg],
+                      pods=pods, conf=conf_with("nodegroup"))
+    ctx.run()
+    ctx.expect_bind("default/mljob-0", "n0")
+
+
+def test_usage_threshold_filters_hot_nodes():
+    hot = Node(name="hot", allocatable={"cpu": 8},
+               annotations={"usage.volcano-tpu.io/cpu": "0.95"})
+    cool = Node(name="cool", allocatable={"cpu": 8},
+                annotations={"usage.volcano-tpu.io/cpu": "0.1"})
+    pg, pods = gang_job("j", replicas=1, requests={"cpu": 1})
+    ctx = TestContext(nodes=[hot, cool], podgroups=[pg], pods=pods,
+                      conf=conf_with("usage"))
+    ctx.run()
+    ctx.expect_bind("default/j-0", "cool")
+
+
+def test_resourcequota_blocks_over_quota_namespace():
+    pg, pods = gang_job("quotajob", replicas=4, requests={"cpu": 4})
+    ctx = TestContext(nodes=nodes(4), podgroups=[pg], pods=pods,
+                      conf=conf_with("resourcequota"))
+    ctx.cluster.config_maps["resourcequota/default"] = {"cpu": 8}
+    ctx.run()
+    ctx.expect_bind_num(0)
+    ctx.expect_podgroup_phase("default/quotajob", PodGroupPhase.PENDING)
+
+
+def test_task_topology_affinity_colocates():
+    pg, pods = gang_job("pair", replicas=2, requests={"cpu": 1})
+    pg.annotations["task-topology.volcano-tpu.io/affinity"] = \
+        "worker/worker"
+    ctx = TestContext(nodes=nodes(2, cpu="8"), podgroups=[pg], pods=pods,
+                      conf=conf_with("task-topology"))
+    ctx.run()
+    bound_nodes = {n for _, n in ctx.cluster.binds}
+    assert len(bound_nodes) == 1  # both workers co-located
+
+
+def test_resource_strategy_fit_packs_tpu():
+    tpu_nodes = [Node(name=f"t{i}", allocatable={"cpu": 8, TPU: 4})
+                 for i in range(2)]
+    # pre-load t1 with a 2-chip pod
+    pg0, pods0 = gang_job("seed", replicas=1, requests={TPU: 2},
+                          running_on=["t1"],
+                          pg_phase=PodGroupPhase.RUNNING)
+    pg, pods = gang_job("packme", replicas=1, requests={TPU: 2})
+    ctx = TestContext(nodes=tpu_nodes, podgroups=[pg0, pg],
+                      pods=pods0 + pods,
+                      conf=conf_with({"name": "resource-strategy-fit",
+                                      "arguments":
+                                      {"resourceStrategyFitWeight": 5}}))
+    ctx.run()
+    ctx.expect_bind("default/packme-0", "t1")  # MostAllocated on chips
+
+
+def test_numaaware_single_numa_policy():
+    inventory = json.dumps({"0": {"cpu": 4, "tpu": 0},
+                            "1": {"cpu": 4, "tpu": 0}})
+    small_numa = Node(name="split", allocatable={"cpu": 8},
+                      annotations={"numa.volcano-tpu.io/nodes": inventory})
+    big_numa = Node(name="fat", allocatable={"cpu": 8},
+                    annotations={"numa.volcano-tpu.io/nodes":
+                                 json.dumps({"0": {"cpu": 8, "tpu": 0}})})
+    pg, pods = gang_job("numajob", replicas=1, requests={"cpu": 6})
+    pods[0].annotations["numa.volcano-tpu.io/policy"] = "single-numa-node"
+    ctx = TestContext(nodes=[small_numa, big_numa], podgroups=[pg],
+                      pods=pods, conf=conf_with("numaaware"))
+    ctx.run()
+    ctx.expect_bind("default/numajob-0", "fat")
+
+
+def test_extender_in_process_hooks():
+    from volcano_tpu.plugins.extender import _EXTENDERS, register_extender
+
+    class VetoN0:
+        def predicate(self, task, node):
+            return "n0 is cursed" if node.name == "n0" else None
+
+    register_extender("test-veto", VetoN0())
+    try:
+        pg, pods = gang_job("extjob", replicas=1, requests={"cpu": 1})
+        ctx = TestContext(nodes=nodes(2), podgroups=[pg], pods=pods,
+                          conf=conf_with("extender"))
+        ctx.run()
+        ctx.expect_bind("default/extjob-0", "n1")
+    finally:
+        _EXTENDERS.pop("test-veto", None)
+
+
+def test_rescheduling_feeds_shuffle():
+    import volcano_tpu.plugins.rescheduling as r
+    r._last_run["ts"] = 0.0
+    busy = Node(name="busy", allocatable={"cpu": 8})
+    idle = Node(name="idle", allocatable={"cpu": 8})
+    pg, pods = gang_job("spread", replicas=2, min_available=0,
+                        requests={"cpu": 4}, running_on=["busy"],
+                        pg_phase=PodGroupPhase.RUNNING)
+    conf = conf_with({"name": "rescheduling", "arguments":
+                      {"rescheduling.interval": 0}}, actions="shuffle")
+    ctx = TestContext(nodes=[busy, idle], podgroups=[pg], pods=pods,
+                      conf=conf)
+    ctx.run(["shuffle"])
+    ctx.expect_evict_num(1)

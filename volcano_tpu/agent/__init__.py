@@ -1,0 +1,5 @@
+"""Node agent (reference: pkg/agent + pkg/metriccollect)."""
+
+from volcano_tpu.agent.agent import NodeAgent, UsageProvider, FakeUsageProvider
+
+__all__ = ["NodeAgent", "UsageProvider", "FakeUsageProvider"]

@@ -1,0 +1,138 @@
+"""Node agent — per-node colocation/QoS daemon.
+
+Reference parity: pkg/agent (event-driven DaemonSet agent: probes feed
+handlers for oversubscription, eviction, resource reporting) +
+pkg/metriccollect.  TPU-first: the agent reports google.com/tpu chip
+inventory and health instead of nvidia.com/gpu (SURVEY.md §2.8), and
+its oversubscription/eviction math runs on usage fractions published as
+node annotations (consumed by the usage plugin and the scheduler's
+oversubscription resource).
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from volcano_tpu.api.resource import TPU, Resource
+from volcano_tpu.api.types import TaskStatus
+
+log = logging.getLogger(__name__)
+
+CPU_USAGE_ANNOTATION = "usage.volcano-tpu.io/cpu"
+MEM_USAGE_ANNOTATION = "usage.volcano-tpu.io/memory"
+OVERSUB_ANNOTATION = "oversubscription.volcano-tpu.io/cpu-millis"
+TPU_HEALTHY_LABEL = "volcano-tpu.io/tpu-healthy"
+AGENT_CORDONED_ANNOTATION = "volcano-tpu.io/cordoned-by-agent"
+TPU_CHIPS_ANNOTATION = "volcano-tpu.io/tpu-chips"
+
+# annotation marking pods the agent may evict under pressure
+PREEMPTABLE_QOS_ANNOTATION = "volcano-tpu.io/qos-level"   # "BE" = best effort
+
+
+@dataclass
+class NodeUsage:
+    cpu_fraction: float = 0.0
+    memory_fraction: float = 0.0
+    tpu_chips_detected: int = 0
+    tpu_chips_healthy: int = 0
+
+
+class UsageProvider(abc.ABC):
+    """Where real usage comes from (cgroups/TPU runtime in production;
+    injected values in tests — mirrors metriccollect/local)."""
+
+    @abc.abstractmethod
+    def usage(self, node_name: str) -> NodeUsage: ...
+
+
+class FakeUsageProvider(UsageProvider):
+    def __init__(self):
+        self.values: Dict[str, NodeUsage] = {}
+
+    def set(self, node_name: str, **kwargs):
+        self.values[node_name] = NodeUsage(**kwargs)
+
+    def usage(self, node_name: str) -> NodeUsage:
+        return self.values.get(node_name, NodeUsage())
+
+
+class NodeAgent:
+    """One agent instance manages one node."""
+
+    def __init__(self, cluster, node_name: str,
+                 provider: Optional[UsageProvider] = None,
+                 oversub_factor: float = 0.6,
+                 eviction_threshold: float = 0.95):
+        self.cluster = cluster
+        self.node_name = node_name
+        self.provider = provider or FakeUsageProvider()
+        self.oversub_factor = oversub_factor
+        self.eviction_threshold = eviction_threshold
+
+    # -- one reporting cycle ------------------------------------------
+
+    def sync(self) -> None:
+        node = self.cluster.nodes.get(self.node_name)
+        if node is None:
+            return
+        usage = self.provider.usage(self.node_name)
+        self._report_usage(node, usage)
+        self._report_tpu_health(node, usage)
+        self._report_oversubscription(node, usage)
+        if max(usage.cpu_fraction, usage.memory_fraction) >= \
+                self.eviction_threshold:
+            self._evict_best_effort(node)
+
+    def _report_usage(self, node, usage: NodeUsage) -> None:
+        node.annotations[CPU_USAGE_ANNOTATION] = f"{usage.cpu_fraction:.3f}"
+        node.annotations[MEM_USAGE_ANNOTATION] = \
+            f"{usage.memory_fraction:.3f}"
+
+    def _report_tpu_health(self, node, usage: NodeUsage) -> None:
+        declared = Resource.from_resource_list(node.allocatable).get(TPU)
+        if declared <= 0 and usage.tpu_chips_detected == 0:
+            return
+        node.annotations[TPU_CHIPS_ANNOTATION] = \
+            f"{usage.tpu_chips_healthy}/{usage.tpu_chips_detected}"
+        healthy = (usage.tpu_chips_healthy >= declared > 0) or \
+            (declared == 0 and usage.tpu_chips_detected ==
+             usage.tpu_chips_healthy)
+        node.labels[TPU_HEALTHY_LABEL] = "true" if healthy else "false"
+        if not healthy:
+            # a slice host with sick chips must not take new work:
+            # the whole ICI mesh is only as healthy as its worst host
+            node.unschedulable = True
+            node.annotations[AGENT_CORDONED_ANNOTATION] = "true"
+            self.cluster.record_event(
+                self.node_name, "TPUUnhealthy",
+                f"{usage.tpu_chips_healthy}/{usage.tpu_chips_detected} "
+                f"chips healthy (declared {declared:g})")
+        elif node.unschedulable and \
+                node.annotations.get(AGENT_CORDONED_ANNOTATION) == "true":
+            # only undo OUR cordon — never an admin's maintenance cordon
+            node.unschedulable = False
+            node.annotations.pop(AGENT_CORDONED_ANNOTATION, None)
+
+    def _report_oversubscription(self, node, usage: NodeUsage) -> None:
+        """Publish reclaimable millicores in 10% steps
+        (pkg/agent/oversubscription/policy/policy.go:40-61)."""
+        alloc = Resource.from_resource_list(node.allocatable)
+        idle_frac = max(0.0, 1.0 - usage.cpu_fraction)
+        stepped = int(idle_frac * 10) / 10.0   # 10% quantization
+        reclaimable = alloc.milli_cpu * stepped * self.oversub_factor
+        node.annotations[OVERSUB_ANNOTATION] = str(int(reclaimable))
+
+    def _evict_best_effort(self, node) -> None:
+        for pod in list(self.cluster.pods.values()):
+            if pod.node_name != self.node_name:
+                continue
+            if pod.phase is not TaskStatus.RUNNING:
+                continue
+            if pod.annotations.get(PREEMPTABLE_QOS_ANNOTATION) == "BE":
+                log.info("agent %s: evicting BE pod %s under pressure",
+                         self.node_name, pod.key)
+                self.cluster.evict_pod(pod.namespace, pod.name,
+                                       "node resource pressure")

@@ -87,6 +87,12 @@ class JobPhase(enum.Enum):
     FAILED = "Failed"
 
 
+# Terminal vcjob phases — single source of truth for the job
+# controller, the garbage collector and cron history pruning.
+FINISHED_JOB_PHASES = (JobPhase.COMPLETED, JobPhase.FAILED,
+                       JobPhase.ABORTED)
+
+
 class JobEvent(enum.Enum):
     """Pod/job events that lifecycle policies match on (bus/v1alpha1)."""
 

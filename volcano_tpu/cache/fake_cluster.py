@@ -45,6 +45,19 @@ class FakeCluster(Cluster):
         # watchers notified on any mutation (controllers use this)
         self._watchers: List[Callable[[str, object], None]] = []
 
+    # picklable for CLI state files: locks and watcher callbacks are
+    # process-local and recreated on load
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_lock", None)
+        state.pop("_watchers", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._lock = threading.RLock()
+        self._watchers = []
+
     # -- mutation helpers (the "kubectl" surface) ----------------------
 
     def add_node(self, node: Node):

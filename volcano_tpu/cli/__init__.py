@@ -1,0 +1,1 @@
+"""CLI (reference: pkg/cli + cmd/cli vcctl)."""

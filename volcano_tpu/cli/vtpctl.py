@@ -1,0 +1,268 @@
+"""vtpctl — the framework CLI.
+
+Reference parity: cmd/cli/vcctl.go:36-41 (job run/list/view/suspend/
+resume/delete; queue create/list/get/delete; pod list) plus the
+slurm-style shortcuts (vsub/vjobs/vqueues/vcancel analogues exposed as
+subcommands).  Standalone mode drives a pickled FakeCluster state file
+(--state), so the full control plane is scriptable without a cluster:
+
+    python -m volcano_tpu.cli.vtpctl --state c.pkl init --slices sa=v5e-16
+    python -m volcano_tpu.cli.vtpctl --state c.pkl job run -N train \
+        --replicas 4 --tpu 4 --plugins jax,svc
+    python -m volcano_tpu.cli.vtpctl --state c.pkl tick
+    python -m volcano_tpu.cli.vtpctl --state c.pkl job list
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pickle
+import sys
+from typing import List, Optional
+
+from volcano_tpu.api.pod import Container, Pod
+from volcano_tpu.api.queue import Queue
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.types import JobPhase
+from volcano_tpu.api.vcjob import TaskSpec, VCJob
+
+
+def _load(path: str):
+    try:
+        with open(path, "rb") as f:
+            return pickle.load(f)
+    except FileNotFoundError:
+        from volcano_tpu.cache.fake_cluster import FakeCluster
+        from volcano_tpu.webhooks import default_admission
+        cluster = FakeCluster()
+        cluster.admission = default_admission()
+        return cluster
+
+
+def _save(cluster, path: str):
+    with open(path, "wb") as f:
+        pickle.dump(cluster, f)
+
+
+def _table(rows: List[List[str]], headers: List[str]) -> str:
+    rows = [headers] + [[str(c) for c in r] for r in rows]
+    widths = [max(len(r[i]) for r in rows) for i in range(len(headers))]
+    return "\n".join(
+        "  ".join(c.ljust(w) for c, w in zip(r, widths)) for r in rows)
+
+
+# -- subcommand handlers ----------------------------------------------
+
+def cmd_init(cluster, args):
+    from volcano_tpu.simulator import slice_nodes
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    for spec in args.slices or []:
+        name, kind = spec.split("=", 1)
+        for node in slice_nodes(slice_for(name, kind), dcn_pod=args.dcn_pod):
+            cluster.add_node(node)
+    from volcano_tpu.controllers.hypernode import HyperNodeController
+    ctrl = HyperNodeController()
+    ctrl.initialize(cluster)
+    ctrl.sync()
+    print(f"cluster: {len(cluster.nodes)} nodes, "
+          f"{len(cluster.hypernodes)} hypernodes")
+
+
+def cmd_job_run(cluster, args):
+    requests = {"cpu": args.cpu}
+    if args.tpu:
+        requests[TPU] = args.tpu
+    job = VCJob(
+        name=args.name,
+        namespace=args.namespace,
+        min_available=args.min_available or args.replicas,
+        queue=args.queue,
+        tasks=[TaskSpec(name=args.task_name, replicas=args.replicas,
+                        template=Pod(name="t", containers=[
+                            Container(image=args.image,
+                                      requests=requests)]))],
+        plugins={p: [] for p in (args.plugins.split(",")
+                                 if args.plugins else [])},
+    )
+    job = cluster.add_vcjob(job)
+    print(f"job {job.key} submitted (queue={job.queue}, "
+          f"minAvailable={job.min_available})")
+
+
+def cmd_job_list(cluster, args):
+    rows = []
+    for job in cluster.vcjobs.values():
+        if args.namespace and job.namespace != args.namespace:
+            continue
+        rows.append([job.namespace, job.name, job.phase.value,
+                     f"{job.running}/{job.total_replicas()}",
+                     job.queue, f"{job.retry_count}"])
+    print(_table(rows, ["NAMESPACE", "NAME", "PHASE", "RUNNING",
+                        "QUEUE", "RETRIES"]))
+
+
+def cmd_job_view(cluster, args):
+    job = cluster.vcjobs.get(f"{args.namespace}/{args.name}")
+    if job is None:
+        sys.exit(f"job {args.namespace}/{args.name} not found")
+    out = {
+        "name": job.name, "namespace": job.namespace,
+        "phase": job.phase.value, "queue": job.queue,
+        "minAvailable": job.min_available,
+        "status": {"pending": job.pending, "running": job.running,
+                   "succeeded": job.succeeded, "failed": job.failed},
+        "tasks": [{"name": t.name, "replicas": t.replicas}
+                  for t in job.tasks],
+        "message": job.state_message,
+        "pods": [{"name": p.name, "phase": p.phase.value,
+                  "node": p.node_name}
+                 for p in cluster.pods.values() if p.owner == job.uid],
+    }
+    print(json.dumps(out, indent=2))
+
+
+def cmd_job_delete(cluster, args):
+    key = f"{args.namespace}/{args.name}"
+    if key not in cluster.vcjobs:
+        sys.exit(f"job {key} not found")
+    cluster.delete_vcjob(key)
+    print(f"job {key} deleted")
+
+
+def cmd_queue_create(cluster, args):
+    from volcano_tpu.api.resource import Resource
+    queue = Queue(name=args.name, weight=args.weight, parent=args.parent)
+    if args.capability:
+        queue.capability = Resource.from_resource_list(
+            json.loads(args.capability))
+    if cluster.admission:
+        cluster.admission.admit_queue(queue, cluster)
+    cluster.add_queue(queue)
+    print(f"queue {queue.name} created (weight={queue.weight})")
+
+
+def cmd_queue_list(cluster, args):
+    rows = [[q.name, q.weight, q.state.value, q.parent or "-"]
+            for q in cluster.queues.values()]
+    print(_table(rows, ["NAME", "WEIGHT", "STATE", "PARENT"]))
+
+
+def cmd_pod_list(cluster, args):
+    rows = []
+    for pod in cluster.pods.values():
+        if args.namespace and pod.namespace != args.namespace:
+            continue
+        rows.append([pod.namespace, pod.name, pod.phase.value,
+                     pod.node_name or "-"])
+    print(_table(rows, ["NAMESPACE", "NAME", "PHASE", "NODE"]))
+
+
+def cmd_tick(cluster, args):
+    """Run controllers + one scheduling cycle + kubelet tick."""
+    from volcano_tpu.controllers import ControllerManager
+    from volcano_tpu.scheduler import Scheduler
+    mgr = ControllerManager(cluster, enabled=[
+        "job", "podgroup", "queue", "hypernode", "garbagecollector",
+        "jobflow", "cronjob"])
+    sched = Scheduler(cluster, schedule_period=0)
+    for _ in range(args.cycles):
+        mgr.sync_all()
+        sched.run_once()
+        cluster.tick()
+    mgr.stop()
+    print(f"ran {args.cycles} cycle(s): {len(cluster.binds)} binds, "
+          f"{len(cluster.evictions)} evictions")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="vtpctl",
+        description="volcano-tpu batch scheduling CLI")
+    parser.add_argument("--state", default="vtpctl-cluster.pkl",
+                        help="cluster state file (standalone mode)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("init", help="provision simulated TPU slices")
+    p.add_argument("--slices", nargs="*",
+                   help="name=kind, e.g. sa=v5e-16")
+    p.add_argument("--dcn-pod", default="dcn-0")
+    p.set_defaults(fn=cmd_init)
+
+    job = sub.add_parser("job", help="job operations").add_subparsers(
+        dest="job_cmd", required=True)
+    p = job.add_parser("run")
+    p.add_argument("-N", "--name", required=True)
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--replicas", type=int, default=1)
+    p.add_argument("--min-available", type=int, default=None)
+    p.add_argument("--task-name", default="worker")
+    p.add_argument("--queue", default="default")
+    p.add_argument("--image", default="busybox")
+    p.add_argument("--cpu", default="1")
+    p.add_argument("--tpu", type=int, default=0)
+    p.add_argument("--plugins", default="")
+    p.set_defaults(fn=cmd_job_run)
+    p = job.add_parser("list")
+    p.add_argument("-n", "--namespace", default=None)
+    p.set_defaults(fn=cmd_job_list)
+    p = job.add_parser("view")
+    p.add_argument("-N", "--name", required=True)
+    p.add_argument("-n", "--namespace", default="default")
+    p.set_defaults(fn=cmd_job_view)
+    p = job.add_parser("delete")
+    p.add_argument("-N", "--name", required=True)
+    p.add_argument("-n", "--namespace", default="default")
+    p.set_defaults(fn=cmd_job_delete)
+
+    queue = sub.add_parser("queue", help="queue operations").add_subparsers(
+        dest="queue_cmd", required=True)
+    p = queue.add_parser("create")
+    p.add_argument("-N", "--name", required=True)
+    p.add_argument("--weight", type=int, default=1)
+    p.add_argument("--parent", default="")
+    p.add_argument("--capability", default="",
+                   help='JSON resource list, e.g. \'{"cpu": 10}\'')
+    p.set_defaults(fn=cmd_queue_create)
+    p = queue.add_parser("list")
+    p.set_defaults(fn=cmd_queue_list)
+
+    pod = sub.add_parser("pod", help="pod operations").add_subparsers(
+        dest="pod_cmd", required=True)
+    p = pod.add_parser("list")
+    p.add_argument("-n", "--namespace", default=None)
+    p.set_defaults(fn=cmd_pod_list)
+
+    p = sub.add_parser("tick",
+                       help="advance the standalone control plane")
+    p.add_argument("--cycles", type=int, default=1)
+    p.set_defaults(fn=cmd_tick)
+
+    # slurm-style shortcuts (vsub/vjobs/vqueues/vcancel)
+    p = sub.add_parser("vjobs", help="alias of: job list")
+    p.add_argument("-n", "--namespace", default=None)
+    p.set_defaults(fn=cmd_job_list)
+    p = sub.add_parser("vqueues", help="alias of: queue list")
+    p.set_defaults(fn=cmd_queue_list)
+
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    cluster = _load(args.state)
+    from volcano_tpu.webhooks import AdmissionError
+    try:
+        args.fn(cluster, args)
+    except AdmissionError as e:
+        print(f"admission denied: {e}", file=sys.stderr)
+        return 1
+    except BrokenPipeError:
+        # output piped into head etc.; state still saved below
+        pass
+    _save(cluster, args.state)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

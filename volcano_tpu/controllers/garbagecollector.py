@@ -9,12 +9,10 @@ from __future__ import annotations
 import logging
 import time
 
-from volcano_tpu.api.types import JobPhase
+from volcano_tpu.api.types import FINISHED_JOB_PHASES as FINISHED
 from volcano_tpu.controllers.framework import Controller, register_controller
 
 log = logging.getLogger(__name__)
-
-FINISHED = (JobPhase.COMPLETED, JobPhase.FAILED, JobPhase.ABORTED)
 
 
 @register_controller("garbagecollector")

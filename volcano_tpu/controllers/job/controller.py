@@ -18,6 +18,7 @@ from typing import Dict, List, Optional
 from volcano_tpu.api.pod import Pod
 from volcano_tpu.api.podgroup import PodGroup, SubGroupPolicy
 from volcano_tpu.api.types import (
+    FINISHED_JOB_PHASES,
     GROUP_NAME_ANNOTATION,
     JOB_NAME_LABEL,
     SUBGROUP_LABEL,
@@ -37,7 +38,7 @@ log = logging.getLogger(__name__)
 
 VERSION_LABEL = "volcano-tpu.io/job-version"
 
-TERMINAL_PHASES = (JobPhase.COMPLETED, JobPhase.FAILED, JobPhase.ABORTED)
+TERMINAL_PHASES = FINISHED_JOB_PHASES
 
 
 @register_controller("job")
@@ -217,12 +218,20 @@ class JobController(Controller):
             if name not in desired and not pod.is_terminated():
                 self.cluster.delete_pod(pod.key)
 
+        # plugin instances are per-job, not per-pod: constructing (and
+        # re-parsing arguments) once keeps a 256-replica materialization
+        # linear
+        plugins = [p for p in (get_job_plugin(n, a)
+                               for n, a in job.plugins.items())
+                   if p is not None]
         for name, (spec, index) in desired.items():
             if name in existing:
                 continue
-            self.cluster.add_pod(self._build_pod(job, spec, index, name))
+            self.cluster.add_pod(
+                self._build_pod(job, spec, index, name, plugins))
 
-    def _build_pod(self, job: VCJob, spec, index: int, name: str) -> Pod:
+    def _build_pod(self, job: VCJob, spec, index: int, name: str,
+                   plugins) -> Pod:
         template = spec.template_pod()
         pod = template.clone()
         pod.name = name
@@ -244,10 +253,8 @@ class JobController(Controller):
             pod.labels[SUBGROUP_LABEL] = spec.subgroup
         if job.priority_class:
             pod.priority_class = job.priority_class
-        for plugin_name, args in job.plugins.items():
-            plugin = get_job_plugin(plugin_name, args)
-            if plugin is not None:
-                plugin.on_pod_create(pod, job)
+        for plugin in plugins:
+            plugin.on_pod_create(pod, job)
         return pod
 
     # -- lifecycle policies -------------------------------------------
@@ -271,6 +278,11 @@ class JobController(Controller):
             if job.retry_count >= job.max_retry:
                 self._transition(job, JobPhase.FAILED,
                                  f"maxRetry ({job.max_retry}) exceeded")
+                # terminal via policy: release every pod the job holds —
+                # a dead 256-host gang must not pin its slice
+                for p in list(self.cluster.pods.values()):
+                    if p.owner == job.uid:
+                        self.cluster.delete_pod(p.key)
                 return
             job.retry_count += 1
             job.version += 1

@@ -1,0 +1,94 @@
+"""JobFlow + JobTemplate controllers — DAGs of vcjobs.
+
+Reference parity: pkg/controllers/jobflow
+(jobflow_controller_action.go:38,76,108 syncJobFlow deploys jobs whose
+dependencies are Completed; states pending/running/succeed/failed) and
+pkg/controllers/jobtemplate.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from typing import Dict, Optional
+
+from volcano_tpu.api.jobflow import JobFlow, JobFlowPhase, JobTemplate
+from volcano_tpu.api.types import JobPhase
+from volcano_tpu.api.vcjob import VCJob
+from volcano_tpu.controllers.framework import Controller, register_controller
+
+log = logging.getLogger(__name__)
+
+
+@register_controller("jobflow")
+class JobFlowController(Controller):
+    name = "jobflow"
+
+    def initialize(self, cluster):
+        super().initialize(cluster)
+        # standalone stores for the flow CRDs (mapping views like the
+        # rest of the Cluster surface)
+        if not hasattr(cluster, "jobflows"):
+            cluster.jobflows = {}
+        if not hasattr(cluster, "jobtemplates"):
+            cluster.jobtemplates = {}
+
+    def sync(self) -> None:
+        for flow in list(self.cluster.jobflows.values()):
+            try:
+                self.sync_flow(flow)
+            except Exception:  # noqa: BLE001
+                log.exception("jobflow %s sync failed", flow.key)
+
+    # -- reconcile ----------------------------------------------------
+
+    def sync_flow(self, flow: JobFlow) -> None:
+        if flow.phase in (JobFlowPhase.SUCCEED, JobFlowPhase.FAILED):
+            return
+
+        job_phases: Dict[str, Optional[JobPhase]] = {}
+        for step in flow.flows:
+            job = self.cluster.vcjobs.get(
+                f"{flow.namespace}/{flow.job_name(step.name)}")
+            job_phases[step.name] = job.phase if job else None
+
+        deployed_any = False
+        for step in flow.flows:
+            if job_phases[step.name] is not None:
+                continue  # already deployed
+            deps = step.depends_on.targets if step.depends_on else []
+            if all(job_phases.get(d) is JobPhase.COMPLETED for d in deps):
+                self._deploy(flow, step)
+                deployed_any = True
+
+        phases = [p for p in job_phases.values()]
+        if any(p is JobPhase.FAILED or p is JobPhase.ABORTED
+               for p in phases):
+            flow.phase = JobFlowPhase.FAILED
+        elif all(p is JobPhase.COMPLETED for p in phases) and phases:
+            flow.phase = JobFlowPhase.SUCCEED
+            if flow.job_retain_policy == "delete":
+                for step in flow.flows:
+                    self.cluster.delete_vcjob(
+                        f"{flow.namespace}/{flow.job_name(step.name)}")
+        elif deployed_any or any(p is not None for p in phases):
+            flow.phase = JobFlowPhase.RUNNING
+
+    def _deploy(self, flow: JobFlow, step) -> None:
+        template = self.cluster.jobtemplates.get(
+            f"{flow.namespace}/{step.name}")
+        if template is None or template.job is None:
+            log.warning("jobflow %s: missing template %s",
+                        flow.key, step.name)
+            return
+        job: VCJob = copy.deepcopy(template.job)
+        job.name = flow.job_name(step.name)
+        job.namespace = flow.namespace
+        from volcano_tpu.api.pod import new_uid
+        job.uid = new_uid()
+        for attr, value in (step.patch or {}).items():
+            if hasattr(job, attr):
+                setattr(job, attr, copy.deepcopy(value))
+        self.cluster.add_vcjob(job)
+        flow.deployed_jobs.append(job.key)
+        log.info("jobflow %s deployed %s", flow.key, job.key)

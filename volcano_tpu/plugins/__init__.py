@@ -16,3 +16,15 @@ import volcano_tpu.plugins.binpack       # noqa: F401
 import volcano_tpu.plugins.deviceshare   # noqa: F401
 import volcano_tpu.plugins.topology      # noqa: F401
 import volcano_tpu.plugins.capacity      # noqa: F401
+import volcano_tpu.plugins.sla           # noqa: F401
+import volcano_tpu.plugins.pdb           # noqa: F401
+import volcano_tpu.plugins.cdp           # noqa: F401
+import volcano_tpu.plugins.tdm           # noqa: F401
+import volcano_tpu.plugins.nodegroup     # noqa: F401
+import volcano_tpu.plugins.usage         # noqa: F401
+import volcano_tpu.plugins.resourcequota # noqa: F401
+import volcano_tpu.plugins.tasktopology  # noqa: F401
+import volcano_tpu.plugins.resource_strategy_fit  # noqa: F401
+import volcano_tpu.plugins.numaaware     # noqa: F401
+import volcano_tpu.plugins.extender      # noqa: F401
+import volcano_tpu.plugins.rescheduling  # noqa: F401

@@ -1,0 +1,82 @@
+"""Numaaware plugin — host-to-chip locality on TPU hosts.
+
+Reference parity: plugins/numaaware/numaaware.go:85,169,191 (NUMA fit
+from the Numatopology CRD with topology-manager policies).  TPU-first
+reading (SURVEY.md §2.3 mapping): on a TPU host the relevant locality
+is cpu-NUMA-node to PCIe-attached chips; nodes publish their NUMA
+inventory via annotations and pods opt into a policy:
+
+  node annotation  numa.volcano-tpu.io/nodes:
+      '{"0": {"cpu": 56, "tpu": 2}, "1": {"cpu": 56, "tpu": 2}}'
+  pod annotation   numa.volcano-tpu.io/policy:
+      best-effort | single-numa-node
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+from volcano_tpu.api.fit_error import unschedulable
+from volcano_tpu.api.job_info import TaskInfo
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.resource import TPU, parse_cpu
+from volcano_tpu.framework.plugins import Plugin, register_plugin
+
+NUMA_NODES_ANNOTATION = "numa.volcano-tpu.io/nodes"
+NUMA_POLICY_ANNOTATION = "numa.volcano-tpu.io/policy"
+MAX_SCORE = 100.0
+
+
+def numa_inventory(node: NodeInfo) -> Optional[Dict[str, Dict[str, float]]]:
+    if node.node is None:
+        return None
+    raw = node.node.annotations.get(NUMA_NODES_ANNOTATION)
+    if not raw:
+        return None
+    try:
+        return json.loads(raw)
+    except ValueError:
+        return None
+
+
+@register_plugin("numaaware")
+class NumaAwarePlugin(Plugin):
+    name = "numaaware"
+
+    def on_session_open(self, ssn):
+        ssn.add_predicate_fn(self.name, self._predicate)
+        ssn.add_node_order_fn(self.name, self._score)
+
+    @staticmethod
+    def _fits_single_numa(task: TaskInfo, inventory) -> bool:
+        need_cpu = task.resreq.milli_cpu
+        need_tpu = task.resreq.get(TPU)
+        for numa in inventory.values():
+            cpu_cap = parse_cpu(numa.get("cpu", 0))
+            tpu_cap = float(numa.get("tpu", 0))
+            if need_cpu <= cpu_cap and need_tpu <= tpu_cap:
+                return True
+        return False
+
+    def _predicate(self, task: TaskInfo, node: NodeInfo):
+        policy = task.pod.annotations.get(NUMA_POLICY_ANNOTATION)
+        if policy != "single-numa-node":
+            return None
+        inventory = numa_inventory(node)
+        if inventory is None:
+            return None  # no topology published: don't block
+        if not self._fits_single_numa(task, inventory):
+            return unschedulable(
+                "request cannot fit a single NUMA node", "numaaware",
+                resolvable=False)
+        return None
+
+    def _score(self, task: TaskInfo, node: NodeInfo) -> float:
+        policy = task.pod.annotations.get(NUMA_POLICY_ANNOTATION)
+        if policy not in ("best-effort", "single-numa-node"):
+            return 0.0
+        inventory = numa_inventory(node)
+        if inventory is None:
+            return 0.0
+        return MAX_SCORE if self._fits_single_numa(task, inventory) else 0.0

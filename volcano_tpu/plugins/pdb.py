@@ -1,0 +1,59 @@
+"""PDB plugin — respect PodDisruptionBudgets in evictions.
+
+Reference parity: plugins/pdb/pdb.go:135-137.  Budgets are declared as
+pod annotations (standalone analogue of the PDB CRD):
+  volcano-tpu.io/disruption-group: <name>
+  volcano-tpu.io/min-available:    <int>  (per group)
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import List
+
+from volcano_tpu.api.job_info import TaskInfo
+from volcano_tpu.framework.plugins import Plugin, register_plugin
+
+GROUP_ANNOTATION = "volcano-tpu.io/disruption-group"
+MIN_AVAILABLE_ANNOTATION = "volcano-tpu.io/min-available"
+
+
+@register_plugin("pdb")
+class PDBPlugin(Plugin):
+    name = "pdb"
+
+    def on_session_open(self, ssn):
+        self.ssn = ssn
+        ssn.add_preemptable_fn(self.name, self._filter)
+        ssn.add_reclaimable_fn(self.name, self._filter)
+        ssn.add_unified_evictable_fn(self.name, self._filter)
+
+    def _filter(self, ctx, candidates: List[TaskInfo]) -> List[TaskInfo]:
+        # current healthy members per disruption group (cluster-wide)
+        healthy = defaultdict(int)
+        minima = {}
+        for job in self.ssn.jobs.values():
+            for t in job.tasks.values():
+                group = t.pod.annotations.get(GROUP_ANNOTATION)
+                if not group:
+                    continue
+                if t.occupies_resources():
+                    healthy[group] += 1
+                raw = t.pod.annotations.get(MIN_AVAILABLE_ANNOTATION)
+                if raw is not None:
+                    try:
+                        minima[group] = max(minima.get(group, 0), int(raw))
+                    except ValueError:
+                        pass
+
+        victims = []
+        planned = defaultdict(int)
+        for t in candidates:
+            group = t.pod.annotations.get(GROUP_ANNOTATION)
+            if not group or group not in minima:
+                victims.append(t)
+                continue
+            if healthy[group] - planned[group] - 1 >= minima[group]:
+                victims.append(t)
+                planned[group] += 1
+        return victims

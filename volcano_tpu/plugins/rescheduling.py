@@ -1,0 +1,64 @@
+"""Rescheduling plugin — periodic low-utilization rebalancing.
+
+Reference parity: plugins/rescheduling/rescheduling.go:110 (strategy
+lowNodeUtilization feeds VictimTasks; shuffle executes).  Arguments:
+  rescheduling.interval: seconds between passes (default 300)
+  rescheduling.lowThreshold:  fraction below which a node is "low"
+  rescheduling.highThreshold: fraction above which a node is "high"
+Victims are preemptable pods on HIGH nodes, movable only while LOW
+nodes exist to absorb them.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+from volcano_tpu.api.job_info import TaskInfo
+from volcano_tpu.api.resource import MIN_RESOURCE
+from volcano_tpu.framework.plugins import Plugin, register_plugin
+
+_last_run = {"ts": 0.0}
+
+
+@register_plugin("rescheduling")
+class ReschedulingPlugin(Plugin):
+    name = "rescheduling"
+
+    def __init__(self, arguments=None):
+        super().__init__(arguments)
+        self.interval = float(self.arguments.get("rescheduling.interval", 300))
+        self.low = float(self.arguments.get("rescheduling.lowThreshold", 0.2))
+        self.high = float(self.arguments.get("rescheduling.highThreshold", 0.8))
+
+    def on_session_open(self, ssn):
+        self.ssn = ssn
+        ssn.add_victim_tasks_fn(self.name, self._victims)
+
+    @staticmethod
+    def _utilization(node) -> float:
+        frac = 0.0
+        for dim, cap in node.allocatable.res.items():
+            if cap > MIN_RESOURCE:
+                frac = max(frac, node.used.get(dim) / cap)
+        return frac
+
+    def _victims(self) -> List[TaskInfo]:
+        now = time.time()
+        if now - _last_run["ts"] < self.interval:
+            return []
+        nodes = [n for n in self.ssn.nodes.values() if n.ready]
+        low = [n for n in nodes if self._utilization(n) < self.low]
+        high = [n for n in nodes if self._utilization(n) > self.high]
+        if not low or not high:
+            return []
+        _last_run["ts"] = now
+        victims = []
+        for node in high:
+            for t in node.tasks.values():
+                if t.occupies_resources() and t.preemptable:
+                    job = self.ssn.jobs.get(t.job)
+                    victim = job.tasks.get(t.uid) if job else None
+                    victims.append(victim or t)
+                    break  # one per high node per pass
+        return victims

@@ -1,0 +1,64 @@
+"""Resource-strategy-fit plugin — per-resource scoring strategy mix.
+
+Reference parity: plugins/resource-strategy-fit/
+resource_strategy_fit.go:266,274 (each resource type independently
+scored MostAllocated or LeastAllocated with its own weight).
+Arguments:
+  resourceStrategyFitWeight: 10
+  resources:
+    google.com/tpu: {type: MostAllocated, weight: 2}
+    cpu:            {type: LeastAllocated, weight: 1}
+"""
+
+from __future__ import annotations
+
+from volcano_tpu.api.job_info import TaskInfo
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.resource import MIN_RESOURCE, TPU
+from volcano_tpu.framework.plugins import Plugin, register_plugin
+
+MAX_SCORE = 100.0
+
+# TPU default: pack chips (keep whole slices free); spread cpu.
+DEFAULT_STRATEGY = {
+    TPU: {"type": "MostAllocated", "weight": 2},
+    "cpu": {"type": "LeastAllocated", "weight": 1},
+}
+
+
+@register_plugin("resource-strategy-fit")
+class ResourceStrategyFitPlugin(Plugin):
+    name = "resource-strategy-fit"
+
+    def __init__(self, arguments=None):
+        super().__init__(arguments)
+        self.weight = float(self.arguments.get("resourceStrategyFitWeight", 1))
+        self.strategies = dict(DEFAULT_STRATEGY)
+        for dim, spec in dict(self.arguments.get("resources", {})).items():
+            self.strategies[dim] = {
+                "type": spec.get("type", "LeastAllocated"),
+                "weight": float(spec.get("weight", 1)),
+            }
+
+    def on_session_open(self, ssn):
+        ssn.add_node_order_fn(self.name, self._score)
+
+    def _score(self, task: TaskInfo, node: NodeInfo) -> float:
+        total, weights = 0.0, 0.0
+        for dim, req in task.resreq.res.items():
+            strategy = self.strategies.get(dim)
+            if strategy is None or req < MIN_RESOURCE:
+                continue
+            alloc = node.allocatable.get(dim)
+            if alloc < MIN_RESOURCE:
+                continue
+            frac = min(1.0, (node.used.get(dim) + req) / alloc)
+            w = strategy["weight"]
+            if strategy["type"] == "MostAllocated":
+                total += w * frac
+            else:
+                total += w * (1.0 - frac)
+            weights += w
+        if weights == 0:
+            return 0.0
+        return self.weight * MAX_SCORE * total / weights
