@@ -1,0 +1,231 @@
+"""Agent scheduler fast path + sharding + HyperJob."""
+
+import time
+
+from volcano_tpu.agentscheduler import AgentScheduler
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.pod import Container, Pod, make_pod
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.shard import AGENT_SCHEDULER, SHARD_MODE_HARD, \
+    SHARD_MODE_SOFT
+from volcano_tpu.api.types import JobPhase, TaskStatus
+from volcano_tpu.api.vcjob import TaskSpec, VCJob
+from volcano_tpu.cache.fake_cluster import FakeCluster
+from volcano_tpu.controllers import ControllerManager
+from volcano_tpu.controllers.hyperjob import HyperJob, ReplicatedJob
+from volcano_tpu.controllers.sharding import SHARD_LABEL, ShardingController
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.simulator import make_tpu_cluster
+from volcano_tpu.webhooks import default_admission
+
+
+def agent_pod(name, cpu="1"):
+    pod = make_pod(name, requests={"cpu": cpu})
+    pod.scheduler_name = AGENT_SCHEDULER
+    return pod
+
+
+def test_agent_scheduler_binds_pods_fast():
+    cluster = FakeCluster()
+    for i in range(4):
+        cluster.add_node(Node(name=f"n{i}",
+                              allocatable={"cpu": 8, "pods": 110}))
+    sched = AgentScheduler(cluster)
+    for i in range(20):
+        cluster.add_pod(agent_pod(f"a{i}"))
+    bound = sched.run_until_drained()
+    assert bound == 20
+    assert len(cluster.binds) == 20
+
+
+def test_agent_scheduler_parks_unschedulable_and_retries():
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="n0", allocatable={"cpu": 1}))
+    sched = AgentScheduler(cluster)
+    cluster.add_pod(agent_pod("big", cpu="4"))
+    sched.run_until_drained()
+    assert len(cluster.binds) == 0
+    assert len(sched.queue.unschedulable) == 1
+    # capacity arrives -> parked pod reactivates and binds
+    cluster.add_node(Node(name="n1", allocatable={"cpu": 8}))
+    sched.refresh()
+    sched.queue.activate_unschedulable()
+    sched.run_until_drained()
+    assert ("default/big", "n1") in cluster.binds
+
+
+def test_agent_scheduler_bind_generation_conflict():
+    """Simulate a racing worker committing between candidate selection
+    and bind: the stale-generation node must be skipped and the pod
+    retried rather than double-booked."""
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="n0", allocatable={"cpu": 8}))
+    cluster.add_node(Node(name="n1", allocatable={"cpu": 8}))
+    sched = AgentScheduler(cluster, candidates=2)
+    cluster.add_pod(agent_pod("p0"))
+
+    orig = sched._select_candidates
+    raced = {}
+
+    def sabotaged(task):
+        candidates = orig(task)
+        if candidates and not raced:
+            # another worker commits onto the top candidate AFTER the
+            # generation was read: stored generation is now stale
+            node, _ = candidates[0]
+            raced["node"] = node.name
+            node.bind_generation += 1
+        return candidates
+
+    sched._select_candidates = sabotaged
+    sched.run_until_drained()
+    # bound exactly once, on the runner-up node
+    assert len(cluster.binds) == 1
+    assert cluster.binds[0][1] != raced["node"]
+
+
+def test_agent_scheduler_racing_instances_never_overbind():
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="n0", allocatable={"cpu": 2, "pods": 110}))
+    s1, s2 = AgentScheduler(cluster), AgentScheduler(cluster)
+    for i in range(4):
+        cluster.add_pod(agent_pod(f"p{i}"))
+    b1 = s1.run_until_drained()
+    s2.refresh()
+    b2 = s2.run_until_drained()
+    assert b1 + b2 == 2               # capacity is 2 cpu
+    assert len({k for k, _ in cluster.binds}) == len(cluster.binds)
+
+
+def test_agent_scheduler_node_events_update_cache():
+    """A node added after startup becomes schedulable WITHOUT a manual
+    refresh (incremental cache honesty)."""
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="n0", allocatable={"cpu": 1}))
+    sched = AgentScheduler(cluster)
+    cluster.add_pod(agent_pod("big", cpu="4"))
+    sched.run_until_drained()
+    assert len(cluster.binds) == 0
+    cluster.add_node(Node(name="n1", allocatable={"cpu": 8}))
+    sched.run_until_drained()
+    assert ("default/big", "n1") in cluster.binds
+
+
+def test_sharding_fraction_policy_keeps_tpu_with_batch():
+    cluster = make_tpu_cluster([("sa", "v5e-16")],
+                               extra_nodes=[
+                                   Node(name=f"cpu{i}",
+                                        allocatable={"cpu": 16})
+                                   for i in range(4)])
+    ctrl = ShardingController(policy="fraction", agent_fraction=0.5)
+    ctrl.initialize(cluster)
+    ctrl.sync()
+    agent_shard = cluster.nodeshards["agent"].nodes
+    batch_shard = cluster.nodeshards["batch"].nodes
+    assert len(agent_shard) == 2
+    assert all(n.startswith("cpu") for n in agent_shard)
+    assert all(n in batch_shard for n in
+               [f"sa-w{i}" for i in range(4)])
+
+
+def test_agent_scheduler_hard_shard_mode():
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="agent0", allocatable={"cpu": 8},
+                          labels={SHARD_LABEL: "agent"}))
+    cluster.add_node(Node(name="batch0", allocatable={"cpu": 8}))
+    ctrl = ShardingController(policy="label")
+    ctrl.initialize(cluster)
+    ctrl.sync()
+    sched = AgentScheduler(cluster, shard_mode=SHARD_MODE_HARD)
+    cluster.add_pod(agent_pod("p0"))
+    sched.run_until_drained()
+    assert cluster.binds == [("default/p0", "agent0")]
+
+
+def test_hyperjob_members_and_gang():
+    cluster = make_tpu_cluster([("sa", "v5e-16"), ("sb", "v5e-16")])
+    cluster.admission = default_admission()
+    mgr = ControllerManager(cluster, enabled=["job", "hyperjob"])
+    sched = Scheduler(cluster, schedule_period=0)
+
+    template = VCJob(name="member", min_available=4,
+                     tasks=[TaskSpec(name="w", replicas=4,
+                                     template=Pod(name="t", containers=[
+                                         Container(requests={"cpu": 8,
+                                                             TPU: 4})]))])
+    hj = HyperJob(name="multislice", min_available=2,
+                  replicated_jobs=[ReplicatedJob(name="rep", replicas=2,
+                                                 template=template)],
+                  max_domains=2)
+    cluster.hyperjobs = {hj.key: hj}
+
+    for _ in range(4):
+        mgr.sync_all()
+        sched.run_once()
+        cluster.tick()
+
+    assert "default/multislice-rep-0" in cluster.vcjobs
+    assert "default/multislice-rep-1" in cluster.vcjobs
+    from volcano_tpu.controllers.hyperjob import HyperJobPhase
+    assert hj.phase is HyperJobPhase.RUNNING
+    # maxDomains forced slice-local members: each fills one slice
+    slices = {}
+    for key, node in cluster.binds:
+        member = key.split("/")[1].rsplit("-w-", 1)[0]
+        slices.setdefault(member, set()).add(node.rsplit("-w", 1)[0])
+    assert all(len(s) == 1 for s in slices.values())
+
+
+def test_hyperjob_max_domains_caps_spread():
+    """3 members with max_domains=1: ALL land in the single allowed DCN
+    pod, members beyond its capacity wait."""
+    cluster = make_tpu_cluster(
+        [("sa", "v5e-16"), ("sb", "v5e-16"), ("sc", "v5e-16")],
+        dcn_pods={"sa": "dcnA", "sb": "dcnA", "sc": "dcnB"})
+    cluster.admission = default_admission()
+    mgr = ControllerManager(cluster, enabled=["job", "hyperjob"])
+    sched = Scheduler(cluster, schedule_period=0)
+    template = VCJob(name="member", min_available=4,
+                     tasks=[TaskSpec(name="w", replicas=4,
+                                     template=Pod(name="t", containers=[
+                                         Container(requests={"cpu": 8,
+                                                             TPU: 4})]))])
+    hj = HyperJob(name="capped", min_available=2,
+                  replicated_jobs=[ReplicatedJob(name="rep", replicas=3,
+                                                 template=template)],
+                  max_domains=1)
+    cluster.hyperjobs = {hj.key: hj}
+    for _ in range(4):
+        mgr.sync_all()
+        sched.run_once()
+        cluster.tick()
+    # only dcnA (= slices sa+sb, 2 members' worth) may host members
+    used_slices = {n.rsplit("-w", 1)[0] for _, n in cluster.binds}
+    assert used_slices <= {"sa", "sb"}
+    assert len(used_slices) == 2
+
+
+def test_batch_scheduler_hard_shard_mode():
+    """With hard sharding, batch allocate never touches agent nodes."""
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="agent0", allocatable={"cpu": 64},
+                          labels={SHARD_LABEL: "agent"}))
+    cluster.add_node(Node(name="batch0", allocatable={"cpu": 8}))
+    ctrl = ShardingController(policy="label")
+    ctrl.initialize(cluster)
+    ctrl.sync()
+    from volcano_tpu.uthelper import gang_job
+    from volcano_tpu.api.podgroup import PodGroup
+    pg, pods = gang_job("batchjob", replicas=2, min_available=1,
+                        requests={"cpu": 4})
+    cluster.add_podgroup(pg)
+    for p in pods:
+        cluster.add_pod(p)
+    conf = {"actions": "enqueue, allocate, backfill",
+            "configurations": {"allocate": {"shard-mode": "hard"}},
+            "tiers": [{"plugins": [{"name": "gang"},
+                                   {"name": "predicates"},
+                                   {"name": "nodeorder"}]}]}
+    Scheduler(cluster, conf=conf, schedule_period=0).run_once()
+    assert all(n == "batch0" for _, n in cluster.binds)
+    assert len(cluster.binds) == 2  # both fit batch0

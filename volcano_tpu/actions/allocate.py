@@ -80,9 +80,38 @@ class AllocateAction(Action):
             return
 
         stmt = ssn.statement()
-        self._allocate_tasks(ssn, queue, job, stmt,
-                             list(ssn.nodes.values()))
+        own_shard, mode = self._shard_view(ssn)
+        if own_shard is not None:
+            shard_nodes = [n for n in ssn.nodes.values()
+                           if n.name in own_shard]
+            self._allocate_tasks(ssn, queue, job, stmt, shard_nodes)
+            if mode == "soft" and job.tasks_in_status(TaskStatus.PENDING):
+                # spill what didn't fit the shard onto the full cluster
+                self._allocate_tasks(ssn, queue, job, stmt,
+                                     list(ssn.nodes.values()),
+                                     record_errors=False)
+        else:
+            self._allocate_tasks(ssn, queue, job, stmt,
+                                 list(ssn.nodes.values()))
         self._finish(ssn, job, stmt)
+
+    @staticmethod
+    def _shard_view(ssn):
+        """(own shard node set, mode) — None when sharding is off.
+
+        Candidate-node gradient by shard (allocate.go:886-919): hard
+        restricts to the scheduler's NodeShard; soft prefers it.
+        """
+        from volcano_tpu.controllers.sharding import shard_nodes_for
+        mode = str(ssn.conf.configurations.get("allocate", {})
+                   .get("shard-mode", "none"))
+        if mode not in ("soft", "hard"):
+            return None, "none"
+        own = shard_nodes_for(ssn.cache.cluster,
+                              ssn.cache.scheduler_name)
+        if not own:
+            return None, mode
+        return set(own), mode
 
     def _finish(self, ssn, job: JobInfo, stmt) -> None:
         if ssn.job_ready(job):

@@ -18,6 +18,8 @@ import volcano_tpu.controllers.queue             # noqa: E402,F401
 import volcano_tpu.controllers.garbagecollector  # noqa: E402,F401
 import volcano_tpu.controllers.jobflow           # noqa: E402,F401
 import volcano_tpu.controllers.cronjob           # noqa: E402,F401
+import volcano_tpu.controllers.sharding          # noqa: E402,F401
+import volcano_tpu.controllers.hyperjob          # noqa: E402,F401
 
 __all__ = ["Controller", "ControllerManager", "register_controller",
            "CONTROLLERS"]

@@ -1,0 +1,141 @@
+"""HyperJob controller — replicated vcjobs with job-level gang.
+
+Reference parity: staging/.../training/v1alpha1/hyperjob.go:29-67 +
+docs/design/hyperjob-multi-cluster-job-splitting.md: a HyperJob stamps
+out replicas of vcjob templates (replicatedJobs), is Running when
+minAvailable member jobs run, and splits members across topology
+domains (here: DCN pods via per-member networkTopology, maxDomains
+capping the spread) — the TPU reading of multi-cluster splitting.
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from volcano_tpu.api.pod import new_uid
+from volcano_tpu.api.types import FINISHED_JOB_PHASES, JobPhase
+from volcano_tpu.api.vcjob import VCJob
+from volcano_tpu.controllers.framework import Controller, register_controller
+
+log = logging.getLogger(__name__)
+
+
+class HyperJobPhase(enum.Enum):
+    PENDING = "Pending"
+    RUNNING = "Running"
+    COMPLETED = "Completed"
+    FAILED = "Failed"
+
+
+@dataclass
+class ReplicatedJob:
+    name: str
+    replicas: int = 1
+    template: Optional[VCJob] = None
+
+
+@dataclass
+class HyperJob:
+    name: str
+    namespace: str = "default"
+    uid: str = field(default_factory=new_uid)
+    replicated_jobs: List[ReplicatedJob] = field(default_factory=list)
+    min_available: int = 1          # member jobs that must be Running
+    max_domains: int = 0            # 0 = unlimited spread
+    phase: HyperJobPhase = HyperJobPhase.PENDING
+    creation_time: float = field(default_factory=time.time)
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def member_name(self, rj: ReplicatedJob, index: int) -> str:
+        return f"{self.name}-{rj.name}-{index}"
+
+
+@register_controller("hyperjob")
+class HyperJobController(Controller):
+    name = "hyperjob"
+
+    def initialize(self, cluster):
+        super().initialize(cluster)
+        if not hasattr(cluster, "hyperjobs"):
+            cluster.hyperjobs = {}
+
+    def sync(self) -> None:
+        for hj in list(self.cluster.hyperjobs.values()):
+            try:
+                self.sync_hyperjob(hj)
+            except Exception:  # noqa: BLE001
+                log.exception("hyperjob %s sync failed", hj.key)
+
+    def sync_hyperjob(self, hj: HyperJob) -> None:
+        if hj.phase in (HyperJobPhase.COMPLETED, HyperJobPhase.FAILED):
+            return
+
+        allowed_domains = self._allowed_domains(hj)
+        phases: List[Optional[JobPhase]] = []
+        member_index = 0
+        for rj in hj.replicated_jobs:
+            for i in range(rj.replicas):
+                key = f"{hj.namespace}/{hj.member_name(rj, i)}"
+                member = self.cluster.vcjobs.get(key)
+                if member is None and rj.template is not None:
+                    member = self._deploy(hj, rj, i, member_index,
+                                          allowed_domains)
+                member_index += 1
+                phases.append(member.phase if member else None)
+
+        running = sum(1 for p in phases if p is JobPhase.RUNNING)
+        completed = sum(1 for p in phases if p is JobPhase.COMPLETED)
+        failed = sum(1 for p in phases
+                     if p in (JobPhase.FAILED, JobPhase.ABORTED))
+        total = len(phases)
+
+        if completed >= hj.min_available:
+            hj.phase = HyperJobPhase.COMPLETED
+        elif total - failed < hj.min_available:
+            hj.phase = HyperJobPhase.FAILED
+        elif running + completed >= hj.min_available:
+            hj.phase = HyperJobPhase.RUNNING
+
+    def _allowed_domains(self, hj: HyperJob) -> List[str]:
+        """The max_domains lowest-named tier-2 (DCN pod) hypernodes the
+        member set may occupy (empty = unrestricted)."""
+        if hj.max_domains <= 0:
+            return []
+        tier2 = sorted(hn.name for hn in self.cluster.hypernodes.values()
+                       if hn.tier == 2)
+        return tier2[: hj.max_domains]
+
+    def _deploy(self, hj: HyperJob, rj: ReplicatedJob, index: int,
+                member_index: int, allowed_domains: List[str]) -> VCJob:
+        job = copy.deepcopy(rj.template)
+        job.name = hj.member_name(rj, index)
+        job.namespace = hj.namespace
+        job.uid = new_uid()
+        if hj.max_domains > 0:
+            if job.network_topology is None:
+                # each member stays slice-local (ICI-coherent)
+                from volcano_tpu.api.podgroup import NetworkTopologySpec
+                from volcano_tpu.api.types import NetworkTopologyMode
+                job.network_topology = NetworkTopologySpec(
+                    NetworkTopologyMode.HARD, 1)
+            if allowed_domains:
+                # the SPREAD cap: pin member round-robin onto one of the
+                # allowed DCN pods via node affinity on the pod label
+                from volcano_tpu.controllers.hypernode import DCN_POD_LABEL
+                domain = allowed_domains[member_index % len(allowed_domains)]
+                for spec in job.tasks:
+                    template = spec.template_pod()
+                    template.affinity_node_terms = [
+                        {DCN_POD_LABEL: [domain]}]
+                    spec.template = template
+        self.cluster.add_vcjob(job)
+        log.info("hyperjob %s deployed member %s", hj.key, job.key)
+        return job

@@ -1,0 +1,77 @@
+"""Sharding controller — partition nodes between schedulers.
+
+Reference parity: pkg/controllers/sharding/sharding_controller.go:55
+(+ policies and node-utilization tracking).  Policies:
+- label:   nodes labeled volcano-tpu.io/shard=agent go to the agent
+           scheduler, everything else to batch.
+- fraction: a fixed fraction of non-TPU nodes goes to the agent
+           scheduler (TPU slice hosts always stay with batch — gang
+           machinery owns them).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List
+
+from volcano_tpu.api.shard import (
+    AGENT_SCHEDULER,
+    BATCH_SCHEDULER,
+    NodeShard,
+)
+from volcano_tpu.api.types import TPU_SLICE_LABEL
+from volcano_tpu.controllers.framework import Controller, register_controller
+
+log = logging.getLogger(__name__)
+
+SHARD_LABEL = "volcano-tpu.io/shard"
+
+
+@register_controller("sharding")
+class ShardingController(Controller):
+    name = "sharding"
+
+    def __init__(self, policy: str = "label", agent_fraction: float = 0.25):
+        self.policy = policy
+        self.agent_fraction = agent_fraction
+
+    def initialize(self, cluster):
+        super().initialize(cluster)
+        if not hasattr(cluster, "nodeshards"):
+            cluster.nodeshards = {}
+
+    def sync(self) -> None:
+        snap = self.cluster.list_all()
+        agent_nodes: List[str] = []
+        batch_nodes: List[str] = []
+        candidates = sorted(snap.nodes, key=lambda n: n.name)
+        if self.policy == "label":
+            for node in candidates:
+                if node.labels.get(SHARD_LABEL) == "agent":
+                    agent_nodes.append(node.name)
+                else:
+                    batch_nodes.append(node.name)
+        else:  # fraction policy over non-TPU nodes
+            non_tpu = [n for n in candidates
+                       if not n.labels.get(TPU_SLICE_LABEL)]
+            take = int(len(non_tpu) * self.agent_fraction)
+            agent_set = {n.name for n in non_tpu[:take]}
+            for node in candidates:
+                (agent_nodes if node.name in agent_set
+                 else batch_nodes).append(node.name)
+
+        self.cluster.nodeshards = {
+            "batch": NodeShard(name="batch", scheduler=BATCH_SCHEDULER,
+                               nodes=batch_nodes),
+            "agent": NodeShard(name="agent", scheduler=AGENT_SCHEDULER,
+                               nodes=agent_nodes),
+        }
+
+
+def shard_nodes_for(cluster, scheduler_name: str) -> List[str]:
+    """Node names assigned to *scheduler_name* (empty = no sharding)."""
+    shards = getattr(cluster, "nodeshards", {}) or {}
+    for shard in shards.values():
+        if shard.scheduler == scheduler_name:
+            return list(shard.nodes)
+    return []
