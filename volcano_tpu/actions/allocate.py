@@ -148,6 +148,40 @@ class AllocateAction(Action):
 
         placed = 0
         failed_specs = set()
+        # A plugin with task-identity-dependent predicates (extender)
+        # makes cached verdicts unsound: fall back to per-task sweeps.
+        cache_enabled = not ssn.task_dependent_predicates
+        # Per-spec predicate/score cache with single-node invalidation:
+        # a gang's tasks are identical, and a placement only changes the
+        # state of the ONE node it landed on — so feasibility and
+        # per-node scores are recomputed just for that node instead of
+        # sweeping all nodes per task (the reference parallelizes this
+        # sweep; we make it incremental).  Task-dependent scores
+        # (BatchNodeOrder, e.g. topology pull) are still per task.
+        spec_cache: Dict[str, dict] = {}
+
+        def build_entry(task):
+            fit_nodes = predicate_nodes(ssn, task, candidate_nodes,
+                                        record_errors)
+            entry = {
+                "proto": task,
+                "fits": {n.name: n for n in fit_nodes},
+                "scores": {n.name: ssn.node_order(task, n)
+                           for n in fit_nodes},
+            }
+            spec_cache[task.task_spec] = entry
+            return entry
+
+        def invalidate(node):
+            for entry in spec_cache.values():
+                proto = entry["proto"]
+                if ssn.predicate(proto, node) is None:
+                    entry["fits"][node.name] = node
+                    entry["scores"][node.name] = ssn.node_order(proto, node)
+                else:
+                    entry["fits"].pop(node.name, None)
+                    entry["scores"].pop(node.name, None)
+
         for task in tasks:
             if task.task_spec in failed_specs:
                 # identical spec already failed everywhere this round
@@ -169,19 +203,31 @@ class AllocateAction(Action):
                 failed_specs.add(task.task_spec)
                 continue
 
-            fit_nodes = predicate_nodes(ssn, task, candidate_nodes,
-                                        record_errors)
+            if cache_enabled:
+                entry = spec_cache.get(task.task_spec) or build_entry(task)
+                fit_nodes = list(entry["fits"].values())
+                base_scores = entry["scores"]
+            else:
+                fit_nodes = predicate_nodes(ssn, task, candidate_nodes,
+                                            record_errors)
+                base_scores = None
             idle_fit, future_fit = split_by_fit(task, fit_nodes)
 
-            node = prioritize_nodes(ssn, task, idle_fit)
+            node = prioritize_nodes(ssn, task, idle_fit,
+                                    base_scores=base_scores)
+            pipelined = False
+            if node is None:
+                node = prioritize_nodes(ssn, task, future_fit,
+                                        base_scores=base_scores)
+                pipelined = node is not None
             if node is not None:
-                stmt.allocate(task, node)
+                if pipelined:
+                    stmt.pipeline(task, node)
+                else:
+                    stmt.allocate(task, node)
                 placed += 1
-                continue
-            node = prioritize_nodes(ssn, task, future_fit)
-            if node is not None:
-                stmt.pipeline(task, node)
-                placed += 1
+                if cache_enabled:
+                    invalidate(node)
                 continue
 
             if record_errors and not fit_nodes:

@@ -57,9 +57,15 @@ def split_by_fit(task: TaskInfo, nodes: List[NodeInfo]
     return idle_fit, future_fit
 
 
-def prioritize_nodes(ssn, task: TaskInfo,
-                     nodes: List[NodeInfo]) -> Optional[NodeInfo]:
-    """Score candidates (BatchNodeOrder + NodeOrder) and return the best."""
+def prioritize_nodes(ssn, task: TaskInfo, nodes: List[NodeInfo],
+                     base_scores: Optional[Dict[str, float]] = None
+                     ) -> Optional[NodeInfo]:
+    """Score candidates (BatchNodeOrder + NodeOrder) and return the best.
+
+    base_scores: precomputed per-node NodeOrder scores (the allocate
+    hot loop's per-spec cache); task-dependent BatchNodeOrder is always
+    evaluated fresh.
+    """
     if not nodes:
         return None
     if len(nodes) == 1:
@@ -67,7 +73,9 @@ def prioritize_nodes(ssn, task: TaskInfo,
     scores: Dict[str, float] = ssn.batch_node_order(task, nodes)
     best, best_score = None, None
     for node in nodes:
-        s = scores.get(node.name, 0.0) + ssn.node_order(task, node)
+        per_node = (base_scores.get(node.name, 0.0) if base_scores is not None
+                    else ssn.node_order(task, node))
+        s = scores.get(node.name, 0.0) + per_node
         if best_score is None or s > best_score or \
                 (s == best_score and node.name < best.name):
             best, best_score = node, s

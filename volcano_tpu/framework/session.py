@@ -70,7 +70,13 @@ class Session:
 
         # extension-point registries: point -> plugin name -> fn
         self._fns: Dict[str, Dict[str, Callable]] = defaultdict(dict)
+        self._enabled_cache: Dict[str, list] = {}
         self.event_handlers: List[EventHandler] = []
+        # Plugins whose predicate verdicts depend on TASK IDENTITY or
+        # cross-node external state (not just task spec + node state)
+        # must add their name here: it disables allocate's per-spec
+        # predicate/score cache so every task gets a fresh sweep.
+        self.task_dependent_predicates: Set[str] = set()
 
         # PodGroup phases dirtied this session, flushed by job_updater.
         self.dirty_jobs: Set[str] = set()
@@ -104,6 +110,7 @@ class Session:
 
     def add_fn(self, point: str, plugin: str, fn: Callable):
         self._fns[point][plugin] = fn
+        self._enabled_cache.pop(point, None)
 
     def add_event_handler(self, handler: EventHandler):
         self.event_handlers.append(handler)
@@ -138,18 +145,27 @@ class Session:
     # -- tier-walking dispatch helpers ---------------------------------
 
     def _enabled_fns(self, point: str):
-        """Yield (plugin_option, fn) honoring tier order + enable flags."""
+        """(plugin_option, fn) tiers honoring order + enable flags.
+
+        Registrations only happen during plugin OnSessionOpen, so the
+        resolved tier walk is memoized per point (the dispatcher runs
+        hundreds of thousands of times per cycle)."""
+        cached = self._enabled_cache.get(point)
+        if cached is not None:
+            return cached
         fns = self._fns.get(point)
-        if not fns:
-            return
-        for tier in self.tiers:
-            tier_fns = []
-            for opt in tier.plugins:
-                fn = fns.get(opt.name)
-                if fn is not None and opt.is_enabled(point):
-                    tier_fns.append((opt, fn))
-            if tier_fns:
-                yield tier_fns
+        result = []
+        if fns:
+            for tier in self.tiers:
+                tier_fns = []
+                for opt in tier.plugins:
+                    fn = fns.get(opt.name)
+                    if fn is not None and opt.is_enabled(point):
+                        tier_fns.append((opt, fn))
+                if tier_fns:
+                    result.append(tier_fns)
+        self._enabled_cache[point] = result
+        return result
 
     def _compare(self, point: str, a, b) -> int:
         for tier_fns in self._enabled_fns(point):

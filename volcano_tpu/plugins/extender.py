@@ -82,6 +82,9 @@ class ExtenderPlugin(Plugin):
     def on_session_open(self, ssn):
         if not self.extenders:
             return
+        # external verdicts may key on task identity or external state:
+        # opt out of the per-spec predicate cache
+        ssn.task_dependent_predicates.add(self.name)
         ssn.add_predicate_fn(self.name, self._predicate)
         ssn.add_batch_node_order_fn(self.name, self._batch_order)
         ssn.add_job_enqueueable_fn(self.name, self._enqueueable)
