@@ -1,0 +1,153 @@
+"""Command bus (suspend/resume), hdrf, data locality, colocation
+config, metrics endpoint."""
+
+import urllib.request
+
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.pod import Container, Pod
+from volcano_tpu.api.queue import Queue
+from volcano_tpu.api.types import JobPhase, PodGroupPhase
+from volcano_tpu.api.vcjob import TaskSpec, VCJob
+from volcano_tpu.cache.fake_cluster import FakeCluster
+from volcano_tpu.controllers import ControllerManager
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.simulator import make_tpu_cluster
+from volcano_tpu.uthelper import TestContext, gang_job
+from volcano_tpu.webhooks import default_admission
+
+
+def stack():
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    cluster.admission = default_admission()
+    mgr = ControllerManager(cluster, enabled=["job"])
+    sched = Scheduler(cluster, schedule_period=0)
+    return cluster, mgr, sched
+
+
+def simple_job(name="j", replicas=2):
+    return VCJob(name=name, min_available=replicas,
+                 tasks=[TaskSpec(name="w", replicas=replicas,
+                                 template=Pod(name="t", containers=[
+                                     Container(requests={"cpu": 1})]))])
+
+
+def pump(cluster, mgr, sched, n=3):
+    for _ in range(n):
+        mgr.sync_all()
+        sched.run_once()
+        cluster.tick()
+
+
+def test_suspend_resume_via_command_bus():
+    cluster, mgr, sched = stack()
+    job = cluster.add_vcjob(simple_job())
+    pump(cluster, mgr, sched)
+    assert cluster.vcjobs[job.key].phase is JobPhase.RUNNING
+
+    cluster.add_command(job.key, "AbortJob")     # vtpctl job suspend
+    pump(cluster, mgr, sched)
+    assert cluster.vcjobs[job.key].phase is JobPhase.ABORTED
+    assert not [p for p in cluster.pods.values() if p.owner == job.uid]
+
+    cluster.add_command(job.key, "ResumeJob")    # vtpctl job resume
+    pump(cluster, mgr, sched, n=4)
+    j = cluster.vcjobs[job.key]
+    assert j.phase is JobPhase.RUNNING
+    assert j.version == 1
+
+
+def test_hdrf_orders_by_queue_path_share():
+    """Hierarchical DRF: jobs in the less-consumed subtree go first."""
+    from volcano_tpu.cache.cache import SchedulerCache
+    from volcano_tpu.conf import load_conf
+    from volcano_tpu.framework.framework import close_session, open_session
+
+    cluster = FakeCluster()
+    for i in range(2):
+        cluster.add_node(Node(name=f"n{i}", allocatable={"cpu": 8}))
+    cluster.add_queue(Queue(name="org-a"))
+    cluster.add_queue(Queue(name="team-a1", parent="org-a"))
+    cluster.add_queue(Queue(name="org-b"))
+    # org-a already consumes half the cluster
+    pg_run, pods_run = gang_job("hog", queue="team-a1", replicas=2,
+                                requests={"cpu": 4},
+                                running_on=["n0", "n1"],
+                                pg_phase=PodGroupPhase.RUNNING)
+    pg_a, pods_a = gang_job("next-a", queue="team-a1", replicas=1,
+                            requests={"cpu": 4})
+    pg_b, pods_b = gang_job("next-b", queue="org-b", replicas=1,
+                            requests={"cpu": 4})
+    for pg, pods in [(pg_run, pods_run), (pg_a, pods_a), (pg_b, pods_b)]:
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+    conf = load_conf({
+        "actions": "enqueue, allocate",
+        "tiers": [{"plugins": [
+            {"name": "gang"},
+            {"name": "drf", "arguments": {"drf.enable-hierarchy": True}},
+            {"name": "predicates"}, {"name": "nodeorder"}]}]})
+    ssn = open_session(SchedulerCache(cluster), conf)
+    job_a = next(j for j in ssn.jobs.values() if j.name == "next-a")
+    job_b = next(j for j in ssn.jobs.values() if j.name == "next-b")
+    # org-b's path share (0) < org-a's (0.5): next-b sorts first
+    assert ssn.job_order_fn(job_b, job_a)
+    assert not ssn.job_order_fn(job_a, job_b)
+    close_session(ssn)
+
+
+def test_datalocality_scores_and_hard_mode():
+    nodes = [Node(name="data0", allocatable={"cpu": 8}),
+             Node(name="far0", allocatable={"cpu": 8})]
+    pg, pods = gang_job("trainer", replicas=1, requests={"cpu": 1})
+    pods[0].annotations["data.volcano-tpu.io/claims"] = "imagenet"
+    ctx = TestContext(nodes=nodes, podgroups=[pg], pods=pods,
+                      conf={"actions": "enqueue, allocate",
+                            "tiers": [{"plugins": [
+                                {"name": "gang"}, {"name": "predicates"},
+                                {"name": "datalocality"}]}]})
+    ctx.cluster.datasources = {"imagenet": {"nodes": ["data0"]}}
+    ctx.run()
+    ctx.expect_bind("default/trainer-0", "data0")
+
+    # hard mode: no local node -> unschedulable
+    pg2, pods2 = gang_job("strict", replicas=1, requests={"cpu": 1})
+    pods2[0].annotations["data.volcano-tpu.io/claims"] = "imagenet"
+    pods2[0].annotations["data.volcano-tpu.io/claim-mode"] = "hard"
+    ctx2 = TestContext(nodes=[Node(name="far0", allocatable={"cpu": 8})],
+                       podgroups=[pg2], pods=pods2,
+                       conf={"actions": "enqueue, allocate",
+                             "tiers": [{"plugins": [
+                                 {"name": "gang"}, {"name": "predicates"},
+                                 {"name": "datalocality"}]}]})
+    ctx2.cluster.datasources = {"imagenet": {"nodes": ["data0"]}}
+    ctx2.run()
+    ctx2.expect_bind_num(0)
+
+
+def test_colocation_config_pushes_to_agents():
+    from volcano_tpu.agent import NodeAgent
+    from volcano_tpu.controllers.colocation import ColocationConfigController
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    agent = NodeAgent(cluster, "sa-w0")
+    ctrl = ColocationConfigController()
+    ctrl.initialize(cluster)
+    ctrl.register_agent(agent)
+    cluster.config_maps["colocation/config"] = {
+        "oversub-factor": "0.9", "eviction-threshold": "0.8"}
+    ctrl.sync()
+    assert agent.oversub_factor == 0.9
+    assert agent.eviction_threshold == 0.8
+
+
+def test_metrics_http_endpoint():
+    from volcano_tpu import metrics
+    metrics.inc("test_requests_total", 3)
+    server = metrics.serve(port=0)
+    try:
+        port = server.server_address[1]
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+        assert "test_requests_total 3" in body
+    finally:
+        server.shutdown()

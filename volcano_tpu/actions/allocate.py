@@ -151,6 +151,11 @@ class AllocateAction(Action):
         # A plugin with task-identity-dependent predicates (extender)
         # makes cached verdicts unsound: fall back to per-task sweeps.
         cache_enabled = not ssn.task_dependent_predicates
+
+        def task_cacheable(task) -> bool:
+            # bare pods default to spec "": they may be heterogeneous,
+            # so only named (controller-stamped, identical) specs cache
+            return cache_enabled and bool(task.task_spec)
         # Per-spec predicate/score cache with single-node invalidation:
         # a gang's tasks are identical, and a placement only changes the
         # state of the ONE node it landed on — so feasibility and
@@ -203,7 +208,7 @@ class AllocateAction(Action):
                 failed_specs.add(task.task_spec)
                 continue
 
-            if cache_enabled:
+            if task_cacheable(task):
                 entry = spec_cache.get(task.task_spec) or build_entry(task)
                 fit_nodes = list(entry["fits"].values())
                 base_scores = entry["scores"]
@@ -226,7 +231,7 @@ class AllocateAction(Action):
                 else:
                     stmt.allocate(task, node)
                 placed += 1
-                if cache_enabled:
+                if spec_cache:
                     invalidate(node)
                 continue
 

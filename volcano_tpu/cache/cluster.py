@@ -119,3 +119,18 @@ class Cluster(abc.ABC):
     @abc.abstractmethod
     def delete_vcjob(self, key: str) -> None:
         """Delete a vcjob by ns/name key."""
+
+    # -- command bus (bus/v1alpha1 Command analogue) -------------------
+    # Default in-memory implementation; backends may override to
+    # persist Commands as CRs.
+
+    def add_command(self, target_key: str, action: str) -> None:
+        if not hasattr(self, "commands"):
+            self.commands = []
+        self.commands.append({"target": target_key, "action": action})
+
+    def drain_commands(self, target_key: str):
+        cmds = getattr(self, "commands", [])
+        mine = [c for c in cmds if c["target"] == target_key]
+        self.commands = [c for c in cmds if c["target"] != target_key]
+        return mine

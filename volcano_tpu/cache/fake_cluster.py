@@ -34,6 +34,7 @@ class FakeCluster(Cluster):
         self.hypernodes: Dict[str, HyperNode] = {}
         self.priority_classes: Dict[str, PriorityClass] = {}
         self.vcjobs: Dict[str, object] = {}       # key: ns/name -> VCJob
+        self.commands: List[dict] = []            # bus/v1alpha1 analogue
         self.services: Dict[str, dict] = {}       # svc plugin artifacts
         self.config_maps: Dict[str, dict] = {}
         self.secrets: Dict[str, dict] = {}
@@ -102,6 +103,22 @@ class FakeCluster(Cluster):
         with self._lock:
             self.hypernodes[hn.name] = hn
         self._notify("hypernode", hn)
+
+    # -- command bus (bus/v1alpha1 Command CRD analogue) ---------------
+
+    def add_command(self, target_key: str, action: str):
+        """Queue a delegated action (abort/resume/restart/...) against a
+        vcjob; the job controller consumes and deletes it."""
+        with self._lock:
+            self.commands.append({"target": target_key, "action": action})
+        self._notify("command", {"target": target_key, "action": action})
+
+    def drain_commands(self, target_key: str):
+        with self._lock:
+            cmds = getattr(self, "commands", [])
+            mine = [c for c in cmds if c["target"] == target_key]
+            self.commands = [c for c in cmds if c["target"] != target_key]
+        return mine
 
     # -- vcjobs (admission-gated like the apiserver webhook path) ------
 

@@ -130,6 +130,14 @@ def cmd_job_delete(cluster, args):
     print(f"job {key} deleted")
 
 
+def cmd_job_command(cluster, args, action):
+    key = f"{args.namespace}/{args.name}"
+    if key not in cluster.vcjobs:
+        sys.exit(f"job {key} not found")
+    cluster.add_command(key, action)
+    print(f"job {key}: {action} requested")
+
+
 def cmd_queue_create(cluster, args):
     from volcano_tpu.api.resource import Resource
     queue = Queue(name=args.name, weight=args.weight, parent=args.parent)
@@ -214,6 +222,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-N", "--name", required=True)
     p.add_argument("-n", "--namespace", default="default")
     p.set_defaults(fn=cmd_job_delete)
+    for verb, action in (("suspend", "AbortJob"), ("resume", "ResumeJob"),
+                         ("restart", "RestartJob"),
+                         ("complete", "CompleteJob")):
+        p = job.add_parser(verb)
+        p.add_argument("-N", "--name", required=True)
+        p.add_argument("-n", "--namespace", default="default")
+        p.set_defaults(fn=lambda c, a, _act=action: cmd_job_command(c, a, _act))
 
     queue = sub.add_parser("queue", help="queue operations").add_subparsers(
         dest="queue_cmd", required=True)

@@ -71,6 +71,7 @@ class JobController(Controller):
     # -- reconcile ----------------------------------------------------
 
     def sync_job(self, job: VCJob, pods: List[Pod]) -> None:
+        self._apply_commands(job)
         if job.phase in TERMINAL_PHASES:
             return
 
@@ -256,6 +257,46 @@ class JobController(Controller):
         for plugin in plugins:
             plugin.on_pod_create(pod, job)
         return pod
+
+    # -- command bus (bus/v1alpha1 delegated actions) ------------------
+
+    def _apply_commands(self, job: VCJob) -> None:
+        for cmd in self.cluster.drain_commands(job.key):
+            action = cmd["action"]
+            log.info("job %s: command %s", job.key, action)
+            if action == JobAction.ABORT_JOB.value and \
+                    job.phase not in TERMINAL_PHASES:
+                self._transition(job, JobPhase.ABORTING, "command: abort")
+            elif action == JobAction.RESUME_JOB.value:
+                if job.phase is JobPhase.ABORTING:
+                    # abort still in flight: requeue, don't drop
+                    self.cluster.add_command(job.key, action)
+                elif job.phase is JobPhase.ABORTED:
+                    job.version += 1
+                    job.finish_time = None
+                    self._transition(job, JobPhase.PENDING,
+                                     "command: resume")
+                    pg = self.cluster.podgroups.get(job.key)
+                    if pg is not None:
+                        pg.phase = PodGroupPhase.PENDING
+                        self.cluster.update_podgroup_status(pg)
+            elif action == JobAction.RESTART_JOB.value and \
+                    job.phase not in TERMINAL_PHASES:
+                job.version += 1
+                self._transition(job, JobPhase.RESTARTING,
+                                 "command: restart")
+            elif action == JobAction.TERMINATE_JOB.value and \
+                    job.phase not in TERMINAL_PHASES:
+                self._transition(job, JobPhase.TERMINATING,
+                                 "command: terminate")
+            elif action == JobAction.COMPLETE_JOB.value and \
+                    job.phase not in TERMINAL_PHASES:
+                self._transition(job, JobPhase.COMPLETING,
+                                 "command: complete")
+            else:
+                log.warning("job %s: command %s not applicable in phase "
+                            "%s (dropped)", job.key, action,
+                            job.phase.value)
 
     # -- lifecycle policies -------------------------------------------
 

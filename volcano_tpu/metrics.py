@@ -57,6 +57,36 @@ def reset():
         _counters.clear()
 
 
+def serve(port: int = 0):
+    """Expose /metrics over HTTP (Prometheus scrape endpoint analogue;
+    reference: per-binary Prometheus registries).  Returns the server —
+    call .shutdown() to stop; port 0 picks a free port
+    (server.server_address[1])."""
+    import http.server
+    import threading
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - stdlib API
+            if self.path != "/metrics":
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = dump().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet
+            pass
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server
+
+
 def dump() -> str:
     """Prometheus text exposition."""
     lines = []

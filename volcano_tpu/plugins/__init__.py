@@ -28,3 +28,4 @@ import volcano_tpu.plugins.resource_strategy_fit  # noqa: F401
 import volcano_tpu.plugins.numaaware     # noqa: F401
 import volcano_tpu.plugins.extender      # noqa: F401
 import volcano_tpu.plugins.rescheduling  # noqa: F401
+import volcano_tpu.plugins.datalocality  # noqa: F401

@@ -30,21 +30,57 @@ class DRFPlugin(Plugin):
     def __init__(self, arguments=None):
         super().__init__(arguments)
         self.attrs: Dict[str, _JobAttr] = {}
+        self.queue_attrs: Dict[str, _JobAttr] = {}
         self.total = Resource()
+        # hdrf: hierarchical mode compares root-to-leaf queue-path
+        # shares (reference drf.go hierarchical; conflicts with
+        # proportion per pkg/scheduler/util.go:78-80)
+        self.hierarchy = bool(self.arguments.get("drf.enable-hierarchy",
+                                                 False))
+        self._queues = {}
 
     def on_session_open(self, ssn):
         self.total = ssn.total_resource
+        self._queues = ssn.queues
         for job in ssn.jobs.values():
             attr = _JobAttr()
             attr.allocated = job.allocated()
             self._update_share(attr)
             self.attrs[job.uid] = attr
+            if self.hierarchy:
+                for qname in self._queue_chain(job.queue):
+                    qattr = self.queue_attrs.setdefault(qname, _JobAttr())
+                    qattr.allocated.add(attr.allocated)
+        for qattr in self.queue_attrs.values():
+            self._update_share(qattr)
 
         ssn.add_job_order_fn(self.name, self._job_order)
+        if self.hierarchy:
+            ssn.add_queue_order_fn(self.name, self._queue_order)
         ssn.add_preemptable_fn(self.name, self._preemptable(ssn))
         ssn.add_event_handler(EventHandler(
-            allocate_fn=lambda e: self._on_event(e, +1),
-            deallocate_fn=lambda e: self._on_event(e, -1)))
+            allocate_fn=lambda e: self._on_event(e, +1, ssn),
+            deallocate_fn=lambda e: self._on_event(e, -1, ssn)))
+
+    def _queue_chain(self, queue_name: str):
+        """leaf -> root path of queue names (cycle-safe)."""
+        chain, seen = [], set()
+        cur = queue_name
+        while cur and cur not in seen and cur in self._queues:
+            chain.append(cur)
+            seen.add(cur)
+            cur = self._queues[cur].parent
+        return chain
+
+    def _path_shares(self, queue_name: str):
+        """Root-to-leaf share vector for hierarchical comparison."""
+        return [self.queue_attrs[q].share
+                for q in reversed(self._queue_chain(queue_name))
+                if q in self.queue_attrs]
+
+    def _queue_order(self, a, b) -> int:
+        sa, sb = self._path_shares(a.name), self._path_shares(b.name)
+        return -1 if sa < sb else (1 if sb < sa else 0)
 
     def _update_share(self, attr: _JobAttr):
         share = 0.0
@@ -55,6 +91,10 @@ class DRFPlugin(Plugin):
         attr.share = share
 
     def _job_order(self, a: JobInfo, b: JobInfo) -> int:
+        if self.hierarchy and a.queue != b.queue:
+            sa, sb = self._path_shares(a.queue), self._path_shares(b.queue)
+            if sa != sb:
+                return -1 if sa < sb else 1
         sa = self.attrs[a.uid].share if a.uid in self.attrs else 0.0
         sb = self.attrs[b.uid].share if b.uid in self.attrs else 0.0
         return -1 if sa < sb else (1 if sb < sa else 0)
@@ -88,7 +128,7 @@ class DRFPlugin(Plugin):
             return victims
         return fn
 
-    def _on_event(self, event, sign: int):
+    def _on_event(self, event, sign: int, ssn=None):
         attr = self.attrs.get(event.task.job)
         if attr is None:
             return
@@ -97,3 +137,15 @@ class DRFPlugin(Plugin):
         else:
             attr.allocated.sub_unchecked(event.task.resreq)
         self._update_share(attr)
+        if self.hierarchy and ssn is not None:
+            job = ssn.jobs.get(event.task.job)
+            if job is not None:
+                for qname in self._queue_chain(job.queue):
+                    qattr = self.queue_attrs.get(qname)
+                    if qattr is None:
+                        continue
+                    if sign > 0:
+                        qattr.allocated.add(event.task.resreq)
+                    else:
+                        qattr.allocated.sub_unchecked(event.task.resreq)
+                    self._update_share(qattr)
