@@ -1,0 +1,68 @@
+"""Pallas flash-attention kernel vs reference math (interpret mode)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from volcano_tpu.workloads.ops.flash_attention import (
+    flash_attention, supported,
+)
+from volcano_tpu.workloads.ring_attention import local_causal_attention
+
+
+def _rand_qkv(b=2, t=256, h=2, d=128, seed=0):
+    k1, k2, k3 = jax.random.split(jax.random.key(seed), 3)
+    return (jax.random.normal(k1, (b, t, h, d)),
+            jax.random.normal(k2, (b, t, h, d)),
+            jax.random.normal(k3, (b, t, h, d)))
+
+
+def test_flash_matches_reference_causal():
+    q, k, v = _rand_qkv()
+    out = flash_attention(q, k, v, interpret=True)
+    ref = local_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_non_causal():
+    q, k, v = _rand_qkv(t=128)
+    out = flash_attention(q, k, v, causal=False, interpret=True)
+    # reference: plain softmax attention
+    s = jnp.einsum("bqhd,bkhd->bqhk", q, k) / jnp.sqrt(q.shape[-1])
+    ref = jnp.einsum("bqhk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_unsupported_shapes_fall_back():
+    q, k, v = _rand_qkv(t=96, d=64)  # not block-aligned
+    assert not supported(96, 64)
+    out = flash_attention(q, k, v, interpret=True)
+    ref = local_causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_model_with_flash_attention_matches_jnp_path():
+    from volcano_tpu.workloads import model as model_lib
+    cfg_flash = model_lib.tiny_config(d_model=256, n_heads=2,
+                                      use_flash_attention=True)
+    cfg_plain = model_lib.tiny_config(d_model=256, n_heads=2)
+    params = model_lib.init_params(jax.random.key(0), cfg_flash)
+    tokens = jax.random.randint(jax.random.key(1), (2, 128), 0,
+                                cfg_flash.vocab_size)
+    # head_dim = 128 and t = 128 -> kernel path eligible; on CPU the
+    # pallas call runs in interpret mode only if requested, so compare
+    # via interpret by monkeypatching the entry
+    import volcano_tpu.workloads.ops as ops
+    orig = ops.flash_attention
+    fa_interpret = lambda *a, **kw: orig(*a, **{**kw, "interpret": True})
+    try:
+        ops.flash_attention = fa_interpret
+        l_flash = model_lib.forward(params, tokens, cfg_flash)
+    finally:
+        ops.flash_attention = orig
+    l_plain = model_lib.forward(params, tokens, cfg_plain)
+    np.testing.assert_allclose(np.asarray(l_flash), np.asarray(l_plain),
+                               atol=5e-4, rtol=5e-4)

@@ -36,6 +36,9 @@ class ModelConfig:
     max_seq: int = 2048
     dtype: Any = jnp.bfloat16
     use_ring_attention: bool = False
+    # Pallas flash-attention kernel on TPU (falls back to the jnp path
+    # when shapes don't block-align); ring attention wins when sp > 1.
+    use_flash_attention: bool = False
     remat: bool = True
 
     @property
@@ -145,6 +148,13 @@ def _attention(x, blk, cfg: ModelConfig, positions, mesh: Optional[Mesh]):
             check_vma=False,
         )
         o = attn(q, k, v)
+    elif cfg.use_flash_attention and (
+            mesh is None or mesh.shape.get("sp", 1) == 1):
+        # the kernel sees only its local sequence shard; under sp > 1
+        # ring attention (above) or the jnp path (below, GSPMD-gathered)
+        # must own attention instead
+        from volcano_tpu.workloads.ops import flash_attention
+        o = flash_attention(q, k, v)
     else:
         o = local_causal_attention(q, k, v)
     return o.reshape(b, t, d) @ blk["wo"].astype(x.dtype)

@@ -1,0 +1,5 @@
+"""Pallas TPU kernels for the workload hot ops."""
+
+from volcano_tpu.workloads.ops.flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,148 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The workload's attention hot path, written for the MXU: blockwise
+QK^T -> online softmax -> PV with float32 accumulators carried through
+a fori_loop, one grid program per (batch*head, q-block).  K/V rows for
+the program's head live in VMEM (validation sequence lengths are a few
+K tokens, well under the ~16MB VMEM budget); the kernel keeps all
+matmuls at MXU-friendly block shapes (q/k blocks x head_dim).
+
+Falls back transparently to the jnp implementation when shapes don't
+block-align or when running under sequence parallelism (ring attention
+owns that path).  interpret=True runs the same kernel on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
+                  block_k: int, seq_len: int, causal: bool):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)          # [block_q, d]
+    d = q.shape[-1]
+    scale = jax.lax.rsqrt(jnp.float32(d))
+
+    if causal:
+        # skip k-blocks entirely past the diagonal: they are fully
+        # masked, no need to pay their QK^T/PV matmuls
+        num_k_blocks = (qi * block_q + block_q - 1) // block_k + 1
+    else:
+        num_k_blocks = seq_len // block_k
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(kk, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(kk * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kk * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            k_pos = kk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)        # [bq, 1]
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((block_q, d), dtype=jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((block_q, 1), dtype=jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (acc0, m0, l0))
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
+
+
+def _flash_bh(q, k, v, *, block_q: int, block_k: int, causal: bool,
+              interpret: bool):
+    """q/k/v: [bh, t, d] -> [bh, t, d]."""
+    bh, t, d = q.shape
+    grid = (bh, t // block_q)
+    kernel = functools.partial(_flash_kernel, block_q=block_q,
+                               block_k=block_k, seq_len=t, causal=causal)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def supported(t: int, d: int, block_q: int = 128,
+              block_k: int = 128) -> bool:
+    return t % block_q == 0 and t % block_k == 0 and d % 128 == 0
+
+
+def _reference(q, k, v, causal: bool):
+    s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / jnp.sqrt(
+        jnp.float32(q.shape[-1]))
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+        s = jnp.where(mask[None, :, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqhk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+    out = _flash_bh(to_bh(q), to_bh(k), to_bh(v), block_q=block_q,
+                    block_k=block_k, causal=causal, interpret=interpret)
+    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+    # backward recomputes through the fused reference expression (XLA
+    # fuses it well); a dedicated backward kernel is the follow-up —
+    # gradients stay exact either way.
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, causal), q, k, v)
+    return vjp(g.astype(q.dtype))
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Causal flash attention; q/k/v: [b, t, h, d] -> [b, t, h, d].
+    Differentiable (custom VJP)."""
+    b, t, h, d = q.shape
+    if not supported(t, d, block_q, block_k):
+        # fallback honors the causal flag (the jnp reference expression)
+        return _reference(q, k, v, causal)
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
