@@ -28,8 +28,10 @@ TPU_HEALTHY_LABEL = "volcano-tpu.io/tpu-healthy"
 AGENT_CORDONED_ANNOTATION = "volcano-tpu.io/cordoned-by-agent"
 TPU_CHIPS_ANNOTATION = "volcano-tpu.io/tpu-chips"
 
+from volcano_tpu.api.types import QOS_BEST_EFFORT, QOS_LEVEL_ANNOTATION
+
 # annotation marking pods the agent may evict under pressure
-PREEMPTABLE_QOS_ANNOTATION = "volcano-tpu.io/qos-level"   # "BE" = best effort
+PREEMPTABLE_QOS_ANNOTATION = QOS_LEVEL_ANNOTATION
 
 
 @dataclass
@@ -131,7 +133,7 @@ class NodeAgent:
                 continue
             if pod.phase is not TaskStatus.RUNNING:
                 continue
-            if pod.annotations.get(PREEMPTABLE_QOS_ANNOTATION) == "BE":
+            if pod.annotations.get(PREEMPTABLE_QOS_ANNOTATION) == QOS_BEST_EFFORT:
                 log.info("agent %s: evicting BE pod %s under pressure",
                          self.node_name, pod.key)
                 self.cluster.evict_pod(pod.namespace, pod.name,

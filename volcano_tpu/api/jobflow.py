@@ -26,6 +26,11 @@ class JobFlowPhase(enum.Enum):
 @dataclass
 class FlowDependsOn:
     targets: List[str] = field(default_factory=list)
+    # probes relax the default "target Completed" gate: a dependency is
+    # satisfied once the target reaches the probed phase (reference
+    # flow/v1alpha1 DependsOn.Probes — status-based analogue of its
+    # HTTP/TCP pod probes).  e.g. [{"phase": "Running"}]
+    probes: List[dict] = field(default_factory=list)
 
 
 @dataclass

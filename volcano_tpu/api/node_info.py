@@ -56,10 +56,23 @@ class NodeInfo:
         self.capability = (Resource.from_resource_list(node.capacity or node.allocatable)
                            if node else Resource())
         self.idle = self.allocatable.clone()
+        # reclaimable slack the node agent measured from real usage —
+        # usable ONLY by best-effort-QoS tasks (reference
+        # node_info.go:83-89 OversubscriptionResource)
+        self.oversubscription = Resource()
+        if node is not None:
+            raw = node.annotations.get(
+                "oversubscription.volcano-tpu.io/cpu-millis")
+            if raw:
+                try:
+                    extra = float(raw)
+                    if extra > 0:
+                        self.oversubscription = Resource({"cpu": extra})
+                except ValueError:
+                    pass
         self.used = Resource()
         self.releasing = Resource()
         self.pipelined = Resource()
-        self.oversubscription = Resource()
         self.tasks: Dict[str, "TaskInfo"] = {}
         # Conflict-aware binder optimistic-concurrency token
         # (reference api/node_info.go:100 BindGeneration).
@@ -113,6 +126,12 @@ class NodeInfo:
         return (self.idle.clone().add(self.releasing)
                 .sub_unchecked(self.pipelined))
 
+    def oversub_remaining(self) -> Resource:
+        """Unconsumed oversubscription slack: the published slack minus
+        whatever BE work has already overdrafted past allocatable."""
+        overdraft, _ = self.used.diff(self.allocatable)
+        return self.oversubscription.clone().sub_unchecked(overdraft)
+
     # -- task accounting ----------------------------------------------
 
     def add_task(self, task: "TaskInfo"):
@@ -136,8 +155,16 @@ class NodeInfo:
         elif task.status is TaskStatus.PIPELINED:
             self.pipelined.add(req)
         elif task.occupies_resources():
+            from volcano_tpu.api.types import (
+                QOS_BEST_EFFORT, QOS_LEVEL_ANNOTATION,
+            )
+            budget = self.idle
+            if task.pod.annotations.get(QOS_LEVEL_ANNOTATION) == \
+                    QOS_BEST_EFFORT:
+                # only BE tasks may overdraft into measured slack
+                budget = self.idle.clone().add(self.oversub_remaining())
             if task.status in (TaskStatus.ALLOCATED, TaskStatus.BINDING) \
-                    and not req.less_equal(self.idle):
+                    and not req.less_equal(budget):
                 raise ValueError(
                     f"node {self.name} has insufficient idle "
                     f"{self.idle} for task {task.key} requiring {req}")

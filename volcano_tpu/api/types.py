@@ -153,6 +153,11 @@ TPU_SLICE_LABEL = "cloud.google.com/gke-tpu-slice"                # slice name/i
 TPU_WORKER_ID_LABEL = "cloud.google.com/gke-tpu-worker-id"        # host index in slice
 TPU_COORDS_LABEL = "volcano-tpu.io/ici-coords"                    # "x,y,z" of host in mesh
 
+# QoS level annotation shared by the scheduler's BE fit path and the
+# agent's BE eviction path; value "BE" marks best-effort colocation pods.
+QOS_LEVEL_ANNOTATION = "volcano-tpu.io/qos-level"
+QOS_BEST_EFFORT = "BE"
+
 # PodGroup annotation carrying gangpreempt's domain nominations across
 # sessions: JSON {subgroup-name: hypernode-name} ("" = whole job).
 NOMINATED_HYPERNODES_ANNOTATION = \

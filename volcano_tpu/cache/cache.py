@@ -65,6 +65,10 @@ class Snapshot:
         for n in self.nodes.values():
             if n.ready:
                 total.add(n.allocatable)
+                # measured oversubscription slack is real capacity for
+                # queue-share math; node-level fit still restricts it to
+                # best-effort-QoS tasks (actions/util.split_by_fit)
+                total.add(n.oversubscription)
         return total
 
 

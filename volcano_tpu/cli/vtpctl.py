@@ -138,6 +138,32 @@ def cmd_job_command(cluster, args, action):
     print(f"job {key}: {action} requested")
 
 
+def cmd_jobflow_create(cluster, args):
+    from volcano_tpu.api.jobflow import Flow, FlowDependsOn, JobFlow
+    flows = []
+    for spec in args.flows:
+        # "name" or "name:dep1+dep2"
+        if ":" in spec:
+            name, deps = spec.split(":", 1)
+            flows.append(Flow(name=name, depends_on=FlowDependsOn(
+                targets=deps.split("+"))))
+        else:
+            flows.append(Flow(name=spec))
+    flow = JobFlow(name=args.name, namespace=args.namespace, flows=flows)
+    if not hasattr(cluster, "jobflows"):
+        cluster.jobflows = {}
+    cluster.jobflows[flow.key] = flow
+    print(f"jobflow {flow.key} created ({len(flows)} steps)")
+
+
+def cmd_jobflow_list(cluster, args):
+    rows = []
+    for flow in getattr(cluster, "jobflows", {}).values():
+        rows.append([flow.namespace, flow.name, flow.phase.value,
+                     f"{len(flow.deployed_jobs)}/{len(flow.flows)}"])
+    print(_table(rows, ["NAMESPACE", "NAME", "PHASE", "DEPLOYED"]))
+
+
 def cmd_queue_create(cluster, args):
     from volcano_tpu.api.resource import Resource
     queue = Queue(name=args.name, weight=args.weight, parent=args.parent)
@@ -229,6 +255,18 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("-N", "--name", required=True)
         p.add_argument("-n", "--namespace", default="default")
         p.set_defaults(fn=lambda c, a, _act=action: cmd_job_command(c, a, _act))
+
+    jobflow = sub.add_parser("jobflow",
+                             help="jobflow operations").add_subparsers(
+        dest="jobflow_cmd", required=True)
+    p = jobflow.add_parser("create")
+    p.add_argument("-N", "--name", required=True)
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--flows", nargs="+", required=True,
+                   help='steps as "template" or "template:dep1+dep2"')
+    p.set_defaults(fn=cmd_jobflow_create)
+    p = jobflow.add_parser("list")
+    p.set_defaults(fn=cmd_jobflow_list)
 
     queue = sub.add_parser("queue", help="queue operations").add_subparsers(
         dest="queue_cmd", required=True)

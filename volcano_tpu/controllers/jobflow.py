@@ -56,10 +56,8 @@ class JobFlowController(Controller):
         for step in flow.flows:
             if job_phases[step.name] is not None:
                 continue  # already deployed
-            deps = step.depends_on.targets if step.depends_on else []
-            if all(job_phases.get(d) is JobPhase.COMPLETED for d in deps):
-                self._deploy(flow, step)
-                deployed_any = True
+            if self._deps_satisfied(step, job_phases):
+                deployed_any |= self._deploy(flow, step)
 
         phases = [p for p in job_phases.values()]
         if any(p is JobPhase.FAILED or p is JobPhase.ABORTED
@@ -74,13 +72,36 @@ class JobFlowController(Controller):
         elif deployed_any or any(p is not None for p in phases):
             flow.phase = JobFlowPhase.RUNNING
 
-    def _deploy(self, flow: JobFlow, step) -> None:
+    @staticmethod
+    def _deps_satisfied(step, job_phases) -> bool:
+        if step.depends_on is None:
+            return True
+        satisfying = set()
+        for probe in step.depends_on.probes:
+            phase = probe.get("phase")
+            if not phase:
+                continue
+            try:
+                satisfying.add(JobPhase(phase))
+            except ValueError:
+                log.warning("flow %s: unknown probe phase %r",
+                            step.name, phase)
+        if not satisfying:
+            satisfying = {JobPhase.COMPLETED}
+        if JobPhase.RUNNING in satisfying:
+            # a dependency probed for Running is also satisfied once the
+            # target already finished successfully
+            satisfying.add(JobPhase.COMPLETED)
+        return all(job_phases.get(d) in satisfying
+                   for d in step.depends_on.targets)
+
+    def _deploy(self, flow: JobFlow, step) -> bool:
         template = self.cluster.jobtemplates.get(
             f"{flow.namespace}/{step.name}")
         if template is None or template.job is None:
             log.warning("jobflow %s: missing template %s",
                         flow.key, step.name)
-            return
+            return False
         job: VCJob = copy.deepcopy(template.job)
         job.name = flow.job_name(step.name)
         job.namespace = flow.namespace
@@ -92,3 +113,4 @@ class JobFlowController(Controller):
         self.cluster.add_vcjob(job)
         flow.deployed_jobs.append(job.key)
         log.info("jobflow %s deployed %s", flow.key, job.key)
+        return True

@@ -83,3 +83,57 @@ class PredicatesPlugin(Plugin):
                         "node(s) didn't have free ports", "predicates")
 
         return None
+
+
+# pod topology spread: pods opt in via annotations
+#   spread.volcano-tpu.io/topology-key: <node label, e.g. zone>
+#   spread.volcano-tpu.io/max-skew:     <int, default 1>
+# Pods of the same job spread across distinct values of the topology
+# key with bounded skew (upstream pod-topology-spread analogue; the
+# reference wraps the k8s plugin, predicates.go:37).
+SPREAD_KEY_ANNOTATION = "spread.volcano-tpu.io/topology-key"
+SPREAD_SKEW_ANNOTATION = "spread.volcano-tpu.io/max-skew"
+
+
+@register_plugin("pod-topology-spread")
+class PodTopologySpreadPlugin(Plugin):
+    name = "pod-topology-spread"
+
+    def on_session_open(self, ssn):
+        self.ssn = ssn
+        ssn.add_predicate_fn(self.name, self._predicate)
+
+    def _predicate(self, task: TaskInfo, node: NodeInfo):
+        key = task.pod.annotations.get(SPREAD_KEY_ANNOTATION)
+        if not key:
+            return None
+        try:
+            max_skew = int(task.pod.annotations.get(
+                SPREAD_SKEW_ANNOTATION, 1))
+        except ValueError:
+            max_skew = 1
+        my_value = node.labels.get(key)
+        if my_value is None:
+            return unschedulable(
+                f"node missing spread topology key {key!r}",
+                "pod-topology-spread", resolvable=False)
+
+        # count the job's occupying tasks per topology value
+        counts: dict = {}
+        domains = set()
+        for n in self.ssn.nodes.values():
+            value = n.labels.get(key)
+            if value is None:
+                continue
+            domains.add(value)
+            for t in n.tasks.values():
+                if t.job == task.job and t.occupies_resources():
+                    counts[value] = counts.get(value, 0) + 1
+        if not domains:
+            return None
+        global_min = min(counts.get(d, 0) for d in domains)
+        if counts.get(my_value, 0) + 1 - global_min > max_skew:
+            return unschedulable(
+                f"placing here would exceed max skew {max_skew} "
+                f"over {key}", "pod-topology-spread")
+        return None

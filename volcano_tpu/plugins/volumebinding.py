@@ -1,0 +1,158 @@
+"""Volumebinding plugin — PV/PVC zone-affine binding.
+
+Reference parity: pkg/scheduler/capabilities/volumebinding (forked k8s
+volume binder with assume-cache and scorer).  Standalone model:
+
+- persistent volumes live on the cluster:
+    cluster.persistent_volumes[name] = {
+        "capacity_gi": 100, "zone": "us-central2-b",
+        "claimed_by": ""            # pvc key once bound
+    }
+- pods claim via annotation  volume.volcano-tpu.io/claims: "pvc-a,pvc-b"
+  and pvc specs via          cluster.pvcs[name] = {"request_gi": 10,
+                                                    "bound_pv": ""}
+
+Predicate: every claimed PVC must be bound (then its PV's zone must
+match the node) or bindable to an unclaimed PV in the node's zone.
+Score: prefer nodes whose zone already holds the PVs (data gravity).
+An assume-cache of in-session bindings prevents two pods binding the
+same PV in one cycle; bindings commit at session close (PreBind
+analogue).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from volcano_tpu.api.fit_error import unschedulable
+from volcano_tpu.api.job_info import TaskInfo
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.framework.plugins import Plugin, register_plugin
+
+CLAIMS_ANNOTATION = "volume.volcano-tpu.io/claims"
+ZONE_LABEL = "topology.kubernetes.io/zone"
+MAX_SCORE = 100.0
+
+
+@register_plugin("volumebinding")
+class VolumeBindingPlugin(Plugin):
+    name = "volumebinding"
+
+    def on_session_open(self, ssn):
+        self.ssn = ssn
+        cluster = ssn.cache.cluster
+        self.pvs: Dict[str, dict] = dict(
+            getattr(cluster, "persistent_volumes", {}) or {})
+        self.pvcs: Dict[str, dict] = dict(
+            getattr(cluster, "pvcs", {}) or {})
+        # assume-cache: pv -> pvc assumed this session (populated at
+        # ALLOCATION time so two pods can't pass the predicate against
+        # the same free PV in one cycle)
+        self.assumed: Dict[str, str] = {}
+        self.planned: Dict[str, str] = {}        # pvc -> pv to commit
+        self._task_pvs: Dict[str, list] = {}     # task uid -> [(pvc, pv)]
+        # always register: a pod claiming an unknown PVC must be gated
+        # even when the cluster has no PVCs at all
+        ssn.add_predicate_fn(self.name, self._predicate)
+        ssn.add_node_order_fn(self.name, self._score)
+        from volcano_tpu.framework.session import EventHandler
+        ssn.add_event_handler(EventHandler(
+            allocate_fn=self._on_allocate,
+            deallocate_fn=self._on_deallocate))
+
+    @staticmethod
+    def _claims(task: TaskInfo) -> List[str]:
+        raw = task.pod.annotations.get(CLAIMS_ANNOTATION, "")
+        return [c.strip() for c in raw.split(",") if c.strip()]
+
+    def _bindable_pv(self, pvc_name: str, zone: str) -> Optional[str]:
+        pvc = self.pvcs.get(pvc_name)
+        if pvc is None:
+            return None
+        if pvc.get("bound_pv"):
+            pv = self.pvs.get(pvc["bound_pv"])
+            return pvc["bound_pv"] if pv and pv.get("zone") == zone \
+                else None
+        for name, pv in sorted(self.pvs.items()):
+            if pv.get("claimed_by") or name in self.assumed:
+                continue
+            if pv.get("zone") != zone:
+                continue
+            if pv.get("capacity_gi", 0) >= pvc.get("request_gi", 0):
+                return name
+        return None
+
+    def _predicate(self, task: TaskInfo, node: NodeInfo):
+        claims = self._claims(task)
+        if not claims:
+            return None
+        zone = node.labels.get(ZONE_LABEL, "")
+        for pvc_name in claims:
+            if pvc_name not in self.pvcs:
+                return unschedulable(
+                    f"unknown PVC {pvc_name!r}", "volumebinding",
+                    resolvable=False)
+            if self._bindable_pv(pvc_name, zone) is None:
+                return unschedulable(
+                    f"no bindable volume for PVC {pvc_name!r} in zone "
+                    f"{zone or '<none>'}", "volumebinding")
+        return None
+
+    def _score(self, task: TaskInfo, node: NodeInfo) -> float:
+        claims = self._claims(task)
+        if not claims:
+            return 0.0
+        zone = node.labels.get(ZONE_LABEL, "")
+        ok = sum(1 for c in claims if self._bindable_pv(c, zone))
+        return MAX_SCORE * ok / len(claims)
+
+    def _on_allocate(self, event):
+        """Assume PVs the moment a claiming task is placed, so later
+        predicate calls in the same cycle see them as taken."""
+        task = event.task
+        claims = self._claims(task)
+        if not claims or not task.node_name:
+            return
+        node = self.ssn.nodes.get(task.node_name)
+        if node is None:
+            return
+        zone = node.labels.get(ZONE_LABEL, "")
+        reserved = []
+        for pvc_name in claims:
+            if pvc_name not in self.pvcs or \
+                    self.pvcs[pvc_name].get("bound_pv"):
+                continue
+            pv = self._bindable_pv(pvc_name, zone)
+            if pv is not None:
+                self.assumed[pv] = pvc_name
+                self.planned[pvc_name] = pv
+                reserved.append((pvc_name, pv))
+        if reserved:
+            self._task_pvs[task.uid] = reserved
+
+    def _on_deallocate(self, event):
+        for pvc_name, pv in self._task_pvs.pop(event.task.uid, []):
+            self.assumed.pop(pv, None)
+            self.planned.pop(pvc_name, None)
+
+    def on_session_close(self, ssn):
+        if not getattr(self, "planned", None):
+            return
+        # commit bindings whose tasks actually went to bind
+        from volcano_tpu.api.types import TaskStatus
+        committed_uids = {
+            t.uid for job in ssn.jobs.values()
+            for t in job.tasks.values()
+            if t.status in (TaskStatus.BINDING, TaskStatus.BOUND)}
+        cluster = ssn.cache.cluster
+        for uid, reserved in self._task_pvs.items():
+            if uid not in committed_uids:
+                continue
+            for pvc_name, pv_name in reserved:
+                live_pvc = getattr(cluster, "pvcs", {}).get(pvc_name)
+                live_pv = getattr(cluster, "persistent_volumes",
+                                  {}).get(pv_name)
+                if live_pvc is not None and live_pv is not None and \
+                        not live_pvc.get("bound_pv"):
+                    live_pvc["bound_pv"] = pv_name
+                    live_pv["claimed_by"] = pvc_name
