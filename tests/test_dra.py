@@ -1,0 +1,91 @@
+"""DRA: resource claims over structured device pools."""
+
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.queue import Queue
+from volcano_tpu.uthelper import TestContext, gang_job
+
+CONF = {"actions": "enqueue, allocate",
+        "tiers": [{"plugins": [{"name": "gang"}, {"name": "predicates"},
+                               {"name": "dra"}]}]}
+
+
+def dra_ctx(claims, slices, pods_claims, queues=(), queue_ann=None):
+    nodes = [Node(name=n, allocatable={"cpu": 32, "pods": 110})
+             for n in slices]
+    pgs, pods = [], []
+    for i, claim_list in enumerate(pods_claims):
+        pg, ps = gang_job(f"j{i}", replicas=1, requests={"cpu": 1},
+                          queue=queues[i] if queues else "default")
+        ps[0].annotations["dra.volcano-tpu.io/claims"] = ",".join(claim_list)
+        pgs.append(pg)
+        pods.extend(ps)
+    from volcano_tpu.api.queue import Queue as Q
+    qs = []
+    for qn in set(queues):
+        q = Q(name=qn)
+        if queue_ann and qn in queue_ann:
+            q.annotations.update(queue_ann[qn])
+        qs.append(q)
+    ctx = TestContext(nodes=nodes, podgroups=pgs, pods=pods, queues=qs,
+                      conf=CONF)
+    ctx.cluster.resource_slices = dict(slices)
+    ctx.cluster.resource_claims = dict(claims)
+    return ctx
+
+
+def test_claim_steers_to_node_with_devices_and_commits():
+    ctx = dra_ctx(
+        claims={"claim-a": {"class": "tpu-accel", "count": 1,
+                            "allocated_node": "", "allocated_devices": []}},
+        slices={"n0": [], "n1": [{"name": "d0", "class": "tpu-accel"}]},
+        pods_claims=[["claim-a"]])
+    ctx.run()
+    ctx.expect_bind("default/j0-0", "n1")
+    claim = ctx.cluster.resource_claims["claim-a"]
+    assert claim["allocated_node"] == "n1"
+    assert claim["allocated_devices"] == ["d0"]
+
+
+def test_two_claims_cannot_share_one_device():
+    ctx = dra_ctx(
+        claims={"c1": {"class": "tpu-accel", "count": 1,
+                       "allocated_node": "", "allocated_devices": []},
+                "c2": {"class": "tpu-accel", "count": 1,
+                       "allocated_node": "", "allocated_devices": []}},
+        slices={"n0": [{"name": "d0", "class": "tpu-accel"}]},
+        pods_claims=[["c1"], ["c2"]])
+    ctx.run()
+    ctx.expect_bind_num(1)   # only one claim can own d0
+
+
+def test_allocated_claim_pins_node():
+    ctx = dra_ctx(
+        claims={"pinned": {"class": "tpu-accel", "count": 1,
+                           "allocated_node": "n0",
+                           "allocated_devices": ["d0"]}},
+        slices={"n0": [{"name": "d0", "class": "tpu-accel"}],
+                "n1": [{"name": "d1", "class": "tpu-accel"}]},
+        pods_claims=[["pinned"]])
+    ctx.run()
+    ctx.expect_bind("default/j0-0", "n0")
+
+
+def test_queue_device_quota():
+    ctx = dra_ctx(
+        claims={"c1": {"class": "tpu-accel", "count": 1,
+                       "allocated_node": "", "allocated_devices": []},
+                "c2": {"class": "tpu-accel", "count": 1,
+                       "allocated_node": "", "allocated_devices": []}},
+        slices={"n0": [{"name": "d0", "class": "tpu-accel"},
+                       {"name": "d1", "class": "tpu-accel"}]},
+        pods_claims=[["c1"], ["c2"]],
+        queues=["limited", "limited"],
+        queue_ann={"limited": {"dra.volcano-tpu.io/quota.tpu-accel": "1"}})
+    ctx.run()
+    ctx.expect_bind_num(1)   # quota of 1 device for the queue
+
+
+def test_unknown_claim_rejected():
+    ctx = dra_ctx(claims={}, slices={"n0": []}, pods_claims=[["ghost"]])
+    ctx.run()
+    ctx.expect_bind_num(0)

@@ -23,7 +23,8 @@ log = logging.getLogger(__name__)
 
 CPU_USAGE_ANNOTATION = "usage.volcano-tpu.io/cpu"
 MEM_USAGE_ANNOTATION = "usage.volcano-tpu.io/memory"
-OVERSUB_ANNOTATION = "oversubscription.volcano-tpu.io/cpu-millis"
+from volcano_tpu.api.types import OVERSUBSCRIPTION_CPU_ANNOTATION
+OVERSUB_ANNOTATION = OVERSUBSCRIPTION_CPU_ANNOTATION
 TPU_HEALTHY_LABEL = "volcano-tpu.io/tpu-healthy"
 AGENT_CORDONED_ANNOTATION = "volcano-tpu.io/cordoned-by-agent"
 TPU_CHIPS_ANNOTATION = "volcano-tpu.io/tpu-chips"

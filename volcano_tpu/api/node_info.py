@@ -61,8 +61,10 @@ class NodeInfo:
         # node_info.go:83-89 OversubscriptionResource)
         self.oversubscription = Resource()
         if node is not None:
-            raw = node.annotations.get(
-                "oversubscription.volcano-tpu.io/cpu-millis")
+            from volcano_tpu.api.types import (
+                OVERSUBSCRIPTION_CPU_ANNOTATION,
+            )
+            raw = node.annotations.get(OVERSUBSCRIPTION_CPU_ANNOTATION)
             if raw:
                 try:
                     extra = float(raw)

@@ -158,6 +158,11 @@ TPU_COORDS_LABEL = "volcano-tpu.io/ici-coords"                    # "x,y,z" of h
 QOS_LEVEL_ANNOTATION = "volcano-tpu.io/qos-level"
 QOS_BEST_EFFORT = "BE"
 
+# Node annotation: reclaimable millicores published by the node agent,
+# consumed by the scheduler's BE fit path.
+OVERSUBSCRIPTION_CPU_ANNOTATION = \
+    "oversubscription.volcano-tpu.io/cpu-millis"
+
 # PodGroup annotation carrying gangpreempt's domain nominations across
 # sessions: JSON {subgroup-name: hypernode-name} ("" = whole job).
 NOMINATED_HYPERNODES_ANNOTATION = \

@@ -30,3 +30,4 @@ import volcano_tpu.plugins.extender      # noqa: F401
 import volcano_tpu.plugins.rescheduling  # noqa: F401
 import volcano_tpu.plugins.datalocality  # noqa: F401
 import volcano_tpu.plugins.volumebinding # noqa: F401
+import volcano_tpu.plugins.dra           # noqa: F401

@@ -1,0 +1,225 @@
+"""DRA plugin — dynamic resource allocation (claims over device pools).
+
+Reference parity: volcano's DRA plumbing (SURVEY §2.1: claim tracking
+in cache cache.go:1590, per-queue DRA device accounting
+framework/session_dra_queue_status.go, upstream dynamicresources
+filter wrapped in predicates.go:154-162, PreBind claim-status write
+cache.go:1407).  Standalone model:
+
+- nodes advertise structured device pools (ResourceSlice analogue):
+    cluster.resource_slices[node_name] = [
+        {"name": "dev0", "class": "tpu-v5e-accel"}, ...]
+- claims (ResourceClaim analogue) live on the cluster:
+    cluster.resource_claims[name] = {
+        "class": "tpu-v5e-accel", "count": 1, "namespace": "default",
+        "allocated_node": "", "allocated_devices": []}
+- pods reference claims via annotation
+    dra.volcano-tpu.io/claims: "claim-a,claim-b"
+- queues may cap devices per class (per-queue DRA capacity):
+    queue annotation dra.volcano-tpu.io/quota.<class>: "8"
+
+Predicate: allocated claims pin their node; unallocated claims need
+enough free matching devices (in-session assume-cache, released on
+deallocate).  Claim allocations commit at session close for tasks that
+went to bind (PreBind analogue).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from volcano_tpu.api.fit_error import unschedulable
+from volcano_tpu.api.job_info import TaskInfo
+from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.framework.plugins import Plugin, register_plugin
+
+CLAIMS_ANNOTATION = "dra.volcano-tpu.io/claims"
+QUOTA_PREFIX = "dra.volcano-tpu.io/quota."
+MAX_SCORE = 100.0
+
+
+@register_plugin("dra")
+class DRAPlugin(Plugin):
+    name = "dra"
+
+    def on_session_open(self, ssn):
+        self.ssn = ssn
+        cluster = ssn.cache.cluster
+        self.slices: Dict[str, List[dict]] = dict(
+            getattr(cluster, "resource_slices", {}) or {})
+        self.claims: Dict[str, dict] = dict(
+            getattr(cluster, "resource_claims", {}) or {})
+        # device name -> claim holding it (committed + assumed)
+        self.device_owner: Dict[str, str] = {}
+        for cname, claim in self.claims.items():
+            for dev in claim.get("allocated_devices", []):
+                self.device_owner[dev] = cname
+        # in-session assumptions: task uid -> [(claim, node, devices)]
+        self._task_assumes: Dict[str, list] = {}
+        # per-queue allocated device counts per class (committed state)
+        self.queue_devices: Dict[str, Dict[str, int]] = defaultdict(
+            lambda: defaultdict(int))
+        for job in ssn.jobs.values():
+            for t in job.tasks.values():
+                if not t.occupies_resources():
+                    continue
+                for cname in self._task_claims(t):
+                    claim = self.claims.get(cname)
+                    if claim and claim.get("allocated_node"):
+                        self.queue_devices[job.queue][claim["class"]] += \
+                            len(claim.get("allocated_devices", []))
+
+        ssn.add_predicate_fn(self.name, self._predicate)
+        ssn.add_node_order_fn(self.name, self._score)
+        from volcano_tpu.framework.session import EventHandler
+        ssn.add_event_handler(EventHandler(
+            allocate_fn=self._on_allocate,
+            deallocate_fn=self._on_deallocate))
+
+    @staticmethod
+    def _task_claims(task: TaskInfo) -> List[str]:
+        raw = task.pod.annotations.get(CLAIMS_ANNOTATION, "")
+        return [c.strip() for c in raw.split(",") if c.strip()]
+
+    def _free_devices(self, node_name: str, device_class: str) -> List[str]:
+        return [d["name"] for d in self.slices.get(node_name, [])
+                if d.get("class") == device_class
+                and d["name"] not in self.device_owner]
+
+    def _queue_quota_ok(self, task: TaskInfo, claim: dict,
+                        extra: int = 0) -> bool:
+        """extra: devices already taken by earlier claims of the same
+        task in the current predicate pass."""
+        job = self.ssn.jobs.get(task.job)
+        queue = self.ssn.queues.get(job.queue) if job else None
+        if queue is None:
+            return True
+        raw = queue.queue.annotations.get(
+            f"{QUOTA_PREFIX}{claim['class']}")
+        if raw is None:
+            return True
+        try:
+            quota = int(raw)
+        except ValueError:
+            return True
+        used = self.queue_devices[job.queue][claim["class"]]
+        return used + extra + claim.get("count", 1) <= quota
+
+    # -- callbacks -----------------------------------------------------
+
+    def _predicate(self, task: TaskInfo, node: NodeInfo):
+        # claims of one task are checked against a shared view: devices
+        # and quota consumed by earlier claims of THIS task count
+        taken_here: Dict[str, int] = defaultdict(int)   # class -> devices
+        for cname in self._task_claims(task):
+            claim = self.claims.get(cname)
+            if claim is None:
+                return unschedulable(f"unknown resource claim {cname!r}",
+                                     "dra", resolvable=False)
+            allocated_node = claim.get("allocated_node")
+            if allocated_node:
+                if allocated_node != node.name:
+                    return unschedulable(
+                        f"claim {cname!r} is allocated on "
+                        f"{allocated_node!r}", "dra", resolvable=False)
+                continue
+            need = claim.get("count", 1)
+            cls = claim["class"]
+            if not self._queue_quota_ok(task, claim,
+                                        extra=taken_here[cls]):
+                return unschedulable(
+                    f"queue device quota exhausted for class {cls!r}",
+                    "dra")
+            free = self._free_devices(node.name, cls)
+            if len(free) - taken_here[cls] < need:
+                return unschedulable(
+                    f"not enough free {cls!r} devices for claim "
+                    f"{cname!r}", "dra")
+            taken_here[cls] += need
+        return None
+
+    def _score(self, task: TaskInfo, node: NodeInfo) -> float:
+        claims = self._task_claims(task)
+        if not claims:
+            return 0.0
+        total = 0.0
+        for cname in claims:
+            claim = self.claims.get(cname)
+            if claim is None:
+                continue
+            if claim.get("allocated_node") == node.name:
+                total += 1.0
+            elif self._free_devices(node.name, claim["class"]):
+                total += 0.5
+        return MAX_SCORE * total / len(claims)
+
+    def _on_allocate(self, event):
+        task = event.task
+        claims = self._task_claims(task)
+        if not claims or not task.node_name:
+            return
+        assumed = []
+        job = self.ssn.jobs.get(task.job)
+        for cname in claims:
+            claim = self.claims.get(cname)
+            if claim is None or claim.get("allocated_node"):
+                continue
+            need = claim.get("count", 1)
+            free = self._free_devices(task.node_name, claim["class"])
+            if len(free) < need:
+                # never assume a partial claim: roll back this task's
+                # earlier assumptions and leave it to resync
+                import logging
+                logging.getLogger(__name__).warning(
+                    "dra: claim %s short of devices on %s at allocate "
+                    "time; releasing task assumptions", cname,
+                    task.node_name)
+                for prev_cname, _n, devs in assumed:
+                    for dev in devs:
+                        self.device_owner.pop(dev, None)
+                    if job is not None:
+                        prev = self.claims.get(prev_cname)
+                        if prev is not None:
+                            self.queue_devices[job.queue][prev["class"]] \
+                                -= len(devs)
+                return
+            devices = free[:need]
+            for dev in devices:
+                self.device_owner[dev] = cname
+            assumed.append((cname, task.node_name, devices))
+            if job is not None:
+                self.queue_devices[job.queue][claim["class"]] += \
+                    len(devices)
+        if assumed:
+            self._task_assumes[task.uid] = assumed
+
+    def _on_deallocate(self, event):
+        job = self.ssn.jobs.get(event.task.job)
+        for cname, _node, devices in self._task_assumes.pop(
+                event.task.uid, []):
+            for dev in devices:
+                self.device_owner.pop(dev, None)
+            claim = self.claims.get(cname)
+            if job is not None and claim is not None:
+                self.queue_devices[job.queue][claim["class"]] -= \
+                    len(devices)
+
+    def on_session_close(self, ssn):
+        if not getattr(self, "_task_assumes", None):
+            return
+        from volcano_tpu.api.types import TaskStatus
+        committed = {
+            t.uid for job in ssn.jobs.values()
+            for t in job.tasks.values()
+            if t.status in (TaskStatus.BINDING, TaskStatus.BOUND)}
+        cluster = ssn.cache.cluster
+        live = getattr(cluster, "resource_claims", {})
+        for uid, assumes in self._task_assumes.items():
+            if uid not in committed:
+                continue
+            for cname, node_name, devices in assumes:
+                claim = live.get(cname)
+                if claim is not None and not claim.get("allocated_node"):
+                    claim["allocated_node"] = node_name
+                    claim["allocated_devices"] = list(devices)

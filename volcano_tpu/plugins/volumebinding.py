@@ -49,7 +49,6 @@ class VolumeBindingPlugin(Plugin):
         # ALLOCATION time so two pods can't pass the predicate against
         # the same free PV in one cycle)
         self.assumed: Dict[str, str] = {}
-        self.planned: Dict[str, str] = {}        # pvc -> pv to commit
         self._task_pvs: Dict[str, list] = {}     # task uid -> [(pvc, pv)]
         # always register: a pod claiming an unknown PVC must be gated
         # even when the cluster has no PVCs at all
@@ -65,7 +64,8 @@ class VolumeBindingPlugin(Plugin):
         raw = task.pod.annotations.get(CLAIMS_ANNOTATION, "")
         return [c.strip() for c in raw.split(",") if c.strip()]
 
-    def _bindable_pv(self, pvc_name: str, zone: str) -> Optional[str]:
+    def _bindable_pv(self, pvc_name: str, zone: str,
+                     exclude: Optional[set] = None) -> Optional[str]:
         pvc = self.pvcs.get(pvc_name)
         if pvc is None:
             return None
@@ -75,6 +75,8 @@ class VolumeBindingPlugin(Plugin):
                 else None
         for name, pv in sorted(self.pvs.items()):
             if pv.get("claimed_by") or name in self.assumed:
+                continue
+            if exclude and name in exclude:
                 continue
             if pv.get("zone") != zone:
                 continue
@@ -87,15 +89,20 @@ class VolumeBindingPlugin(Plugin):
         if not claims:
             return None
         zone = node.labels.get(ZONE_LABEL, "")
+        # PVs picked by earlier PVCs of THIS task are off the table for
+        # its later PVCs (intra-task reservation)
+        taken_here: set = set()
         for pvc_name in claims:
             if pvc_name not in self.pvcs:
                 return unschedulable(
                     f"unknown PVC {pvc_name!r}", "volumebinding",
                     resolvable=False)
-            if self._bindable_pv(pvc_name, zone) is None:
+            pv = self._bindable_pv(pvc_name, zone, exclude=taken_here)
+            if pv is None:
                 return unschedulable(
                     f"no bindable volume for PVC {pvc_name!r} in zone "
                     f"{zone or '<none>'}", "volumebinding")
+            taken_here.add(pv)
         return None
 
     def _score(self, task: TaskInfo, node: NodeInfo) -> float:
@@ -122,21 +129,30 @@ class VolumeBindingPlugin(Plugin):
             if pvc_name not in self.pvcs or \
                     self.pvcs[pvc_name].get("bound_pv"):
                 continue
-            pv = self._bindable_pv(pvc_name, zone)
-            if pv is not None:
-                self.assumed[pv] = pvc_name
-                self.planned[pvc_name] = pv
-                reserved.append((pvc_name, pv))
+            pv = self._bindable_pv(pvc_name, zone,
+                                   exclude={p for _, p in reserved})
+            if pv is None:
+                # never leave a claim partially unbound: release this
+                # task's reservations and let resync handle it
+                import logging
+                logging.getLogger(__name__).warning(
+                    "volumebinding: PVC %s lost its PV on %s at "
+                    "allocate time; releasing task reservations",
+                    pvc_name, task.node_name)
+                for _, prev_pv in reserved:
+                    self.assumed.pop(prev_pv, None)
+                return
+            self.assumed[pv] = pvc_name
+            reserved.append((pvc_name, pv))
         if reserved:
             self._task_pvs[task.uid] = reserved
 
     def _on_deallocate(self, event):
-        for pvc_name, pv in self._task_pvs.pop(event.task.uid, []):
+        for _pvc_name, pv in self._task_pvs.pop(event.task.uid, []):
             self.assumed.pop(pv, None)
-            self.planned.pop(pvc_name, None)
 
     def on_session_close(self, ssn):
-        if not getattr(self, "planned", None):
+        if not getattr(self, "_task_pvs", None):
             return
         # commit bindings whose tasks actually went to bind
         from volcano_tpu.api.types import TaskStatus

@@ -24,7 +24,7 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
                   block_k: int, seq_len: int, causal: bool):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)          # [block_q, d]
@@ -68,11 +68,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int,
     acc, m, l = jax.lax.fori_loop(0, num_k_blocks, body, (acc0, m0, l0))
     safe_l = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
+    # per-row log-sum-exp, consumed by the backward kernels
+    lse_ref[0] = m + jnp.log(safe_l)
 
 
 def _flash_bh(q, k, v, *, block_q: int, block_k: int, causal: bool,
               interpret: bool):
-    """q/k/v: [bh, t, d] -> [bh, t, d]."""
+    """q/k/v: [bh, t, d] -> (out [bh, t, d], lse [bh, t, 1] f32)."""
     bh, t, d = q.shape
     grid = (bh, t // block_q)
     kernel = functools.partial(_flash_kernel, block_q=block_q,
@@ -85,10 +87,150 @@ def _flash_bh(q, k, v, *, block_q: int, block_k: int, causal: bool,
             pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, block_q: int, block_k: int, seq_len: int,
+                   causal: bool):
+    """dQ for one q-block: dq_i = scale * sum_j dS_ij K_j with
+    dS = P o (dP - D), dP = dO V^T, P = exp(S - lse)."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]                      # [block_q, 1]
+    delta = delta_ref[0]                  # [block_q, 1]
+    d = q.shape[-1]
+    scale = jax.lax.rsqrt(jnp.float32(d))
+    if causal:
+        num_k_blocks = (qi * block_q + block_q - 1) // block_k + 1
+    else:
+        num_k_blocks = seq_len // block_k
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(kk, dq):
+        k_blk = k_ref[0, pl.ds(kk * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kk * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = kk * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_k_blocks, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q: int, block_k: int,
+                    seq_len: int, causal: bool):
+    """dK/dV for one k-block: loop over q-blocks at/after the diagonal."""
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)      # [block_k, d]
+    v = v_ref[0].astype(jnp.float32)
+    d = k.shape[-1]
+    scale = jax.lax.rsqrt(jnp.float32(d))
+    num_q_blocks = seq_len // block_q
+    start_q = (ki * block_k) // block_q if causal else 0
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    def body(qq, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(qq * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(qq * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, pl.ds(qq * block_q, block_q), :]
+        delta_blk = delta_ref[0, pl.ds(qq * block_q, block_q), :]
+        s = jax.lax.dot_general(q_blk, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse_blk)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        dv_new = dv + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do_blk, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_blk)
+        dk_new = dk + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start_q, num_q_blocks, body, (dk0, dv0))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bh_bwd(q, k, v, out, lse, do, *, block_q: int, block_k: int,
+                  causal: bool, interpret: bool):
+    bh, t, d = q.shape
+    # D_i = rowsum(dO_i * O_i) — cheap fused elementwise reduce
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)            # [bh, t, 1]
+
+    row_specs = [
+        pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),   # q
+        pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),   # k
+        pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),   # v
+        pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0)),   # do
+        pl.BlockSpec((1, t, 1), lambda i, j: (i, 0, 0)),   # lse
+        pl.BlockSpec((1, t, 1), lambda i, j: (i, 0, 0)),   # delta
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_q=block_q,
+                          block_k=block_k, seq_len=t, causal=causal),
+        grid=(bh, t // block_q),
+        in_specs=[pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                  row_specs[1], row_specs[2],
+                  pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+                  pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0)),
+                  pl.BlockSpec((1, block_q, 1), lambda i, j: (i, j, 0))],
         out_specs=pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q,
+                          block_k=block_k, seq_len=t, causal=causal),
+        grid=(bh, t // block_k),
+        in_specs=[row_specs[0],
+                  pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+                  pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+                  row_specs[3], row_specs[4], row_specs[5]],
+        out_specs=[pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0)),
+                   pl.BlockSpec((1, block_k, d), lambda i, j: (i, j, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d), q.dtype)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
 def supported(t: int, d: int, block_q: int = 128,
@@ -109,29 +251,40 @@ def _reference(q, k, v, causal: bool):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
+def _to_bh(x):
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from_bh(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    b, t, h, d = q.shape
-
-    def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-
-    out = _flash_bh(to_bh(q), to_bh(k), to_bh(v), block_q=block_q,
-                    block_k=block_k, causal=causal, interpret=interpret)
-    return out.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    b, _, h, _ = q.shape
+    out, _ = _flash_bh(_to_bh(q), _to_bh(k), _to_bh(v), block_q=block_q,
+                       block_k=block_k, causal=causal, interpret=interpret)
+    return _from_bh(out, b, h)
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    b, _, h, _ = q.shape
+    qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
+    out, lse = _flash_bh(qb, kb, vb, block_q=block_q, block_k=block_k,
+                         causal=causal, interpret=interpret)
+    return _from_bh(out, b, h), (qb, kb, vb, out, lse, b, h)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
-    # backward recomputes through the fused reference expression (XLA
-    # fuses it well); a dedicated backward kernel is the follow-up —
-    # gradients stay exact either way.
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q, k, v: _reference(q, k, v, causal), q, k, v)
-    return vjp(g.astype(q.dtype))
+    # flash backward kernels: dq over q-blocks, dk/dv over k-blocks,
+    # both skipping fully-masked blocks past the causal diagonal
+    qb, kb, vb, out, lse, b, h = residuals
+    dq, dk, dv = _flash_bh_bwd(qb, kb, vb, out, lse, _to_bh(g),
+                               block_q=block_q, block_k=block_k,
+                               causal=causal, interpret=interpret)
+    return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
