@@ -35,6 +35,11 @@ class ModelConfig:
     d_ff: int = 1408
     max_seq: int = 2048
     dtype: Any = jnp.bfloat16
+    # Mixture-of-experts: every other block's MLP becomes a routed
+    # expert layer (experts sharded over fsdp x tp); 0 = dense model.
+    n_experts: int = 0
+    expert_top_k: int = 2
+    moe_aux_weight: float = 0.01
     use_ring_attention: bool = False
     # Pallas flash-attention kernel on TPU (falls back to the jnp path
     # when shapes don't block-align); ring attention wins when sp > 1.
@@ -65,20 +70,30 @@ def init_params(rng, cfg: ModelConfig) -> Dict[str, Any]:
         "blocks": [],
     }
     for i in range(cfg.n_layers):
-        k = jax.random.split(keys[2 + i], 7)
+        k = jax.random.split(keys[2 + i], 8)
         d, f = cfg.d_model, cfg.d_ff
-        params["blocks"].append({
+        block = {
             "attn_norm": jnp.ones((d,)),
             "wq": jax.random.normal(k[0], (d, d)) * scale,
             "wk": jax.random.normal(k[1], (d, d)) * scale,
             "wv": jax.random.normal(k[2], (d, d)) * scale,
             "wo": jax.random.normal(k[3], (d, d)) * scale,
             "mlp_norm": jnp.ones((d,)),
-            "w_gate": jax.random.normal(k[4], (d, f)) * scale,
-            "w_up": jax.random.normal(k[5], (d, f)) * scale,
-            "w_down": jax.random.normal(k[6], (f, d)) * (f ** -0.5),
-        })
+        }
+        if _is_moe_block(cfg, i):
+            block.update(init_moe_params(k[7], d, f, cfg.n_experts, scale))
+        else:
+            block.update({
+                "w_gate": jax.random.normal(k[4], (d, f)) * scale,
+                "w_up": jax.random.normal(k[5], (d, f)) * scale,
+                "w_down": jax.random.normal(k[6], (f, d)) * (f ** -0.5),
+            })
+        params["blocks"].append(block)
     return params
+
+
+def _is_moe_block(cfg: ModelConfig, layer_idx: int) -> bool:
+    return cfg.n_experts > 0 and layer_idx % 2 == 1
 
 
 _PARAM_SPECS = {
@@ -95,6 +110,15 @@ _PARAM_SPECS = {
     "w_up": P("fsdp", "tp"),
     "w_down": P("tp", "fsdp"),
 }
+
+# expert-parallel specs (expert dim rides fsdp; see workloads/moe.py)
+from volcano_tpu.workloads.moe import (  # noqa: E402
+    MOE_PARAM_SPECS as _MOE_SPECS,
+    init_moe_params,
+    moe_mlp,
+)
+
+_PARAM_SPECS.update({name: P(*axes) for name, axes in _MOE_SPECS.items()})
 
 
 def param_specs(params) -> Any:
@@ -167,15 +191,20 @@ def _mlp(x, blk):
 
 
 def _block(x, blk, cfg: ModelConfig, positions, mesh):
+    """Returns (x, moe_aux_loss) — aux is 0 for dense blocks so the
+    structure stays uniform under jax.checkpoint."""
     x = x + _attention(_rms_norm(x, blk["attn_norm"]), blk, cfg,
                        positions, mesh)
-    x = x + _mlp(_rms_norm(x, blk["mlp_norm"]), blk)
-    return x
+    h = _rms_norm(x, blk["mlp_norm"])
+    if "router" in blk:
+        y, aux = moe_mlp(h, blk, cfg.n_experts, cfg.expert_top_k)
+        return x + y, aux
+    return x + _mlp(h, blk), jnp.float32(0.0)
 
 
-def forward(params, tokens, cfg: ModelConfig,
-            mesh: Optional[Mesh] = None) -> jnp.ndarray:
-    """tokens [b, t] -> logits [b, t, vocab]."""
+def forward_with_aux(params, tokens, cfg: ModelConfig,
+                     mesh: Optional[Mesh] = None):
+    """tokens [b, t] -> (logits [b, t, vocab], moe aux loss scalar)."""
     b, t = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]
     positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
@@ -185,21 +214,32 @@ def forward(params, tokens, cfg: ModelConfig,
         block_fn = jax.checkpoint(
             _block, static_argnums=(2, 4),
             policy=jax.checkpoint_policies.nothing_saveable)
+    aux_total = jnp.float32(0.0)
     for blk in params["blocks"]:
-        x = block_fn(x, blk, cfg, positions, mesh)
+        x, aux = block_fn(x, blk, cfg, positions, mesh)
+        aux_total = aux_total + aux
 
     x = _rms_norm(x, params["final_norm"])
-    return (x @ params["head"].astype(cfg.dtype)).astype(jnp.float32)
+    logits = (x @ params["head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits, aux_total
+
+
+def forward(params, tokens, cfg: ModelConfig,
+            mesh: Optional[Mesh] = None) -> jnp.ndarray:
+    """tokens [b, t] -> logits [b, t, vocab]."""
+    return forward_with_aux(params, tokens, cfg, mesh)[0]
 
 
 def loss_fn(params, batch, cfg: ModelConfig,
             mesh: Optional[Mesh] = None) -> jnp.ndarray:
-    """Next-token cross entropy; batch: {"tokens": [b, t]}."""
+    """Next-token cross entropy (+ MoE load-balancing aux);
+    batch: {"tokens": [b, t]}."""
     tokens = batch["tokens"]
-    logits = forward(params, tokens, cfg, mesh)
+    logits, moe_aux = forward_with_aux(params, tokens, cfg, mesh)
     targets = jnp.roll(tokens, -1, axis=1)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     # last position predicts the rolled-around token: mask it out
     mask = jnp.ones_like(nll).at[:, -1].set(0.0)
-    return jnp.sum(nll * mask) / jnp.sum(mask)
+    ce = jnp.sum(nll * mask) / jnp.sum(mask)
+    return ce + cfg.moe_aux_weight * moe_aux
