@@ -54,6 +54,16 @@ def init_sharded(rng, cfg: ModelConfig, mesh: Mesh, optimizer):
     params = jax.jit(model_lib.init_params, static_argnums=(1,),
                      out_shardings=p_shardings)(rng, cfg)
     opt_state = jax.jit(optimizer.init)(params)
+    # optimizer scalars (step counts etc.) come out single-device;
+    # replicate them onto the mesh so every consumer — including a
+    # checkpoint restore using this state as the shape/sharding "like"
+    # — sees one consistent device assignment
+    replicated = NamedSharding(mesh, P())
+    opt_state = jax.tree.map(
+        lambda x: jax.device_put(x, replicated)
+        if isinstance(getattr(x, "sharding", None),
+                      jax.sharding.SingleDeviceSharding) else x,
+        opt_state)
     return params, opt_state, p_shardings
 
 
