@@ -105,9 +105,66 @@ def bench_utilization_under_contention() -> float:
     return used / total_chips
 
 
+def bench_reference_gang_shape() -> float:
+    """The reference harness's default gang scenario (benchmark/README
+    JOBS=10, REPLICAS=100, MIN_AVAILABLE=100 over 100 nodes): seconds
+    until all 1000 pods are bound."""
+    from volcano_tpu.api.node_info import Node
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.uthelper import gang_job
+
+    cluster = FakeCluster()
+    for i in range(100):
+        cluster.add_node(Node(name=f"n{i}",
+                              allocatable={"cpu": 112, "pods": 256}))
+    for j in range(10):
+        pg, pods = gang_job(f"job{j}", replicas=100, requests={"cpu": 1})
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+    sched = Scheduler(cluster, conf=BENCH_CONF, schedule_period=0)
+    t0 = time.perf_counter()
+    for _ in range(50):  # bounded: a stall must fail, not hang the driver
+        sched.run_once()
+        cluster.tick()
+        if len(cluster.binds) >= 1000:
+            break
+    assert len(cluster.binds) >= 1000, \
+        f"gang shape stalled at {len(cluster.binds)}/1000 binds"
+    return time.perf_counter() - t0
+
+
+def bench_agent_scheduler_throughput() -> float:
+    """Fast-path pods/second over a 500-pod burst (the reference's
+    bare-pod benchmark default, benchmark/README PODS=500)."""
+    from volcano_tpu.agentscheduler import AgentScheduler
+    from volcano_tpu.api.node_info import Node
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.shard import AGENT_SCHEDULER
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+
+    cluster = FakeCluster()
+    for i in range(20):
+        cluster.add_node(Node(name=f"n{i}",
+                              allocatable={"cpu": 64, "pods": 256}))
+    sched = AgentScheduler(cluster)
+    for i in range(500):
+        pod = make_pod(f"a{i}", requests={"cpu": "100m"})
+        pod.scheduler_name = AGENT_SCHEDULER
+        cluster.add_pod(pod)
+    t0 = time.perf_counter()
+    bound = sched.run_until_drained()
+    dt = time.perf_counter() - t0
+    assert bound == 500, f"agent bound {bound}/500"
+    return bound / dt
+
+
 def main():
     p50 = bench_gang_allocate_latency()
     utilization = bench_utilization_under_contention()
+    gang_shape_s = bench_reference_gang_shape()
+    agent_pps = bench_agent_scheduler_throughput()
     print(json.dumps({
         "metric": "p50_gang_allocate_latency_256host_v5p1024",
         "value": round(p50, 4),
@@ -116,6 +173,8 @@ def main():
         "extra": {
             "chip_utilization_under_contention": round(utilization, 4),
             "utilization_target": 0.95,
+            "reference_gang_shape_1000pods_s": round(gang_shape_s, 4),
+            "agent_scheduler_pods_per_s": round(agent_pps),
             "trials": TRIALS,
             "cluster_hosts": 256 + 64 + 16,
         },
