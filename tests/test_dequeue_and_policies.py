@@ -1,0 +1,109 @@
+"""Queue dequeue strategies, preemptionPolicy Never, scale-up,
+session-close unschedulable accounting."""
+
+import time
+
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.pod import Container, Pod
+from volcano_tpu.api.queue import Queue
+from volcano_tpu.api.types import JobPhase, PodGroupPhase
+from volcano_tpu.api.vcjob import TaskSpec, VCJob
+from volcano_tpu.cache.cluster import PriorityClass
+from volcano_tpu.controllers import ControllerManager
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.simulator import make_tpu_cluster
+from volcano_tpu.uthelper import TestContext, gang_job
+from volcano_tpu.webhooks import default_admission
+from volcano_tpu import metrics
+
+
+def nodes(n, cpu="8"):
+    return [Node(name=f"n{i}", allocatable={"cpu": cpu, "pods": 110})
+            for i in range(n)]
+
+
+def two_jobs_ctx(strategy):
+    q = Queue(name="q", dequeue_strategy=strategy)
+    # head job (older) cannot fit; second job can
+    pg_big, pods_big = gang_job("big", queue="q", replicas=2,
+                                requests={"cpu": 6})
+    pg_big.creation_time = time.time() - 100
+    pg_small, pods_small = gang_job("small", queue="q", replicas=1,
+                                    requests={"cpu": 2})
+    return TestContext(nodes=nodes(1), queues=[q],
+                       podgroups=[pg_big, pg_small],
+                       pods=pods_big + pods_small)
+
+
+def test_traverse_strategy_skips_blocked_head():
+    ctx = two_jobs_ctx("traverse")
+    ctx.run()
+    assert "default/small-0" in ctx.bind_map
+
+
+def test_fifo_strategy_blocks_queue_behind_head():
+    ctx = two_jobs_ctx("fifo")
+    ctx.run()
+    # head can't schedule -> strict FIFO blocks the whole queue
+    assert "default/small-0" not in ctx.bind_map
+    ctx.expect_bind_num(0)
+
+
+def test_preemption_policy_never():
+    # lo fills BOTH nodes (4+4 on each) so hi can only run by evicting —
+    # the Never policy must forbid exactly that
+    pg_lo, pods_lo = gang_job("lo", replicas=4, min_available=1,
+                              requests={"cpu": 4},
+                              running_on=["n0", "n1"],
+                              pg_phase=PodGroupPhase.RUNNING)
+    pg_hi, pods_hi = gang_job("hi", replicas=1, requests={"cpu": 4},
+                              priority_class="polite",
+                              pg_phase=PodGroupPhase.INQUEUE)
+    conf = {"actions": "enqueue, allocate, preempt",
+            "tiers": [{"plugins": [{"name": "priority"}, {"name": "gang"},
+                                   {"name": "predicates"},
+                                   {"name": "nodeorder"}]}]}
+    ctx = TestContext(
+        nodes=nodes(2), podgroups=[pg_lo, pg_hi],
+        pods=pods_lo + pods_hi, conf=conf,
+        priority_classes=[PriorityClass("polite", 1000,
+                                        preemption_policy="Never")])
+    ctx.run()
+    ctx.expect_evict_num(0)  # high priority but polite: no preemption
+
+
+def test_job_scale_up():
+    """Growing replicas materializes and schedules the new pods
+    (reference e2e job_scale_up_down.go)."""
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    cluster.admission = default_admission()
+    mgr = ControllerManager(cluster, enabled=["job"])
+    sched = Scheduler(cluster, schedule_period=0)
+    job = cluster.add_vcjob(VCJob(
+        name="elastic", min_available=1,
+        tasks=[TaskSpec(name="w", replicas=2,
+                        template=Pod(name="t", containers=[
+                            Container(requests={"cpu": 1})]))]))
+    for _ in range(3):
+        mgr.sync_all()
+        sched.run_once()
+        cluster.tick()
+    assert cluster.vcjobs[job.key].running == 2
+
+    job.tasks[0].replicas = 4
+    cluster.update_vcjob(job)
+    for _ in range(3):
+        mgr.sync_all()
+        sched.run_once()
+        cluster.tick()
+    assert cluster.vcjobs[job.key].running == 4
+
+
+def test_unschedulable_accounting_on_session_close():
+    metrics.reset()
+    pg, pods = gang_job("toolarge", replicas=2, requests={"cpu": 100})
+    ctx = TestContext(nodes=nodes(1), podgroups=[pg], pods=pods)
+    ctx.run()
+    assert metrics.get_counter("unschedule_job_count") >= 1
+    assert any(reason == "Unschedulable"
+               for _, reason, _ in ctx.cluster.events)

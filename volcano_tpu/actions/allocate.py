@@ -56,6 +56,14 @@ class AllocateAction(Action):
                 continue
             job = jobs.pop()
             self._allocate_job(ssn, queue, job)
+            if queue.queue.dequeue_strategy == "fifo" and \
+                    not ssn.job_ready(job):
+                # strict FIFO: the head job blocks the queue until it
+                # schedules (Queue.dequeueStrategy, types.go:459-519);
+                # "traverse" (default behavior here) moves on
+                log.debug("queue %s fifo head %s not ready; queue blocked",
+                          queue.name, job.key)
+                continue
             queues.push(queue)
 
     @staticmethod

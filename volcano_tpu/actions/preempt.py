@@ -53,6 +53,7 @@ class PreemptAction(Action):
                 and ssn.job_starving(job)
                 and not job.has_topology_constraint()
                 and ssn.job_valid(job) is None
+                and self._may_preempt(ssn, job)
                 and (job.podgroup is None or job.podgroup.phase in
                      (PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING,
                       PodGroupPhase.UNKNOWN))
@@ -62,6 +63,13 @@ class PreemptAction(Action):
             jobs = PriorityQueue(ssn.job_order_fn, starving)
             for job in jobs:
                 self._preempt_for_job(ssn, queue, job)
+
+    @staticmethod
+    def _may_preempt(ssn, job: JobInfo) -> bool:
+        """PriorityClass preemptionPolicy: Never bars a job from being
+        a preemptor (it still schedules normally)."""
+        pc = ssn.priority_classes.get(job.priority_class)
+        return pc is None or pc.preemption_policy != "Never"
 
     def _preempt_for_job(self, ssn, queue, job: JobInfo):
         stmt = ssn.statement()

@@ -34,7 +34,9 @@ class Queue:
     reclaimable: bool = True
     priority: int = 0
     parent: str = ""                           # hierarchical queues
-    dequeue_strategy: str = DEQUEUE_FIFO
+    # reference default is traverse (types.go:503,519): a blocked head
+    # job does NOT starve the rest of the queue unless fifo is chosen
+    dequeue_strategy: str = DEQUEUE_TRAVERSE
 
     # status
     state: QueueState = QueueState.OPEN

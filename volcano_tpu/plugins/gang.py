@@ -21,6 +21,26 @@ from volcano_tpu.framework.session import ABSTAIN, PERMIT, REJECT
 class GangPlugin(Plugin):
     name = "gang"
 
+    def on_session_close(self, ssn):
+        """Record unschedulable gangs + unready task counts at session
+        end (gang.go OnSessionClose: unScheduleJobCount metrics and
+        Unschedulable events)."""
+        from volcano_tpu import metrics
+        unready_tasks = 0
+        unschedulable_jobs = 0
+        for job in ssn.jobs.values():
+            if not job.tasks or job.is_ready():
+                continue
+            unschedulable_jobs += 1
+            unready_tasks += max(
+                0, job.min_available - job.ready_task_num())
+            ssn.cache.record_event(
+                job.key, "Unschedulable",
+                job.fit_error() or
+                f"{job.ready_task_num()}/{job.min_available} tasks ready")
+        metrics.inc("unschedule_job_count", unschedulable_jobs)
+        metrics.inc("unschedule_task_count", unready_tasks)
+
     def on_session_open(self, ssn):
         ssn.add_job_valid_fn(self.name, self._job_valid)
         ssn.add_job_ready_fn(self.name, self._job_ready)
