@@ -104,6 +104,45 @@ def test_unschedulable_accounting_on_session_close():
     pg, pods = gang_job("toolarge", replicas=2, requests={"cpu": 100})
     ctx = TestContext(nodes=nodes(1), podgroups=[pg], pods=pods)
     ctx.run()
-    assert metrics.get_counter("unschedule_job_count") >= 1
+    assert metrics.get_gauge("unschedule_job_count") >= 1
     assert any(reason == "Unschedulable"
                for _, reason, _ in ctx.cluster.events)
+
+
+def test_preemption_policy_never_blocks_gangpreempt_too():
+    """A Never-policy HARD-topology gang must not evict via gangpreempt
+    (the topology path bypasses preempt, so the gate must hold there)."""
+    from volcano_tpu.api.podgroup import NetworkTopologySpec
+    from volcano_tpu.api.types import NetworkTopologyMode
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    pg_lo, pods_lo = gang_job("filler", replicas=4, min_available=1,
+                              requests={"cpu": 8, "google.com/tpu": 4},
+                              running_on=[f"sa-w{i}" for i in range(4)],
+                              pg_phase=PodGroupPhase.RUNNING)
+    pg_hi, pods_hi = gang_job(
+        "polite-train", replicas=4,
+        requests={"cpu": 8, "google.com/tpu": 4},
+        priority_class="polite",
+        network_topology=NetworkTopologySpec(NetworkTopologyMode.HARD, 1),
+        pg_phase=PodGroupPhase.INQUEUE)
+    for pg, pods in [(pg_lo, pods_lo), (pg_hi, pods_hi)]:
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+    cluster.add_priority_class(
+        PriorityClass("polite", 1000, preemption_policy="Never"))
+    from volcano_tpu.cache.cache import SchedulerCache
+    from volcano_tpu.conf import load_conf
+    ctx = TestContext.__new__(TestContext)
+    ctx.cluster = cluster
+    ctx.conf = load_conf({
+        "actions": "enqueue, allocate, gangpreempt",
+        "tiers": [{"plugins": [
+            {"name": "priority"}, {"name": "gang"},
+            {"name": "conformance"}, {"name": "predicates"},
+            {"name": "nodeorder"}, {"name": "deviceshare"},
+            {"name": "network-topology-aware"}]}]})
+    ctx.cache = SchedulerCache(cluster)
+    ctx.last_session = None
+    ctx.run()
+    ctx.expect_evict_num(0)

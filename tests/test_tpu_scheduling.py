@@ -160,3 +160,27 @@ def test_soft_topology_prefers_colocation():
     ctx.expect_bind_num(4)
     slices_used = {n.rsplit("-w", 1)[0] for _, n in ctx.cluster.binds}
     assert len(slices_used) == 1
+
+
+def test_1024_host_multislice_gang_scale():
+    """4 x 256-host subgroups fill four v5p-1024 slices in one cycle
+    (scale regression: must stay well under the 2s p50 target)."""
+    import time as _time
+    sg = [SubGroupPolicy(name=f"rep{i}", min_member=256,
+                         network_topology=NetworkTopologySpec(
+                             NetworkTopologyMode.HARD, 1))
+          for i in range(4)]
+    pg, pods = gang_job("mega", replicas=1024, requests={"cpu": 8, TPU: 4},
+                        sub_group_policies=sg,
+                        labels_per_pod=lambda i: {SUBGROUP_LABEL:
+                                                  f"rep{i // 256}"})
+    ctx = tpu_ctx([(f"pod{i}", "v5p-1024") for i in range(5)],
+                  podgroups=[pg], pods=pods)
+    cluster = ctx.cluster
+    t0 = _time.perf_counter()
+    ctx.run()
+    elapsed = _time.perf_counter() - t0
+    ctx.expect_bind_num(1024)
+    assert elapsed < 5.0, f"1024-host cycle took {elapsed:.2f}s"
+    used = {n.split("-w")[0] for _, n in cluster.binds}
+    assert len(used) == 4  # one slice per subgroup

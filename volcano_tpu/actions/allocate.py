@@ -56,7 +56,8 @@ class AllocateAction(Action):
                 continue
             job = jobs.pop()
             self._allocate_job(ssn, queue, job)
-            if queue.queue.dequeue_strategy == "fifo" and \
+            from volcano_tpu.api.queue import DEQUEUE_FIFO
+            if queue.queue.dequeue_strategy == DEQUEUE_FIFO and \
                     not ssn.job_ready(job):
                 # strict FIFO: the head job blocks the queue until it
                 # schedules (Queue.dequeueStrategy, types.go:459-519);

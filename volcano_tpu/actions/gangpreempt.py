@@ -29,6 +29,7 @@ from volcano_tpu.actions.bundle import (
     create_job_bundles,
     sort_bundles_for_preempt,
 )
+from volcano_tpu.actions.util import may_preempt
 from volcano_tpu.actions.topology_alloc import candidate_domains
 
 log = logging.getLogger(__name__)
@@ -166,6 +167,7 @@ class GangPreemptAction(Action):
                 and job.has_topology_constraint()
                 and ssn.job_starving(job)
                 and ssn.job_valid(job) is None
+                and may_preempt(ssn, job)
                 and not any(s.nominated_hypernode
                             for s in job.sub_jobs.values())
                 and (job.podgroup is None or job.podgroup.phase in

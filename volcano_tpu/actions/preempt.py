@@ -21,7 +21,7 @@ from volcano_tpu import metrics
 log = logging.getLogger(__name__)
 
 
-from volcano_tpu.actions.util import victim_sort_key
+from volcano_tpu.actions.util import may_preempt, victim_sort_key
 
 
 def select_victims_on_node(ssn, preemptor: TaskInfo, node,
@@ -53,7 +53,7 @@ class PreemptAction(Action):
                 and ssn.job_starving(job)
                 and not job.has_topology_constraint()
                 and ssn.job_valid(job) is None
-                and self._may_preempt(ssn, job)
+                and may_preempt(ssn, job)
                 and (job.podgroup is None or job.podgroup.phase in
                      (PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING,
                       PodGroupPhase.UNKNOWN))
@@ -63,13 +63,6 @@ class PreemptAction(Action):
             jobs = PriorityQueue(ssn.job_order_fn, starving)
             for job in jobs:
                 self._preempt_for_job(ssn, queue, job)
-
-    @staticmethod
-    def _may_preempt(ssn, job: JobInfo) -> bool:
-        """PriorityClass preemptionPolicy: Never bars a job from being
-        a preemptor (it still schedules normally)."""
-        pc = ssn.priority_classes.get(job.priority_class)
-        return pc is None or pc.preemption_policy != "Never"
 
     def _preempt_for_job(self, ssn, queue, job: JobInfo):
         stmt = ssn.statement()

@@ -19,6 +19,7 @@ from volcano_tpu.util import PriorityQueue
 from volcano_tpu import metrics
 
 from volcano_tpu.actions.preempt import select_victims_on_node
+from volcano_tpu.actions.util import may_preempt
 
 log = logging.getLogger(__name__)
 
@@ -30,14 +31,13 @@ class ReclaimAction(Action):
         for queue_name, queue in sorted(ssn.queues.items()):
             if ssn.overused(queue):
                 continue
-            from volcano_tpu.actions.preempt import PreemptAction
             starving = [
                 job for job in ssn.jobs.values()
                 if job.queue == queue_name
                 and ssn.job_starving(job)
                 and ssn.job_valid(job) is None
                 # preemptionPolicy: Never bars reclaim too (reclaim.go:144)
-                and PreemptAction._may_preempt(ssn, job)
+                and may_preempt(ssn, job)
                 and (job.podgroup is None or job.podgroup.phase in
                      (PodGroupPhase.INQUEUE, PodGroupPhase.RUNNING,
                       PodGroupPhase.UNKNOWN))

@@ -15,6 +15,14 @@ from volcano_tpu.api.job_info import JobInfo, TaskInfo
 from volcano_tpu.api.node_info import NodeInfo
 
 
+def may_preempt(ssn, job) -> bool:
+    """PriorityClass preemptionPolicy: Never bars a job from being a
+    preemptor in preempt, reclaim, gangpreempt and gangreclaim alike
+    (it still schedules normally)."""
+    pc = ssn.priority_classes.get(job.priority_class)
+    return pc is None or pc.preemption_policy != "Never"
+
+
 def victim_sort_key(ssn):
     """Cheapest eviction first: lowest job priority, then lowest task
     priority, then smallest request — shared by per-node victim

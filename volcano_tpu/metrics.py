@@ -17,6 +17,7 @@ _observations: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], List[float]] = \
     defaultdict(list)
 _counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = \
     defaultdict(float)
+_gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
 
 
 def _key(name: str, labels: dict) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
@@ -31,6 +32,17 @@ def observe(name: str, value: float, **labels):
 def inc(name: str, value: float = 1.0, **labels):
     with _lock:
         _counters[_key(name, labels)] += value
+
+
+def set_gauge(name: str, value: float, **labels):
+    """Point-in-time value (e.g. current unschedulable-job count)."""
+    with _lock:
+        _gauges[_key(name, labels)] = value
+
+
+def get_gauge(name: str, **labels) -> float:
+    with _lock:
+        return _gauges.get(_key(name, labels), 0.0)
 
 
 def get_observations(name: str, **labels) -> List[float]:
@@ -55,6 +67,7 @@ def reset():
     with _lock:
         _observations.clear()
         _counters.clear()
+        _gauges.clear()
 
 
 def serve(port: int = 0):
@@ -91,6 +104,10 @@ def dump() -> str:
     """Prometheus text exposition."""
     lines = []
     with _lock:
+        for (name, labels), value in sorted(_gauges.items()):
+            lbl = ",".join(f'{k}="{v}"' for k, v in labels)
+            lines.append(f"{name}{{{lbl}}} {value}" if lbl
+                         else f"{name} {value}")
         for (name, labels), value in sorted(_counters.items()):
             lbl = ",".join(f'{k}="{v}"' for k, v in labels)
             lines.append(f"{name}{{{lbl}}} {value}" if lbl

@@ -38,8 +38,10 @@ class GangPlugin(Plugin):
                 job.key, "Unschedulable",
                 job.fit_error() or
                 f"{job.ready_task_num()}/{job.min_available} tasks ready")
-        metrics.inc("unschedule_job_count", unschedulable_jobs)
-        metrics.inc("unschedule_task_count", unready_tasks)
+        # gauges: the CURRENT unschedulable population, not a running
+        # total (reference metrics.go:166-180 uses .Set)
+        metrics.set_gauge("unschedule_job_count", unschedulable_jobs)
+        metrics.set_gauge("unschedule_task_count", unready_tasks)
 
     def on_session_open(self, ssn):
         ssn.add_job_valid_fn(self.name, self._job_valid)
