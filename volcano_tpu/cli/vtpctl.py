@@ -90,6 +90,19 @@ def cmd_job_run(cluster, args):
           f"minAvailable={job.min_available})")
 
 
+def cmd_job_create(cluster, args):
+    from volcano_tpu.cli.manifest import ManifestError, load_jobs
+    try:
+        jobs = load_jobs(args.filename)
+    except (ManifestError, OSError) as e:
+        sys.exit(f"error: {e}")
+    for job in jobs:
+        job = cluster.add_vcjob(job)
+        print(f"job {job.key} created (queue={job.queue}, "
+              f"minAvailable={job.min_available}, "
+              f"tasks={[t.name for t in job.tasks]})")
+
+
 def cmd_job_list(cluster, args):
     rows = []
     for job in cluster.vcjobs.values():
@@ -237,6 +250,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tpu", type=int, default=0)
     p.add_argument("--plugins", default="")
     p.set_defaults(fn=cmd_job_run)
+    p = job.add_parser("create", help="create job(s) from a YAML manifest")
+    p.add_argument("-f", "--filename", required=True)
+    p.set_defaults(fn=cmd_job_create)
     p = job.add_parser("list")
     p.add_argument("-n", "--namespace", default=None)
     p.set_defaults(fn=cmd_job_list)
