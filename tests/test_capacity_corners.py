@@ -1,0 +1,102 @@
+"""Capacity/proportion corner cases: guarantees, capabilities, closed
+queues (reference capacity_test.go / proportion_test.go scenarios)."""
+
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.queue import Queue
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.types import PodGroupPhase, QueueState
+from volcano_tpu.uthelper import TestContext, gang_job
+
+
+def nodes(n, cpu="8"):
+    return [Node(name=f"n{i}", allocatable={"cpu": cpu, "pods": 110})
+            for i in range(n)]
+
+
+CAPACITY_CONF = {
+    "actions": "enqueue, allocate, backfill",
+    "tiers": [
+        {"plugins": [{"name": "priority"}, {"name": "gang"}]},
+        {"plugins": [{"name": "predicates"}, {"name": "capacity"},
+                     {"name": "nodeorder"}]},
+    ],
+}
+
+
+def test_capability_hard_cap():
+    """A queue never allocates past its capability even on an idle
+    cluster."""
+    q = Queue(name="capped", capability=Resource({"cpu": 4000}))
+    pg, pods = gang_job("j", queue="capped", replicas=4, min_available=1,
+                        requests={"cpu": 2})
+    ctx = TestContext(nodes=nodes(4), queues=[q], podgroups=[pg],
+                      pods=pods, conf=CAPACITY_CONF)
+    ctx.run()
+    ctx.expect_bind_num(2)  # 4000m cap / 2000m per task
+
+
+def test_guarantee_reserved_from_siblings():
+    """A queue's guarantee is carved out of what siblings may admit,
+    even while the guaranteed queue is idle."""
+    q_g = Queue(name="gold", guarantee=Resource({"cpu": 12000}))
+    q_o = Queue(name="other")
+    pg, pods = gang_job("greedy", queue="other", replicas=8,
+                        min_available=8, requests={"cpu": 2})
+    pg.min_resources = Resource({"cpu": 16000})  # declared => gated
+    ctx = TestContext(nodes=nodes(2), queues=[q_g, q_o],
+                      podgroups=[pg], pods=pods, conf=CAPACITY_CONF)
+    ctx.run()
+    # total 16 cpu - 12 guarantee = 4 cpu realCapability for "other":
+    # the 16-cpu gang may not even enqueue
+    ctx.expect_podgroup_phase("default/greedy", PodGroupPhase.PENDING)
+    ctx.expect_bind_num(0)
+
+
+def test_guaranteed_queue_can_use_its_floor_under_pressure():
+    q_g = Queue(name="gold", guarantee=Resource({"cpu": 8000}))
+    q_o = Queue(name="other")
+    pg_o, pods_o = gang_job("noise", queue="other", replicas=4,
+                            min_available=1, requests={"cpu": 2})
+    pg_g, pods_g = gang_job("vip", queue="gold", replicas=4,
+                            min_available=4, requests={"cpu": 2})
+    ctx = TestContext(nodes=nodes(2), queues=[q_g, q_o],
+                      podgroups=[pg_o, pg_g], pods=pods_o + pods_g,
+                      conf=CAPACITY_CONF)
+    ctx.run()
+    vip_bound = sum(1 for k, _ in ctx.cluster.binds if "vip" in k)
+    assert vip_bound == 4  # the full guaranteed gang landed
+
+
+def test_closed_queue_admits_nothing():
+    q = Queue(name="shut", state=QueueState.CLOSED)
+    pg, pods = gang_job("j", queue="shut", replicas=1,
+                        requests={"cpu": 1})
+    ctx = TestContext(nodes=nodes(1), queues=[q], podgroups=[pg],
+                      pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(0)
+    ctx.expect_podgroup_phase("default/j", PodGroupPhase.PENDING)
+
+
+def test_queue_close_drain_reopen_cycle():
+    """vtpctl queue operate semantics: closing drains, reopening
+    admits again."""
+    from volcano_tpu.controllers.queue import QueueController
+    q = Queue(name="cycle")
+    pg, _ = gang_job("j1", queue="cycle", replicas=1,
+                     requests={"cpu": 1}, pg_phase=PodGroupPhase.COMPLETED)
+    ctx = TestContext(nodes=nodes(1), queues=[q], podgroups=[pg],
+                      pods=[])
+    ctrl = QueueController()
+    ctrl.initialize(ctx.cluster)
+    ctrl.close_queue("cycle")
+    assert ctx.cluster.queues["cycle"].state is QueueState.CLOSED
+
+    ctrl.open_queue("cycle")
+    pg2, pods2 = gang_job("j2", queue="cycle", replicas=1,
+                          requests={"cpu": 1})
+    ctx.cluster.add_podgroup(pg2)
+    for p in pods2:
+        ctx.cluster.add_pod(p)
+    ctx.run()
+    ctx.expect_bind("default/j2-0")

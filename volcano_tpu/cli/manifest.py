@@ -124,6 +124,11 @@ def job_from_manifest(data: dict) -> VCJob:
             subgroup=t.get("subGroup", ""),
         ))
 
+    if not tasks:
+        raise ManifestError("spec.tasks must declare at least one task")
+    if sum(t.replicas for t in tasks) <= 0:
+        raise ManifestError("total task replicas must be > 0")
+
     nt = spec.get("networkTopology")
     network_topology = None
     if nt:
@@ -131,12 +136,17 @@ def job_from_manifest(data: dict) -> VCJob:
             network_topology = NetworkTopologySpec(
                 mode=NetworkTopologyMode(nt.get("mode", "hard")),
                 highest_tier_allowed=int(nt.get("highestTierAllowed", 1)))
-        except ValueError as e:
+        except (TypeError, ValueError) as e:
             raise ManifestError(f"invalid networkTopology {nt!r}") from e
 
     plugins = spec.get("plugins", {})
     if not isinstance(plugins, dict):
         raise ManifestError("spec.plugins must be a mapping")
+    for pname, pargs in plugins.items():
+        if pargs is not None and not isinstance(pargs, list):
+            raise ManifestError(
+                f"plugin {pname!r} arguments must be a list, got "
+                f"{type(pargs).__name__}")
 
     # reference default: minAvailable = total replicas (full gang) —
     # never 0, which would disable gang scheduling entirely

@@ -189,6 +189,21 @@ def cmd_queue_create(cluster, args):
     print(f"queue {queue.name} created (weight={queue.weight})")
 
 
+def cmd_queue_operate(cluster, args):
+    from volcano_tpu.controllers.queue import QueueController
+    if args.name not in cluster.queues:
+        sys.exit(f"queue {args.name} not found")
+    ctrl = QueueController()
+    ctrl.initialize(cluster)
+    if args.action == "close":
+        ctrl.close_queue(args.name)   # drained queue flips Closed now
+        print(f"queue {args.name}: "
+              f"{cluster.queues[args.name].state.value}")
+    elif args.action == "open":
+        ctrl.open_queue(args.name)
+        print(f"queue {args.name} opened")
+
+
 def cmd_queue_list(cluster, args):
     rows = [[q.name, q.weight, q.state.value, q.parent or "-"]
             for q in cluster.queues.values()]
@@ -295,6 +310,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_queue_create)
     p = queue.add_parser("list")
     p.set_defaults(fn=cmd_queue_list)
+    p = queue.add_parser("operate", help="open/close a queue")
+    p.add_argument("-N", "--name", required=True)
+    p.add_argument("--action", choices=["open", "close"], required=True)
+    p.set_defaults(fn=cmd_queue_operate)
 
     pod = sub.add_parser("pod", help="pod operations").add_subparsers(
         dest="pod_cmd", required=True)
