@@ -65,3 +65,47 @@ def test_moe_sharded_training_descends():
         losses.append(float(metrics["loss"]))
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
+
+
+def test_capacity_dispatch_matches_dense_when_roomy():
+    """With capacity generous enough that nothing drops, the GShard
+    dispatch path must equal the dense path exactly."""
+    from volcano_tpu.workloads.moe import moe_mlp, init_moe_params
+    d, f, E = 32, 64, 4
+    params = init_moe_params(jax.random.key(0), d, f, E, 0.1)
+    x = jax.random.normal(jax.random.key(1), (2, 16, d))
+    dense, aux_d = moe_mlp(x, params, E, top_k=2, capacity_factor=0.0)
+    # cf covering the worst case (all tokens to one expert)
+    roomy, aux_c = moe_mlp(x, params, E, top_k=2,
+                           capacity_factor=float(E))
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(roomy),
+                               atol=1e-5, rtol=1e-5)
+    assert float(aux_d) == float(aux_c)
+
+
+def test_capacity_dispatch_drops_overflow_finite():
+    from volcano_tpu.workloads.moe import moe_mlp, init_moe_params
+    d, f, E = 32, 64, 4
+    params = init_moe_params(jax.random.key(0), d, f, E, 0.1)
+    x = jax.random.normal(jax.random.key(1), (2, 64, d))
+    tight, _ = moe_mlp(x, params, E, top_k=2, capacity_factor=0.5)
+    assert np.isfinite(np.asarray(tight)).all()
+    # tight capacity must differ from dense (some tokens dropped)
+    dense, _ = moe_mlp(x, params, E, top_k=2, capacity_factor=0.0)
+    assert not np.allclose(np.asarray(tight), np.asarray(dense))
+
+
+def test_capacity_moe_sharded_training_descends():
+    mesh = make_mesh({"dp": 2, "fsdp": 2, "tp": 2, "sp": 1})
+    cfg = moe_config(moe_capacity_factor=1.25)
+    opt = train.make_optimizer(lr=1e-2, warmup_steps=1)
+    params, state, _ = train.init_sharded(jax.random.key(0), cfg, mesh,
+                                          opt)
+    step = train.make_train_step(cfg, mesh, opt)
+    batch = train.synthetic_batch(jax.random.key(1), cfg, 4, 64, mesh)
+    losses = []
+    for _ in range(3):
+        params, state, metrics = step(params, state, batch)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]

@@ -40,6 +40,10 @@ class ModelConfig:
     n_experts: int = 0
     expert_top_k: int = 2
     moe_aux_weight: float = 0.01
+    # > 0 switches dense dispatch to GShard capacity dispatch: each
+    # expert takes at most ceil(cf * tokens * k / E) tokens, overflow
+    # drops (1.0-1.5 typical; 0 = dense/exact)
+    moe_capacity_factor: float = 0.0
     use_ring_attention: bool = False
     # Pallas flash-attention kernel on TPU (falls back to the jnp path
     # when shapes don't block-align); ring attention wins when sp > 1.
@@ -197,7 +201,8 @@ def _block(x, blk, cfg: ModelConfig, positions, mesh):
                        positions, mesh)
     h = _rms_norm(x, blk["mlp_norm"])
     if "router" in blk:
-        y, aux = moe_mlp(h, blk, cfg.n_experts, cfg.expert_top_k)
+        y, aux = moe_mlp(h, blk, cfg.n_experts, cfg.expert_top_k,
+                         capacity_factor=cfg.moe_capacity_factor)
         return x + y, aux
     return x + _mlp(h, blk), jnp.float32(0.0)
 

@@ -3,14 +3,21 @@
 Experts are sharded over the fsdp x tp mesh axes (expert dim rides
 fsdp), so GSPMD inserts the expert-parallel collectives; routing is
 top-k with a load-balancing auxiliary loss (Switch/GShard style).
-Dispatch is computed densely (every expert sees every token, combined
-by routing weights) — exact, compiler-friendly, and the right
-validation-workload tradeoff; a capacity-based all_to_all dispatch
-kernel is the production-scale follow-up.
+
+Two dispatch modes (moe_mlp capacity_factor):
+- 0 (dense): every expert sees every token, combined by routing
+  weights — exact and the validation default.
+- > 0 (GShard capacity): each expert takes at most C tokens via the
+  dispatch tensor; the token->expert regroup is the all_to_all
+  boundary.  Uses the classic [b, t, E, C] one-hot formulation, whose
+  dispatch tensors grow O(b*t*E*C) — fine at validation scale; an
+  argsort/segment-sum slot assignment is the long-sequence
+  optimization if C grows large.
 """
 
 from __future__ import annotations
 
+import math
 from typing import Dict, Tuple
 
 import jax
@@ -41,29 +48,16 @@ MOE_PARAM_SPECS = {
 }
 
 
-def moe_mlp(x, blk, n_experts: int, top_k: int = 2
-            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """x: [b, t, d] -> (y [b, t, d], aux_loss scalar).
-
-    aux loss = E * sum_e (fraction of tokens routed to e) *
-    (mean router prob of e) — minimized at uniform routing (GShard eq 4).
-    """
-    dtype = x.dtype
-    top_k = min(top_k, n_experts)  # a 1-expert model must not crash top_k
-    logits = (x @ blk["router"].astype(dtype)).astype(jnp.float32)
+def _route(x, blk, n_experts: int, top_k: int):
+    """Shared router: (probs, top_vals, top_idx, aux_loss)."""
+    logits = (x @ blk["router"].astype(x.dtype)).astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)          # [b, t, E]
-
     top_vals, top_idx = jax.lax.top_k(probs, top_k)  # [b, t, k]
     if top_k > 1:
         top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
     # top_k == 1 keeps the raw prob as the combine weight (Switch
     # style): renormalizing to 1.0 would cut the router off from the
     # LM-loss gradient entirely
-    combine = jnp.zeros_like(probs)
-    for i in range(top_k):
-        combine = combine + jax.nn.one_hot(
-            top_idx[..., i], n_experts, dtype=jnp.float32) * \
-            top_vals[..., i:i + 1]
 
     # load-balancing aux loss; token_frac normalized by k so the
     # uniform-routing floor is 1.0 regardless of top_k (GShard eq 4)
@@ -71,13 +65,69 @@ def moe_mlp(x, blk, n_experts: int, top_k: int = 2
         jnp.sum(jax.nn.one_hot(top_idx, n_experts, dtype=jnp.float32),
                 axis=2), axis=(0, 1)) / top_k        # [E]
     prob_frac = jnp.mean(probs, axis=(0, 1))         # [E]
-    aux = n_experts * jnp.sum(token_frac * prob_frac)
+    aux = (n_experts * jnp.sum(token_frac * prob_frac)).astype(jnp.float32)
+    return probs, top_vals, top_idx, aux
 
-    # dense expert compute, combined by routing weights
+
+def _expert_ffn(ei, blk, dtype):
+    """ei: [E, b, C, d] -> [E, b, C, d] through each expert's SwiGLU."""
     gate = jax.nn.silu(jnp.einsum(
-        "btd,edf->btef", x, blk["moe_gate"].astype(dtype)))
-    up = jnp.einsum("btd,edf->btef", x, blk["moe_up"].astype(dtype))
-    expert_out = jnp.einsum(
-        "btef,efd->bted", gate * up, blk["moe_down"].astype(dtype))
-    y = jnp.einsum("bted,bte->btd", expert_out, combine.astype(dtype))
-    return y, aux.astype(jnp.float32)
+        "ebcd,edf->ebcf", ei, blk["moe_gate"].astype(dtype)))
+    up = jnp.einsum("ebcd,edf->ebcf", ei, blk["moe_up"].astype(dtype))
+    return jnp.einsum("ebcf,efd->ebcd", gate * up,
+                      blk["moe_down"].astype(dtype))
+
+
+def moe_mlp(x, blk, n_experts: int, top_k: int = 2,
+            capacity_factor: float = 0.0
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x: [b, t, d] -> (y [b, t, d], aux_loss scalar).
+
+    capacity_factor == 0: dense dispatch (every expert sees every
+    token, combined by routing weights — exact, validation-friendly).
+    capacity_factor > 0: GShard-style capacity dispatch — each expert
+    processes at most C = ceil(cf * t * k / E) tokens, gathered via the
+    dispatch tensor (the all_to_all boundary GSPMD shards over the
+    expert axis); overflow tokens are dropped (combine weight 0).
+    """
+    dtype = x.dtype
+    top_k = min(top_k, n_experts)  # a 1-expert model must not crash top_k
+    probs, top_vals, top_idx, aux = _route(x, blk, n_experts, top_k)
+
+    if capacity_factor <= 0:
+        combine = jnp.zeros_like(probs)
+        for i in range(top_k):
+            combine = combine + jax.nn.one_hot(
+                top_idx[..., i], n_experts, dtype=jnp.float32) * \
+                top_vals[..., i:i + 1]
+        # every expert sees the whole sequence (t plays the capacity
+        # role) so both paths share one FFN implementation
+        expert_in = jnp.broadcast_to(x, (n_experts, *x.shape))
+        expert_out = _expert_ffn(expert_in, blk, dtype)  # [E, b, t, d]
+        y = jnp.einsum("ebtd,bte->btd", expert_out, combine.astype(dtype))
+        return y, aux
+
+    b, t, _ = x.shape
+    capacity = max(1, int(math.ceil(capacity_factor * t * top_k
+                                    / n_experts)))
+    combine = jnp.zeros((b, t, n_experts, capacity), dtype=jnp.float32)
+    counts = jnp.zeros((b, n_experts), dtype=jnp.float32)
+    for i in range(top_k):
+        mask = jax.nn.one_hot(top_idx[..., i], n_experts,
+                              dtype=jnp.float32)         # [b, t, E]
+        # this token's position within each expert's buffer
+        pos = jnp.cumsum(mask, axis=1) - mask + counts[:, None, :]
+        keep = mask * (pos < capacity)
+        counts = counts + jnp.sum(keep, axis=1)
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                                dtype=jnp.float32) * keep[..., None]
+        combine = combine + pos_oh * \
+            top_vals[..., i][..., None, None]            # [b, t, E, C]
+
+    dispatch = (combine > 0).astype(dtype)               # [b, t, E, C]
+    # the all_to_all boundary: tokens regroup from (batch, seq) sharding
+    # to (expert, capacity) sharding; GSPMD inserts the collectives
+    expert_in = jnp.einsum("btec,btd->ebcd", dispatch, x)
+    expert_out = _expert_ffn(expert_in, blk, dtype)      # [E, b, C, d]
+    y = jnp.einsum("btec,ebcd->btd", combine.astype(dtype), expert_out)
+    return y, aux
