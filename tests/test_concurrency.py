@@ -1,0 +1,103 @@
+"""Concurrency stress — the `go test -race` posture of the reference
+(Makefile:195): scheduler, agent scheduler, controllers, node agents
+and clients all mutating one cluster from separate threads, with
+invariants checked at the end.
+"""
+
+import itertools
+import threading
+import time
+from collections import defaultdict
+
+from volcano_tpu.agentscheduler import AgentScheduler
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.pod import make_pod
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.shard import AGENT_SCHEDULER
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.controllers import ControllerManager
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.simulator import make_tpu_cluster
+from volcano_tpu.uthelper import gang_job
+
+
+def test_concurrent_control_plane_stress():
+    cluster = make_tpu_cluster(
+        [("sa", "v5e-16")],
+        extra_nodes=[Node(name=f"cpu{i}",
+                          allocatable={"cpu": 32, "pods": 110})
+                     for i in range(4)])
+    sched = Scheduler(cluster, schedule_period=0.01)
+    agent = AgentScheduler(cluster)
+    mgr = ControllerManager(cluster, enabled=["job", "podgroup",
+                                              "garbagecollector"])
+    stop = threading.Event()
+    errors = []
+
+    def guard(fn):
+        def run():
+            try:
+                while not stop.is_set():
+                    fn()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+        return run
+
+    counter = itertools.count()  # unique names across client threads
+
+    def client():
+        i = next(counter)
+        pod = make_pod(f"burst-{i}", requests={"cpu": "100m"})
+        pod.scheduler_name = AGENT_SCHEDULER
+        cluster.add_pod(pod)
+        time.sleep(0.001)
+
+    threads = [
+        threading.Thread(target=guard(sched.run_once)),
+        threading.Thread(target=guard(agent.run_until_drained)),
+        threading.Thread(target=guard(mgr.sync_all)),
+        threading.Thread(target=guard(cluster.tick)),
+        threading.Thread(target=guard(client)),
+        threading.Thread(target=guard(client)),
+    ]
+    for t in threads:
+        t.start()
+
+    # inject batch work mid-flight from the main thread
+    for j in range(5):
+        pg, pods = gang_job(f"gang{j}", replicas=2,
+                            requests={"cpu": 4, "google.com/tpu": 4})
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+        time.sleep(0.05)
+
+    time.sleep(1.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive(), f"worker {t.name} hung (deadlock?)"
+    mgr.stop()
+
+    assert not errors, f"concurrent errors: {errors!r}"
+    # invariants: each pod key bound exactly once, to a known node
+    seen = {}
+    for key, node in cluster.binds:
+        assert key not in seen, \
+            f"{key} bound twice ({seen[key]} then {node})"
+        seen[key] = node
+        assert node in cluster.nodes, f"{key} bound to unknown {node}"
+    # no node over its cpu allocatable among RUNNING pods
+    used = defaultdict(float)
+    for pod in cluster.pods.values():
+        if pod.node_name and pod.phase in (TaskStatus.RUNNING,
+                                           TaskStatus.BOUND):
+            used[pod.node_name] += pod.resource_requests().milli_cpu
+    for name, mcpu in used.items():
+        node = cluster.nodes[name]   # existence asserted above
+        alloc = Resource.from_resource_list(node.allocatable).milli_cpu
+        assert mcpu <= alloc + 0.1, \
+            f"node {name} overcommitted: {mcpu} > {alloc}"
+    # progress happened on both paths
+    assert any(k.startswith("default/gang") for k, _ in cluster.binds)
+    assert any(k.startswith("default/burst") for k, _ in cluster.binds)
