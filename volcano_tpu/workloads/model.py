@@ -235,16 +235,21 @@ def forward(params, tokens, cfg: ModelConfig,
     return forward_with_aux(params, tokens, cfg, mesh)[0]
 
 
+def next_token_loss(logits, tokens) -> jnp.ndarray:
+    """Shared next-token CE: logits [b, t, V], tokens [b, t] -> scalar.
+    The last position predicts the rolled-around token and is masked."""
+    targets = jnp.roll(tokens, -1, axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = jnp.ones_like(nll).at[:, -1].set(0.0)
+    return jnp.sum(nll * mask) / jnp.sum(mask)
+
+
 def loss_fn(params, batch, cfg: ModelConfig,
             mesh: Optional[Mesh] = None) -> jnp.ndarray:
     """Next-token cross entropy (+ MoE load-balancing aux);
     batch: {"tokens": [b, t]}."""
     tokens = batch["tokens"]
     logits, moe_aux = forward_with_aux(params, tokens, cfg, mesh)
-    targets = jnp.roll(tokens, -1, axis=1)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    # last position predicts the rolled-around token: mask it out
-    mask = jnp.ones_like(nll).at[:, -1].set(0.0)
-    ce = jnp.sum(nll * mask) / jnp.sum(mask)
+    ce = next_token_loss(logits, tokens)
     return ce + cfg.moe_aux_weight * moe_aux

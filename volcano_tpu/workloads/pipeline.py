@@ -1,0 +1,208 @@
+"""Pipeline parallelism — GPipe over a `pp` mesh axis.
+
+The SPMD pipelining pattern: every device runs the same program inside
+shard_map; device s holds the parameters of stage s (block stack with a
+leading stage dim sharded over "pp"); activations (and their rotary
+positions) flow stage-to-stage through `ppermute` while microbatches
+stream through, and reverse-mode autodiff of the scan+ppermute yields
+the pipelined backward schedule for free.
+
+Schedule (S stages, M microbatches, T = M + S - 1 ticks):
+  tick k: stage 0 injects microbatch k (if k < M); every stage applies
+  its blocks to whatever sits in its buffer; the result hops to the
+  next stage; the last stage's outputs for ticks S-1..T-1 are
+  microbatch 0..M-1's activations, gathered for the LM head.
+
+Scope: dense block stacks (a heterogeneous MoE stack cannot be
+leaf-stacked across stages — rejected with a clear error);
+cfg.remat applies per stage.  In the training path only the token ids
+are replicated across stages; embedding happens in-pipe so the
+embedded batch never materializes on every device.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from volcano_tpu.workloads import model as model_lib
+from volcano_tpu.workloads.model import ModelConfig
+
+
+def make_pp_mesh(n_stages: int, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()[:n_stages]
+    if len(devices) != n_stages:
+        raise ValueError(f"need {n_stages} devices, have {len(devices)}")
+    return Mesh(np.asarray(devices), ("pp",))
+
+
+def stack_stage_params(params: Dict[str, Any], n_stages: int):
+    """Re-layout the flagship model's params for pipelining:
+    blocks[S*B] -> per-leaf stacks [S, B, ...] (sharded over pp), with
+    embed/final_norm/head left replicated.  Dense stacks only."""
+    blocks = params["blocks"]
+    if len(blocks) % n_stages != 0:
+        raise ValueError(
+            f"{len(blocks)} blocks not divisible by {n_stages} stages")
+    keys0 = set(blocks[0])
+    for i, blk in enumerate(blocks):
+        if "router" in blk:
+            raise ValueError(
+                "pipeline parallelism supports dense block stacks only "
+                f"(block {i} is MoE); use dp/fsdp/tp/sp/ep for MoE "
+                "models")
+        if set(blk) != keys0:
+            raise ValueError(
+                f"block {i} keys differ from block 0; stages must be "
+                "homogeneous to stack")
+    per_stage = len(blocks) // n_stages
+
+    def stack(name):
+        return jnp.stack([
+            jnp.stack([blocks[s * per_stage + b][name]
+                       for b in range(per_stage)])
+            for s in range(n_stages)])          # [S, B, ...]
+
+    stage_blocks = {name: stack(name) for name in blocks[0]}
+    outer = {k: v for k, v in params.items() if k != "blocks"}
+    return outer, stage_blocks
+
+
+def stage_param_shardings(stage_blocks, outer, mesh: Mesh):
+    stage_sh = jax.tree.map(
+        lambda x: NamedSharding(mesh, P("pp", *([None] * (x.ndim - 1)))),
+        stage_blocks)
+    outer_sh = jax.tree.map(lambda x: NamedSharding(mesh, P()), outer)
+    return outer_sh, stage_sh
+
+
+def _apply_stage(x, stage_blocks, cfg: ModelConfig, positions):
+    """Run this device's B blocks over x; stage_blocks leaves [B, ...].
+    Honors cfg.remat exactly like forward_with_aux."""
+    block_fn = model_lib._block
+    if cfg.remat:
+        block_fn = jax.checkpoint(
+            model_lib._block, static_argnums=(2, 4),
+            policy=jax.checkpoint_policies.nothing_saveable)
+    per_stage = next(iter(stage_blocks.values())).shape[0]
+    for b in range(per_stage):
+        blk = {name: leaf[b] for name, leaf in stage_blocks.items()}
+        x, _ = block_fn(x, blk, cfg, positions, None)
+    return x
+
+
+def _pipe(inject_fn, stage_blocks, cfg: ModelConfig, mesh: Mesh,
+          n_microbatches: int, mb_shape, pos_shape, dtype):
+    """The schedule itself, inside shard_map.
+
+    inject_fn(k) -> (x_mb, positions_mb) for microbatch k — called only
+    for its stage-0 value; other stages consume their ring buffers.
+    Returns the last stage's completed activations [M, *mb_shape].
+    """
+    n_stages = mesh.shape["pp"]
+    idx = jax.lax.axis_index("pp")
+    M = n_microbatches
+    total = M + n_stages - 1
+    perm = [(i, (i + 1)) for i in range(n_stages - 1)]
+
+    def tick(carry, k):
+        buf, buf_pos = carry
+        inj_x, inj_pos = inject_fn(jnp.clip(k, 0, M - 1))
+        cur = jnp.where(idx == 0, inj_x, buf)
+        cur_pos = jnp.where(idx == 0, inj_pos, buf_pos)
+        out = _apply_stage(cur, stage_blocks, cfg, cur_pos)
+        nxt = jax.lax.ppermute(out, "pp", perm)
+        nxt_pos = jax.lax.ppermute(cur_pos, "pp", perm)
+        return (nxt, nxt_pos), out
+
+    zero = (jnp.zeros(mb_shape, dtype),
+            jnp.zeros(pos_shape, jnp.int32))
+    _, outs = jax.lax.scan(tick, zero, jnp.arange(total))
+    is_last = (idx == n_stages - 1).astype(dtype)
+    done = outs[n_stages - 1:] * is_last          # [M, *mb_shape]
+    return jax.lax.psum(done, "pp")
+
+
+def pipelined_apply_blocks(x, stage_blocks, cfg: ModelConfig, positions,
+                           mesh: Mesh, n_microbatches: int):
+    """x [b, t, d] (embedded), positions [b, t] -> [b, t, d] after ALL
+    blocks with the GPipe schedule.  n_microbatches must divide b.
+    Positions ride the ring with their microbatch, so per-sample
+    position ids are handled correctly."""
+    b, t, d = x.shape
+    if b % n_microbatches != 0:
+        raise ValueError(f"batch {b} not divisible by "
+                         f"{n_microbatches} microbatches")
+    mb = b // n_microbatches
+    x_mb = x.reshape(n_microbatches, mb, t, d)
+    pos_mb = positions.reshape(n_microbatches, mb, t).astype(jnp.int32)
+
+    def pipeline(x_mb, pos_mb, stage_blocks):
+        stage_blocks = jax.tree.map(lambda l: l[0], stage_blocks)
+        return _pipe(lambda k: (x_mb[k], pos_mb[k]), stage_blocks, cfg,
+                     mesh, n_microbatches, x_mb.shape[1:],
+                     pos_mb.shape[1:], x_mb.dtype)
+
+    fn = jax.shard_map(
+        pipeline, mesh=mesh,
+        in_specs=(P(), P(), jax.tree.map(lambda _: P("pp"), stage_blocks)),
+        out_specs=P(),
+        check_vma=False)
+    return fn(x_mb, pos_mb, stage_blocks).reshape(b, t, d)
+
+
+def pipelined_loss(outer, stage_blocks, tokens, cfg: ModelConfig,
+                   mesh: Mesh, n_microbatches: int) -> jnp.ndarray:
+    """Full LM loss with the block stack pipelined over pp.  Only the
+    token ids are replicated across stages: embedding happens in-pipe
+    on stage 0, so no device holds the whole embedded batch."""
+    b, t = tokens.shape
+    if b % n_microbatches != 0:
+        raise ValueError(f"batch {b} not divisible by "
+                         f"{n_microbatches} microbatches")
+    mb = b // n_microbatches
+    tokens_mb = tokens.reshape(n_microbatches, mb, t)
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None, :],
+                                 (mb, t))
+
+    def pipeline(tokens_mb, embed, stage_blocks):
+        stage_blocks = jax.tree.map(lambda l: l[0], stage_blocks)
+
+        def inject(k):
+            return embed.astype(cfg.dtype)[tokens_mb[k]], positions
+
+        mb_shape = (mb, t, cfg.d_model)
+        return _pipe(inject, stage_blocks, cfg, mesh, n_microbatches,
+                     mb_shape, (mb, t), cfg.dtype)
+
+    fn = jax.shard_map(
+        pipeline, mesh=mesh,
+        in_specs=(P(), P(), jax.tree.map(lambda _: P("pp"), stage_blocks)),
+        out_specs=P(),
+        check_vma=False)
+    x = fn(tokens_mb, outer["embed"], stage_blocks).reshape(b, t,
+                                                            cfg.d_model)
+    x = model_lib._rms_norm(x, outer["final_norm"])
+    logits = (x @ outer["head"].astype(cfg.dtype)).astype(jnp.float32)
+    return model_lib.next_token_loss(logits, tokens)
+
+
+def make_pipelined_train_step(cfg: ModelConfig, mesh: Mesh, optimizer,
+                              n_microbatches: int):
+    def step(outer, stage_blocks, opt_state, batch):
+        def loss_fn(outer, stage_blocks):
+            return pipelined_loss(outer, stage_blocks, batch["tokens"],
+                                  cfg, mesh, n_microbatches)
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            outer, stage_blocks)
+        params = (outer, stage_blocks)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        outer, stage_blocks = optax.apply_updates(params, updates)
+        return outer, stage_blocks, opt_state, {"loss": loss}
+
+    return jax.jit(step, donate_argnums=(0, 1, 2))
