@@ -108,14 +108,22 @@ class HyperNodesInfo:
         # Resolve membership: wire children and direct node members.
         # A child keeps its first parent; an edge that would close a
         # cycle (malformed CRs whose selectors match each other) is
-        # dropped rather than hanging later tree walks.
+        # dropped rather than hanging later tree walks.  Exact-match
+        # members resolve by dict lookup — only regex/label selectors
+        # pay a scan (the wiring is on the per-session snapshot path).
+        real_set = set(real)
         for hn in hns:
             info = self.members[hn.name]
             for m in hn.members:
                 if m.kind == "HyperNode":
-                    for cand in self.members:
-                        if cand == hn.name or not m.matches(cand):
-                            continue
+                    if m.exact:
+                        candidates = ([m.exact]
+                                      if m.exact in self.members
+                                      and m.exact != hn.name else [])
+                    else:
+                        candidates = [c for c in self.members
+                                      if c != hn.name and m.matches(c)]
+                    for cand in candidates:
                         if self.members[cand].parent is not None:
                             continue
                         if cand in self.ancestors(hn.name):
@@ -123,9 +131,13 @@ class HyperNodesInfo:
                         info.children.add(cand)
                         self.members[cand].parent = hn.name
                 else:
-                    for node in real:
-                        if m.matches(node, node_labels.get(node)):
-                            info.direct_nodes.add(node)
+                    if m.exact:
+                        if m.exact in real_set:
+                            info.direct_nodes.add(m.exact)
+                    else:
+                        for node in real:
+                            if m.matches(node, node_labels.get(node)):
+                                info.direct_nodes.add(node)
             info.nodes |= info.direct_nodes
 
         # Virtual root above all parentless hypernodes.
