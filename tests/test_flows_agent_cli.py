@@ -173,3 +173,70 @@ def test_cli_end_to_end(tmp_path):
     assert all(p["node"].startswith("sa-") for p in view["pods"])
     queues = run("vqueues")
     assert "research" in queues
+
+
+def test_jobtemplate_cli_feeds_jobflow(tmp_path):
+    """jobtemplate create -f + jobflow create drive a DAG end-to-end
+    through the CLI."""
+    state = str(tmp_path / "c.pkl")
+    env = dict(os.environ, PYTHONPATH=os.getcwd())
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "volcano_tpu.cli.vtpctl",
+             "--state", state, *args],
+            capture_output=True, text=True, env=env, check=True).stdout
+
+    manifest = tmp_path / "steps.yaml"
+    manifest.write_text("""
+kind: Job
+metadata: {name: prep}
+spec:
+  minAvailable: 1
+  tasks:
+    - name: w
+      replicas: 1
+      template:
+        spec:
+          containers: [{resources: {requests: {cpu: "1"}}}]
+---
+kind: Job
+metadata: {name: train}
+spec:
+  minAvailable: 1
+  tasks:
+    - name: w
+      replicas: 1
+      template:
+        spec:
+          containers: [{resources: {requests: {cpu: "1"}}}]
+""")
+    run("init", "--slices", "sa=v5e-16")
+    run("jobtemplate", "create", "-f", str(manifest))
+    assert "prep" in run("jobtemplate", "list")
+    run("jobflow", "create", "-N", "pipe", "--flows", "prep",
+        "train:prep")
+    run("tick", "--cycles", "2")
+    assert "pipe-prep" in run("job", "list")
+
+
+def test_scheduler_conf_hot_reload(tmp_path):
+    """Editing the conf file mid-run changes the actions on the next
+    cycle (reference: fsnotify hot reload)."""
+    conf_path = tmp_path / "conf.yaml"
+    conf_path.write_text(
+        "actions: \"enqueue, allocate\"\n"
+        "tiers:\n  - plugins:\n      - name: gang\n"
+        "      - name: predicates\n      - name: nodeorder\n")
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    sched = Scheduler(cluster, conf_path=str(conf_path),
+                      schedule_period=0)
+    sched.run_once()
+    assert sched.conf.actions == ["enqueue", "allocate"]
+    conf_path.write_text(
+        "actions: \"allocate, backfill\"\n"
+        "tiers:\n  - plugins:\n      - name: gang\n"
+        "      - name: predicates\n      - name: nodeorder\n")
+    os.utime(conf_path, (time.time() + 2, time.time() + 2))
+    sched.run_once()
+    assert sched.conf.actions == ["allocate", "backfill"]

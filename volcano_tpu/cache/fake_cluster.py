@@ -35,6 +35,8 @@ class FakeCluster(Cluster):
         self.priority_classes: Dict[str, PriorityClass] = {}
         self.vcjobs: Dict[str, object] = {}       # key: ns/name -> VCJob
         self.commands: List[dict] = []            # bus/v1alpha1 analogue
+        self.jobflows: Dict[str, object] = {}     # flow/v1alpha1 JobFlow
+        self.jobtemplates: Dict[str, object] = {} # flow/v1alpha1 JobTemplate
         self.services: Dict[str, dict] = {}       # svc plugin artifacts
         self.config_maps: Dict[str, dict] = {}
         self.secrets: Dict[str, dict] = {}
@@ -58,6 +60,9 @@ class FakeCluster(Cluster):
         self.__dict__.update(state)
         self._lock = threading.RLock()
         self._watchers = []
+        # stores added after old state files were written
+        for attr in ("jobflows", "jobtemplates", "commands"):
+            self.__dict__.setdefault(attr, [] if attr == "commands" else {})
 
     # -- mutation helpers (the "kubectl" surface) ----------------------
 

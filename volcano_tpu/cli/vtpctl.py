@@ -151,6 +151,27 @@ def cmd_job_command(cluster, args, action):
     print(f"job {key}: {action} requested")
 
 
+def cmd_jobtemplate_create(cluster, args):
+    from volcano_tpu.api.jobflow import JobTemplate
+    from volcano_tpu.cli.manifest import ManifestError, load_jobs
+    try:
+        jobs = load_jobs(args.filename)
+    except (ManifestError, OSError) as e:
+        sys.exit(f"error: {e}")
+    for job in jobs:
+        tmpl = JobTemplate(name=job.name, namespace=job.namespace,
+                           job=job)
+        cluster.jobtemplates[tmpl.key] = tmpl
+        print(f"jobtemplate {tmpl.key} created")
+
+
+def cmd_jobtemplate_list(cluster, args):
+    rows = [[t.namespace, t.name,
+             ",".join(ts.name for ts in (t.job.tasks if t.job else []))]
+            for t in getattr(cluster, "jobtemplates", {}).values()]
+    print(_table(rows, ["NAMESPACE", "NAME", "TASKS"]))
+
+
 def cmd_jobflow_create(cluster, args):
     from volcano_tpu.api.jobflow import Flow, FlowDependsOn, JobFlow
     flows = []
@@ -163,8 +184,6 @@ def cmd_jobflow_create(cluster, args):
         else:
             flows.append(Flow(name=spec))
     flow = JobFlow(name=args.name, namespace=args.namespace, flows=flows)
-    if not hasattr(cluster, "jobflows"):
-        cluster.jobflows = {}
     cluster.jobflows[flow.key] = flow
     print(f"jobflow {flow.key} created ({len(flows)} steps)")
 
@@ -286,6 +305,16 @@ def build_parser() -> argparse.ArgumentParser:
         p.add_argument("-N", "--name", required=True)
         p.add_argument("-n", "--namespace", default="default")
         p.set_defaults(fn=lambda c, a, _act=action: cmd_job_command(c, a, _act))
+
+    jobtemplate = sub.add_parser(
+        "jobtemplate", help="jobtemplate operations").add_subparsers(
+        dest="jobtemplate_cmd", required=True)
+    p = jobtemplate.add_parser("create")
+    p.add_argument("-f", "--filename", required=True,
+                   help="Job manifest(s) stored as templates")
+    p.set_defaults(fn=cmd_jobtemplate_create)
+    p = jobtemplate.add_parser("list")
+    p.set_defaults(fn=cmd_jobtemplate_list)
 
     jobflow = sub.add_parser("jobflow",
                              help="jobflow operations").add_subparsers(
