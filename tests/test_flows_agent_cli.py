@@ -240,3 +240,55 @@ def test_scheduler_conf_hot_reload(tmp_path):
     os.utime(conf_path, (time.time() + 2, time.time() + 2))
     sched.run_once()
     assert sched.conf.actions == ["allocate", "backfill"]
+
+
+def test_scheduling_gate_lifted_on_admission():
+    """Pods gated on queue admission schedule only after their
+    PodGroup leaves Pending (SchGateManager analogue)."""
+    from volcano_tpu.uthelper import TestContext, gang_job
+    from volcano_tpu.framework.job_updater import QUEUE_ADMISSION_GATE
+    from volcano_tpu.api.node_info import Node
+    pg, pods = gang_job("gated", replicas=2, requests={"cpu": 1})
+    for p in pods:
+        p.scheduling_gates.append(QUEUE_ADMISSION_GATE)
+    ctx = TestContext(nodes=[Node(name="n0", allocatable={"cpu": 8})],
+                      podgroups=[pg], pods=pods)
+    ctx.run()
+    # cycle 1: enqueue admits, gates lifted at close — no binds yet
+    assert all(not p.scheduling_gates for p in pods)
+    ctx.expect_bind_num(0)
+    ctx.run()
+    ctx.expect_bind_num(2)  # cycle 2: gates gone, gang binds
+
+
+def test_prometheus_usage_source_feeds_agent():
+    """The Prometheus client source scrapes a live endpoint and drives
+    the node agent's usage annotations."""
+    import urllib.request
+    from volcano_tpu import metrics
+    from volcano_tpu.agent import NodeAgent
+    from volcano_tpu.metrics_source import PrometheusUsageSource
+
+    metrics.reset()
+    metrics.set_gauge("node_cpu_usage_fraction", 0.77, node="sa-w0")
+    metrics.set_gauge("node_memory_usage_fraction", 0.33, node="sa-w0")
+    server = metrics.serve(port=0)
+    try:
+        url = f"http://127.0.0.1:{server.server_address[1]}/metrics"
+        source = PrometheusUsageSource(url)
+        assert source.refresh()
+        cluster = make_tpu_cluster([("sa", "v5e-16")])
+        NodeAgent(cluster, "sa-w0", source).sync()
+        node = cluster.nodes["sa-w0"]
+        assert node.annotations["usage.volcano-tpu.io/cpu"] == "0.770"
+        assert node.annotations["usage.volcano-tpu.io/memory"] == "0.330"
+    finally:
+        server.shutdown()
+
+
+def test_prometheus_source_degrades_on_unreachable_endpoint():
+    from volcano_tpu.metrics_source import PrometheusUsageSource
+    source = PrometheusUsageSource("http://127.0.0.1:1/metrics",
+                                   timeout=0.2)
+    assert source.refresh() is False
+    assert source.usage("any").cpu_fraction == 0.0

@@ -96,7 +96,9 @@ class NodeAgent:
 
     def _report_tpu_health(self, node, usage: NodeUsage) -> None:
         declared = Resource.from_resource_list(node.allocatable).get(TPU)
-        if declared <= 0 and usage.tpu_chips_detected == 0:
+        if usage.tpu_chips_detected == 0:
+            # no chip telemetry from this provider (e.g. a usage-only
+            # Prometheus source): never cordon on absence of data
             return
         node.annotations[TPU_CHIPS_ANNOTATION] = \
             f"{usage.tpu_chips_healthy}/{usage.tpu_chips_detected}"

@@ -31,4 +31,5 @@ def close_session(ssn: Session) -> None:
     for plugin in reversed(list(ssn.plugins.values())):
         plugin.on_session_close(ssn)
     job_updater.update_job_statuses(ssn)
+    job_updater.remove_admission_gates(ssn)
     ssn.cache.flush_binds()

@@ -8,6 +8,28 @@ from __future__ import annotations
 
 from volcano_tpu.api.types import PodGroupPhase, TaskStatus
 
+# Pods may be created gated on queue admission; the gate manager lifts
+# this gate once their PodGroup leaves Pending (reference:
+# gate.SchGateManager, scheduler.go:101-108 + allocate.go:749-765,
+# feature gate SchedulingGatesQueueAdmission).
+QUEUE_ADMISSION_GATE = "volcano-tpu.io/queue-admission"
+
+
+def remove_admission_gates(ssn) -> int:
+    """Lift the queue-admission scheduling gate from pods of admitted
+    podgroups (async in the reference; session-close here)."""
+    removed = 0
+    for job in ssn.jobs.values():
+        pg = job.podgroup
+        if pg is None or pg.phase is PodGroupPhase.PENDING:
+            continue
+        for task in job.tasks.values():
+            gates = task.pod.scheduling_gates
+            if QUEUE_ADMISSION_GATE in gates:
+                gates.remove(QUEUE_ADMISSION_GATE)
+                removed += 1
+    return removed
+
 
 def update_job_statuses(ssn) -> int:
     """Recompute + push PodGroup status for jobs dirtied this session."""

@@ -1,0 +1,92 @@
+"""External usage metrics sources for the node agent / usage plugin.
+
+Reference parity: pkg/scheduler/metrics/source
+(metrics_client_{prometheus,elasticsearch}.go) — pulls real node
+utilization from a metrics backend.  Here the Prometheus client reads
+exposition-format text over HTTP and feeds the agent's UsageProvider
+protocol; metric names are configurable:
+
+    node_cpu_usage_fraction{node="sa-w0"} 0.42
+    node_memory_usage_fraction{node="sa-w0"} 0.61
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import urllib.request
+from typing import Dict, Tuple
+
+from volcano_tpu.agent.agent import NodeUsage, UsageProvider
+
+log = logging.getLogger(__name__)
+
+# 'name{labels} value [timestamp]' — federation endpoints append the
+# millisecond timestamp
+_SAMPLE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)\{(?P<labels>[^}]*)\}\s+'
+    r'(?P<value>[-+0-9.eEna]+)(?:\s+\d+)?\s*$')
+_LABEL = re.compile(r'(\w+)="([^"]*)"')
+
+
+def parse_exposition(text: str) -> Dict[Tuple[str, str], float]:
+    """{(metric, node): value} for node-labeled samples."""
+    out: Dict[Tuple[str, str], float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE.match(line)
+        if not m:
+            continue
+        labels = dict(_LABEL.findall(m.group("labels")))
+        node = labels.get("node") or labels.get("instance")
+        if not node:
+            continue
+        try:
+            out[(m.group("name"), node)] = float(m.group("value"))
+        except ValueError:
+            continue
+    return out
+
+
+class PrometheusUsageSource(UsageProvider):
+    """Scrapes a Prometheus-format endpoint for per-node usage."""
+
+    def __init__(self, url: str,
+                 cpu_metric: str = "node_cpu_usage_fraction",
+                 mem_metric: str = "node_memory_usage_fraction",
+                 timeout: float = 2.0,
+                 stale_after: float = 60.0):
+        self.url = url
+        self.cpu_metric = cpu_metric
+        self.mem_metric = mem_metric
+        self.timeout = timeout
+        self.stale_after = stale_after
+        self._samples: Dict[Tuple[str, str], float] = {}
+        self._last_success = 0.0
+
+    def refresh(self) -> bool:
+        import time
+        try:
+            with urllib.request.urlopen(self.url,
+                                        timeout=self.timeout) as resp:
+                self._samples = parse_exposition(resp.read().decode())
+            self._last_success = time.time()
+            return True
+        except Exception as e:  # noqa: BLE001 - degrade, don't crash
+            log.warning("usage scrape of %s failed: %s", self.url, e)
+            return False
+
+    def usage(self, node_name: str) -> NodeUsage:
+        import time
+        if time.time() - self._last_success > self.stale_after:
+            # bound the damage of a dead endpoint: past the TTL report
+            # "unknown" (zeros) rather than acting on stale pressure
+            return NodeUsage()
+        return NodeUsage(
+            cpu_fraction=self._samples.get(
+                (self.cpu_metric, node_name), 0.0),
+            memory_fraction=self._samples.get(
+                (self.mem_metric, node_name), 0.0),
+        )
