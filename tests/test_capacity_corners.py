@@ -100,3 +100,64 @@ def test_queue_close_drain_reopen_cycle():
         ctx.cluster.add_pod(p)
     ctx.run()
     ctx.expect_bind("default/j2-0")
+
+
+def test_elastic_resources_unblock_admission():
+    """A queue full of ELASTIC work (running beyond gang floors) still
+    admits a new job: the elastic share is reclaimable, so it doesn't
+    count against realCapability (proportion.go attr.elastic)."""
+    q = Queue(name="q", capability=Resource({"cpu": 16000}))
+    # elastic job: min 1 of 8 replicas, all running -> 14000m elastic
+    pg_run, pods_run = gang_job("elastic", queue="q", replicas=8,
+                                min_available=1, requests={"cpu": 2},
+                                running_on=[f"n{i % 2}" for i in range(8)],
+                                pg_phase=PodGroupPhase.RUNNING)
+    pg_new, pods_new = gang_job("newcomer", queue="q", replicas=4,
+                                min_available=4, requests={"cpu": 2})
+    pg_new.min_resources = Resource({"cpu": 8000})  # declared => gated
+    conf = {"actions": "enqueue, allocate, backfill",
+            "tiers": [{"plugins": [{"name": "gang"},
+                                   {"name": "predicates"},
+                                   {"name": "proportion"},
+                                   {"name": "nodeorder"}]}]}
+    ctx = TestContext(nodes=nodes(2), queues=[q],
+                      podgroups=[pg_run, pg_new],
+                      pods=pods_run + pods_new, conf=conf)
+    ctx.run()
+    # without elastic accounting: 16000 allocated + 8000 min > 16000 cap
+    # => REJECT; with it: 16000 - 14000 elastic + 8000 = 10000 <= cap
+    ctx.expect_podgroup_phase("default/newcomer", PodGroupPhase.INQUEUE)
+
+
+def test_hierarchical_ancestor_reclaim():
+    """Reclaim needs surplus at the leaf AND every ancestor; it stops
+    the moment either floor is reached (capacity.go:500-600)."""
+    eng = Queue(name="eng", deserved=Resource({"cpu": 8000}))
+    ml = Queue(name="ml", parent="eng",
+               deserved=Resource({"cpu": 8000}))
+    web = Queue(name="web", deserved=Resource({"cpu": 8000}))
+    conf = {
+        "actions": "enqueue, allocate, reclaim",
+        "tiers": [
+            {"plugins": [{"name": "priority"}, {"name": "gang"}]},
+            {"plugins": [{"name": "predicates"}, {"name": "capacity"},
+                         {"name": "nodeorder"}]},
+        ],
+    }
+    # ml runs 16 cpu — over BOTH its own deserved (8) and eng's (8);
+    # reclaim proceeds while leaf AND ancestor keep surplus, stopping
+    # at the 8-cpu floor (hierarchical veto semantics, capacity.go:500+)
+    pg_ml, pods_ml = gang_job("mljob", queue="ml", replicas=8,
+                              min_available=1, requests={"cpu": 2},
+                              running_on=[f"n{i % 2}" for i in range(8)],
+                              pg_phase=PodGroupPhase.RUNNING)
+    pg_web, pods_web = gang_job("webjob", queue="web", replicas=4,
+                                min_available=4, requests={"cpu": 2},
+                                pg_phase=PodGroupPhase.INQUEUE)
+    ctx = TestContext(nodes=nodes(2), queues=[eng, ml, web],
+                      podgroups=[pg_ml, pg_web],
+                      pods=pods_ml + pods_web, conf=conf)
+    ctx.run()
+    # leaf ml and ancestor eng both over their 8-cpu deserved: web
+    # reclaims 8 cpu (4 tasks), leaving the subtree at its floor
+    assert len(ctx.cluster.evictions) == 4

@@ -397,6 +397,14 @@ class JobInfo:
             total.add(r)
         return total
 
+    def elastic_resources(self, allocated: Optional[Resource] = None
+                          ) -> Resource:
+        """Resources held beyond the gang floor (reference
+        GetElasticResources, job_info.go:654: ExceededPart(allocated,
+        minResources)) — reclaimable without breaking the gang."""
+        alloc = allocated if allocated is not None else self.allocated()
+        return alloc.clone().sub_unchecked(self.min_request())
+
     # -- fit errors ----------------------------------------------------
 
     def record_fit_error(self, task: TaskInfo, node_name: str, fe: FitError):

@@ -28,13 +28,15 @@ ROOT_QUEUE = "root"
 
 class _QueueAttr:
     __slots__ = ("queue", "deserved", "allocated", "inqueue",
-                 "guarantee", "capability", "real_capability", "parent")
+                 "guarantee", "capability", "real_capability", "parent",
+                 "elastic")
 
     def __init__(self, queue: Optional[QueueInfo]):
         self.queue = queue
         self.deserved = Resource()
         self.allocated = Resource()
         self.inqueue = Resource()
+        self.elastic = Resource()
         self.guarantee = queue.guarantee if queue else Resource()
         self.capability = queue.capability if queue else None
         self.real_capability = Resource()
@@ -113,6 +115,7 @@ class CapacityPlugin(Plugin):
         # usage accounting (jobs contribute to their queue + ancestors)
         for job in ssn.jobs.values():
             alloc = job.allocated()
+            elastic = job.elastic_resources(alloc)
             inq = (job.min_request()
                    if job.podgroup
                    and job.podgroup.phase is PodGroupPhase.INQUEUE
@@ -121,6 +124,7 @@ class CapacityPlugin(Plugin):
             for qname in self._chain(job.queue):
                 attr = self.attrs[qname]
                 attr.allocated.add(alloc)
+                attr.elastic.add(elastic)
                 if inq is not None:
                     attr.inqueue.add(inq)
 
@@ -168,12 +172,46 @@ class CapacityPlugin(Plugin):
         return attr is not None and attr.share() >= 1.0 - 1e-9
 
     def _preemptive(self, queue: QueueInfo, task: TaskInfo) -> bool:
-        return self._allocatable(queue, task)
+        """May this queue absorb *task* via reclaim?  Checks the
+        queue's OWN capacity, and deserved on ANY requested dimension
+        (capacity.go:648-683 LessEqualPartly semantics: a queue owed
+        chips may reclaim them even while over on cpu)."""
+        attr = self.attrs.get(queue.name)
+        if attr is None:
+            return True
+        future = attr.allocated.clone().add(task.resreq)
+        dims = list(task.resreq.res.keys())
+        if not future.less_equal_with_dimensions(attr.real_capability,
+                                                 dims):
+            return False
+        return any(future.get(d) <= attr.deserved.get(d) + 0.1
+                   for d in dims)
 
     def _reclaimable(self, ssn):
+        """Hierarchical reclaim (capacity.go:500-600): a victim is
+        eligible only when (a) evicting it keeps its queue at/above its
+        GUARANTEE, (b) its own queue currently exceeds deserved on some
+        contended dimension (childEligible), and (c) every non-root
+        ancestor also exceeds deserved — the ancestor check is an
+        ADDITIONAL veto against reclaiming where there is no real
+        contention, never a substitute for leaf exceedance.  Running
+        eviction totals update the view victim by victim."""
         def fn(ctx, candidates: List[TaskInfo]):
             victims = []
             evicted: Dict[str, Resource] = defaultdict(Resource)
+
+            def exceeds_deserved(attr, evicted_res) -> bool:
+                current = attr.allocated.clone().sub_unchecked(evicted_res)
+                over, _ = current.diff(attr.deserved)
+                return not over.is_empty()
+
+            def guarantee_ok(attr, evicted_res, req) -> bool:
+                would_be = attr.allocated.clone() \
+                    .sub_unchecked(evicted_res).sub_unchecked(req)
+                return attr.guarantee.less_equal(would_be,
+                                                 zero="defaultZero") or \
+                    attr.guarantee.is_empty()
+
             for t in candidates:
                 job = ssn.jobs.get(t.job)
                 if job is None:
@@ -182,17 +220,16 @@ class CapacityPlugin(Plugin):
                 if attr is None or attr.queue is None or \
                         not attr.queue.reclaimable:
                     continue
-                would_be = attr.allocated.clone() \
-                    .sub_unchecked(evicted[job.queue]) \
-                    .sub_unchecked(t.resreq)
-                # give back only while the queue stays over (or at) its
-                # deserved share in the dims being contended
-                if would_be.less_partly(attr.deserved) and \
-                        not attr.deserved.less_equal(would_be,
-                                                     zero="defaultZero"):
+                if not guarantee_ok(attr, evicted[job.queue], t.resreq):
                     continue
+                chain = [q for q in self._chain(job.queue)
+                         if q != ROOT_QUEUE]
+                if not all(exceeds_deserved(self.attrs[q], evicted[q])
+                           for q in chain):
+                    continue  # leaf or an ancestor lacks surplus
                 victims.append(t)
-                evicted[job.queue].add(t.resreq)
+                for q in chain:
+                    evicted[q].add(t.resreq)
             return victims
         return fn
 
@@ -214,7 +251,8 @@ class CapacityPlugin(Plugin):
         min_req = job.min_request()
         for qname in self._chain(job.queue):
             attr = self.attrs[qname]
-            future = attr.allocated.clone().add(attr.inqueue).add(min_req)
+            future = attr.allocated.clone().add(attr.inqueue) \
+                .add(min_req).sub_unchecked(attr.elastic)
             if not future.less_equal_with_dimensions(
                     attr.real_capability, min_req.res.keys()):
                 return REJECT

@@ -24,7 +24,8 @@ from volcano_tpu.framework.session import (
 
 class _QueueAttr:
     __slots__ = ("queue", "weight", "deserved", "allocated", "request",
-                 "inqueue", "capability", "guarantee", "real_capability")
+                 "inqueue", "capability", "guarantee", "real_capability",
+                 "elastic")
 
     def __init__(self, queue: QueueInfo):
         self.queue = queue
@@ -33,6 +34,10 @@ class _QueueAttr:
         self.allocated = Resource()
         self.request = Resource()
         self.inqueue = Resource()
+        # resources running jobs hold BEYOND their gang floors — they
+        # can be reclaimed without breaking any gang, so admission math
+        # treats them as available (proportion.go attr.elastic)
+        self.elastic = Resource()
         self.capability = queue.capability
         self.guarantee = queue.guarantee
         # cluster total minus other queues' guarantees, capped by
@@ -77,7 +82,9 @@ class ProportionPlugin(Plugin):
             if attr is None:
                 continue
             attr.request.add(job.total_request)
-            attr.allocated.add(job.allocated())
+            allocated = job.allocated()
+            attr.allocated.add(allocated)
+            attr.elastic.add(job.elastic_resources(allocated))
             if job.podgroup and job.podgroup.phase is PodGroupPhase.INQUEUE \
                     and not job.is_ready() and job.has_min_resources:
                 attr.inqueue.add(job.min_request())
@@ -203,7 +210,10 @@ class ProportionPlugin(Plugin):
         if not job.has_min_resources:
             return PERMIT  # proportion.go:421-424
         min_req = job.min_request()
-        future = attr.allocated.clone().add(attr.inqueue).add(min_req)
+        # elastic resources are reclaimable without gang harm, so they
+        # don't block admission (proportion.go:430)
+        future = attr.allocated.clone().add(attr.inqueue).add(min_req) \
+            .sub_unchecked(attr.elastic)
         if future.less_equal_with_dimensions(attr.real_capability,
                                              min_req.res.keys()):
             return PERMIT
