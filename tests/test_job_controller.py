@@ -249,3 +249,71 @@ def test_podgroup_controller_wraps_bare_pods():
     group = pod.annotations[GROUP_NAME_ANNOTATION]
     assert f"default/{group}" in cluster.podgroups
     assert cluster.podgroups[f"default/{group}"].min_member == 1
+
+
+def test_task_depends_on_gates_materialization():
+    """tasks[].dependsOn: workers start only after the master runs
+    ('any' iteration); 'all' waits for every target replica."""
+    from volcano_tpu.api.vcjob import DependsOn
+    cluster = mk_cluster()
+    mgr = ControllerManager(cluster, enabled=["job"])
+    sched = Scheduler(cluster, schedule_period=0)
+    tasks = [
+        TaskSpec(name="master", replicas=2,
+                 template=Pod(name="t", containers=[
+                     Container(requests={"cpu": 1})])),
+        TaskSpec(name="worker", replicas=2,
+                 depends_on=DependsOn(name=["master"], iteration="all"),
+                 template=Pod(name="t", containers=[
+                     Container(requests={"cpu": 1})])),
+    ]
+    job = cluster.add_vcjob(mk_job(name="dag", tasks=tasks,
+                                   min_available=2))
+    mgr.sync_all()
+    names = {p.name for p in cluster.pods.values() if p.owner == job.uid}
+    assert names == {"dag-master-0", "dag-master-1"}  # workers gated
+
+    # one master running is NOT enough for iteration=all (phases set
+    # manually — no scheduler cycles, so state stays exactly as written)
+    cluster.pods["default/dag-master-0"].phase = TaskStatus.RUNNING
+    mgr.sync_all()
+    names = {p.name for p in cluster.pods.values() if p.owner == job.uid}
+    assert "dag-worker-0" not in names
+
+    cluster.pods["default/dag-master-1"].phase = TaskStatus.RUNNING
+    mgr.sync_all()
+    names = {p.name for p in cluster.pods.values() if p.owner == job.uid}
+    assert {"dag-worker-0", "dag-worker-1"} <= names
+
+    # dependency degrading later never deletes started workers
+    cluster.complete_pod("default/dag-master-0", succeeded=False)
+    mgr.sync_all()
+    names = {p.name for p in cluster.pods.values() if p.owner == job.uid}
+    assert {"dag-worker-0", "dag-worker-1"} <= names
+
+
+def test_depends_on_any_across_target_list():
+    """iteration='any' with two targets: ONE satisfied target unblocks
+    (an unschedulable sibling must not deadlock the dependent)."""
+    from volcano_tpu.api.vcjob import DependsOn
+    cluster = mk_cluster()
+    mgr = ControllerManager(cluster, enabled=["job"])
+    tasks = [
+        TaskSpec(name="a", replicas=1, min_available=1,
+                 template=Pod(name="t", containers=[
+                     Container(requests={"cpu": 1})])),
+        TaskSpec(name="b", replicas=1, min_available=1,
+                 template=Pod(name="t", containers=[
+                     Container(requests={"cpu": 999})])),  # never fits
+        TaskSpec(name="dep", replicas=1,
+                 depends_on=DependsOn(name=["a", "b"], iteration="any"),
+                 template=Pod(name="t", containers=[
+                     Container(requests={"cpu": 1})])),
+    ]
+    job = cluster.add_vcjob(mk_job(name="anyjob", tasks=tasks,
+                                   min_available=1))
+    mgr.sync_all()
+    cluster.pods["default/anyjob-a-0"].phase = TaskStatus.RUNNING
+    mgr.sync_all()
+    names = {p.name for p in cluster.pods.values() if p.owner == job.uid}
+    assert "anyjob-dep-0" in names   # a satisfied; b irrelevant

@@ -59,13 +59,24 @@ def test_update_task_status_moves_index():
 
 
 def test_task_min_available():
-    job = mk_job(min_member=2, min_task_member={"ps": 1, "worker": 2})
+    # minAvailable >= sum of task minima: the per-task check binds
+    # (below the sum it is skipped entirely, job_info.go:1026-1029)
+    job = mk_job(min_member=3, min_task_member={"ps": 1, "worker": 2})
     job.add_task(mk_task("ps0", spec="ps", status=TaskStatus.RUNNING))
     job.add_task(mk_task("w0", spec="worker", status=TaskStatus.RUNNING))
     assert not job.check_task_min_available_ready()   # worker has 1 of 2
     job.add_task(mk_task("w1", spec="worker", status=TaskStatus.ALLOCATED))
     assert job.check_task_min_available_ready()
     assert job.check_task_min_available()
+
+
+def test_task_min_available_skipped_below_sum():
+    """minAvailable below the per-task total: per-task minima do not
+    bind (what lets dependsOn jobs gang on their first stage)."""
+    job = mk_job(min_member=1, min_task_member={"ps": 1, "worker": 2})
+    job.add_task(mk_task("ps0", spec="ps", status=TaskStatus.RUNNING))
+    assert job.check_task_min_available()
+    assert job.check_task_min_available_ready()
 
 
 def test_subjob_gang():

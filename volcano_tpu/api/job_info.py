@@ -343,6 +343,11 @@ class JobInfo:
         tasks (reference CheckTaskValid)."""
         if not self.task_min_available:
             return True
+        if self.min_available < sum(self.task_min_available.values()):
+            # job-level floor below the per-task total: per-task minima
+            # don't bind (job_info.go:1026-1029) — this is what lets
+            # dependsOn jobs gang on their first stage only
+            return True
         alive_per_spec: Dict[str, int] = defaultdict(int)
         for t in self.tasks.values():
             if t.is_alive():
@@ -354,6 +359,11 @@ class JobInfo:
         """Per-task-spec minima met by READY tasks (CheckTaskReady)."""
         if not self.task_min_available:
             return True
+        if self.min_available < sum(self.task_min_available.values()):
+            # job-level floor below the per-task total: per-task minima
+            # don't bind (job_info.go:1026-1029) — this is what lets
+            # dependsOn jobs gang on their first stage only
+            return True
         ready_per_spec: Dict[str, int] = defaultdict(int)
         for t in self.tasks.values():
             if t.status in READY_TASK_STATUSES:
@@ -363,6 +373,11 @@ class JobInfo:
 
     def check_task_min_available_pipelined(self) -> bool:
         if not self.task_min_available:
+            return True
+        if self.min_available < sum(self.task_min_available.values()):
+            # job-level floor below the per-task total: per-task minima
+            # don't bind (job_info.go:1026-1029) — this is what lets
+            # dependsOn jobs gang on their first stage only
             return True
         per_spec: Dict[str, int] = defaultdict(int)
         for t in self.tasks.values():

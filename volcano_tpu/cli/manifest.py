@@ -119,7 +119,9 @@ def job_from_manifest(data: dict) -> VCJob:
                            if "minAvailable" in t else None),
             template=_pod_template(t.get("template", {})),
             policies=_policies(t.get("policies", [])),
-            depends_on=DependsOn(name=list(depends.get("name", [])))
+            depends_on=DependsOn(
+                name=list(depends.get("name", [])),
+                iteration=depends.get("iteration", "any"))
             if depends else None,
             subgroup=t.get("subGroup", ""),
         ))

@@ -207,12 +207,52 @@ class JobController(Controller):
             plugin.on_job_add(job, self.cluster)
         job.controlled_resources["plugins-applied"] = "true"
 
+    @staticmethod
+    def _task_ready_counts(job: VCJob, pods: List[Pod]) -> Dict[str, int]:
+        counts: Dict[str, int] = defaultdict(int)
+        for p in pods:
+            if p.phase in (TaskStatus.RUNNING, TaskStatus.SUCCEEDED):
+                counts[p.task_spec] += 1
+        return counts
+
+    def _task_unblocked(self, job: VCJob, spec,
+                        ready: Dict[str, int]) -> bool:
+        """tasks[].dependsOn gates materialization (reference
+        waitDependsOnTaskMeetCondition): a TARGET is satisfied once its
+        running/succeeded count reaches its own minAvailable (default:
+        replicas); iteration picks across the name LIST — 'any' needs
+        one satisfied target (OR), 'all' needs every one (AND)."""
+        if spec.depends_on is None or not spec.depends_on.name:
+            return True
+
+        def target_ok(target_name: str) -> bool:
+            target = job.task_by_name(target_name)
+            if target is None:
+                return True  # webhook validates; be lenient at runtime
+            need = (target.min_available
+                    if target.min_available is not None
+                    else target.replicas)
+            return ready.get(target_name, 0) >= need
+
+        targets = spec.depends_on.name
+        if spec.depends_on.iteration == "all":
+            return all(target_ok(n) for n in targets)
+        return any(target_ok(n) for n in targets)
+
     def _materialize_pods(self, job: VCJob, pods: List[Pod]) -> None:
         existing = {p.name: p for p in pods}
         desired = {}
+        creatable = set()
+        ready_counts = self._task_ready_counts(job, pods)
         for spec in job.tasks:
+            # dependsOn gates CREATION only — a dependency degrading
+            # later must never delete already-started dependents
+            unblocked = self._task_unblocked(job, spec, ready_counts)
             for i in range(spec.replicas):
-                desired[f"{job.name}-{spec.name}-{i}"] = (spec, i)
+                name = f"{job.name}-{spec.name}-{i}"
+                desired[name] = (spec, i)
+                if unblocked:
+                    creatable.add(name)
 
         # scale down: delete pods not desired anymore
         for name, pod in existing.items():
@@ -226,7 +266,7 @@ class JobController(Controller):
                                for n, a in job.plugins.items())
                    if p is not None]
         for name, (spec, index) in desired.items():
-            if name in existing:
+            if name in existing or name not in creatable:
                 continue
             self.cluster.add_pod(
                 self._build_pod(job, spec, index, name, plugins))

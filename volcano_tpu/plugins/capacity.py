@@ -184,7 +184,8 @@ class CapacityPlugin(Plugin):
         if not future.less_equal_with_dimensions(attr.real_capability,
                                                  dims):
             return False
-        return any(future.get(d) <= attr.deserved.get(d) + 0.1
+        from volcano_tpu.api.resource import MIN_RESOURCE
+        return any(future.get(d) <= attr.deserved.get(d) + MIN_RESOURCE
                    for d in dims)
 
     def _reclaimable(self, ssn):
@@ -196,14 +197,20 @@ class CapacityPlugin(Plugin):
         ADDITIONAL veto against reclaiming where there is no real
         contention, never a substitute for leaf exceedance.  Running
         eviction totals update the view victim by victim."""
+        from volcano_tpu.api.resource import MIN_RESOURCE
+
         def fn(ctx, candidates: List[TaskInfo]):
             victims = []
             evicted: Dict[str, Resource] = defaultdict(Resource)
 
-            def exceeds_deserved(attr, evicted_res) -> bool:
+            def exceeds_on_relevant(attr, evicted_res, req) -> bool:
+                """Over deserved on a dimension the VICTIM actually
+                frees (reference GreaterPartlyWithRelevantDimensions) —
+                surplus memory never justifies evicting a cpu-only pod."""
                 current = attr.allocated.clone().sub_unchecked(evicted_res)
-                over, _ = current.diff(attr.deserved)
-                return not over.is_empty()
+                return any(
+                    current.get(d) > attr.deserved.get(d) + MIN_RESOURCE
+                    for d in req.res)
 
             def guarantee_ok(attr, evicted_res, req) -> bool:
                 would_be = attr.allocated.clone() \
@@ -222,14 +229,26 @@ class CapacityPlugin(Plugin):
                     continue
                 if not guarantee_ok(attr, evicted[job.queue], t.resreq):
                     continue
+                if not exceeds_on_relevant(attr, evicted[job.queue],
+                                           t.resreq):
+                    continue  # the leaf itself must hold surplus
+                # ancestors veto only when they carry EXPLICIT deserved
+                # policy; an unconfigured parent (deserved defaulted to
+                # realCapability) never blocks reclaim within it
                 chain = [q for q in self._chain(job.queue)
-                         if q != ROOT_QUEUE]
-                if not all(exceeds_deserved(self.attrs[q], evicted[q])
-                           for q in chain):
-                    continue  # leaf or an ancestor lacks surplus
+                         if q != ROOT_QUEUE and q != job.queue]
+                policy_ancestors = [
+                    q for q in chain
+                    if self.attrs[q].queue is not None
+                    and self.attrs[q].queue.deserved_spec is not None]
+                if not all(exceeds_on_relevant(self.attrs[q], evicted[q],
+                                               t.resreq)
+                           for q in policy_ancestors):
+                    continue  # an explicitly-capped ancestor lacks surplus
                 victims.append(t)
-                for q in chain:
-                    evicted[q].add(t.resreq)
+                for q in self._chain(job.queue):
+                    if q != ROOT_QUEUE:
+                        evicted[q].add(t.resreq)
             return victims
         return fn
 
