@@ -131,18 +131,12 @@ def test_preemption_policy_never_blocks_gangpreempt_too():
             cluster.add_pod(p)
     cluster.add_priority_class(
         PriorityClass("polite", 1000, preemption_policy="Never"))
-    from volcano_tpu.cache.cache import SchedulerCache
-    from volcano_tpu.conf import load_conf
-    ctx = TestContext.__new__(TestContext)
-    ctx.cluster = cluster
-    ctx.conf = load_conf({
+    ctx = TestContext(cluster=cluster, conf={
         "actions": "enqueue, allocate, gangpreempt",
         "tiers": [{"plugins": [
             {"name": "priority"}, {"name": "gang"},
             {"name": "conformance"}, {"name": "predicates"},
             {"name": "nodeorder"}, {"name": "deviceshare"},
             {"name": "network-topology-aware"}]}]})
-    ctx.cache = SchedulerCache(cluster)
-    ctx.last_session = None
     ctx.run()
     ctx.expect_evict_num(0)

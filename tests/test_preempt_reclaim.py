@@ -189,13 +189,7 @@ def test_gangpreempt_nomination_two_cycle_handshake():
         cluster.add_pod(p)
     cluster.add_priority_class(PriorityClass("high", 1000))
 
-    ctx = TestContext.__new__(TestContext)
-    ctx.cluster = cluster
-    from volcano_tpu.conf import load_conf
-    from volcano_tpu.cache.cache import SchedulerCache
-    ctx.conf = load_conf(conf)
-    ctx.cache = SchedulerCache(cluster)
-    ctx.last_session = None
+    ctx = TestContext(cluster=cluster, conf=conf)
 
     ctx.run()
     # cycle 1: evictions fired (3 surplus tasks of one filler gang =
@@ -213,3 +207,59 @@ def test_gangpreempt_nomination_two_cycle_handshake():
     train_binds = {n for k, n in cluster.binds if k.startswith("default/train")}
     assert len(train_binds) == 4
     assert len({n.rsplit("-w", 1)[0] for n in train_binds}) == 1
+
+
+def test_gangreclaim_cross_queue_slice_reclaim():
+    """A starving hard-topology gang in an under-share queue reclaims a
+    whole slice from an over-share queue via gangreclaim's bundles +
+    nomination, then lands there."""
+    conf = {
+        "actions": "enqueue, allocate, gangreclaim, backfill",
+        "tiers": [
+            {"plugins": [{"name": "priority"}, {"name": "gang"},
+                         {"name": "conformance"}]},
+            {"plugins": [{"name": "predicates"}, {"name": "proportion"},
+                         {"name": "nodeorder"}, {"name": "deviceshare"},
+                         {"name": "network-topology-aware"}]},
+        ],
+    }
+    cluster = make_tpu_cluster([("sa", "v5e-16"), ("sb", "v5e-16")])
+    cluster.add_queue(Queue(name="greedy", weight=1))
+    cluster.add_queue(Queue(name="owed", weight=1))
+    # greedy holds BOTH slices with elastic gangs (min 1)
+    for s in ("sa", "sb"):
+        pg, pods = gang_job(f"filler-{s}", queue="greedy", replicas=4,
+                            min_available=1,
+                            requests={"cpu": 8, TPU: 4},
+                            running_on=[f"{s}-w{i}" for i in range(4)],
+                            pg_phase=PodGroupPhase.RUNNING)
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+    # owed queue: hard tier-1 gang needing one whole slice
+    pg_hi, pods_hi = gang_job(
+        "owed-train", queue="owed", replicas=4,
+        requests={"cpu": 8, TPU: 4},
+        network_topology=NetworkTopologySpec(NetworkTopologyMode.HARD, 1),
+        pg_phase=PodGroupPhase.INQUEUE)
+    cluster.add_podgroup(pg_hi)
+    for p in pods_hi:
+        cluster.add_pod(p)
+
+    ctx = TestContext(cluster=cluster, conf=conf)
+
+    ctx.run()
+    # cycle 1: gangreclaim evicted greedy's surplus in one slice and
+    # pinned the nomination
+    assert len(cluster.evictions) >= 3
+    assert all("filler" in e for e in cluster.evictions)
+    from volcano_tpu.api.types import NOMINATED_HYPERNODES_ANNOTATION
+    assert NOMINATED_HYPERNODES_ANNOTATION in \
+        cluster.podgroups["default/owed-train"].annotations
+
+    cluster.tick()
+    cluster.tick()
+    ctx.run()
+    train_nodes = {n for k, n in cluster.binds if "owed-train" in k}
+    assert len(train_nodes) == 4
+    assert len({n.rsplit("-w", 1)[0] for n in train_nodes}) == 1

@@ -19,27 +19,19 @@ from volcano_tpu.uthelper import TestContext, gang_job
 
 def tpu_ctx(slices, podgroups=(), pods=(), conf=None, **kwargs):
     cluster = make_tpu_cluster(slices, **kwargs)
-    ctx = TestContext.__new__(TestContext)
-    ctx.cluster = cluster
-    for pg in podgroups:
-        cluster.add_podgroup(pg)
-    for p in pods:
-        cluster.add_pod(p)
-    from volcano_tpu.conf import load_conf
-    ctx.conf = load_conf(conf or {
-        "actions": "enqueue, allocate, backfill",
-        "tiers": [
-            {"plugins": [{"name": "priority"}, {"name": "gang"},
-                         {"name": "conformance"}]},
-            {"plugins": [{"name": "overcommit"}, {"name": "drf"},
-                         {"name": "predicates"}, {"name": "proportion"},
-                         {"name": "nodeorder"}, {"name": "binpack"},
-                         {"name": "deviceshare"},
-                         {"name": "network-topology-aware"}]},
-        ]})
-    ctx.cache = SchedulerCache(cluster)
-    ctx.last_session = None
-    return ctx
+    return TestContext(
+        cluster=cluster, podgroups=podgroups, pods=pods,
+        conf=conf or {
+            "actions": "enqueue, allocate, backfill",
+            "tiers": [
+                {"plugins": [{"name": "priority"}, {"name": "gang"},
+                             {"name": "conformance"}]},
+                {"plugins": [{"name": "overcommit"}, {"name": "drf"},
+                             {"name": "predicates"}, {"name": "proportion"},
+                             {"name": "nodeorder"}, {"name": "binpack"},
+                             {"name": "deviceshare"},
+                             {"name": "network-topology-aware"}]},
+            ]})
 
 
 def test_hypernode_discovery_builds_slice_tree():

@@ -41,8 +41,11 @@ class TestContext:
                  hypernodes: Sequence[HyperNode] = (),
                  priority_classes: Sequence[PriorityClass] = (),
                  conf=None,
-                 actions: str = "enqueue, allocate, backfill"):
-        self.cluster = FakeCluster()
+                 actions: str = "enqueue, allocate, backfill",
+                 cluster: Optional[FakeCluster] = None):
+        # a prebuilt cluster (e.g. simulator.make_tpu_cluster) may be
+        # passed directly; declared objects are added on top of it
+        self.cluster = cluster if cluster is not None else FakeCluster()
         for n in nodes:
             self.cluster.add_node(n)
         for q in queues:
