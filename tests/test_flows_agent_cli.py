@@ -292,3 +292,49 @@ def test_prometheus_source_degrades_on_unreachable_endpoint():
                                    timeout=0.2)
     assert source.refresh() is False
     assert source.usage("any").cpu_fraction == 0.0
+
+
+def test_agent_cpu_and_network_qos_handlers():
+    """Burst/throttle + DCN split published from real usage."""
+    from volcano_tpu.agent import FakeUsageProvider, NodeAgent
+    from volcano_tpu.api.pod import make_pod
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    be = make_pod("be", node_name="sa-w0", phase=TaskStatus.RUNNING,
+                  requests={"cpu": 2},
+                  annotations={"volcano-tpu.io/qos-level": "BE"})
+    guaranteed = make_pod("g", node_name="sa-w0",
+                          phase=TaskStatus.RUNNING,
+                          requests={"cpu": 4})
+    cluster.add_pod(be)
+    cluster.add_pod(guaranteed)
+    provider = FakeUsageProvider()
+    provider.set("sa-w0", cpu_fraction=0.5, tpu_chips_detected=4,
+                 tpu_chips_healthy=4)
+    NodeAgent(cluster, "sa-w0", provider).sync()
+
+    # BE: burst sized from the NODE's idle (112 cpu * 0.5), not the
+    # pod's request (true best-effort pods request nothing)
+    assert be.annotations["qos.volcano-tpu.io/cpu-burst-millis"] == "56000"
+    assert be.annotations["qos.volcano-tpu.io/cpu-throttled"] == "false"
+    # guaranteed: fixed headroom, no throttle key
+    assert guaranteed.annotations[
+        "qos.volcano-tpu.io/cpu-burst-millis"] == "800"
+    assert "qos.volcano-tpu.io/cpu-throttled" not in guaranteed.annotations
+    # DCN split: 40% offline at low pressure, BE pod gets its share
+    node = cluster.nodes["sa-w0"]
+    assert node.annotations[
+        "networkqos.volcano-tpu.io/offline-limit-mbps"] == "40000"
+    assert node.annotations[
+        "networkqos.volcano-tpu.io/online-guarantee-mbps"] == "60000"
+    assert be.annotations[
+        "networkqos.volcano-tpu.io/pod-limit-mbps"] == "40000"
+
+    # pressure shrinks the offline share and throttles BE bursting
+    provider.set("sa-w0", cpu_fraction=0.9, tpu_chips_detected=4,
+                 tpu_chips_healthy=4)
+    NodeAgent(cluster, "sa-w0", provider).sync()
+    assert node.annotations[
+        "networkqos.volcano-tpu.io/offline-limit-mbps"] == "10000"
+    assert be.annotations["qos.volcano-tpu.io/cpu-throttled"] == "true"
+    # throttled => burst zeroed (no contradictory signals)
+    assert be.annotations["qos.volcano-tpu.io/cpu-burst-millis"] == "0"

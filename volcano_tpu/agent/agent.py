@@ -29,6 +29,19 @@ TPU_HEALTHY_LABEL = "volcano-tpu.io/tpu-healthy"
 AGENT_CORDONED_ANNOTATION = "volcano-tpu.io/cordoned-by-agent"
 TPU_CHIPS_ANNOTATION = "volcano-tpu.io/tpu-chips"
 
+# cpu QoS outputs (cgroup enforcer inputs)
+CPU_BURST_ANNOTATION = "qos.volcano-tpu.io/cpu-burst-millis"
+CPU_THROTTLE_ANNOTATION = "qos.volcano-tpu.io/cpu-throttled"
+
+# DCN egress shaping (CNI/kernel enforcer inputs; the TPU reading of
+# the reference's eBPF/tc online/offline bandwidth split)
+DCN_BANDWIDTH_ANNOTATION = "networkqos.volcano-tpu.io/dcn-mbps"
+DCN_OFFLINE_LIMIT_ANNOTATION = "networkqos.volcano-tpu.io/offline-limit-mbps"
+DCN_ONLINE_GUARANTEE_ANNOTATION = \
+    "networkqos.volcano-tpu.io/online-guarantee-mbps"
+DCN_POD_LIMIT_ANNOTATION = "networkqos.volcano-tpu.io/pod-limit-mbps"
+DEFAULT_DCN_MBPS = 100_000  # 100 Gbps per host default
+
 from volcano_tpu.api.types import QOS_BEST_EFFORT, QOS_LEVEL_ANNOTATION
 
 # annotation marking pods the agent may evict under pressure
@@ -85,6 +98,8 @@ class NodeAgent:
         self._report_usage(node, usage)
         self._report_tpu_health(node, usage)
         self._report_oversubscription(node, usage)
+        self._apply_cpu_qos(node, usage)
+        self._apply_network_qos(node, usage)
         if max(usage.cpu_fraction, usage.memory_fraction) >= \
                 self.eviction_threshold:
             self._evict_best_effort(node)
@@ -129,6 +144,77 @@ class NodeAgent:
         stepped = int(idle_frac * 10) / 10.0   # 10% quantization
         reclaimable = alloc.milli_cpu * stepped * self.oversub_factor
         node.annotations[OVERSUB_ANNOTATION] = str(int(reclaimable))
+
+    def _apply_cpu_qos(self, node, usage: NodeUsage) -> None:
+        """cpuburst/cputhrottle handlers (reference: pkg/agent/events/
+        handlers/{cpuburst,cputhrottle}) — control-plane half: compute
+        per-pod burst quota / throttle decisions from real usage and
+        publish them as pod annotations; a kubelet-side enforcer would
+        program cgroup cpu.cfs_burst_us / cfs_quota_us from these."""
+        idle_frac = max(0.0, 1.0 - usage.cpu_fraction)
+        node_idle_m = Resource.from_resource_list(
+            node.allocatable).milli_cpu * idle_frac
+        throttled = usage.cpu_fraction > self.eviction_threshold * 0.9
+        for pod in self.cluster.pods.values():
+            if pod.node_name != self.node_name or \
+                    pod.phase is not TaskStatus.RUNNING:
+                continue
+            qos = pod.annotations.get(PREEMPTABLE_QOS_ANNOTATION)
+            request_m = pod.resource_requests().milli_cpu
+            if qos == QOS_BEST_EFFORT:
+                # BE pods burst into the node's measured idle (requests
+                # are often 0 for true best-effort — the reference sizes
+                # from allocatable idle, not requests); under pressure
+                # the burst is zeroed, matching the throttle flag
+                burst = 0 if throttled else int(node_idle_m)
+                pod.annotations[CPU_BURST_ANNOTATION] = str(burst)
+                pod.annotations[CPU_THROTTLE_ANNOTATION] = (
+                    "true" if throttled else "false")
+            else:
+                # guaranteed pods: fixed burst headroom, never throttled
+                pod.annotations[CPU_BURST_ANNOTATION] = \
+                    str(int(request_m * 0.2))
+                pod.annotations.pop(CPU_THROTTLE_ANNOTATION, None)
+
+    def _apply_network_qos(self, node, usage: NodeUsage) -> None:
+        """networkqos handler (reference: pkg/networkqos — clsact qdisc
+        + eBPF maps shaping online/offline DCN bandwidth) — control-
+        plane half: split the node's DCN egress budget between online
+        (guaranteed) and offline (BE) pods and publish the split; the
+        CNI/kernel enforcer consumes these annotations."""
+        try:
+            total_mbps = float(node.annotations.get(
+                DCN_BANDWIDTH_ANNOTATION, DEFAULT_DCN_MBPS))
+        except (TypeError, ValueError):
+            # a malformed operator annotation must never kill the sync
+            # cycle (the eviction check runs after this handler)
+            log.warning("node %s: invalid %s annotation; using default",
+                        self.node_name, DCN_BANDWIDTH_ANNOTATION)
+            total_mbps = float(DEFAULT_DCN_MBPS)
+        be_pods, other_pods = [], []
+        for p in self.cluster.pods.values():
+            if p.node_name != self.node_name or \
+                    p.phase is not TaskStatus.RUNNING:
+                continue
+            if p.annotations.get(PREEMPTABLE_QOS_ANNOTATION) == \
+                    QOS_BEST_EFFORT:
+                be_pods.append(p)
+            else:
+                other_pods.append(p)
+        # offline (BE) traffic is capped at a fraction of the link,
+        # shrinking to a floor under online pressure
+        offline_share = 0.4 if usage.cpu_fraction < 0.8 else 0.1
+        offline_mbps = int(total_mbps * offline_share)
+        node.annotations[DCN_OFFLINE_LIMIT_ANNOTATION] = str(offline_mbps)
+        node.annotations[DCN_ONLINE_GUARANTEE_ANNOTATION] = \
+            str(int(total_mbps - offline_mbps))
+        if be_pods:
+            per_pod = offline_mbps // len(be_pods)
+            for pod in be_pods:
+                pod.annotations[DCN_POD_LIMIT_ANNOTATION] = str(per_pod)
+        for pod in other_pods:
+            # a pod promoted out of BE must not keep a stale cap
+            pod.annotations.pop(DCN_POD_LIMIT_ANNOTATION, None)
 
     def _evict_best_effort(self, node) -> None:
         for pod in list(self.cluster.pods.values()):
