@@ -338,3 +338,68 @@ def test_agent_cpu_and_network_qos_handlers():
     assert be.annotations["qos.volcano-tpu.io/cpu-throttled"] == "true"
     # throttled => burst zeroed (no contradictory signals)
     assert be.annotations["qos.volcano-tpu.io/cpu-burst-millis"] == "0"
+
+
+def test_elasticsearch_usage_source_end_to_end():
+    """ES aggregation query -> per-node usage -> agent annotations."""
+    import http.server
+    import json as _json
+    import threading
+    from volcano_tpu.agent import NodeAgent
+    from volcano_tpu.metrics_source import ElasticsearchUsageSource
+
+    seen = {}
+
+    class FakeES(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = _json.loads(
+                self.rfile.read(int(self.headers["Content-Length"])))
+            seen["path"] = self.path
+            seen["query"] = body
+            resp = _json.dumps({"aggregations": {"nodes": {"buckets": [
+                {"key": "sa-w0", "cpu": {"value": 0.66},
+                 "mem": {"value": 0.25}},
+                {"key": "sa-w1", "cpu": {"value": None},
+                 "mem": {"value": 0.10}},
+            ]}}}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(resp)))
+            self.end_headers()
+            self.wfile.write(resp)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), FakeES)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        source = ElasticsearchUsageSource(
+            f"http://127.0.0.1:{server.server_address[1]}")
+        assert source.refresh()
+        # one terms-by-host search against the configured index
+        assert seen["path"] == "/metricbeat-*/_search"
+        assert seen["query"]["aggs"]["nodes"]["terms"]["field"] == \
+            "host.hostname"
+        assert source.usage("sa-w0").cpu_fraction == 0.66
+        assert source.usage("sa-w1").cpu_fraction == 0.0  # null avg
+        assert source.usage("missing").cpu_fraction == 0.0
+
+        cluster = make_tpu_cluster([("sa", "v5e-16")])
+        NodeAgent(cluster, "sa-w0", source).sync()
+        assert cluster.nodes["sa-w0"].annotations[
+            "usage.volcano-tpu.io/cpu"] == "0.660"
+    finally:
+        server.shutdown()
+
+
+def test_elasticsearch_source_degrades_and_goes_stale():
+    from volcano_tpu.metrics_source import ElasticsearchUsageSource
+    source = ElasticsearchUsageSource("http://127.0.0.1:1", timeout=0.2)
+    assert source.refresh() is False
+    assert source.usage("any").cpu_fraction == 0.0
+    # a successful past refresh past its TTL reads as unknown too
+    source._usage = {"n": __import__(
+        "volcano_tpu.agent.agent", fromlist=["NodeUsage"]
+    ).NodeUsage(cpu_fraction=0.9)}
+    source._last_success = 1.0  # epoch: long past stale_after
+    assert source.usage("n").cpu_fraction == 0.0

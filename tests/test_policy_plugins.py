@@ -229,3 +229,95 @@ def test_rescheduling_feeds_shuffle():
                       conf=conf)
     ctx.run(["shuffle"])
     ctx.expect_evict_num(1)
+
+
+def test_numatopology_object_node_policy_gates_without_pod_optin():
+    """A Numatopology with kubelet TopologyManagerPolicy=single-numa-node
+    gates ALL pods on that node (reference numaaware: node policy rules),
+    steering a 6-cpu task to the node whose cell can hold it."""
+    from volcano_tpu.api.numatopology import tpu_host_numatopology
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    cluster = FakeCluster()
+    for node in nodes(2):
+        cluster.add_node(node)
+    # n0: 2 cells x 4 cpu (cannot hold 6 in one cell); n1: 1 cell x 8
+    cluster.add_numatopology(tpu_host_numatopology(
+        "n0", cpu_millis=8000, tpu_chips=0, numa_cells=2,
+        policy="single-numa-node"))
+    cluster.add_numatopology(tpu_host_numatopology(
+        "n1", cpu_millis=8000, tpu_chips=0, numa_cells=1,
+        policy="single-numa-node"))
+    pg, pods = gang_job("numacrd", replicas=1, requests={"cpu": 6})
+    ctx = TestContext(cluster=cluster, podgroups=[pg], pods=pods,
+                      conf=conf_with("numaaware"))
+    ctx.run()
+    ctx.expect_bind("default/numacrd-0", "n1")
+
+
+def test_numatopology_tpu_chip_split_and_pod_policy_escalation():
+    """4-chip host split 2+2 across cells: a 4-chip single-numa pod is
+    unschedulable there, and the pod annotation escalates over a
+    best-effort node policy."""
+    from volcano_tpu.api.numatopology import tpu_host_numatopology
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="host", allocatable={
+        "cpu": 112, "google.com/tpu": 4, "pods": 110}))
+    topo = tpu_host_numatopology("host", cpu_millis=112000, tpu_chips=4,
+                                 numa_cells=2, policy="best-effort")
+    assert topo.numa_res["google.com/tpu"] == {"0": 2.0, "1": 2.0}
+    cluster.add_numatopology(topo)
+    pg, pods = gang_job("chips", replicas=1,
+                        requests={"cpu": 8, TPU: 4})
+    pods[0].annotations["numa.volcano-tpu.io/policy"] = "single-numa-node"
+    ctx = TestContext(cluster=cluster, podgroups=[pg], pods=pods,
+                      conf=conf_with("numaaware"))
+    ctx.run()
+    ctx.expect_bind_num(0)  # 4 chips can't come from one cell
+    # best-effort alone (node policy) must NOT gate: drop the opt-in
+    pods[0].annotations.pop("numa.volcano-tpu.io/policy")
+    ctx2 = TestContext(cluster=cluster, podgroups=[pg], pods=pods,
+                       conf=conf_with("numaaware"))
+    ctx2.run()
+    ctx2.expect_bind("default/chips-0", "host")
+
+
+def test_numatopology_live_deduction_within_session():
+    """numa_res is FREE space, and in-session placements are deducted:
+    a 20-cpu node publishing two 8-cpu-free cells admits two 6-cpu
+    single-numa pods (one per cell) but gates the third, even though
+    the node still has 8 cpu idle overall."""
+    from volcano_tpu.api.numatopology import Numatopology
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="host",
+                          allocatable={"cpu": 20, "pods": 110}))
+    cluster.add_numatopology(Numatopology(
+        name="host",
+        numa_res={"cpu": {"0": 8000.0, "1": 8000.0}},
+        policies={"TopologyManagerPolicy": "single-numa-node"}))
+    pg, pods = gang_job("three", replicas=3, min_available=1,
+                        requests={"cpu": 6})
+    ctx = TestContext(cluster=cluster, podgroups=[pg], pods=pods,
+                      conf=conf_with("numaaware"))
+    ctx.run()
+    ctx.expect_bind_num(2)
+
+
+def test_numatopology_res_reserved_shrinks_cells():
+    """res_reserved is spread across cells and subtracted from free."""
+    from volcano_tpu.api.numatopology import Numatopology
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="host",
+                          allocatable={"cpu": 16, "pods": 110}))
+    cluster.add_numatopology(Numatopology(
+        name="host",
+        numa_res={"cpu": {"0": 8000.0, "1": 8000.0}},
+        policies={"TopologyManagerPolicy": "single-numa-node"},
+        res_reserved={"cpu": 6000.0}))  # 3000 off each cell -> 5000 free
+    pg, pods = gang_job("rsv", replicas=1, requests={"cpu": 6})
+    ctx = TestContext(cluster=cluster, podgroups=[pg], pods=pods,
+                      conf=conf_with("numaaware"))
+    ctx.run()
+    ctx.expect_bind_num(0)

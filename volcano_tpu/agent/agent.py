@@ -104,6 +104,16 @@ class NodeAgent:
                 self.eviction_threshold:
             self._evict_best_effort(node)
 
+    def _running_pods(self) -> List:
+        """Pods RUNNING on this agent's node — the population every
+        QoS/eviction handler operates on."""
+        return [p for p in self.cluster.pods.values()
+                if p.node_name == self.node_name
+                and p.phase is TaskStatus.RUNNING]
+
+    def _allocatable(self, node) -> Resource:
+        return Resource.from_resource_list(node.allocatable)
+
     def _report_usage(self, node, usage: NodeUsage) -> None:
         node.annotations[CPU_USAGE_ANNOTATION] = f"{usage.cpu_fraction:.3f}"
         node.annotations[MEM_USAGE_ANNOTATION] = \
@@ -152,13 +162,9 @@ class NodeAgent:
         publish them as pod annotations; a kubelet-side enforcer would
         program cgroup cpu.cfs_burst_us / cfs_quota_us from these."""
         idle_frac = max(0.0, 1.0 - usage.cpu_fraction)
-        node_idle_m = Resource.from_resource_list(
-            node.allocatable).milli_cpu * idle_frac
+        node_idle_m = self._allocatable(node).milli_cpu * idle_frac
         throttled = usage.cpu_fraction > self.eviction_threshold * 0.9
-        for pod in self.cluster.pods.values():
-            if pod.node_name != self.node_name or \
-                    pod.phase is not TaskStatus.RUNNING:
-                continue
+        for pod in self._running_pods():
             qos = pod.annotations.get(PREEMPTABLE_QOS_ANNOTATION)
             request_m = pod.resource_requests().milli_cpu
             if qos == QOS_BEST_EFFORT:
@@ -192,10 +198,7 @@ class NodeAgent:
                         self.node_name, DCN_BANDWIDTH_ANNOTATION)
             total_mbps = float(DEFAULT_DCN_MBPS)
         be_pods, other_pods = [], []
-        for p in self.cluster.pods.values():
-            if p.node_name != self.node_name or \
-                    p.phase is not TaskStatus.RUNNING:
-                continue
+        for p in self._running_pods():
             if p.annotations.get(PREEMPTABLE_QOS_ANNOTATION) == \
                     QOS_BEST_EFFORT:
                 be_pods.append(p)
@@ -217,12 +220,9 @@ class NodeAgent:
             pod.annotations.pop(DCN_POD_LIMIT_ANNOTATION, None)
 
     def _evict_best_effort(self, node) -> None:
-        for pod in list(self.cluster.pods.values()):
-            if pod.node_name != self.node_name:
-                continue
-            if pod.phase is not TaskStatus.RUNNING:
-                continue
-            if pod.annotations.get(PREEMPTABLE_QOS_ANNOTATION) == QOS_BEST_EFFORT:
+        for pod in self._running_pods():
+            if pod.annotations.get(PREEMPTABLE_QOS_ANNOTATION) == \
+                    QOS_BEST_EFFORT:
                 log.info("agent %s: evicting BE pod %s under pressure",
                          self.node_name, pod.key)
                 self.cluster.evict_pod(pod.namespace, pod.name,

@@ -1,0 +1,76 @@
+"""Numatopology — per-node NUMA inventory object.
+
+Reference parity: staging nodeinfo/v1alpha1 Numatopology CRD
+(numatopology_types.go: spec.policies, spec.numares with per-NUMA
+allocatable, spec.resReserved) consumed by plugins/numaaware.
+TPU-first reading: on a TPU host the inventory that matters is which
+cpu NUMA node each PCIe-attached chip group hangs off, so `numa_res`
+carries both "cpu" (millicores) and "google.com/tpu" per NUMA cell.
+
+The node agent (or a kubelet shim) publishes one Numatopology per
+node; the numaaware plugin prefers it over the legacy annotation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+# node-side kubelet policies, mirroring the reference's spec.policies
+TOPOLOGY_MANAGER_POLICY = "TopologyManagerPolicy"
+CPU_MANAGER_POLICY = "CPUManagerPolicy"
+
+POLICY_NONE = "none"
+POLICY_BEST_EFFORT = "best-effort"
+POLICY_RESTRICTED = "restricted"
+POLICY_SINGLE_NUMA = "single-numa-node"
+
+
+@dataclass
+class Numatopology:
+    """NUMA inventory of one node (name == node name).
+
+    `numa_res` carries the node's CURRENT free amount per cell as of
+    the exporter's last refresh (reference semantics: the
+    resource-exporter republishes from live cgroup state) — not the
+    static capacity.  The numaaware plugin layers its own in-session
+    deductions on top, so placements made between refreshes are
+    accounted for too.
+    """
+
+    name: str
+    # resource -> numa cell id -> CURRENTLY FREE amount
+    # (cpu in MILLIcores to match Resource's internal unit)
+    numa_res: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    # kubelet policies: {"TopologyManagerPolicy": "single-numa-node", ...}
+    policies: Dict[str, str] = field(default_factory=dict)
+    # resources the kubelet holds back per node (not per cell)
+    res_reserved: Dict[str, float] = field(default_factory=dict)
+
+    def cell_free(self, resource: str, cell: str) -> float:
+        return self.numa_res.get(resource, {}).get(cell, 0.0)
+
+    def cells(self):
+        out = set()
+        for per_cell in self.numa_res.values():
+            out.update(per_cell)
+        return sorted(out)
+
+
+def tpu_host_numatopology(node_name: str, cpu_millis: float,
+                          tpu_chips: int, numa_cells: int = 2,
+                          policy: str = POLICY_BEST_EFFORT) -> Numatopology:
+    """Fresh-host inventory for a typical TPU host: chips and cores
+    split evenly across NUMA cells (v5e/v5p hosts are 2-socket, 2
+    chips per socket on 4-chip hosts).  "Fresh" = everything free; an
+    exporter republishing for a busy host passes live free values."""
+    cells = [str(i) for i in range(max(1, numa_cells))]
+    per_cpu = cpu_millis / len(cells)
+    base, extra = divmod(tpu_chips, len(cells))
+    numa_res = {
+        "cpu": {c: per_cpu for c in cells},
+        "google.com/tpu": {c: float(base + (1 if i < extra else 0))
+                           for i, c in enumerate(cells)},
+    }
+    return Numatopology(name=node_name, numa_res=numa_res,
+                        policies={TOPOLOGY_MANAGER_POLICY: policy})

@@ -37,6 +37,7 @@ class FakeCluster(Cluster):
         self.commands: List[dict] = []            # bus/v1alpha1 analogue
         self.jobflows: Dict[str, object] = {}     # flow/v1alpha1 JobFlow
         self.jobtemplates: Dict[str, object] = {} # flow/v1alpha1 JobTemplate
+        self.numatopologies: Dict[str, object] = {}  # nodeinfo/v1alpha1
         self.services: Dict[str, dict] = {}       # svc plugin artifacts
         self.config_maps: Dict[str, dict] = {}
         self.secrets: Dict[str, dict] = {}
@@ -108,6 +109,11 @@ class FakeCluster(Cluster):
         with self._lock:
             self.hypernodes[hn.name] = hn
         self._notify("hypernode", hn)
+
+    def add_numatopology(self, topo):
+        with self._lock:
+            self.numatopologies[topo.name] = topo
+        self._notify("numatopology", topo)
 
     # -- command bus (bus/v1alpha1 Command CRD analogue) ---------------
 

@@ -50,6 +50,89 @@ def parse_exposition(text: str) -> Dict[Tuple[str, str], float]:
     return out
 
 
+class ElasticsearchUsageSource(UsageProvider):
+    """Queries an Elasticsearch metricbeat-style index for per-node
+    usage (reference: metrics_client_elasticsearch.go — avg of
+    system.cpu/memory pct over a trailing window, one search per
+    refresh).
+
+    Issues one `_search` POST with a terms-by-hostname aggregation and
+    avg sub-aggregations, so a cluster of N nodes costs one round trip:
+
+        POST {url}/{index}/_search
+        {"size": 0, "query": {"range": {"@timestamp": {"gte": "now-Xs"}}},
+         "aggs": {"nodes": {"terms": {"field": "host.hostname", ...},
+                  "aggs": {"cpu": {"avg": {"field": <cpu_field>}},
+                           "mem": {"avg": {"field": <mem_field>}}}}}}
+    """
+
+    def __init__(self, url: str, index: str = "metricbeat-*",
+                 cpu_field: str = "system.cpu.total.norm.pct",
+                 mem_field: str = "system.memory.actual.used.pct",
+                 hostname_field: str = "host.hostname",
+                 window_s: float = 300.0,
+                 timeout: float = 5.0,
+                 stale_after: float = 120.0):
+        self.url = url.rstrip("/")
+        self.index = index
+        self.cpu_field = cpu_field
+        self.mem_field = mem_field
+        self.hostname_field = hostname_field
+        self.window_s = window_s
+        self.timeout = timeout
+        self.stale_after = stale_after
+        self._usage: Dict[str, NodeUsage] = {}
+        self._last_success = 0.0
+
+    def _query(self) -> bytes:
+        import json
+        return json.dumps({
+            "size": 0,
+            "query": {"range": {"@timestamp": {
+                "gte": f"now-{int(self.window_s)}s"}}},
+            "aggs": {"nodes": {
+                "terms": {"field": self.hostname_field, "size": 10000},
+                "aggs": {"cpu": {"avg": {"field": self.cpu_field}},
+                         "mem": {"avg": {"field": self.mem_field}}}}},
+        }).encode()
+
+    def refresh(self) -> bool:
+        import json
+        import time
+        req = urllib.request.Request(
+            f"{self.url}/{self.index}/_search", data=self._query(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                body = json.loads(resp.read().decode())
+        except Exception as e:  # noqa: BLE001 - degrade, don't crash
+            log.warning("es usage query to %s failed: %s", self.url, e)
+            return False
+        usage: Dict[str, NodeUsage] = {}
+        buckets = (body.get("aggregations", {})
+                   .get("nodes", {}).get("buckets", []))
+        for b in buckets:
+            name = b.get("key")
+            if not name:
+                continue
+            cpu = (b.get("cpu") or {}).get("value")
+            mem = (b.get("mem") or {}).get("value")
+            usage[name] = NodeUsage(
+                cpu_fraction=float(cpu) if cpu is not None else 0.0,
+                memory_fraction=float(mem) if mem is not None else 0.0)
+        self._usage = usage
+        self._last_success = time.time()
+        return True
+
+    def usage(self, node_name: str) -> NodeUsage:
+        import time
+        if time.time() - self._last_success > self.stale_after:
+            # same TTL contract as the Prometheus source: a dead
+            # backend must read as "unknown", never as stale pressure
+            return NodeUsage()
+        return self._usage.get(node_name, NodeUsage())
+
+
 class PrometheusUsageSource(UsageProvider):
     """Scrapes a Prometheus-format endpoint for per-node usage."""
 

@@ -3,32 +3,61 @@
 Reference parity: plugins/numaaware/numaaware.go:85,169,191 (NUMA fit
 from the Numatopology CRD with topology-manager policies).  TPU-first
 reading (SURVEY.md §2.3 mapping): on a TPU host the relevant locality
-is cpu-NUMA-node to PCIe-attached chips; nodes publish their NUMA
-inventory via annotations and pods opt into a policy:
+is cpu-NUMA-node to PCIe-attached chips.
 
-  node annotation  numa.volcano-tpu.io/nodes:
-      '{"0": {"cpu": 56, "tpu": 2}, "1": {"cpu": 56, "tpu": 2}}'
-  pod annotation   numa.volcano-tpu.io/policy:
-      best-effort | single-numa-node
+Inventory sources, in preference order:
+  1. a `Numatopology` object published per node
+     (cluster.numatopologies[node] — api/numatopology.py).  Its
+     `numa_res` carries the node's CURRENT per-cell free amounts as
+     published by the node agent/exporter (reference semantics: the
+     resource-exporter refreshes the CRD from live cgroup state);
+     `res_reserved` is spread evenly across cells and subtracted.
+  2. legacy node annotation  numa.volcano-tpu.io/nodes:
+      '{"0": {"cpu": 4, "tpu": 2}, "1": {"cpu": 4, "tpu": 2}}'
+
+Between exporter refreshes the scheduler may place several pods on the
+same node in one session, so the plugin keeps a session-local copy of
+each node's cells and deducts every allocation from the best-fitting
+cell (reversed exactly on deallocate) — the gate checks free space,
+not capacity.
+
+The binding policy is the node's TopologyManagerPolicy; a pod may
+opt into a stricter one via  numa.volcano-tpu.io/policy:
+  best-effort | restricted | single-numa-node
+(restricted and single-numa-node both gate placement; best-effort
+only scores — matching the reference's policy semantics.)
 """
 
 from __future__ import annotations
 
 import json
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from volcano_tpu.api.fit_error import unschedulable
 from volcano_tpu.api.job_info import TaskInfo
 from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.api.numatopology import (
+    POLICY_BEST_EFFORT,
+    POLICY_NONE,
+    POLICY_RESTRICTED,
+    POLICY_SINGLE_NUMA,
+    TOPOLOGY_MANAGER_POLICY,
+    Numatopology,
+)
 from volcano_tpu.api.resource import TPU, parse_cpu
 from volcano_tpu.framework.plugins import Plugin, register_plugin
+from volcano_tpu.framework.session import EventHandler
 
 NUMA_NODES_ANNOTATION = "numa.volcano-tpu.io/nodes"
 NUMA_POLICY_ANNOTATION = "numa.volcano-tpu.io/policy"
 MAX_SCORE = 100.0
 
+_GATING = (POLICY_RESTRICTED, POLICY_SINGLE_NUMA)
+_KNOWN = (POLICY_BEST_EFFORT, POLICY_RESTRICTED, POLICY_SINGLE_NUMA)
+
 
 def numa_inventory(node: NodeInfo) -> Optional[Dict[str, Dict[str, float]]]:
+    """Legacy annotation inventory: {cell: {"cpu": cores, "tpu": chips}}."""
     if node.node is None:
         return None
     raw = node.node.annotations.get(NUMA_NODES_ANNOTATION)
@@ -45,38 +74,136 @@ class NumaAwarePlugin(Plugin):
     name = "numaaware"
 
     def on_session_open(self, ssn):
+        self._ssn = ssn
+        self._topologies: Dict[str, Numatopology] = dict(
+            getattr(ssn.cache.cluster, "numatopologies", {}) or {})
+        # node -> [[cpu_free_millis, tpu_free], ...] live for this session
+        self._cells: Dict[str, Optional[List[List[float]]]] = {}
+        # task uid -> [(node, cell index, cpu, tpu)] for exact reversal
+        self._deducted: Dict[str, List[Tuple[str, int, float, float]]] = {}
         ssn.add_predicate_fn(self.name, self._predicate)
         ssn.add_node_order_fn(self.name, self._score)
+        ssn.add_event_handler(EventHandler(
+            allocate_fn=self._on_allocate,
+            deallocate_fn=self._on_deallocate))
 
-    @staticmethod
-    def _fits_single_numa(task: TaskInfo, inventory) -> bool:
-        need_cpu = task.resreq.milli_cpu
-        need_tpu = task.resreq.get(TPU)
-        for numa in inventory.values():
-            cpu_cap = parse_cpu(numa.get("cpu", 0))
-            tpu_cap = float(numa.get("tpu", 0))
-            if need_cpu <= cpu_cap and need_tpu <= tpu_cap:
-                return True
-        return False
+    # -- live inventory -----------------------------------------------
 
-    def _predicate(self, task: TaskInfo, node: NodeInfo):
-        policy = task.pod.annotations.get(NUMA_POLICY_ANNOTATION)
-        if policy != "single-numa-node":
-            return None
+    def _build_cells(self, node: NodeInfo) -> Optional[List[List[float]]]:
+        topo = self._topologies.get(node.name)
+        if topo is not None:
+            cells = topo.cells()
+            if cells:
+                res_cpu = float(topo.res_reserved.get("cpu", 0.0))
+                res_tpu = float(topo.res_reserved.get(TPU, 0.0))
+                n = len(cells)
+                return [[max(0.0, topo.cell_free("cpu", c) - res_cpu / n),
+                         max(0.0, topo.cell_free(TPU, c) - res_tpu / n)]
+                        for c in cells]
         inventory = numa_inventory(node)
         if inventory is None:
+            return None
+        return [[parse_cpu(numa.get("cpu", 0)), float(numa.get("tpu", 0))]
+                for numa in inventory.values()]
+
+    def _live_cells(self, node: NodeInfo) -> Optional[List[List[float]]]:
+        if node.name not in self._cells:
+            self._cells[node.name] = self._build_cells(node)
+        return self._cells[node.name]
+
+    # -- allocation bookkeeping ---------------------------------------
+
+    def _on_allocate(self, event) -> None:
+        task = event.task
+        node = self._ssn.nodes.get(task.node_name)
+        if node is None:
+            return
+        cells = self._live_cells(node)
+        if not cells:
+            return
+        need_cpu = task.resreq.milli_cpu
+        need_tpu = task.resreq.get(TPU)
+        taken: List[Tuple[str, int, float, float]] = []
+        # best-fit: the tightest cell that holds the whole request, so
+        # large cells stay whole for later single-numa tasks
+        fitting = [(cpu + tpu, i) for i, (cpu, tpu) in enumerate(cells)
+                   if need_cpu <= cpu and need_tpu <= tpu]
+        if fitting:
+            _, i = min(fitting)
+            cells[i][0] -= need_cpu
+            cells[i][1] -= need_tpu
+            taken.append((node.name, i, need_cpu, need_tpu))
+        else:
+            # task spans cells (permitted under none/best-effort):
+            # drain largest-first so the deduction mirrors how the
+            # kubelet would actually spread it
+            for i in sorted(range(len(cells)),
+                            key=lambda j: -(cells[j][0] + cells[j][1])):
+                if need_cpu <= 0 and need_tpu <= 0:
+                    break
+                d_cpu = min(need_cpu, cells[i][0])
+                d_tpu = min(need_tpu, cells[i][1])
+                if d_cpu <= 0 and d_tpu <= 0:
+                    continue
+                cells[i][0] -= d_cpu
+                cells[i][1] -= d_tpu
+                need_cpu -= d_cpu
+                need_tpu -= d_tpu
+                taken.append((node.name, i, d_cpu, d_tpu))
+        if taken:
+            self._deducted.setdefault(task.uid, []).extend(taken)
+
+    def _on_deallocate(self, event) -> None:
+        for node_name, i, cpu, tpu in self._deducted.pop(
+                event.task.uid, []):
+            cells = self._cells.get(node_name)
+            if cells and i < len(cells):
+                cells[i][0] += cpu
+                cells[i][1] += tpu
+
+    # -- policy -------------------------------------------------------
+
+    def _node_policy(self, node: NodeInfo) -> str:
+        topo = self._topologies.get(node.name)
+        if topo is None:
+            return POLICY_NONE
+        return topo.policies.get(TOPOLOGY_MANAGER_POLICY, POLICY_NONE)
+
+    def _effective_policy(self, task: TaskInfo, node: NodeInfo) -> str:
+        """Strictest of the node's kubelet policy and the pod's opt-in."""
+        pod = task.pod.annotations.get(NUMA_POLICY_ANNOTATION, POLICY_NONE)
+        node_p = self._node_policy(node)
+        order = (POLICY_NONE, POLICY_BEST_EFFORT, POLICY_RESTRICTED,
+                 POLICY_SINGLE_NUMA)
+        pod_rank = order.index(pod) if pod in order else 0
+        node_rank = order.index(node_p) if node_p in order else 0
+        return order[max(pod_rank, node_rank)]
+
+    @staticmethod
+    def _fits_single_numa(task: TaskInfo, cells) -> bool:
+        need_cpu = task.resreq.milli_cpu
+        need_tpu = task.resreq.get(TPU)
+        return any(need_cpu <= cpu_free and need_tpu <= tpu_free
+                   for cpu_free, tpu_free in cells)
+
+    # -- session hooks ------------------------------------------------
+
+    def _predicate(self, task: TaskInfo, node: NodeInfo):
+        if self._effective_policy(task, node) not in _GATING:
+            return None
+        cells = self._live_cells(node)
+        if cells is None:
             return None  # no topology published: don't block
-        if not self._fits_single_numa(task, inventory):
+        if not self._fits_single_numa(task, cells):
             return unschedulable(
                 "request cannot fit a single NUMA node", "numaaware",
                 resolvable=False)
         return None
 
     def _score(self, task: TaskInfo, node: NodeInfo) -> float:
-        policy = task.pod.annotations.get(NUMA_POLICY_ANNOTATION)
-        if policy not in ("best-effort", "single-numa-node"):
+        if self._effective_policy(task, node) not in _KNOWN:
             return 0.0
-        inventory = numa_inventory(node)
-        if inventory is None:
+        cells = self._live_cells(node)
+        if cells is None:
             return 0.0
-        return MAX_SCORE if self._fits_single_numa(task, inventory) else 0.0
+        return MAX_SCORE if self._fits_single_numa(task, cells) else 0.0
