@@ -321,3 +321,244 @@ def test_numatopology_res_reserved_shrinks_cells():
                       conf=conf_with("numaaware"))
     ctx.run()
     ctx.expect_bind_num(0)
+
+
+def test_numatopology_agent_republish_across_cycles():
+    """The node agent is the exporter: pods bound in earlier cycles
+    shrink the published free cells, so a third 6-cpu single-numa pod
+    is gated in cycle 3 even though sessions are fresh each cycle."""
+    from volcano_tpu.agent import FakeUsageProvider, NodeAgent
+    from volcano_tpu.api.numatopology import Numatopology
+    from volcano_tpu.api.types import TaskStatus
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    from volcano_tpu.scheduler import Scheduler
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="host",
+                          allocatable={"cpu": 20, "pods": 110}))
+    cap = {"cpu": {"0": 8000.0, "1": 8000.0},
+           "google.com/tpu": {"0": 0.0, "1": 0.0}}
+    cluster.add_numatopology(Numatopology(
+        name="host", numa_res={k: dict(v) for k, v in cap.items()},
+        policies={"TopologyManagerPolicy": "single-numa-node"},
+        capacity_res=cap))
+    agent = NodeAgent(cluster, "host", FakeUsageProvider())
+    sched = Scheduler(cluster, schedule_period=0, conf=conf_with(
+        "numaaware"))
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.types import GROUP_NAME_ANNOTATION
+    pg, _ = gang_job("one", replicas=0, min_available=1)
+    cluster.add_podgroup(pg)
+    for cycle in range(3):
+        cluster.add_pod(make_pod(
+            f"one-{cycle}", requests={"cpu": 6},
+            annotations={GROUP_NAME_ANNOTATION: "one"}))
+        sched.run_once()
+        cluster.tick()          # bound -> running
+        agent.sync()            # exporter republishes free cells
+    assert len(cluster.binds) == 2, cluster.binds
+    free = cluster.numatopologies["host"].numa_res["cpu"]
+    assert sorted(free.values()) == [2000.0, 2000.0]
+
+
+def test_numaaware_discarded_preempt_leaves_cells_intact():
+    """evict(victim) -> unevict on statement discard must net to zero
+    cell deduction: a later single-numa pod that fits must still fit."""
+    from volcano_tpu.api.numatopology import Numatopology
+    from volcano_tpu.cache.cache import SchedulerCache
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    from volcano_tpu.conf import load_conf
+    from volcano_tpu.framework.framework import close_session, \
+        open_session
+    from volcano_tpu.framework.statement import Statement
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.types import GROUP_NAME_ANNOTATION, TaskStatus
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="host",
+                          allocatable={"cpu": 20, "pods": 110}))
+    cluster.add_numatopology(Numatopology(
+        name="host", numa_res={"cpu": {"0": 8000.0}},
+        policies={"TopologyManagerPolicy": "single-numa-node"}))
+    pg_v, _ = gang_job("victim", replicas=0, min_available=1)
+    pg_n, _ = gang_job("newcomer", replicas=0, min_available=1)
+    cluster.add_podgroup(pg_v)
+    cluster.add_podgroup(pg_n)
+    vic = make_pod("victim-0", requests={"cpu": 6}, node_name="host",
+                   phase=TaskStatus.RUNNING,
+                   annotations={GROUP_NAME_ANNOTATION: "victim"})
+    new = make_pod("newcomer-0", requests={"cpu": 6},
+                   annotations={GROUP_NAME_ANNOTATION: "newcomer"})
+    cluster.add_pod(vic)
+    cluster.add_pod(new)
+    ssn = open_session(SchedulerCache(cluster), load_conf(
+        conf_with("numaaware")))
+    tasks = {t.name: t for j in ssn.jobs.values()
+             for t in j.tasks.values()}
+    node = ssn.nodes["host"]
+    stmt = Statement(ssn)
+    stmt.evict(tasks["victim-0"], "trial")
+    stmt.discard()   # abandoned preemption: unevict fires allocate
+    assert ssn.predicate(tasks["newcomer-0"], node) is None, \
+        "discarded preempt leaked a phantom NUMA deduction"
+    close_session(ssn)
+
+
+def test_numaaware_preemption_frees_occupied_cell():
+    """A high-priority single-numa pod preempts a BE victim out of a
+    fully-occupied cell: the resolvable gate lets preempt try the
+    node, eviction credits the victim's cell, the preemptor lands."""
+    from volcano_tpu.api.numatopology import Numatopology
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.types import GROUP_NAME_ANNOTATION, \
+        PodGroupPhase, TaskStatus
+    from volcano_tpu.cache.cluster import PriorityClass
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="host",
+                          allocatable={"cpu": 8, "pods": 110}))
+    # exporter already accounted the running victim: cell free = 2000
+    cluster.add_numatopology(Numatopology(
+        name="host", numa_res={"cpu": {"0": 2000.0}},
+        policies={"TopologyManagerPolicy": "single-numa-node"}))
+    cluster.add_priority_class(PriorityClass("high", 1000))
+    # min_available=0: an elastic victim whose gang floor survives
+    # the eviction (gang's preemptable veto protects the floor)
+    pg_v, _ = gang_job("victim", replicas=0, min_available=0,
+                       pg_phase=PodGroupPhase.RUNNING)
+    pg_h, _ = gang_job("hi", replicas=0, min_available=1,
+                       priority_class="high",
+                       pg_phase=PodGroupPhase.INQUEUE)
+    cluster.add_podgroup(pg_v)
+    cluster.add_podgroup(pg_h)
+    vic = make_pod("victim-0", requests={"cpu": 6}, node_name="host",
+                   phase=TaskStatus.RUNNING,
+                   annotations={GROUP_NAME_ANNOTATION: "victim",
+                                "volcano-tpu.io/preemptable": "true"})
+    hi = make_pod("hi-0", requests={"cpu": 6},
+                  annotations={GROUP_NAME_ANNOTATION: "hi"})
+    cluster.add_pod(vic)
+    cluster.add_pod(hi)
+    ctx = TestContext(cluster=cluster, conf=conf_with(
+        "priority", "numaaware", actions="enqueue, allocate, preempt"))
+    ctx.run()
+    assert cluster.evictions == ["default/victim-0"], cluster.evictions
+
+
+def test_numaaware_oversized_request_never_triggers_eviction():
+    """A request bigger than EVERY cell's capacity is unresolvable:
+    preempt must not churn victims it can never benefit from."""
+    from volcano_tpu.api.numatopology import Numatopology
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.types import GROUP_NAME_ANNOTATION, \
+        PodGroupPhase, TaskStatus
+    from volcano_tpu.cache.cluster import PriorityClass
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="host",
+                          allocatable={"cpu": 8, "pods": 110}))
+    cap = {"cpu": {"0": 4000.0, "1": 4000.0},
+           "google.com/tpu": {"0": 0.0, "1": 0.0}}
+    cluster.add_numatopology(Numatopology(
+        name="host", numa_res={"cpu": {"0": 1000.0, "1": 1000.0}},
+        policies={"TopologyManagerPolicy": "single-numa-node"},
+        capacity_res=cap))
+    cluster.add_priority_class(PriorityClass("high", 1000))
+    pg_v, _ = gang_job("victim", replicas=0, min_available=0,
+                       pg_phase=PodGroupPhase.RUNNING)
+    pg_h, _ = gang_job("hi", replicas=0, min_available=1,
+                       priority_class="high",
+                       pg_phase=PodGroupPhase.INQUEUE)
+    cluster.add_podgroup(pg_v)
+    cluster.add_podgroup(pg_h)
+    for i in range(2):
+        cluster.add_pod(make_pod(
+            f"victim-{i}", requests={"cpu": 3}, node_name="host",
+            phase=TaskStatus.RUNNING,
+            annotations={GROUP_NAME_ANNOTATION: "victim",
+                         "volcano-tpu.io/preemptable": "true"}))
+    cluster.add_pod(make_pod(
+        "hi-0", requests={"cpu": 6},
+        annotations={GROUP_NAME_ANNOTATION: "hi"}))
+    ctx = TestContext(cluster=cluster, conf=conf_with(
+        "priority", "numaaware", actions="enqueue, allocate, preempt"))
+    ctx.run()
+    ctx.expect_evict_num(0)     # 6000m can never fit a 4000m cell
+    ctx.expect_bind_num(0)
+
+
+def test_preempt_rolls_back_uncured_evictions():
+    """Victims whose eviction does NOT cure the waved-through failure
+    are rolled back, not committed: cells [1000,1000] with capacity
+    [4000,4000], 3500m preemptor, but the only victims are 500m each —
+    evicting all of them still leaves no 3500m cell, so nothing is
+    evicted."""
+    from volcano_tpu.api.numatopology import Numatopology
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.types import GROUP_NAME_ANNOTATION, \
+        PodGroupPhase, TaskStatus
+    from volcano_tpu.cache.cluster import PriorityClass
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="host",
+                          allocatable={"cpu": 8, "pods": 110}))
+    cap = {"cpu": {"0": 4000.0, "1": 4000.0},
+           "google.com/tpu": {"0": 0.0, "1": 0.0}}
+    cluster.add_numatopology(Numatopology(
+        name="host", numa_res={"cpu": {"0": 1000.0, "1": 1000.0}},
+        policies={"TopologyManagerPolicy": "single-numa-node"},
+        capacity_res=cap))
+    cluster.add_priority_class(PriorityClass("high", 1000))
+    pg_v, _ = gang_job("victim", replicas=0, min_available=0,
+                       pg_phase=PodGroupPhase.RUNNING)
+    pg_h, _ = gang_job("hi", replicas=0, min_available=1,
+                       priority_class="high",
+                       pg_phase=PodGroupPhase.INQUEUE)
+    cluster.add_podgroup(pg_v)
+    cluster.add_podgroup(pg_h)
+    for i in range(2):
+        cluster.add_pod(make_pod(
+            f"victim-{i}", requests={"cpu": 0.5}, node_name="host",
+            phase=TaskStatus.RUNNING,
+            annotations={GROUP_NAME_ANNOTATION: "victim",
+                         "volcano-tpu.io/preemptable": "true"}))
+    cluster.add_pod(make_pod(
+        "hi-0", requests={"cpu": 3.5},
+        annotations={GROUP_NAME_ANNOTATION: "hi"}))
+    ctx = TestContext(cluster=cluster, conf=conf_with(
+        "priority", "numaaware", actions="enqueue, allocate, preempt"))
+    ctx.run()
+    ctx.expect_evict_num(0)     # uncured evictions rolled back
+
+
+def test_preempt_skips_non_evict_curable_resolvable_failures():
+    """A usage-threshold failure is resolvable but not curable by
+    eviction: preempt must skip the node (no victim churn) exactly as
+    it did before predicate_for_preempt existed."""
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.types import GROUP_NAME_ANNOTATION, \
+        PodGroupPhase, TaskStatus
+    from volcano_tpu.cache.cluster import PriorityClass
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    cluster = FakeCluster()
+    cluster.add_node(Node(
+        name="hot", allocatable={"cpu": 8, "pods": 110},
+        annotations={"usage.volcano-tpu.io/cpu": "0.99"}))
+    cluster.add_priority_class(PriorityClass("high", 1000))
+    pg_v, _ = gang_job("victim", replicas=0, min_available=0,
+                       pg_phase=PodGroupPhase.RUNNING)
+    pg_h, _ = gang_job("hi", replicas=0, min_available=1,
+                       priority_class="high",
+                       pg_phase=PodGroupPhase.INQUEUE)
+    cluster.add_podgroup(pg_v)
+    cluster.add_podgroup(pg_h)
+    cluster.add_pod(make_pod(
+        "victim-0", requests={"cpu": 6}, node_name="hot",
+        phase=TaskStatus.RUNNING,
+        annotations={GROUP_NAME_ANNOTATION: "victim",
+                     "volcano-tpu.io/preemptable": "true"}))
+    cluster.add_pod(make_pod(
+        "hi-0", requests={"cpu": 6},
+        annotations={GROUP_NAME_ANNOTATION: "hi"}))
+    ctx = TestContext(cluster=cluster, conf=conf_with(
+        "priority", "usage", actions="enqueue, allocate, preempt"))
+    ctx.run()
+    ctx.expect_evict_num(0)   # over-threshold node: skip, don't churn

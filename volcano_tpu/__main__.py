@@ -37,6 +37,12 @@ def main(argv=None) -> int:
     parser.add_argument("--controllers", default="job,podgroup,queue,"
                         "hypernode,garbagecollector,jobflow,cronjob,"
                         "sharding,hyperjob")
+    parser.add_argument("--node-agents", default="",
+                        help="run per-node QoS agents: 'all' or a "
+                             "comma-separated list of node names")
+    parser.add_argument("--usage-source", default="",
+                        help="agent usage backend: prometheus:URL or "
+                             "es:URL (default: static zeros)")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -72,6 +78,56 @@ def main(argv=None) -> int:
         from volcano_tpu.agentscheduler import AgentScheduler
         agent_sched = AgentScheduler(cluster)
 
+    usage_source = None
+    node_agents = {}
+    if args.node_agents:
+        from volcano_tpu.agent import FakeUsageProvider, NodeAgent
+
+        if args.usage_source:
+            kind, _, url = args.usage_source.partition(":")
+            from volcano_tpu import metrics_source
+            if kind == "prometheus" and url:
+                usage_source = metrics_source.PrometheusUsageSource(url)
+            elif kind == "es" and url:
+                usage_source = metrics_source.ElasticsearchUsageSource(url)
+            else:
+                parser.error(f"unknown --usage-source {args.usage_source!r}"
+                             " (want prometheus:URL or es:URL)")
+        if usage_source is not None:
+            provider = usage_source
+            agent_kwargs = {}
+        else:
+            # no backend: agents still report/cordon on injected data,
+            # but must not fabricate oversubscription slack from the
+            # provider's static zero usage (60% of every node would
+            # become phantom schedulable capacity)
+            log.warning("--node-agents without --usage-source: "
+                        "oversubscription reporting disabled")
+            provider = FakeUsageProvider()
+            agent_kwargs = {"oversub_factor": 0.0}
+        wanted = args.node_agents
+
+        def sync_node_agents():
+            # refreshes happen on the background thread below: a slow
+            # or dead backend must never stall the scheduling loop
+            # (the source's stale TTL degrades reads to zeros)
+            names = (cluster.nodes.keys() if wanted == "all"
+                     else [n.strip() for n in wanted.split(",")
+                           if n.strip()])
+            for name in names:
+                if name not in node_agents and name in cluster.nodes:
+                    node_agents[name] = NodeAgent(cluster, name, provider,
+                                                  **agent_kwargs)
+            for agent in node_agents.values():
+                agent.sync()
+    else:
+        if args.usage_source:
+            log.warning("--usage-source has no effect without "
+                        "--node-agents")
+
+        def sync_node_agents():
+            pass
+
     Dumper(sched).listen_for_signal()
     server = None
     if args.metrics_port:
@@ -86,13 +142,34 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
     signal.signal(signal.SIGINT, lambda *_: stop.set())
 
-    log.info("control plane up: %d nodes, %d controllers%s",
+    if usage_source is not None:
+        # one synchronous refresh so the first cycle sees real data
+        # (bounded by the source's own timeout), then move off-loop
+        usage_source.refresh()
+
+        # cadence must outpace the source's stale TTL or reads degrade
+        # to zeros between refreshes at long scheduling periods
+        ttl = getattr(usage_source, "stale_after", 60.0)
+        interval = max(2.0, min(args.period, ttl / 2.0))
+
+        def refresh_loop():
+            while not stop.is_set():
+                stop.wait(interval)
+                if not stop.is_set():
+                    usage_source.refresh()
+        threading.Thread(target=refresh_loop, name="usage-refresh",
+                         daemon=True).start()
+
+    log.info("control plane up: %d nodes, %d controllers%s%s",
              len(cluster.nodes), len(mgr.controllers),
-             ", agent scheduler" if agent_sched else "")
+             ", agent scheduler" if agent_sched else "",
+             f", node agents ({args.node_agents})"
+             if args.node_agents else "")
     cycles = 0
     clean_exit = False
     try:
         while not stop.is_set():
+            sync_node_agents()
             mgr.sync_all()
             sched.run_once()
             if agent_sched is not None:

@@ -89,10 +89,16 @@ class PreemptAction(Action):
         for node in ssn.nodes.values():
             if not node.ready:
                 continue
-            if ssn.predicate(task, node) is not None:
+            status, waved = ssn.predicate_for_preempt(task, node)
+            if status is not None:
                 continue
-            # no eviction needed if it already fits future idle
-            if task.init_resreq.less_equal(node.future_idle()):
+            # no eviction needed if it already fits future idle — but
+            # when a curable failure was waved through, the FULL
+            # predicate must agree (releasing resources doesn't cure
+            # it); with nothing waved the verdicts are identical and
+            # re-running the chain would be pure duplicate work
+            if task.init_resreq.less_equal(node.future_idle()) and \
+                    (not waved or ssn.predicate(task, node) is None):
                 stmt.pipeline(task, node)
                 return True
             candidates = [
@@ -109,13 +115,20 @@ class PreemptAction(Action):
             chosen = select_victims_on_node(ssn, task, node, victims)
             if chosen is None:
                 continue
+            mark = len(stmt.operations)
             for victim in chosen:
                 # evict through the session view of the victim task
                 vjob = ssn.jobs.get(victim.job)
                 vtask = vjob.tasks.get(victim.uid) if vjob else victim
                 stmt.evict(vtask or victim,
                            f"preempted by {task.key}")
-                metrics.inc("pod_preemption_total")
+            # the evictions must actually cure whatever curable failure
+            # was waved through (e.g. an occupied NUMA cell): otherwise
+            # we'd evict fresh victims every cycle without ever binding
+            if waved and ssn.predicate(task, node) is not None:
+                stmt.rollback_to(mark)
+                continue
+            metrics.inc("pod_preemption_total", len(chosen))
             stmt.pipeline(task, node)
             return True
         return False

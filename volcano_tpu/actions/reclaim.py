@@ -73,9 +73,11 @@ class ReclaimAction(Action):
         for node in ssn.nodes.values():
             if not node.ready:
                 continue
-            if ssn.predicate(task, node) is not None:
+            status, waved = ssn.predicate_for_preempt(task, node)
+            if status is not None:
                 continue
-            if task.init_resreq.less_equal(node.future_idle()):
+            if task.init_resreq.less_equal(node.future_idle()) and \
+                    (not waved or ssn.predicate(task, node) is None):
                 stmt.pipeline(task, node)
                 return True
             candidates = []
@@ -93,11 +95,18 @@ class ReclaimAction(Action):
             chosen = select_victims_on_node(ssn, task, node, victims)
             if chosen is None:
                 continue
+            mark = len(stmt.operations)
             for victim in chosen:
                 vjob = ssn.jobs.get(victim.job)
                 vtask = vjob.tasks.get(victim.uid) if vjob else victim
                 stmt.evict(vtask or victim, f"reclaimed by queue {queue.name}")
-                metrics.inc("pod_reclaim_total")
+            # evictions must cure the curable failure waved through by
+            # predicate_for_preempt, or this evicts every cycle
+            # without ever placing the reclaimer
+            if waved and ssn.predicate(task, node) is not None:
+                stmt.rollback_to(mark)
+                continue
+            metrics.inc("pod_reclaim_total", len(chosen))
             stmt.pipeline(task, node)
             return True
         return False

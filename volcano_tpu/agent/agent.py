@@ -100,6 +100,7 @@ class NodeAgent:
         self._report_oversubscription(node, usage)
         self._apply_cpu_qos(node, usage)
         self._apply_network_qos(node, usage)
+        self._refresh_numatopology()
         if max(usage.cpu_fraction, usage.memory_fraction) >= \
                 self.eviction_threshold:
             self._evict_best_effort(node)
@@ -120,7 +121,7 @@ class NodeAgent:
             f"{usage.memory_fraction:.3f}"
 
     def _report_tpu_health(self, node, usage: NodeUsage) -> None:
-        declared = Resource.from_resource_list(node.allocatable).get(TPU)
+        declared = self._allocatable(node).get(TPU)
         if usage.tpu_chips_detected == 0:
             # no chip telemetry from this provider (e.g. a usage-only
             # Prometheus source): never cordon on absence of data
@@ -149,7 +150,7 @@ class NodeAgent:
     def _report_oversubscription(self, node, usage: NodeUsage) -> None:
         """Publish reclaimable millicores in 10% steps
         (pkg/agent/oversubscription/policy/policy.go:40-61)."""
-        alloc = Resource.from_resource_list(node.allocatable)
+        alloc = self._allocatable(node)
         idle_frac = max(0.0, 1.0 - usage.cpu_fraction)
         stepped = int(idle_frac * 10) / 10.0   # 10% quantization
         reclaimable = alloc.milli_cpu * stepped * self.oversub_factor
@@ -218,6 +219,21 @@ class NodeAgent:
         for pod in other_pods:
             # a pod promoted out of BE must not keep a stale cap
             pod.annotations.pop(DCN_POD_LIMIT_ANNOTATION, None)
+
+    def _refresh_numatopology(self) -> None:
+        """Exporter half of the Numatopology contract
+        (api/numatopology.py): republish per-cell FREE amounts as
+        capacity minus the running pods' requests, so the scheduler's
+        single-NUMA gate sees placements from earlier cycles."""
+        topo = getattr(self.cluster, "numatopologies", {}).get(
+            self.node_name)
+        if topo is None:
+            return
+        reqs = []
+        for pod in self._running_pods():
+            r = pod.resource_requests()
+            reqs.append((r.milli_cpu, r.get(TPU)))
+        topo.recompute_free(reqs)
 
     def _evict_best_effort(self, node) -> None:
         for pod in self._running_pods():

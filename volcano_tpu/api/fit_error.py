@@ -20,13 +20,20 @@ class StatusCode(enum.IntEnum):
 
 
 class Status:
-    __slots__ = ("code", "reason", "plugin")
+    __slots__ = ("code", "reason", "plugin", "evict_curable")
 
     def __init__(self, code: StatusCode = StatusCode.SUCCESS,
-                 reason: str = "", plugin: str = ""):
+                 reason: str = "", plugin: str = "",
+                 evict_curable: bool = False):
         self.code = code
         self.reason = reason
         self.plugin = plugin
+        # True when evicting victims THIS session can flip the verdict
+        # (the plugin tracks in-session eviction effects, e.g.
+        # numaaware's cell crediting).  Resolvable-but-not-curable
+        # failures (usage thresholds, host ports held by RELEASING
+        # victims) are skipped by preempt rather than churned on.
+        self.evict_curable = evict_curable
 
     @property
     def ok(self) -> bool:
@@ -44,10 +51,12 @@ SUCCESS = Status()
 
 
 def unschedulable(reason: str, plugin: str = "",
-                  resolvable: bool = True) -> Status:
+                  resolvable: bool = True,
+                  evict_curable: bool = False) -> Status:
     code = (StatusCode.UNSCHEDULABLE if resolvable
             else StatusCode.UNSCHEDULABLE_AND_UNRESOLVABLE)
-    return Status(code, reason, plugin)
+    return Status(code, reason, plugin,
+                  evict_curable=resolvable and evict_curable)
 
 
 class FitError:

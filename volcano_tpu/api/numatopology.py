@@ -46,6 +46,10 @@ class Numatopology:
     policies: Dict[str, str] = field(default_factory=dict)
     # resources the kubelet holds back per node (not per cell)
     res_reserved: Dict[str, float] = field(default_factory=dict)
+    # static per-cell capacity; when set, the node agent acts as the
+    # exporter and recomputes numa_res from it each sync (see
+    # recompute_free)
+    capacity_res: Dict[str, Dict[str, float]] = field(default_factory=dict)
 
     def cell_free(self, resource: str, cell: str) -> float:
         return self.numa_res.get(resource, {}).get(cell, 0.0)
@@ -55,6 +59,78 @@ class Numatopology:
         for per_cell in self.numa_res.values():
             out.update(per_cell)
         return sorted(out)
+
+    def recompute_free(self, pod_requests) -> None:
+        """Exporter refresh: numa_res = capacity_res minus the running
+        pods' requests packed with deduct_request — the SAME algorithm
+        the numaaware plugin applies in-session, so the exporter's
+        published free cells and the scheduler's deductions agree by
+        construction.  pod_requests: iterable of (cpu_millis, tpu_chips).
+
+        No-op when capacity_res is unset — then numa_res is operator-
+        published and whoever publishes it owns its freshness.
+        """
+        if not self.capacity_res:
+            return
+        cells = sorted({c for per in self.capacity_res.values()
+                        for c in per})
+        free = [[self.capacity_res.get("cpu", {}).get(c, 0.0),
+                 self.capacity_res.get("google.com/tpu", {}).get(c, 0.0)]
+                for c in cells]
+        for cpu_m, tpu in sorted(pod_requests,
+                                 key=lambda r: -(r[0] + r[1])):
+            deduct_request(free, cpu_m, tpu)
+        # only the two tracked resources are recomputed; anything else
+        # published in capacity_res (or operator-set in numa_res) is
+        # carried through untouched rather than dropped
+        recomputed = {
+            "cpu": {c: free[i][0] for i, c in enumerate(cells)},
+            "google.com/tpu": {c: free[i][1]
+                               for i, c in enumerate(cells)},
+        }
+        for res, per_cell in self.capacity_res.items():
+            if res not in recomputed:
+                recomputed[res] = dict(per_cell)
+        for res, per_cell in self.numa_res.items():
+            if res not in recomputed:
+                recomputed[res] = per_cell
+        self.numa_res = recomputed
+
+
+def deduct_request(cells, need_cpu: float, need_tpu: float):
+    """Deduct one request from `cells` ([[cpu_free, tpu_free], ...])
+    in place: best-fit into the tightest cell that holds it whole,
+    else drain largest-first (how the kubelet would spread a request
+    no single cell can satisfy).  Returns [(index, dcpu, dtpu)]
+    actually taken — the exact-reversal record.
+
+    Single source of truth for the packing heuristic: the numaaware
+    plugin's in-session deductions and the node agent's exporter
+    refresh both call this, so their views never drift.
+    """
+    taken = []
+    fitting = [(cpu + tpu, i) for i, (cpu, tpu) in enumerate(cells)
+               if need_cpu <= cpu and need_tpu <= tpu]
+    if fitting:
+        _, i = min(fitting)
+        cells[i][0] -= need_cpu
+        cells[i][1] -= need_tpu
+        taken.append((i, need_cpu, need_tpu))
+        return taken
+    for i in sorted(range(len(cells)),
+                    key=lambda j: -(cells[j][0] + cells[j][1])):
+        if need_cpu <= 0 and need_tpu <= 0:
+            break
+        d_cpu = min(need_cpu, cells[i][0])
+        d_tpu = min(need_tpu, cells[i][1])
+        if d_cpu <= 0 and d_tpu <= 0:
+            continue
+        cells[i][0] -= d_cpu
+        cells[i][1] -= d_tpu
+        need_cpu -= d_cpu
+        need_tpu -= d_tpu
+        taken.append((i, d_cpu, d_tpu))
+    return taken
 
 
 def tpu_host_numatopology(node_name: str, cpu_millis: float,
@@ -73,4 +149,6 @@ def tpu_host_numatopology(node_name: str, cpu_millis: float,
                            for i, c in enumerate(cells)},
     }
     return Numatopology(name=node_name, numa_res=numa_res,
-                        policies={TOPOLOGY_MANAGER_POLICY: policy})
+                        policies={TOPOLOGY_MANAGER_POLICY: policy},
+                        capacity_res={k: dict(v)
+                                      for k, v in numa_res.items()})

@@ -21,7 +21,7 @@ import uuid
 from collections import defaultdict
 from typing import Callable, Dict, List, Optional, Set
 
-from volcano_tpu.api.fit_error import FitErrors
+from volcano_tpu.api.fit_error import FitErrors, StatusCode
 from volcano_tpu.api.job_info import JobInfo, TaskInfo
 from volcano_tpu.api.node_info import NodeInfo
 from volcano_tpu.api.queue_info import QueueInfo
@@ -301,14 +301,43 @@ class Session:
                     return st
         return None
 
-    def predicate(self, task: TaskInfo, node: NodeInfo):
-        """Returns None if task fits node, else a non-ok Status."""
+    def _run_predicates(self, task: TaskInfo, node: NodeInfo,
+                        allow_resolvable: bool):
+        """Returns (status, waved): status is the first fatal non-ok
+        verdict (or None), waved is True when an evict-curable failure
+        was skipped under allow_resolvable."""
+        waved = False
         for tier_fns in self._enabled_fns("predicate"):
             for _, fn in tier_fns:
                 st = fn(task, node)
-                if st is not None and not st.ok:
-                    return st
-        return None
+                if st is None or st.ok:
+                    continue
+                if allow_resolvable and \
+                        st.code is StatusCode.UNSCHEDULABLE and \
+                        getattr(st, "evict_curable", False):
+                    waved = True
+                    continue
+                return st, waved
+        return None, waved
+
+    def predicate(self, task: TaskInfo, node: NodeInfo):
+        """Returns None if task fits node, else a non-ok Status."""
+        return self._run_predicates(task, node, allow_resolvable=False)[0]
+
+    def predicate_for_preempt(self, task: TaskInfo, node: NodeInfo):
+        """Predicate for eviction-flavored actions (reference
+        PredicateForPreemptAction): an UNSCHEDULABLE verdict the
+        issuing plugin marked evict_curable passes — evicting victims
+        this session can flip it — while everything else (including
+        resolvable failures eviction can't observe curing, like usage
+        thresholds) rejects the node as in the normal path.
+
+        Returns (status, waved).  When waved is True a curable failure
+        was skipped, and the caller MUST re-run the full predicate()
+        against post-eviction state before committing a placement;
+        when False the verdict already equals the full predicate's, so
+        no re-check is needed."""
+        return self._run_predicates(task, node, allow_resolvable=True)
 
     def node_order(self, task: TaskInfo, node: NodeInfo) -> float:
         score = 0.0

@@ -43,6 +43,7 @@ from volcano_tpu.api.numatopology import (
     POLICY_SINGLE_NUMA,
     TOPOLOGY_MANAGER_POLICY,
     Numatopology,
+    deduct_request,
 )
 from volcano_tpu.api.resource import TPU, parse_cpu
 from volcano_tpu.framework.plugins import Plugin, register_plugin
@@ -79,8 +80,18 @@ class NumaAwarePlugin(Plugin):
             getattr(ssn.cache.cluster, "numatopologies", {}) or {})
         # node -> [[cpu_free_millis, tpu_free], ...] live for this session
         self._cells: Dict[str, Optional[List[List[float]]]] = {}
+        # node -> reserved-adjusted per-cell capacity ceilings (only
+        # when the topology publishes capacity_res)
+        self._cell_caps: Dict[str, List[List[float]]] = {}
         # task uid -> [(node, cell index, cpu, tpu)] for exact reversal
         self._deducted: Dict[str, List[Tuple[str, int, float, float]]] = {}
+        # running victims evicted this session: their consumption was
+        # never in our cells (exporter free excludes running pods), so
+        # eviction CREDITS their request to the least-free cell (in the
+        # single-NUMA regime that is where the victim almost surely
+        # sat) — letting preempt free a cell — and the matching unevict
+        # on statement discard reverses the credit exactly
+        self._credited: Dict[str, List[Tuple[str, int, float, float]]] = {}
         ssn.add_predicate_fn(self.name, self._predicate)
         ssn.add_node_order_fn(self.name, self._score)
         ssn.add_event_handler(EventHandler(
@@ -97,6 +108,14 @@ class NumaAwarePlugin(Plugin):
                 res_cpu = float(topo.res_reserved.get("cpu", 0.0))
                 res_tpu = float(topo.res_reserved.get(TPU, 0.0))
                 n = len(cells)
+                if topo.capacity_res:
+                    # reserved-adjusted ceilings for eviction credits
+                    self._cell_caps[node.name] = [
+                        [max(0.0, topo.capacity_res.get("cpu", {})
+                             .get(c, 0.0) - res_cpu / n),
+                         max(0.0, topo.capacity_res.get(TPU, {})
+                             .get(c, 0.0) - res_tpu / n)]
+                        for c in cells]
                 return [[max(0.0, topo.cell_free("cpu", c) - res_cpu / n),
                          max(0.0, topo.cell_free(TPU, c) - res_tpu / n)]
                         for c in cells]
@@ -115,47 +134,67 @@ class NumaAwarePlugin(Plugin):
 
     def _on_allocate(self, event) -> None:
         task = event.task
+        credited = self._credited.pop(task.uid, None)
+        if credited is not None and credited and \
+                credited[0][0] == task.node_name:
+            # unevict of a running victim back onto its node (statement
+            # discard): reverse the eviction credit exactly
+            for node_name, i, cpu, tpu in credited:
+                cells = self._cells.get(node_name)
+                if cells and i < len(cells):
+                    cells[i][0] -= cpu
+                    cells[i][1] -= tpu
+            return
         node = self._ssn.nodes.get(task.node_name)
         if node is None:
             return
         cells = self._live_cells(node)
         if not cells:
             return
-        need_cpu = task.resreq.milli_cpu
-        need_tpu = task.resreq.get(TPU)
-        taken: List[Tuple[str, int, float, float]] = []
-        # best-fit: the tightest cell that holds the whole request, so
-        # large cells stay whole for later single-numa tasks
-        fitting = [(cpu + tpu, i) for i, (cpu, tpu) in enumerate(cells)
-                   if need_cpu <= cpu and need_tpu <= tpu]
-        if fitting:
-            _, i = min(fitting)
-            cells[i][0] -= need_cpu
-            cells[i][1] -= need_tpu
-            taken.append((node.name, i, need_cpu, need_tpu))
-        else:
-            # task spans cells (permitted under none/best-effort):
-            # drain largest-first so the deduction mirrors how the
-            # kubelet would actually spread it
-            for i in sorted(range(len(cells)),
-                            key=lambda j: -(cells[j][0] + cells[j][1])):
-                if need_cpu <= 0 and need_tpu <= 0:
-                    break
-                d_cpu = min(need_cpu, cells[i][0])
-                d_tpu = min(need_tpu, cells[i][1])
-                if d_cpu <= 0 and d_tpu <= 0:
-                    continue
-                cells[i][0] -= d_cpu
-                cells[i][1] -= d_tpu
-                need_cpu -= d_cpu
-                need_tpu -= d_tpu
-                taken.append((node.name, i, d_cpu, d_tpu))
-        if taken:
-            self._deducted.setdefault(task.uid, []).extend(taken)
+        taken = deduct_request(cells, task.resreq.milli_cpu,
+                               task.resreq.get(TPU))
+        # record even an EMPTY deduction: the entry marks "allocated
+        # in-session", so a later deallocate of this task reverses
+        # exactly (possibly nothing) instead of falling into the
+        # running-victim credit path and fabricating free space
+        self._deducted.setdefault(task.uid, []).extend(
+            (node.name, i, cpu, tpu) for i, cpu, tpu in taken)
 
     def _on_deallocate(self, event) -> None:
-        for node_name, i, cpu, tpu in self._deducted.pop(
-                event.task.uid, []):
+        taken = self._deducted.pop(event.task.uid, None)
+        if taken is None:
+            # a RUNNING victim being evicted: its consumption is not in
+            # our cells (exporter free excludes running pods), so credit
+            # its request to the least-free cell — in the single-NUMA
+            # regime that is where it almost surely sat — and keep the
+            # record so a later unevict reverses this exactly
+            task = event.task
+            node = self._ssn.nodes.get(task.node_name or "")
+            if node is None:
+                return
+            cells = self._live_cells(node)
+            if not cells:
+                return
+            i = min(range(len(cells)),
+                    key=lambda j: cells[j][0] + cells[j][1])
+            cpu = task.resreq.milli_cpu
+            tpu = task.resreq.get(TPU)
+            caps = self._cell_caps.get(node.name)
+            if caps is not None and i < len(caps):
+                # a cell-spanning victim must not fabricate a phantom
+                # cell bigger than physical capacity — clamp the credit
+                # (and record only what was applied, so the unevict
+                # reversal stays exact).  Without capacity data there
+                # is no sound ceiling; the kubelet's single-NUMA
+                # admission remains the final arbiter there.
+                cpu = min(cpu, max(0.0, caps[i][0] - cells[i][0]))
+                tpu = min(tpu, max(0.0, caps[i][1] - cells[i][1]))
+            cells[i][0] += cpu
+            cells[i][1] += tpu
+            self._credited.setdefault(task.uid, []).append(
+                (node.name, i, cpu, tpu))
+            return
+        for node_name, i, cpu, tpu in taken:
             cells = self._cells.get(node_name)
             if cells and i < len(cells):
                 cells[i][0] += cpu
@@ -195,10 +234,39 @@ class NumaAwarePlugin(Plugin):
         if cells is None:
             return None  # no topology published: don't block
         if not self._fits_single_numa(task, cells):
+            # resolvable only if some cell's CAPACITY could hold the
+            # request — then eviction can free it (see _on_deallocate
+            # crediting).  A request bigger than every cell can never
+            # be cured by evicting victims; marking it resolvable
+            # would make preempt kill fresh victims every cycle.
             return unschedulable(
                 "request cannot fit a single NUMA node", "numaaware",
-                resolvable=False)
+                resolvable=self._fits_capacity(task, node),
+                evict_curable=True)
         return None
+
+    def _fits_capacity(self, task: TaskInfo, node: NodeInfo) -> bool:
+        """Could ANY cell ever hold this request?  Only a published
+        capacity_res can prove 'never' — published free values exclude
+        running victims, so without capacity data we stay permissive
+        (resolvable) and rely on the eviction-cure re-check in
+        preempt/reclaim to roll back evictions that don't help.
+        Ceilings are reserved-adjusted, mirroring _build_cells, so
+        preemption can never place into kubelet-reserved headroom that
+        the normal allocate path refuses."""
+        caps = self._cell_caps.get(node.name)
+        if caps is None:
+            topo = self._topologies.get(node.name)
+            if topo is None or not topo.capacity_res:
+                return True
+            self._live_cells(node)   # builds _cell_caps as a side effect
+            caps = self._cell_caps.get(node.name)
+            if caps is None:
+                return True
+        need_cpu = task.resreq.milli_cpu
+        need_tpu = task.resreq.get(TPU)
+        return any(need_cpu <= cap_cpu and need_tpu <= cap_tpu
+                   for cap_cpu, cap_tpu in caps)
 
     def _score(self, task: TaskInfo, node: NodeInfo) -> float:
         if self._effective_policy(task, node) not in _KNOWN:
