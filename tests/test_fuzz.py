@@ -1,0 +1,56 @@
+
+
+def test_fuzz_numa_deduct_reversal_exact():
+    """deduct_request records must reverse exactly: after any sequence
+    of deducts and replayed reversals, cells return to their initial
+    state bit-for-bit (the invariant the numaaware plugin and the
+    statement rollback machinery rely on)."""
+    import random
+    from volcano_tpu.api.numatopology import deduct_request
+    rng = random.Random(42)
+    for _ in range(300):
+        n = rng.randint(1, 4)
+        cells = [[float(rng.randint(0, 8000)), float(rng.randint(0, 8))]
+                 for _ in range(n)]
+        initial = [list(c) for c in cells]
+        log = []
+        for _ in range(rng.randint(1, 6)):
+            taken = deduct_request(cells, float(rng.randint(0, 6000)),
+                                   float(rng.randint(0, 6)))
+            log.append(taken)
+            for c in cells:
+                assert c[0] >= -1e-9 and c[1] >= -1e-9, \
+                    f"negative cell after deduct: {cells}"
+        for taken in reversed(log):
+            for i, cpu, tpu in reversed(taken):
+                cells[i][0] += cpu
+                cells[i][1] += tpu
+        assert cells == initial, (initial, cells)
+
+
+def test_fuzz_numa_exporter_vs_plugin_agreement():
+    """The exporter's recompute_free and the plugin's in-session
+    deductions are the same algorithm: republishing after N bindings
+    equals deducting those N requests in arrival (size-desc) order."""
+    import random
+    from volcano_tpu.api.numatopology import (
+        Numatopology, deduct_request)
+    rng = random.Random(7)
+    for _ in range(100):
+        ncells = rng.randint(1, 4)
+        cap = {str(i): float(rng.randint(1000, 8000))
+               for i in range(ncells)}
+        chips = {str(i): float(rng.randint(0, 4)) for i in range(ncells)}
+        topo = Numatopology(
+            name="n", numa_res={},
+            capacity_res={"cpu": dict(cap), "google.com/tpu": dict(chips)})
+        reqs = [(float(rng.randint(0, 4000)), float(rng.randint(0, 2)))
+                for _ in range(rng.randint(0, 5))]
+        topo.recompute_free(reqs)
+        cells = sorted(cap)
+        manual = [[cap[c], chips[c]] for c in cells]
+        for cpu, tpu in sorted(reqs, key=lambda r: -(r[0] + r[1])):
+            deduct_request(manual, cpu, tpu)
+        for i, c in enumerate(cells):
+            assert topo.numa_res["cpu"][c] == manual[i][0]
+            assert topo.numa_res["google.com/tpu"][c] == manual[i][1]
