@@ -403,3 +403,30 @@ def test_elasticsearch_source_degrades_and_goes_stale():
     ).NodeUsage(cpu_fraction=0.9)}
     source._last_success = 1.0  # epoch: long past stale_after
     assert source.usage("n").cpu_fraction == 0.0
+
+
+def test_cli_node_list_and_view(tmp_path, capsys):
+    """vtpctl node list/view over a provisioned slice with agent data."""
+    import pickle
+    from volcano_tpu.agent import FakeUsageProvider, NodeAgent
+    from volcano_tpu.api.numatopology import tpu_host_numatopology
+    from volcano_tpu.cli.vtpctl import main
+    state = str(tmp_path / "c.pkl")
+    assert main(["--state", state, "init", "--slices", "sa=v5e-16"]) == 0
+    c = pickle.load(open(state, "rb"))
+    c.add_numatopology(tpu_host_numatopology("sa-w0", 112000, 4))
+    prov = FakeUsageProvider()
+    prov.set("sa-w0", cpu_fraction=0.5, tpu_chips_detected=4,
+             tpu_chips_healthy=4)
+    NodeAgent(c, "sa-w0", prov).sync()
+    pickle.dump(c, open(state, "wb"))
+    capsys.readouterr()
+    assert main(["--state", state, "node", "list"]) == 0
+    out = capsys.readouterr().out
+    assert "sa-w0" in out and "ready" in out and "0.500" in out
+    assert main(["--state", state, "node", "view", "-N", "sa-w0"]) == 0
+    out = capsys.readouterr().out
+    assert "NUMA topology" in out and "TopologyManagerPolicy" in out
+    import pytest
+    with pytest.raises(SystemExit):
+        main(["--state", state, "node", "view", "-N", "nosuch"])

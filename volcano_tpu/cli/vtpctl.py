@@ -239,6 +239,69 @@ def cmd_pod_list(cluster, args):
     print(_table(rows, ["NAMESPACE", "NAME", "PHASE", "NODE"]))
 
 
+def cmd_node_list(cluster, args):
+    from volcano_tpu.agent.agent import (
+        CPU_USAGE_ANNOTATION,
+        TPU_HEALTHY_LABEL,
+    )
+    from volcano_tpu.api.resource import Resource
+    from volcano_tpu.api.types import occupied
+    rows = []
+    for node in cluster.nodes.values():
+        alloc = Resource.from_resource_list(node.allocatable)
+        used = Resource()
+        npods = 0
+        for pod in cluster.pods.values():
+            # occupied() includes RELEASING: evicted-but-not-yet-gone
+            # pods still hold capacity from the scheduler's view
+            if pod.node_name == node.name and occupied(pod.phase):
+                used.add(pod.resource_requests())
+                npods += 1
+        rows.append([
+            node.name,
+            "cordoned" if node.unschedulable else "ready",
+            f"{used.milli_cpu / 1000:g}/{alloc.milli_cpu / 1000:g}",
+            f"{used.get(TPU):g}/{alloc.get(TPU):g}",
+            npods,
+            node.annotations.get(CPU_USAGE_ANNOTATION, "-"),
+            node.labels.get(TPU_HEALTHY_LABEL, "-"),
+        ])
+    print(_table(rows, ["NAME", "STATUS", "CPU", "CHIPS", "PODS",
+                        "USAGE", "TPU-OK"]))
+
+
+def cmd_node_view(cluster, args):
+    node = cluster.nodes.get(args.name)
+    if node is None:
+        sys.exit(f"node {args.name} not found")
+    print(f"Name:          {node.name}")
+    print(f"Unschedulable: {node.unschedulable}")
+    print(f"Allocatable:   {dict(node.allocatable)}")
+    if node.labels:
+        print("Labels:")
+        for k in sorted(node.labels):
+            print(f"  {k}={node.labels[k]}")
+    if node.annotations:
+        print("Annotations:")
+        for k in sorted(node.annotations):
+            print(f"  {k}={node.annotations[k]}")
+    topo = getattr(cluster, "numatopologies", {}).get(node.name)
+    if topo is not None:
+        print("NUMA topology:")
+        for res, per_cell in sorted(topo.numa_res.items()):
+            cells = ", ".join(f"cell{c}={per_cell[c]:g}"
+                              for c in sorted(per_cell))
+            print(f"  {res}: {cells} (free)")
+        for k, v in sorted(topo.policies.items()):
+            print(f"  {k}={v}")
+    pods = [p for p in cluster.pods.values()
+            if p.node_name == node.name]
+    if pods:
+        print("Pods:")
+        for p in sorted(pods, key=lambda p: p.key):
+            print(f"  {p.key} ({p.phase.value})")
+
+
 def cmd_tick(cluster, args):
     """Run controllers + one scheduling cycle + kubelet tick."""
     from volcano_tpu.controllers import ControllerManager
@@ -349,6 +412,14 @@ def build_parser() -> argparse.ArgumentParser:
     p = pod.add_parser("list")
     p.add_argument("-n", "--namespace", default=None)
     p.set_defaults(fn=cmd_pod_list)
+
+    node = sub.add_parser("node", help="node operations").add_subparsers(
+        dest="node_cmd", required=True)
+    p = node.add_parser("list")
+    p.set_defaults(fn=cmd_node_list)
+    p = node.add_parser("view")
+    p.add_argument("-N", "--name", required=True)
+    p.set_defaults(fn=cmd_node_view)
 
     p = sub.add_parser("tick",
                        help="advance the standalone control plane")
