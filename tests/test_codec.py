@@ -1,0 +1,157 @@
+"""Wire codec round-trips for every CRD-analogue kind."""
+
+import dataclasses
+
+from volcano_tpu.api import codec
+from volcano_tpu.api.hypernode import HyperNode, HyperNodeMember
+from volcano_tpu.api.jobflow import Flow, FlowDependsOn, JobFlow, JobTemplate
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.numatopology import Numatopology
+from volcano_tpu.api.pod import Container, Pod, Taint, Toleration, make_pod
+from volcano_tpu.api.podgroup import (NetworkTopologySpec, PodGroup,
+                                      SubGroupPolicy)
+from volcano_tpu.api.queue import Queue
+from volcano_tpu.api.resource import Resource
+from volcano_tpu.api.shard import NodeShard
+from volcano_tpu.api.types import (JobAction, JobEvent, JobPhase,
+                                   NetworkTopologyMode, PodGroupPhase,
+                                   TaskStatus)
+from volcano_tpu.api.vcjob import (DependsOn, LifecyclePolicy, TaskSpec,
+                                   VCJob)
+from volcano_tpu.cache.cluster import PriorityClass
+from volcano_tpu.controllers.cronjob import CronJob
+from volcano_tpu.controllers.hyperjob import HyperJob, ReplicatedJob
+
+
+def roundtrip(obj):
+    return codec.loads(codec.dumps(obj))
+
+
+def assert_same(a, b):
+    assert type(a) is type(b)
+    if dataclasses.is_dataclass(a):
+        for f in dataclasses.fields(a):
+            va, vb = getattr(a, f.name), getattr(b, f.name)
+            assert va == vb or (
+                dataclasses.is_dataclass(va) or isinstance(va, Resource)
+            ), f"{type(a).__name__}.{f.name}: {va!r} != {vb!r}"
+
+
+def test_pod_roundtrip():
+    pod = make_pod(
+        "w-0", requests={"cpu": "4", "memory": "8Gi", "google.com/tpu": 4},
+        labels={"volcano-tpu.io/task-spec": "worker"},
+        annotations={"scheduling.volcano-tpu.io/group-name": "pg1"},
+        phase=TaskStatus.RUNNING, node_name="host-0", priority=10,
+        tolerations=[Toleration(key="tpu", operator="Exists",
+                                effect="NoSchedule")],
+        affinity_node_terms=[{"zone": ["us-central2-b"]}],
+    )
+    pod.containers[0].ports = [8470, 8471]
+    pod.scheduling_gates = ["queue-admission"]
+    got = roundtrip(pod)
+    assert got.key == pod.key
+    assert got.phase is TaskStatus.RUNNING
+    assert got.resource_requests().res == pod.resource_requests().res
+    assert got.tolerations[0].tolerates(Taint(key="tpu"))
+    assert got.affinity_node_terms == [{"zone": ["us-central2-b"]}]
+    assert got.containers[0].ports == [8470, 8471]
+    assert got.scheduling_gates == ["queue-admission"]
+
+
+def test_node_queue_podgroup_roundtrip():
+    node = Node(name="host-0",
+                labels={"cloud.google.com/gke-tpu-topology": "4x4"},
+                allocatable={"cpu": "96", "google.com/tpu": 4},
+                taints=[Taint(key="dedicated", value="tpu")])
+    got = roundtrip(node)
+    assert got.name == "host-0" and got.taints[0].key == "dedicated"
+
+    q = Queue(name="tenant-a", weight=4,
+              capability=Resource({"cpu": 1000}),
+              guarantee=Resource({"google.com/tpu": 16}),
+              parent="root", priority=5)
+    gq = roundtrip(q)
+    assert gq.capability.res == {"cpu": 1000.0}
+    assert gq.guarantee.res == {"google.com/tpu": 16.0}
+    assert gq.parent == "root"
+
+    pg = PodGroup(
+        name="pg1", min_member=4,
+        min_task_member={"worker": 4},
+        min_resources=Resource({"google.com/tpu": 16}),
+        network_topology=NetworkTopologySpec(
+            mode=NetworkTopologyMode.HARD, highest_tier_allowed=0),
+        sub_group_policies=[SubGroupPolicy(name="sg0", min_member=2)],
+        phase=PodGroupPhase.INQUEUE)
+    gpg = roundtrip(pg)
+    assert gpg.min_task_member == {"worker": 4}
+    assert gpg.network_topology.mode is NetworkTopologyMode.HARD
+    assert gpg.sub_group_policies[0].min_member == 2
+    assert gpg.phase is PodGroupPhase.INQUEUE
+
+
+def test_vcjob_roundtrip():
+    job = VCJob(
+        name="train", min_available=8, queue="tenant-a",
+        tasks=[TaskSpec(name="worker", replicas=8,
+                        template=make_pod("tmpl", requests={"cpu": 1}),
+                        policies=[LifecyclePolicy(
+                            action=JobAction.RESTART_JOB,
+                            event=JobEvent.POD_FAILED)],
+                        depends_on=DependsOn(name=["ps"]))],
+        plugins={"jax": [], "svc": []},
+        phase=JobPhase.RUNNING)
+    got = roundtrip(job)
+    assert got.tasks[0].policies[0].action is JobAction.RESTART_JOB
+    assert got.tasks[0].depends_on.name == ["ps"]
+    assert got.tasks[0].template.containers[0].requests == {"cpu": 1}
+    assert got.plugins == {"jax": [], "svc": []}
+    assert got.phase is JobPhase.RUNNING
+
+
+def test_hypernode_flow_misc_roundtrip():
+    hn = HyperNode.of_nodes("slice-0", 0, ["host-0", "host-1"])
+    assert roundtrip(hn).members[0].exact == "host-0"
+    assert roundtrip(hn).members[0].matches("host-0")
+
+    flow = JobFlow(name="f", flows=[
+        Flow(name="train",
+             depends_on=FlowDependsOn(targets=["prep"]))])
+    gf = roundtrip(flow)
+    assert gf.flows[0].depends_on.targets == ["prep"]
+
+    tmpl = JobTemplate(name="t", job=VCJob(name="tj"))
+    assert roundtrip(tmpl).job.name == "tj"
+
+    assert roundtrip(PriorityClass(name="high", value=100)).value == 100
+    assert roundtrip(NodeShard(name="s0")).name == "s0"
+    topo = Numatopology(name="host-0")
+    assert roundtrip(topo).name == "host-0"
+
+    cron = CronJob(name="nightly", schedule="0 2 * * *",
+                   job_template=VCJob(name="cj"))
+    gc = roundtrip(cron)
+    assert gc.schedule == "0 2 * * *" and gc.job_template.name == "cj"
+
+    hj = HyperJob(name="hj", min_available=2, replicated_jobs=[
+        ReplicatedJob(name="rj", replicas=2, template=VCJob(name="m"))])
+    ghj = roundtrip(hj)
+    assert ghj.replicated_jobs[0].template.name == "m"
+
+
+def test_plain_containers_and_tag_collision():
+    assert roundtrip({"a": [1, 2.5, None, "x"], "b": {"c": True}}) == \
+        {"a": [1, 2.5, None, "x"], "b": {"c": True}}
+    # a user dict whose key collides with a codec tag must survive
+    evil = {"#T": "not-a-type", "ok": 1}
+    assert roundtrip(evil) == evil
+    # non-string keys are stringified (JSON object keys are strings)
+    assert roundtrip({1: "a"}) == {"1": "a"}
+
+
+def test_decode_tolerates_unknown_fields():
+    data = codec.encode(Queue(name="q"))
+    data["f"]["some_future_field"] = 42
+    q = codec.decode(data)
+    assert q.name == "q"

@@ -1,0 +1,77 @@
+"""Feature gates (reference: pkg/features/volcano_features.go)."""
+
+import pytest
+
+from volcano_tpu import features
+from volcano_tpu.cache.fake_cluster import FakeCluster
+from volcano_tpu.controllers import ControllerManager
+
+
+@pytest.fixture(autouse=True)
+def reset_gates():
+    yield
+    features.reset()
+
+
+def test_defaults_and_overrides():
+    assert features.enabled("VolcanoJobSupport")
+    assert not features.enabled("SchedulingGatesQueueAdmission")
+    features.set_gate("SchedulingGatesQueueAdmission", True)
+    assert features.enabled("SchedulingGatesQueueAdmission")
+    features.reset("SchedulingGatesQueueAdmission")
+    assert not features.enabled("SchedulingGatesQueueAdmission")
+
+
+def test_parse_flag_string_and_errors():
+    features.parse("PodDisruptionBudgetsSupport=false, VolumeBinding=false")
+    assert not features.enabled("PodDisruptionBudgetsSupport")
+    assert not features.enabled("VolumeBinding")
+    with pytest.raises(features.UnknownFeatureError):
+        features.parse("NoSuchGate=true")
+    with pytest.raises(features.UnknownFeatureError):
+        features.parse("VolumeBinding=maybe")
+    with pytest.raises(features.UnknownFeatureError):
+        features.enabled("NoSuchGate")
+    assert features.known()["VolumeBinding"] is False
+
+
+def test_controller_gates():
+    features.set_gate("CronVolcanoJobSupport", False)
+    mgr = ControllerManager(FakeCluster(), enabled=["cronjob", "queue"])
+    names = [c.name for c in mgr.controllers]
+    mgr.stop()
+    assert "cronjob" not in names and "queue" in names
+
+
+def test_pdb_gate_disables_vetoes():
+    """With PodDisruptionBudgetsSupport=false the pdb plugin registers
+    nothing, so a pdb-protected victim is no longer vetoed."""
+    from volcano_tpu.uthelper import TestContext
+    from volcano_tpu.api.node_info import Node
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.types import TaskStatus
+    from volcano_tpu.plugins.pdb import (GROUP_ANNOTATION,
+                                         MIN_AVAILABLE_ANNOTATION)
+
+    def victims():
+        pod = make_pod("victim", requests={"cpu": 1},
+                       node_name="n0", phase=TaskStatus.RUNNING,
+                       annotations={GROUP_ANNOTATION: "web",
+                                    MIN_AVAILABLE_ANNOTATION: "1"})
+        ctx = TestContext(
+            nodes=[Node(name="n0", allocatable={"cpu": "2"})],
+            pods=[pod],
+            conf={"actions": "enqueue, allocate",
+                  "tiers": [{"plugins": [{"name": "pdb"},
+                                         {"name": "conformance"}]}]})
+        ssn = ctx.run(actions=[])
+        job = next(iter(ssn.jobs.values()))
+        task = next(iter(job.tasks.values()))
+        return ssn.preemptable(None, [task]), task
+
+    allowed, task = victims()
+    assert allowed == []   # vetoed while gate defaults on
+
+    features.set_gate("PodDisruptionBudgetsSupport", False)
+    allowed2, task2 = victims()
+    assert task2 in allowed2

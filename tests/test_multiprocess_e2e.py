@@ -1,0 +1,238 @@
+"""Multi-OS-process control plane e2e.
+
+The reference's defining structural property: scheduler, controllers
+and clients coordinate ONLY through the apiserver, survive component
+crashes, and fail over between leader-elected schedulers
+(cmd/scheduler/app/server.go:99-128).  Here: a state-server process,
+a controller-manager process, scheduler process(es), and this test as
+the kubectl client — every arrow crossing a real HTTP wire.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.types import NetworkTopologyMode, TaskStatus
+from volcano_tpu.api.podgroup import NetworkTopologySpec
+from volcano_tpu.api.vcjob import TaskSpec, VCJob
+from volcano_tpu.api.pod import make_pod
+from volcano_tpu.api.devices.tpu.topology import slice_for
+from volcano_tpu.cache.remote_cluster import RemoteCluster
+from volcano_tpu.simulator import slice_nodes
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def wait_for(cond, timeout=30.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class Plane:
+    """Spawns and reaps the control-plane processes."""
+
+    def __init__(self, tmp_path):
+        self.tmp_path = tmp_path
+        self.procs = {}
+        self.port = free_port()
+        self.url = f"http://127.0.0.1:{self.port}"
+
+    def spawn(self, name, *argv):
+        logf = open(self.tmp_path / f"{name}.log", "w")
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, *argv], stdout=logf, stderr=logf,
+            env=env, cwd=REPO)
+        self.procs[name] = proc
+        return proc
+
+    def start_server(self, tick=0.1):
+        self.spawn("server", "-m", "volcano_tpu.server",
+                   "--port", str(self.port), "--tick-period", str(tick))
+        wait_for(self._server_up, 15, "server /healthz")
+
+    def _server_up(self):
+        try:
+            with urllib.request.urlopen(self.url + "/healthz",
+                                        timeout=1):
+                return True
+        except OSError:
+            return False
+
+    def start_controllers(self):
+        self.spawn("controllers", "-m", "volcano_tpu",
+                   "--cluster-url", self.url,
+                   "--components", "controllers", "--period", "0.1")
+
+    def start_scheduler(self, name="scheduler", leader_elect=False):
+        argv = ["-m", "volcano_tpu", "--cluster-url", self.url,
+                "--components", "scheduler", "--period", "0.1"]
+        if leader_elect:
+            argv += ["--leader-elect", "--holder", name,
+                     "--lease-ttl", "1.0"]
+        return self.spawn(name, *argv)
+
+    def kill(self, name, sig=signal.SIGKILL):
+        proc = self.procs.pop(name, None)
+        if proc and proc.poll() is None:
+            proc.send_signal(sig)
+            proc.wait(timeout=10)
+
+    def leases(self):
+        with urllib.request.urlopen(self.url + "/leases",
+                                    timeout=2) as r:
+            return json.loads(r.read())
+
+    def shutdown(self):
+        for name in list(self.procs):
+            self.kill(name, signal.SIGTERM)
+
+    def dump_logs(self):
+        out = []
+        for f in sorted(self.tmp_path.glob("*.log")):
+            out.append(f"==== {f.name} ====\n{f.read_text()[-4000:]}")
+        return "\n".join(out)
+
+
+@pytest.fixture()
+def plane(tmp_path):
+    p = Plane(tmp_path)
+    try:
+        yield p
+    finally:
+        p.shutdown()
+
+
+def tpu_job(name: str) -> VCJob:
+    """4-host whole-slice gang, hard ICI locality (tier 1)."""
+    return VCJob(
+        name=name, min_available=4,
+        network_topology=NetworkTopologySpec(
+            NetworkTopologyMode.HARD, highest_tier_allowed=1),
+        tasks=[TaskSpec(
+            name="worker", replicas=4,
+            template=make_pod("t", requests={"cpu": 8, TPU: 4}))],
+        plugins={"jax": [], "svc": []},
+    )
+
+
+def slices_used(cluster, job_name):
+    out = set()
+    for p in cluster.pods.values():
+        if p.labels.get("volcano-tpu.io/job-name") == job_name \
+                and p.node_name:
+            out.add(p.node_name.rsplit("-w", 1)[0])
+    return out
+
+
+def running_count(cluster, job_name):
+    return sum(1 for p in cluster.pods.values()
+               if p.labels.get("volcano-tpu.io/job-name") == job_name
+               and p.phase is TaskStatus.RUNNING)
+
+
+def test_three_processes_gang_schedule_and_crash_recovery(plane):
+    plane.start_server()
+    kubectl = RemoteCluster(plane.url)
+    try:
+        # provision 2 x v5e-16 slices = 8 TPU hosts over the wire
+        for sname in ("sa", "sb"):
+            for node in slice_nodes(slice_for(sname, "v5e-16"),
+                                    dcn_pod="dcn-0"):
+                kubectl.add_node(node)
+
+        plane.start_controllers()
+        plane.start_scheduler()
+
+        kubectl.add_vcjob(tpu_job("job1"))
+        try:
+            wait_for(lambda: running_count(kubectl, "job1") == 4,
+                     45, "job1 running")
+        except AssertionError:
+            raise AssertionError(plane.dump_logs())
+        used1 = slices_used(kubectl, "job1")
+        assert len(used1) == 1, f"hard topology violated: {used1}"
+        # hypernode discovery ran over the wire too
+        assert any(hn.tier == 1 for hn in kubectl.hypernodes.values())
+        # jax plugin env crossed the wire
+        pod = next(p for p in kubectl.pods.values()
+                   if p.labels.get("volcano-tpu.io/job-name") == "job1")
+        env = pod.containers[0].env
+        assert "TPU_WORKER_HOSTNAMES" in env, env
+
+        # crash the scheduler (SIGKILL, no cleanup) and restart it:
+        # the fresh scheduler must recover used-capacity state purely
+        # from running pods on the server and pack job2 into the
+        # remaining slice
+        plane.kill("scheduler", signal.SIGKILL)
+        kubectl.add_vcjob(tpu_job("job2"))
+        time.sleep(0.5)
+        assert running_count(kubectl, "job2") == 0  # nobody scheduling
+        plane.start_scheduler("scheduler2")
+        try:
+            wait_for(lambda: running_count(kubectl, "job2") == 4,
+                     45, "job2 running after scheduler restart")
+        except AssertionError:
+            raise AssertionError(plane.dump_logs())
+        used2 = slices_used(kubectl, "job2")
+        assert len(used2) == 1
+        assert not (used1 & used2), (
+            f"restarted scheduler double-booked a slice: {used1}, {used2}")
+    finally:
+        kubectl.close()
+
+
+def test_leader_election_failover(plane):
+    plane.start_server()
+    kubectl = RemoteCluster(plane.url)
+    try:
+        # two slices: job1 fills one, job2 (post-failover) needs the other
+        for sname in ("sa", "sb"):
+            for node in slice_nodes(slice_for(sname, "v5e-16"),
+                                    dcn_pod="dcn-0"):
+                kubectl.add_node(node)
+        plane.start_controllers()
+        plane.start_scheduler("sched-1", leader_elect=True)
+        # wait for leadership before starting the rival: deterministic
+        # first leader
+        wait_for(lambda: plane.leases().get("scheduler", {}).get(
+            "holder") == "sched-1", 15, "sched-1 leadership")
+        plane.start_scheduler("sched-2", leader_elect=True)
+
+        kubectl.add_vcjob(tpu_job("job1"))
+        try:
+            wait_for(lambda: running_count(kubectl, "job1") == 4,
+                     45, "job1 running under sched-1")
+        except AssertionError:
+            raise AssertionError(plane.dump_logs())
+        assert plane.leases()["scheduler"]["holder"] == "sched-1"
+
+        # kill the leader; the standby must take the lease and schedule
+        plane.kill("sched-1", signal.SIGKILL)
+        kubectl.add_vcjob(tpu_job("job2"))
+        try:
+            wait_for(lambda: running_count(kubectl, "job2") == 4,
+                     45, "job2 running after failover")
+        except AssertionError:
+            raise AssertionError(plane.dump_logs())
+        assert plane.leases()["scheduler"]["holder"] == "sched-2"
+    finally:
+        kubectl.close()

@@ -1,0 +1,221 @@
+"""State server + RemoteCluster: the wire boundary in one process.
+
+Multi-OS-process coverage lives in test_multiprocess_e2e.py; here the
+server runs on a background thread and clients talk real HTTP to it,
+which exercises the same codec/watch/bind machinery at unit-test speed.
+"""
+
+import time
+
+import pytest
+
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.pod import make_pod
+from volcano_tpu.api.podgroup import PodGroup
+from volcano_tpu.api.queue import Queue
+from volcano_tpu.api.types import (GROUP_NAME_ANNOTATION, PodGroupPhase,
+                                   TaskStatus)
+from volcano_tpu.api.vcjob import TaskSpec, VCJob
+from volcano_tpu.cache.remote_cluster import RemoteCluster
+from volcano_tpu.server.state_server import serve
+from volcano_tpu.webhooks.admission import AdmissionError
+
+
+@pytest.fixture()
+def wire():
+    httpd, state = serve(port=0)
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    clients = []
+
+    def client(**kw):
+        c = RemoteCluster(url, **kw)
+        clients.append(c)
+        return c
+
+    yield type("Wire", (), {"url": url, "state": state,
+                            "client": staticmethod(client)})
+    for c in clients:
+        c.close()
+    httpd.shutdown()
+
+
+def wait_for(cond, timeout=5.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def gang(n=2, name="job1", queue="default"):
+    pg = PodGroup(name=f"pg-{name}", min_member=n, queue=queue,
+                  phase=PodGroupPhase.INQUEUE)
+    pods = [make_pod(f"{name}-{i}", requests={"cpu": 1},
+                     annotations={GROUP_NAME_ANNOTATION: pg.key})
+            for i in range(n)]
+    return pg, pods
+
+
+def test_crud_and_watch_convergence(wire):
+    a = wire.client()
+    b = wire.client()
+    a.add_node(Node(name="n0", allocatable={"cpu": "8"}))
+    a.add_queue(Queue(name="tenant", weight=3))
+    pg, pods = gang(2)
+    a.add_podgroup(pg)
+    for p in pods:
+        a.add_pod(p)
+    # client a sees its own writes immediately (local echo)
+    assert "n0" in a.nodes and "tenant" in a.queues
+    assert len(a.pods) == 2
+    # client b converges through the watch stream
+    wait_for(lambda: "n0" in b.nodes and len(b.pods) == 2
+             and pg.key in b.podgroups, msg="b mirror convergence")
+    assert b.queues["tenant"].weight == 3
+
+    a.delete_pod(pods[0].key)
+    wait_for(lambda: pods[0].key not in b.pods, msg="delete propagation")
+
+
+def test_bind_evict_conflict(wire):
+    a = wire.client()
+    b = wire.client()
+    a.add_node(Node(name="n0", allocatable={"cpu": "8"}))
+    a.add_pod(make_pod("p0", requests={"cpu": 1}))
+    a.bind_pod("default", "p0", "n0")
+    assert a.pods["default/p0"].phase is TaskStatus.BOUND
+    wait_for(lambda: b.pods.get("default/p0") is not None
+             and b.pods["default/p0"].node_name == "n0",
+             msg="bind propagation")
+    # conflicting second bind -> 409 -> ValueError
+    with pytest.raises(ValueError):
+        b.bind_pod("default", "p0", "n1")
+    # missing pod -> 404 -> KeyError
+    with pytest.raises(KeyError):
+        a.bind_pod("default", "nope", "n0")
+
+    a.evict_pod("default", "p0", "test")
+    wait_for(lambda: b.pods["default/p0"].phase is TaskStatus.RELEASING,
+             msg="evict propagation")
+    a.tick()   # kubelet: releasing -> deleted
+    wait_for(lambda: "default/p0" not in b.pods, msg="tick deletion")
+
+
+def test_admission_runs_server_side(wire):
+    a = wire.client()
+    bad = VCJob(name="bad", min_available=5,
+                tasks=[TaskSpec(name="w", replicas=2,
+                                template=make_pod("t"))])
+    with pytest.raises(AdmissionError):
+        a.add_vcjob(bad)
+    assert "default/bad" not in a.vcjobs
+    good = VCJob(name="good", min_available=2,
+                 tasks=[TaskSpec(name="w", replicas=2,
+                                 template=make_pod("t"))])
+    stored = a.add_vcjob(good)
+    assert stored.queue == "default"
+
+
+def test_scheduler_over_the_wire(wire):
+    """A Scheduler whose only cluster handle is a RemoteCluster
+    gang-schedules pods created by a different client."""
+    from volcano_tpu.scheduler import Scheduler
+
+    kubectl = wire.client()
+    for i in range(3):
+        kubectl.add_node(Node(name=f"n{i}", allocatable={"cpu": "8"}))
+    pg, pods = gang(3, name="wirejob")
+    kubectl.add_podgroup(pg)
+    for p in pods:
+        kubectl.add_pod(p)
+
+    sched_view = wire.client()
+    wait_for(lambda: len(sched_view.nodes) == 3 and
+             len(sched_view.pods) == 3, msg="scheduler mirror sync")
+    sched = Scheduler(sched_view)
+    sched.run_once()
+
+    server_pods = wire.state.cluster.pods
+    bound = [p for p in server_pods.values()
+             if p.phase is TaskStatus.BOUND]
+    assert len(bound) == 3, [
+        (p.key, p.phase) for p in server_pods.values()]
+    # and the kubectl client converges on the binds
+    wait_for(lambda: all(p.node_name for p in kubectl.pods.values()),
+             msg="bind convergence on kubectl client")
+
+
+def test_controllers_over_the_wire(wire):
+    """Controller manager over RemoteCluster materializes a vcjob into
+    pods + podgroup on the server."""
+    from volcano_tpu.controllers import ControllerManager
+
+    kubectl = wire.client()
+    job = kubectl.add_vcjob(
+        VCJob(name="cjob", min_available=2,
+              tasks=[TaskSpec(name="w", replicas=2,
+                              template=make_pod(
+                                  "t", requests={"cpu": 1}))]))
+
+    mgr_view = wire.client()
+    wait_for(lambda: "default/cjob" in mgr_view.vcjobs,
+             msg="job visible to manager")
+    mgr = ControllerManager(mgr_view, enabled=["job", "podgroup", "queue"])
+    mgr.sync_all()
+    mgr.stop()
+
+    server = wire.state.cluster
+    assert "default/cjob" in server.podgroups
+    assert sum(1 for p in server.pods.values()
+               if p.owner == job.uid) == 2
+
+
+def test_lease_leader_election(wire):
+    a = wire.client()
+    r1 = a.lease("scheduler", "proc-a", ttl=0.5)
+    assert r1["acquired"]
+    r2 = a.lease("scheduler", "proc-b", ttl=0.5)
+    assert not r2["acquired"] and r2["holder"] == "proc-a"
+    # renewal by the holder extends
+    assert a.lease("scheduler", "proc-a", ttl=0.5)["acquired"]
+    time.sleep(0.6)
+    # expiry -> takeover
+    r3 = a.lease("scheduler", "proc-b", ttl=0.5)
+    assert r3["acquired"] and r3["holder"] == "proc-b"
+    # release
+    a.lease("scheduler", "proc-b", ttl=0.5, release=True)
+    assert a.lease("scheduler", "proc-c", ttl=0.5)["acquired"]
+
+
+def test_vtpctl_server_mode(wire, capsys):
+    """The CLI in kubectl mode: init slices + run a job over the wire."""
+    from volcano_tpu.cli.vtpctl import main as vtpctl
+
+    assert vtpctl(["--server", wire.url, "init",
+                   "--slices", "sa=v5e-16"]) == 0
+    assert vtpctl(["--server", wire.url, "job", "run", "-N", "t1",
+                   "--replicas", "2", "--tpu", "4", "--cpu", "8"]) == 0
+    assert vtpctl(["--server", wire.url, "job", "list"]) == 0
+    out = capsys.readouterr().out
+    assert "t1" in out
+    server = wire.state.cluster
+    assert len(server.nodes) == 4
+    assert "default/t1" in server.vcjobs
+    assert any(hn.tier == 1 for hn in server.hypernodes.values())
+
+
+def test_commands_and_dict_kinds(wire):
+    a = wire.client()
+    b = wire.client()
+    a.add_command("default/j1", "AbortJob")
+    wait_for(lambda: any(c["target"] == "default/j1"
+                         for c in b.commands), msg="command propagation")
+    got = b.drain_commands("default/j1")
+    assert got == [{"target": "default/j1", "action": "AbortJob"}]
+
+    a.put_object("pvc", {"request_gi": 10, "bound_pv": ""}, key="pvc-a")
+    wait_for(lambda: "pvc-a" in b.pvcs, msg="pvc propagation")
+    assert b.pvcs["pvc-a"]["request_gi"] == 10
+    a.delete_object("pvc", "pvc-a")
+    wait_for(lambda: "pvc-a" not in b.pvcs, msg="pvc deletion")

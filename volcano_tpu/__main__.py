@@ -1,19 +1,34 @@
-"""Run the full control plane as one process.
+"""Run the control plane — single-process or split across a wire.
 
 The binaries parity point (reference cmd/: vc-scheduler,
-vc-controller-manager, vc-agent-scheduler, vc-agent): one daemon
-running the batch scheduler, the controller manager, optionally the
+vc-controller-manager, vc-agent-scheduler, vc-agent): by default one
+daemon runs the batch scheduler, the controller manager, optionally the
 agent fast path and per-node agents, with a Prometheus /metrics
 endpoint and the SIGUSR2 cache dumper.
 
     python -m volcano_tpu --state cluster.pkl --period 1 \
         --metrics-port 9090 --cycles 0        # 0 = run forever
+
+With --cluster-url the process becomes ONE control-plane component
+talking to the state server (volcano_tpu.server) the way the
+reference binaries only meet at the apiserver:
+
+    python -m volcano_tpu.server --port 8700 --tick-period 0.5 &
+    python -m volcano_tpu --cluster-url http://127.0.0.1:8700 \
+        --components scheduler --leader-elect --holder sched-1 &
+    python -m volcano_tpu --cluster-url http://127.0.0.1:8700 \
+        --components controllers &
+
+--leader-elect takes a server lease before scheduling and renews it
+each cycle; losing the lease pauses the component until re-acquired
+(reference: cmd/scheduler/app/server.go:99-128).
 """
 
 from __future__ import annotations
 
 import argparse
 import logging
+import os
 import pickle
 import signal
 import sys
@@ -25,6 +40,21 @@ def main(argv=None) -> int:
     parser.add_argument("--state", default="",
                         help="pickled FakeCluster to load (default: "
                              "empty in-memory cluster)")
+    parser.add_argument("--cluster-url", default="",
+                        help="state-server URL; the process runs its "
+                             "components against the wire instead of an "
+                             "in-memory cluster")
+    parser.add_argument("--components", default="scheduler,controllers",
+                        help="comma list: scheduler,controllers")
+    parser.add_argument("--leader-elect", action="store_true",
+                        help="gate the scheduler on a server lease")
+    parser.add_argument("--holder", default="",
+                        help="leader-election holder identity "
+                             "(default: pid-derived)")
+    parser.add_argument("--lease-ttl", type=float, default=5.0)
+    parser.add_argument("--feature-gates", default="",
+                        help="A=true,B=false overrides "
+                             "(volcano_tpu/features.py)")
     parser.add_argument("--conf", default="",
                         help="scheduler conf YAML path (hot-reloaded)")
     parser.add_argument("--period", type=float, default=1.0)
@@ -51,14 +81,23 @@ def main(argv=None) -> int:
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
     log = logging.getLogger("volcano_tpu.main")
 
-    from volcano_tpu import metrics
+    from volcano_tpu import features, metrics
     from volcano_tpu.cache.fake_cluster import FakeCluster
     from volcano_tpu.controllers import ControllerManager
     from volcano_tpu.dumper import Dumper
     from volcano_tpu.scheduler import Scheduler
     from volcano_tpu.webhooks import default_admission
 
-    if args.state:
+    if args.feature_gates:
+        try:
+            features.parse(args.feature_gates)
+        except features.UnknownFeatureError as e:
+            parser.error(str(e))
+    remote = bool(args.cluster_url)
+    if remote:
+        from volcano_tpu.cache.remote_cluster import RemoteCluster
+        cluster = RemoteCluster(args.cluster_url)
+    elif args.state:
         try:
             with open(args.state, "rb") as f:
                 cluster = pickle.load(f)
@@ -69,10 +108,35 @@ def main(argv=None) -> int:
         cluster = FakeCluster()
         cluster.admission = default_admission()
 
-    sched = Scheduler(cluster, conf_path=args.conf or None,
-                      schedule_period=args.period)
-    mgr = ControllerManager(
-        cluster, enabled=[c for c in args.controllers.split(",") if c])
+    components = {c.strip() for c in args.components.split(",") if c}
+    unknown = components - {"scheduler", "controllers"}
+    if unknown or not components:
+        parser.error(f"--components must be a non-empty subset of "
+                     f"scheduler,controllers (got {args.components!r})")
+    run_sched = "scheduler" in components
+    run_ctrls = "controllers" in components
+
+    sched = None
+    if run_sched:
+        sched = Scheduler(cluster, conf_path=args.conf or None,
+                          schedule_period=args.period)
+    mgr = None
+    if run_ctrls:
+        mgr = ControllerManager(
+            cluster, enabled=[c for c in args.controllers.split(",") if c])
+
+    elector = None
+    if args.leader_elect:
+        if not remote:
+            parser.error("--leader-elect requires --cluster-url")
+        from volcano_tpu.leaderelection import LeaderElector
+        holder = args.holder or f"pid-{os.getpid()}"
+        # one lease per component set: scheduler replicas contend on
+        # "scheduler", controller-manager replicas on "controllers" —
+        # never across roles
+        lease_name = "+".join(sorted(components))
+        elector = LeaderElector(cluster, lease_name, holder,
+                                ttl=args.lease_ttl).start()
     agent_sched = None
     if args.agent_scheduler:
         from volcano_tpu.agentscheduler import AgentScheduler
@@ -128,14 +192,14 @@ def main(argv=None) -> int:
         def sync_node_agents():
             pass
 
-    Dumper(sched).listen_for_signal()
+    if sched is not None:
+        Dumper(sched).listen_for_signal()
     server = None
     if args.metrics_port:
         server = metrics.serve(args.metrics_port)
         log.info("metrics on http://127.0.0.1:%d/metrics",
                  server.server_address[1])
 
-    import os
     import threading
 
     stop = threading.Event()
@@ -160,33 +224,46 @@ def main(argv=None) -> int:
         threading.Thread(target=refresh_loop, name="usage-refresh",
                          daemon=True).start()
 
-    log.info("control plane up: %d nodes, %d controllers%s%s",
-             len(cluster.nodes), len(mgr.controllers),
+    log.info("control plane up: %d nodes, %d controllers%s%s%s",
+             len(cluster.nodes),
+             len(mgr.controllers) if mgr else 0,
              ", agent scheduler" if agent_sched else "",
              f", node agents ({args.node_agents})"
-             if args.node_agents else "")
+             if args.node_agents else "",
+             " [leader-elected]" if elector else "")
     cycles = 0
     clean_exit = False
     try:
         while not stop.is_set():
-            sync_node_agents()
-            mgr.sync_all()
-            sched.run_once()
-            if agent_sched is not None:
-                agent_sched.run_until_drained()
-            cluster.tick()
-            cycles += 1
+            is_leader = elector.is_leader if elector is not None else True
+            if is_leader:
+                sync_node_agents()
+                if mgr is not None:
+                    mgr.sync_all()
+                if sched is not None:
+                    sched.run_once()
+                if agent_sched is not None:
+                    agent_sched.run_until_drained()
+                if not remote:
+                    cluster.tick()
+                cycles += 1
             if args.cycles and cycles >= args.cycles:
                 break
             # Event.wait wakes immediately on signal — no PEP 475
             # sleep-resume delaying shutdown by up to a full period
-            stop.wait(args.period)
+            stop.wait(args.period if is_leader
+                      else min(args.period, 0.5))
         clean_exit = True
     finally:
-        mgr.stop()
+        if elector is not None:
+            elector.stop()
+        if mgr is not None:
+            mgr.stop()
         if server is not None:
             server.shutdown()
-        if args.state and clean_exit:
+        if remote:
+            cluster.close()
+        if args.state and not remote and clean_exit:
             # atomic save, and only on clean exit — a crash mid-cycle
             # must never clobber the last consistent snapshot
             tmp = f"{args.state}.tmp"
@@ -194,11 +271,15 @@ def main(argv=None) -> int:
                 pickle.dump(cluster, f)
             os.replace(tmp, args.state)
             log.info("state saved to %s", args.state)
-        elif args.state:
+        elif args.state and not remote:
             log.warning("exiting on error: NOT overwriting %s",
                         args.state)
-    log.info("ran %d cycles; %d binds, %d evictions",
-             cycles, len(cluster.binds), len(cluster.evictions))
+    if remote:
+        # bind/evict history lives on the server, not in the mirror
+        log.info("ran %d cycles", cycles)
+    else:
+        log.info("ran %d cycles; %d binds, %d evictions",
+                 cycles, len(cluster.binds), len(cluster.evictions))
     return 0
 
 

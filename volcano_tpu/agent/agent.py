@@ -95,15 +95,67 @@ class NodeAgent:
         if node is None:
             return
         usage = self.provider.usage(self.node_name)
+        # remember pre-handler state so only REAL changes are persisted
+        # (a wire-backed cluster must see the kubelet-side patches, but
+        # an unchanged node must not generate watch traffic every sync)
+        node_before = (dict(node.annotations), dict(node.labels),
+                       node.unschedulable)
+        # capture the pod population ONCE: handlers and the persist
+        # diff below must operate on the same objects (the mirror can
+        # swap instances under us between scans in wire mode)
+        pods = self._running_pods()
+        pods_before = {p.key: dict(p.annotations) for p in pods}
         self._report_usage(node, usage)
         self._report_tpu_health(node, usage)
         self._report_oversubscription(node, usage)
-        self._apply_cpu_qos(node, usage)
-        self._apply_network_qos(node, usage)
-        self._refresh_numatopology()
+        self._apply_cpu_qos(node, usage, pods)
+        self._apply_network_qos(node, usage, pods)
+        self._refresh_numatopology(pods)
         if max(usage.cpu_fraction, usage.memory_fraction) >= \
                 self.eviction_threshold:
-            self._evict_best_effort(node)
+            self._evict_best_effort(node, pods)
+        if (dict(node.annotations), dict(node.labels),
+                node.unschedulable) != node_before:
+            self._persist_node(node, node_before)
+        for p in pods:
+            if p.annotations != pods_before.get(p.key):
+                self._persist_pod(p, pods_before[p.key])
+
+    def _persist_node(self, node, before) -> None:
+        """Read-modify-write: if the mirror swapped the node instance
+        mid-sync (wire mode: a concurrent admin cordon/label write),
+        apply only OUR deltas onto the freshest copy — never push a
+        stale whole object over someone else's change."""
+        cur = self.cluster.nodes.get(self.node_name)
+        if cur is None:
+            return
+        if cur is not node:
+            ann_before, labels_before, _ = before
+            for k, v in node.annotations.items():
+                if ann_before.get(k) != v:
+                    cur.annotations[k] = v
+            for k in set(ann_before) - set(node.annotations):
+                cur.annotations.pop(k, None)
+            for k, v in node.labels.items():
+                if labels_before.get(k) != v:
+                    cur.labels[k] = v
+            cur.unschedulable = node.unschedulable
+        self.cluster.put_object("node", cur)
+
+    def _persist_pod(self, pod, ann_before) -> None:
+        """Same discipline for pods: a pod completed/evicted mid-sync
+        must keep its new phase — only the agent-owned QoS annotations
+        are merged onto the current instance."""
+        cur = self.cluster.pods.get(pod.key)
+        if cur is None:
+            return   # deleted mid-sync: nothing to annotate
+        if cur is not pod:
+            for k, v in pod.annotations.items():
+                if ann_before.get(k) != v:
+                    cur.annotations[k] = v
+            for k in set(ann_before) - set(pod.annotations):
+                cur.annotations.pop(k, None)
+        self.cluster.put_object("pod", cur)
 
     def _running_pods(self) -> List:
         """Pods RUNNING on this agent's node — the population every
@@ -156,7 +208,7 @@ class NodeAgent:
         reclaimable = alloc.milli_cpu * stepped * self.oversub_factor
         node.annotations[OVERSUB_ANNOTATION] = str(int(reclaimable))
 
-    def _apply_cpu_qos(self, node, usage: NodeUsage) -> None:
+    def _apply_cpu_qos(self, node, usage: NodeUsage, pods) -> None:
         """cpuburst/cputhrottle handlers (reference: pkg/agent/events/
         handlers/{cpuburst,cputhrottle}) — control-plane half: compute
         per-pod burst quota / throttle decisions from real usage and
@@ -165,7 +217,7 @@ class NodeAgent:
         idle_frac = max(0.0, 1.0 - usage.cpu_fraction)
         node_idle_m = self._allocatable(node).milli_cpu * idle_frac
         throttled = usage.cpu_fraction > self.eviction_threshold * 0.9
-        for pod in self._running_pods():
+        for pod in pods:
             qos = pod.annotations.get(PREEMPTABLE_QOS_ANNOTATION)
             request_m = pod.resource_requests().milli_cpu
             if qos == QOS_BEST_EFFORT:
@@ -183,7 +235,7 @@ class NodeAgent:
                     str(int(request_m * 0.2))
                 pod.annotations.pop(CPU_THROTTLE_ANNOTATION, None)
 
-    def _apply_network_qos(self, node, usage: NodeUsage) -> None:
+    def _apply_network_qos(self, node, usage: NodeUsage, pods) -> None:
         """networkqos handler (reference: pkg/networkqos — clsact qdisc
         + eBPF maps shaping online/offline DCN bandwidth) — control-
         plane half: split the node's DCN egress budget between online
@@ -199,7 +251,7 @@ class NodeAgent:
                         self.node_name, DCN_BANDWIDTH_ANNOTATION)
             total_mbps = float(DEFAULT_DCN_MBPS)
         be_pods, other_pods = [], []
-        for p in self._running_pods():
+        for p in pods:
             if p.annotations.get(PREEMPTABLE_QOS_ANNOTATION) == \
                     QOS_BEST_EFFORT:
                 be_pods.append(p)
@@ -220,7 +272,7 @@ class NodeAgent:
             # a pod promoted out of BE must not keep a stale cap
             pod.annotations.pop(DCN_POD_LIMIT_ANNOTATION, None)
 
-    def _refresh_numatopology(self) -> None:
+    def _refresh_numatopology(self, pods) -> None:
         """Exporter half of the Numatopology contract
         (api/numatopology.py): republish per-cell FREE amounts as
         capacity minus the running pods' requests, so the scheduler's
@@ -230,13 +282,16 @@ class NodeAgent:
         if topo is None:
             return
         reqs = []
-        for pod in self._running_pods():
+        for pod in pods:
             r = pod.resource_requests()
             reqs.append((r.milli_cpu, r.get(TPU)))
+        before = {res: dict(cells) for res, cells in topo.numa_res.items()}
         topo.recompute_free(reqs)
+        if topo.numa_res != before:
+            self.cluster.put_object("numatopology", topo)
 
-    def _evict_best_effort(self, node) -> None:
-        for pod in self._running_pods():
+    def _evict_best_effort(self, node, pods) -> None:
+        for pod in pods:
             if pod.annotations.get(PREEMPTABLE_QOS_ANNOTATION) == \
                     QOS_BEST_EFFORT:
                 log.info("agent %s: evicting BE pod %s under pressure",

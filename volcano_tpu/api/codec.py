@@ -1,0 +1,142 @@
+"""JSON codec for API objects crossing the wire boundary.
+
+The control plane splits into separate OS processes (state server,
+scheduler, controller manager, agents) that exchange CRD-analogue
+objects over HTTP/JSON — the stand-in for the reference's apiserver
+serialization (staging/src/volcano.sh/apis generated deepcopy/JSON
+round-trip).  Rather than hand-writing marshal functions per type, the
+codec reflects over the dataclass/enum registry:
+
+  dataclass  -> {"#T": "ClassName", "f": {field: value...}}
+  Enum       -> {"#E": ["EnumName", value]}
+  Resource   -> {"#R": {dim: amount}}
+  plain dict -> passed through ({"#D": {...}} wrapper only if a key
+                collides with a tag)
+  list/tuple -> list
+
+Decoding tolerates missing/extra fields (forward/backward compat the
+way k8s JSON does): unknown keys are dropped, absent ones take the
+dataclass default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+from typing import Any, Dict
+
+_TAGS = ("#T", "#E", "#R", "#D")
+
+_CLASSES: Dict[str, type] = {}
+_ENUMS: Dict[str, type] = {}
+_FIELDS: Dict[str, frozenset] = {}
+_built = False
+
+
+def register_class(cls: type) -> type:
+    """Register a dataclass or Enum for wire round-trips."""
+    if isinstance(cls, type) and issubclass(cls, enum.Enum):
+        _ENUMS[cls.__name__] = cls
+    elif dataclasses.is_dataclass(cls):
+        _CLASSES[cls.__name__] = cls
+        _FIELDS[cls.__name__] = frozenset(
+            f.name for f in dataclasses.fields(cls))
+    return cls
+
+
+def _scan(module) -> None:
+    for obj in vars(module).values():
+        if isinstance(obj, type) and (
+                dataclasses.is_dataclass(obj)
+                or issubclass(obj, enum.Enum)):
+            register_class(obj)
+
+
+def _build_registry() -> None:
+    """Import every module holding wire types and index them.
+
+    Lazy so that importing the codec never drags the controller stack
+    into processes that only need the API layer.
+    """
+    global _built
+    if _built:
+        return
+    from volcano_tpu.api import (hypernode, jobflow, node_info,
+                                 numatopology, pod, podgroup, queue,
+                                 shard, types, vcjob)
+    from volcano_tpu.cache import cluster as cluster_mod
+    from volcano_tpu.controllers import cronjob, hyperjob
+    for mod in (types, pod, node_info, podgroup, queue, hypernode,
+                vcjob, jobflow, numatopology, shard, cluster_mod,
+                cronjob, hyperjob):
+        _scan(mod)
+    _built = True
+
+
+def encode(obj: Any) -> Any:
+    """Encode an API object into JSON-serializable data."""
+    from volcano_tpu.api.resource import Resource
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, Resource):
+        return {"#R": dict(obj.res)}
+    if isinstance(obj, enum.Enum):
+        return {"#E": [type(obj).__name__, obj.value]}
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        _build_registry()
+        name = type(obj).__name__
+        if name not in _CLASSES:
+            register_class(type(obj))
+        fields = {f.name: encode(getattr(obj, f.name))
+                  for f in dataclasses.fields(obj)}
+        return {"#T": name, "f": fields}
+    if isinstance(obj, dict):
+        out = {str(k): encode(v) for k, v in obj.items()}
+        if any(t in out for t in _TAGS):
+            return {"#D": out}
+        return out
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [encode(v) for v in obj]
+    raise TypeError(f"codec: cannot encode {type(obj).__name__}: {obj!r}")
+
+
+def decode(data: Any) -> Any:
+    """Decode JSON data produced by :func:`encode`."""
+    if data is None or isinstance(data, (bool, int, float, str)):
+        return data
+    if isinstance(data, list):
+        return [decode(v) for v in data]
+    if isinstance(data, dict):
+        if "#R" in data and len(data) == 1:
+            from volcano_tpu.api.resource import Resource
+            return Resource(data["#R"])
+        if "#E" in data and len(data) == 1:
+            _build_registry()
+            name, value = data["#E"]
+            cls = _ENUMS.get(name)
+            if cls is None:
+                raise KeyError(f"codec: unknown enum {name!r}")
+            return cls(value)
+        if "#T" in data:
+            _build_registry()
+            name = data["#T"]
+            cls = _CLASSES.get(name)
+            if cls is None:
+                raise KeyError(f"codec: unknown class {name!r}")
+            known = _FIELDS[name]
+            kwargs = {k: decode(v) for k, v in data.get("f", {}).items()
+                      if k in known}
+            return cls(**kwargs)
+        if "#D" in data and len(data) == 1:
+            return {k: decode(v) for k, v in data["#D"].items()}
+        return {k: decode(v) for k, v in data.items()}
+    raise TypeError(f"codec: cannot decode {type(data).__name__}")
+
+
+def dumps(obj: Any) -> str:
+    return json.dumps(encode(obj), separators=(",", ":"))
+
+
+def loads(text: str) -> Any:
+    return decode(json.loads(text))

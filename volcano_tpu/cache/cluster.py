@@ -120,6 +120,26 @@ class Cluster(abc.ABC):
     def delete_vcjob(self, key: str) -> None:
         """Delete a vcjob by ns/name key."""
 
+    # -- generic object store ------------------------------------------
+    # One create/update + delete pair covering every registered kind
+    # (cache/kinds.py) so controllers and plugins persist through the
+    # SAME seam regardless of backend (in-memory or wire).  Mirrors the
+    # reference's dynamic clientset over the CRD scheme.
+
+    @abc.abstractmethod
+    def put_object(self, kind: str, obj, key: Optional[str] = None):
+        """Create or update an object of `kind`; returns the stored
+        object (admission may mutate for admission-gated kinds)."""
+
+    @abc.abstractmethod
+    def delete_object(self, kind: str, key: str) -> None:
+        """Delete by key; no-op when absent."""
+
+    def get_objects(self, kind: str) -> Dict[str, object]:
+        """Read view of a kind's store (key -> object)."""
+        from volcano_tpu.cache.kinds import KINDS
+        return getattr(self, KINDS[kind].attr)
+
     # -- command bus (bus/v1alpha1 Command analogue) -------------------
     # Default in-memory implementation; backends may override to
     # persist Commands as CRs.

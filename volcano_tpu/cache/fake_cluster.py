@@ -37,10 +37,16 @@ class FakeCluster(Cluster):
         self.commands: List[dict] = []            # bus/v1alpha1 analogue
         self.jobflows: Dict[str, object] = {}     # flow/v1alpha1 JobFlow
         self.jobtemplates: Dict[str, object] = {} # flow/v1alpha1 JobTemplate
+        self.cronjobs: Dict[str, object] = {}     # batch/v1alpha1 CronJob
+        self.hyperjobs: Dict[str, object] = {}    # training/v1alpha1 HyperJob
+        self.nodeshards: Dict[str, object] = {}   # shard/v1alpha1 NodeShard
         self.numatopologies: Dict[str, object] = {}  # nodeinfo/v1alpha1
         self.services: Dict[str, dict] = {}       # svc plugin artifacts
         self.config_maps: Dict[str, dict] = {}
         self.secrets: Dict[str, dict] = {}
+        self.pvcs: Dict[str, dict] = {}           # volumebinding claims
+        self.pvs: Dict[str, dict] = {}            # volumebinding volumes
+        self.datasources: Dict[str, dict] = {}    # datadependency/v1alpha1
         self.events: List[Tuple[str, str, str]] = []
         self.binds: List[Tuple[str, str]] = []    # (pod key, node) history
         self.evictions: List[str] = []
@@ -62,8 +68,10 @@ class FakeCluster(Cluster):
         self._lock = threading.RLock()
         self._watchers = []
         # stores added after old state files were written
-        for attr in ("jobflows", "jobtemplates", "commands"):
-            self.__dict__.setdefault(attr, [] if attr == "commands" else {})
+        from volcano_tpu.cache.kinds import KINDS
+        self.__dict__.setdefault("commands", [])
+        for spec in KINDS.values():
+            self.__dict__.setdefault(spec.attr, {})
 
     # -- mutation helpers (the "kubectl" surface) ----------------------
 
@@ -163,6 +171,38 @@ class FakeCluster(Cluster):
     def add_priority_class(self, pc: PriorityClass):
         with self._lock:
             self.priority_classes[pc.name] = pc
+        self._notify("priority_class", pc)
+
+    # -- generic object store ------------------------------------------
+
+    def put_object(self, kind: str, obj, key: Optional[str] = None):
+        from volcano_tpu.cache.kinds import KINDS, key_for
+        if kind == "vcjob" and key is None:
+            # keep the admission-gated create path authoritative
+            # (an explicit key marks an update/status flush — the
+            # create chain must not re-run on those)
+            return self.add_vcjob(obj)
+        spec = KINDS[kind]
+        k = key_for(kind, obj, key)
+        if kind == "queue" and self.admission is not None and \
+                k not in self.queues:
+            # queue creates are webhook-gated too (reference
+            # pkg/webhooks/admission/queues): wire-path creates must
+            # hit the same chain the in-process CLI applies
+            obj = self.admission.admit_queue(obj, self)
+        with self._lock:
+            getattr(self, spec.attr)[k] = obj
+        self._notify(kind, obj if spec.key_of else {"key": k, "obj": obj})
+        return obj
+
+    def delete_object(self, kind: str, key: str) -> None:
+        from volcano_tpu.cache.kinds import KINDS
+        spec = KINDS[kind]
+        with self._lock:
+            obj = getattr(self, spec.attr).pop(key, None)
+        if obj is not None:
+            self._notify(f"{kind}_deleted",
+                         obj if spec.key_of else {"key": key, "obj": obj})
 
     def watch(self, fn: Callable[[str, object], None]):
         self._watchers.append(fn)
@@ -223,6 +263,8 @@ class FakeCluster(Cluster):
             pod = self.pods.get(f"{namespace}/{name}")
             if pod is not None:
                 pod.nominated_node = node_name
+        if pod is not None:
+            self._notify("pod", pod)
 
     def update_podgroup_status(self, pg: PodGroup) -> None:
         with self._lock:
@@ -239,11 +281,15 @@ class FakeCluster(Cluster):
         Bound -> Running; Releasing -> deleted."""
         with self._lock:
             to_delete = []
+            started = []
             for key, pod in self.pods.items():
                 if pod.phase is TaskStatus.BOUND:
                     pod.phase = TaskStatus.RUNNING
+                    started.append(pod)
                 elif pod.phase is TaskStatus.RELEASING:
                     to_delete.append(key)
+        for pod in started:
+            self._notify("pod", pod)
         for key in to_delete:
             self.delete_pod(key)
 

@@ -1,0 +1,63 @@
+"""Registry of object kinds the cluster store holds.
+
+One table shared by FakeCluster (in-memory store), the state server
+(HTTP apiserver analogue) and RemoteCluster (client mirror), so the
+three never drift on what kinds exist, which attribute holds them, and
+how an object keys itself.  Reference analogue: the CRD scheme
+registration in staging/src/volcano.sh/apis (one Group/Version/Kind
+table driving clientsets, informers and the apiserver alike).
+
+Dict-kinds (services, config maps, secrets, PVCs, PVs, datasources)
+hold plain dicts whose key the writer supplies; typed kinds derive the
+key from the object.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, NamedTuple, Optional
+
+
+class KindSpec(NamedTuple):
+    attr: str                                # store attribute on Cluster
+    key_of: Optional[Callable[[object], str]]  # None => caller supplies
+
+
+def _key(obj) -> str:
+    return obj.key
+
+
+def _name(obj) -> str:
+    return obj.name
+
+
+KINDS: Dict[str, KindSpec] = {
+    "pod": KindSpec("pods", _key),
+    "node": KindSpec("nodes", _name),
+    "podgroup": KindSpec("podgroups", _key),
+    "queue": KindSpec("queues", _name),
+    "hypernode": KindSpec("hypernodes", _name),
+    "priority_class": KindSpec("priority_classes", _name),
+    "vcjob": KindSpec("vcjobs", _key),
+    "jobflow": KindSpec("jobflows", _key),
+    "jobtemplate": KindSpec("jobtemplates", _key),
+    "cronjob": KindSpec("cronjobs", _key),
+    "hyperjob": KindSpec("hyperjobs", _key),
+    "nodeshard": KindSpec("nodeshards", _name),
+    "numatopology": KindSpec("numatopologies", _name),
+    # plain-dict kinds (plugin/operator supplied payloads)
+    "service": KindSpec("services", None),
+    "config_map": KindSpec("config_maps", None),
+    "secret": KindSpec("secrets", None),
+    "pvc": KindSpec("pvcs", None),
+    "pv": KindSpec("pvs", None),
+    "datasource": KindSpec("datasources", None),
+}
+
+
+def key_for(kind: str, obj, key: Optional[str] = None) -> str:
+    spec = KINDS[kind]
+    if key is not None:
+        return key
+    if spec.key_of is None:
+        raise ValueError(f"kind {kind!r} needs an explicit key")
+    return spec.key_of(obj)

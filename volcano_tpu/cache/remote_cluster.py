@@ -1,0 +1,331 @@
+"""RemoteCluster: the wire-backed Cluster implementation.
+
+The client half of the process split (server half:
+volcano_tpu/server/state_server.py).  Mirrors the reference scheduler's
+informer architecture (pkg/scheduler/cache/cache.go:109,
+event_handlers.go): a local object mirror is bootstrapped by one full
+LIST (/snapshot) and then kept current by a background WATCH long-poll
+thread; reads (list_all, store attributes) are served from the mirror
+with zero RPCs, and every write goes to the server AND is echoed into
+the mirror immediately so a process observes its own writes without
+waiting for the watch round-trip (the reference's assume-cache
+discipline, cache.go:1342 AddBindTask).
+
+Stdlib-only: urllib over HTTP/JSON with the api/codec.py codec.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+from volcano_tpu.api import codec
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.cache.cluster import Cluster, ClusterSnapshot
+from volcano_tpu.cache.kinds import KINDS, key_for
+
+log = logging.getLogger(__name__)
+
+
+class RemoteError(RuntimeError):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class RemoteCluster(Cluster):
+    def __init__(self, base_url: str, start_watch: bool = True,
+                 timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self._mlock = threading.RLock()        # mirror + watchers
+        self._watchers: List[Callable[[str, object], None]] = []
+        self._rv = 0
+        self._stop = threading.Event()
+        # mirror stores, same attribute names as FakeCluster
+        for spec in KINDS.values():
+            setattr(self, spec.attr, {})
+        self.commands: List[dict] = []
+        self.events: List[tuple] = []          # local record only
+        self.resync()
+        self._watch_thread = None
+        if start_watch:
+            self._watch_thread = threading.Thread(
+                target=self._watch_loop, name="cluster-watch", daemon=True)
+            self._watch_thread.start()
+
+    # -- HTTP ----------------------------------------------------------
+
+    def _request(self, method: str, path: str, payload=None,
+                 timeout: Optional[float] = None):
+        data = None
+        if payload is not None:
+            data = json.dumps(payload, separators=(",", ":")).encode()
+        req = urllib.request.Request(
+            self.base_url + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=timeout or self.timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read()).get("error", str(e))
+            except Exception:  # noqa: BLE001
+                msg = str(e)
+            if e.code == 422:
+                from volcano_tpu.webhooks.admission import AdmissionError
+                raise AdmissionError(msg) from None
+            if e.code == 409:
+                raise ValueError(msg) from None
+            if e.code == 404:
+                raise KeyError(msg) from None
+            raise RemoteError(e.code, msg) from None
+
+    # -- mirror maintenance --------------------------------------------
+
+    def resync(self) -> None:
+        """Full LIST: replace the mirror (bootstrap + ring fall-off)."""
+        payload = self._request("GET", "/snapshot")
+        with self._mlock:
+            self._rv = payload["rv"]
+            stores = payload["stores"]
+            for kind, spec in KINDS.items():
+                mirror = getattr(self, spec.attr)
+                mirror.clear()
+                for k, enc in stores.get(kind, {}).items():
+                    mirror[k] = codec.decode(enc)
+            self.commands = codec.decode(stores.get("_commands", [])) or []
+
+    def _apply_event(self, kind: str, obj) -> None:
+        """Fold one watch event into the mirror."""
+        deleted = kind.endswith("_deleted")
+        base = kind[:-len("_deleted")] if deleted else kind
+        spec = KINDS.get(base)
+        if spec is not None:
+            if spec.key_of is None:
+                key, obj = obj["key"], obj["obj"]
+            else:
+                key = spec.key_of(obj)
+            with self._mlock:
+                store = getattr(self, spec.attr)
+                if deleted:
+                    store.pop(key, None)
+                else:
+                    store[key] = obj
+        elif base == "command":
+            with self._mlock:
+                self.commands.append(obj)
+        self._notify(kind, obj)
+
+    def _watch_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                payload = self._request(
+                    "GET", f"/watch?since={self._rv}&timeout=25",
+                    timeout=60.0)
+            except Exception:  # noqa: BLE001 — server restart etc.
+                if self._stop.wait(1.0):
+                    return
+                continue
+            if payload.get("resync") or payload["rv"] < self._rv:
+                # ring fall-off — or the server restarted and its rv
+                # counter reset below ours: either way the incremental
+                # stream is broken and only a full re-list recovers
+                try:
+                    self.resync()
+                except Exception:  # noqa: BLE001
+                    log.exception("resync failed")
+                continue
+            for ev in payload["events"]:
+                self._rv = max(self._rv, ev["rv"])
+                try:
+                    self._apply_event(ev["kind"], codec.decode(ev["obj"]))
+                except Exception:  # noqa: BLE001
+                    log.exception("watch event %s failed", ev["kind"])
+            self._rv = max(self._rv, payload["rv"])
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def _notify(self, kind: str, obj) -> None:
+        for w in list(self._watchers):
+            try:
+                w(kind, obj)
+            except Exception:  # noqa: BLE001
+                log.exception("watcher failed on %s", kind)
+
+    # -- Cluster interface: reads --------------------------------------
+
+    def list_all(self) -> ClusterSnapshot:
+        with self._mlock:
+            return ClusterSnapshot(
+                pods=list(self.pods.values()),
+                nodes=list(self.nodes.values()),
+                podgroups=list(self.podgroups.values()),
+                queues=list(self.queues.values()),
+                hypernodes=list(self.hypernodes.values()),
+                priority_classes=list(self.priority_classes.values()),
+                vcjobs=list(self.vcjobs.values()),
+            )
+
+    def watch(self, fn) -> None:
+        self._watchers.append(fn)
+
+    def unwatch(self, fn) -> None:
+        try:
+            self._watchers.remove(fn)
+        except ValueError:
+            pass
+
+    # -- Cluster interface: writes (server + local echo) ---------------
+
+    def put_object(self, kind: str, obj, key: Optional[str] = None):
+        resp = self._request("POST", f"/objects/{kind}",
+                             {"obj": codec.encode(obj), "key": key})
+        stored = codec.decode(resp["obj"])
+        spec = KINDS[kind]
+        k = key_for(kind, stored if spec.key_of else obj, key)
+        with self._mlock:
+            getattr(self, spec.attr)[k] = stored
+        self._notify(kind, stored if spec.key_of
+                     else {"key": k, "obj": stored})
+        return stored
+
+    def delete_object(self, kind: str, key: str) -> None:
+        from urllib.parse import quote
+        self._request("DELETE",
+                      f"/objects/{kind}?key={quote(key, safe='')}")
+        spec = KINDS[kind]
+        with self._mlock:
+            obj = getattr(self, spec.attr).pop(key, None)
+        if obj is not None:
+            self._notify(f"{kind}_deleted",
+                         obj if spec.key_of else {"key": key, "obj": obj})
+
+    # typed conveniences matching the FakeCluster surface ---------------
+
+    def add_node(self, node):
+        return self.put_object("node", node)
+
+    def remove_node(self, name: str):
+        self.delete_object("node", name)
+
+    def add_pod(self, pod) -> None:
+        self.put_object("pod", pod)
+
+    def delete_pod(self, key: str) -> None:
+        self.delete_object("pod", key)
+
+    def add_podgroup(self, pg) -> None:
+        self.put_object("podgroup", pg)
+
+    def delete_podgroup(self, key: str) -> None:
+        self.delete_object("podgroup", key)
+
+    def add_queue(self, queue):
+        return self.put_object("queue", queue)
+
+    def add_hypernode(self, hn) -> None:
+        self.put_object("hypernode", hn)
+
+    def delete_hypernode(self, name: str) -> None:
+        self.delete_object("hypernode", name)
+
+    def add_numatopology(self, topo) -> None:
+        self.put_object("numatopology", topo)
+
+    def add_priority_class(self, pc) -> None:
+        self.put_object("priority_class", pc)
+
+    def add_vcjob(self, job):
+        return self.put_object("vcjob", job)
+
+    def update_vcjob(self, job) -> None:
+        # explicit key marks this as an UPDATE: the server must not
+        # re-run create admission on a status flush (e.g. a job whose
+        # queue has closed since creation would 422 forever)
+        self.put_object("vcjob", job, key=job.key)
+
+    def delete_vcjob(self, key: str) -> None:
+        self.delete_object("vcjob", key)
+
+    # -- scheduler write path ------------------------------------------
+
+    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
+        self._request("POST", "/bind", {
+            "namespace": namespace, "name": name, "node_name": node_name})
+        with self._mlock:
+            pod = self.pods.get(f"{namespace}/{name}")
+            if pod is not None:
+                pod.node_name = node_name
+                pod.phase = TaskStatus.BOUND
+
+    def evict_pod(self, namespace: str, name: str, reason: str = "") -> None:
+        self._request("POST", "/evict", {
+            "namespace": namespace, "name": name, "reason": reason})
+        with self._mlock:
+            pod = self.pods.get(f"{namespace}/{name}")
+            if pod is not None:
+                pod.phase = TaskStatus.RELEASING
+                pod.status_message = reason
+
+    def nominate_pod(self, namespace: str, name: str,
+                     node_name: str) -> None:
+        self._request("POST", "/nominate", {
+            "namespace": namespace, "name": name, "node_name": node_name})
+        with self._mlock:
+            pod = self.pods.get(f"{namespace}/{name}")
+            if pod is not None:
+                pod.nominated_node = node_name
+
+    def update_podgroup_status(self, pg) -> None:
+        self._request("POST", "/podgroup_status",
+                      {"obj": codec.encode(pg)})
+        with self._mlock:
+            self.podgroups[pg.key] = pg
+
+    def record_event(self, obj_key: str, reason: str,
+                     message: str) -> None:
+        self.events.append((obj_key, reason, message))
+        try:
+            self._request("POST", "/record_event", {
+                "obj_key": obj_key, "reason": reason, "message": message})
+        except Exception:  # noqa: BLE001 — events are best-effort
+            log.debug("record_event failed", exc_info=True)
+
+    # -- command bus ---------------------------------------------------
+
+    def add_command(self, target_key: str, action: str) -> None:
+        self._request("POST", "/command",
+                      {"target": target_key, "action": action})
+
+    def drain_commands(self, target_key: str):
+        resp = self._request("POST", "/drain_commands",
+                             {"target": target_key})
+        with self._mlock:
+            self.commands = [c for c in self.commands
+                             if c.get("target") != target_key]
+        return resp["commands"]
+
+    # -- test / simulation surface -------------------------------------
+
+    def tick(self) -> None:
+        self._request("POST", "/tick")
+
+    def complete_pod(self, key: str, succeeded: bool = True,
+                     exit_code=None) -> None:
+        self._request("POST", "/complete_pod", {
+            "key": key, "succeeded": succeeded, "exit_code": exit_code})
+
+    # -- leader election -----------------------------------------------
+
+    def lease(self, name: str, holder: str, ttl: float = 15.0,
+              release: bool = False) -> dict:
+        return self._request("POST", "/lease", {
+            "name": name, "holder": holder, "ttl": ttl,
+            "release": release})

@@ -161,7 +161,7 @@ def cmd_jobtemplate_create(cluster, args):
     for job in jobs:
         tmpl = JobTemplate(name=job.name, namespace=job.namespace,
                            job=job)
-        cluster.jobtemplates[tmpl.key] = tmpl
+        cluster.put_object("jobtemplate", tmpl)
         print(f"jobtemplate {tmpl.key} created")
 
 
@@ -184,7 +184,7 @@ def cmd_jobflow_create(cluster, args):
         else:
             flows.append(Flow(name=spec))
     flow = JobFlow(name=args.name, namespace=args.namespace, flows=flows)
-    cluster.jobflows[flow.key] = flow
+    cluster.put_object("jobflow", flow)
     print(f"jobflow {flow.key} created ({len(flows)} steps)")
 
 
@@ -202,9 +202,13 @@ def cmd_queue_create(cluster, args):
     if args.capability:
         queue.capability = Resource.from_resource_list(
             json.loads(args.capability))
-    if cluster.admission:
-        cluster.admission.admit_queue(queue, cluster)
-    cluster.add_queue(queue)
+    admission = getattr(cluster, "admission", None)
+    if admission is not None:
+        queue = admission.admit_queue(queue, cluster)
+        cluster.add_queue(queue)
+    else:
+        # wire mode: the server runs the admission chain on create
+        cluster.put_object("queue", queue)
     print(f"queue {queue.name} created (weight={queue.weight})")
 
 
@@ -315,8 +319,8 @@ def cmd_tick(cluster, args):
         sched.run_once()
         cluster.tick()
     mgr.stop()
-    print(f"ran {args.cycles} cycle(s): {len(cluster.binds)} binds, "
-          f"{len(cluster.evictions)} evictions")
+    bound = sum(1 for p in cluster.pods.values() if p.node_name)
+    print(f"ran {args.cycles} cycle(s): {bound} pods placed")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -325,6 +329,10 @@ def build_parser() -> argparse.ArgumentParser:
         description="volcano-tpu batch scheduling CLI")
     parser.add_argument("--state", default="vtpctl-cluster.pkl",
                         help="cluster state file (standalone mode)")
+    parser.add_argument("--server", default="",
+                        help="state-server URL (kubectl mode: talk to "
+                             "the live control plane instead of a "
+                             "state file)")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("init", help="provision simulated TPU slices")
@@ -438,7 +446,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
-    cluster = _load(args.state)
+    if args.server:
+        # kubectl mode: reads come from the watch-bootstrapped mirror,
+        # writes hit the live server; no state file is touched
+        from volcano_tpu.cache.remote_cluster import RemoteCluster
+        cluster = RemoteCluster(args.server, start_watch=False)
+    else:
+        cluster = _load(args.state)
     from volcano_tpu.webhooks import AdmissionError
     try:
         args.fn(cluster, args)
@@ -448,7 +462,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     except BrokenPipeError:
         # output piped into head etc.; state still saved below
         pass
-    _save(cluster, args.state)
+    if args.server:
+        cluster.close()
+    else:
+        _save(cluster, args.state)
     return 0
 
 

@@ -107,6 +107,14 @@ class CronJobController(Controller):
                 log.exception("cronjob %s sync failed", cron.key)
 
     def sync_cron(self, cron: CronJob, now: float) -> None:
+        before = (list(cron.active_jobs), list(cron.finished_jobs),
+                  cron.last_schedule_time)
+        self._reconcile(cron, now)
+        if (cron.active_jobs, cron.finished_jobs,
+                cron.last_schedule_time) != before:
+            self.cluster.put_object("cronjob", cron)
+
+    def _reconcile(self, cron: CronJob, now: float) -> None:
         # prune finished runs from active list; enforce history limit
         finished = []
         still_active = []

@@ -50,7 +50,17 @@ class ControllerManager:
         self.cluster = cluster
         self.controllers: List[Controller] = []
         names = enabled if enabled is not None else list(CONTROLLERS)
+        # controller-level feature gates (pkg/features/volcano_features.go)
+        from volcano_tpu import features
+        gated = {"cronjob": "CronVolcanoJobSupport",
+                 "podgroup": "WorkLoadSupport",
+                 "job": "VolcanoJobSupport"}
         for name in names:
+            gate = gated.get(name)
+            if gate is not None and not features.enabled(gate):
+                log.info("controller %s disabled by feature gate %s",
+                         name, gate)
+                continue
             builder = CONTROLLERS.get(name)
             if builder is None:
                 log.warning("unknown controller %s", name)

@@ -77,6 +77,12 @@ class HyperJobController(Controller):
     def sync_hyperjob(self, hj: HyperJob) -> None:
         if hj.phase in (HyperJobPhase.COMPLETED, HyperJobPhase.FAILED):
             return
+        before = hj.phase
+        self._reconcile(hj)
+        if hj.phase != before:
+            self.cluster.put_object("hyperjob", hj)
+
+    def _reconcile(self, hj: HyperJob) -> None:
 
         allowed_domains = self._allowed_domains(hj)
         phases: List[Optional[JobPhase]] = []

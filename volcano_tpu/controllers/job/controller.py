@@ -93,6 +93,10 @@ class JobController(Controller):
                 if pod.phase is TaskStatus.FAILED and \
                         not pod.annotations.get("vc-policy-handled"):
                     pod.annotations["vc-policy-handled"] = "true"
+                    # persist the handled marker: after a controller
+                    # restart (or over the wire) the policy must not
+                    # fire a second time for the same failure
+                    self.cluster.put_object("pod", pod)
                     self._apply_policy(job, pod, JobEvent.POD_FAILED)
                     if job.phase in TERMINAL_PHASES or \
                             job.phase is JobPhase.RESTARTING:
@@ -286,6 +290,15 @@ class JobController(Controller):
         pod.phase = TaskStatus.PENDING
         pod.node_name = ""
         pod.annotations[GROUP_NAME_ANNOTATION] = job.name
+        from volcano_tpu import features
+        if features.enabled("SchedulingGatesQueueAdmission"):
+            # pods start gated; the scheduler lifts the gate once the
+            # podgroup's queue admits it (job_updater.py; reference
+            # feature gate of the same name)
+            from volcano_tpu.framework.job_updater import (
+                QUEUE_ADMISSION_GATE)
+            if QUEUE_ADMISSION_GATE not in pod.scheduling_gates:
+                pod.scheduling_gates.append(QUEUE_ADMISSION_GATE)
         pod.labels[JOB_NAME_LABEL] = job.name
         pod.labels[TASK_SPEC_LABEL] = spec.name
         pod.labels[TASK_INDEX_LABEL] = str(index)

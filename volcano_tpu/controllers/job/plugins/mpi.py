@@ -32,15 +32,15 @@ class MPIPlugin(JobPlugin):
         # mpi requires the ssh keypair secret
         get_job_plugin("ssh").on_job_add(job, cluster)
         hosts = task_hostnames(job, self.worker)
-        cluster.config_maps[f"{job.namespace}/{job.name}-mpi-hostfile"] = {
+        cluster.put_object("config_map", {
             "hostfile": "\n".join(f"{h} slots=1" for h in hosts),
-        }
+        }, key=f"{job.namespace}/{job.name}-mpi-hostfile")
 
     def on_job_delete(self, job, cluster):
         # symmetric with on_job_add: the ssh secret we created goes too
         get_job_plugin("ssh").on_job_delete(job, cluster)
-        cluster.config_maps.pop(f"{job.namespace}/{job.name}-mpi-hostfile",
-                                None)
+        cluster.delete_object("config_map",
+                              f"{job.namespace}/{job.name}-mpi-hostfile")
 
     def on_pod_create(self, pod, job):
         set_env(pod, "MPI_HOST",

@@ -22,18 +22,18 @@ class SvcPlugin(JobPlugin):
 
     def on_job_add(self, job, cluster):
         key = f"{job.namespace}/{job.name}"
-        cluster.services[key] = {
+        cluster.put_object("service", {
             "name": job.name, "namespace": job.namespace,
             "headless": True, "selector": {JOB_NAME_LABEL: job.name},
-        }
+        }, key=key)
         hosts = {f"{spec.name}.host": "\n".join(task_hostnames(job, spec.name))
                  for spec in job.tasks}
-        cluster.config_maps[f"{key}-svc"] = hosts
+        cluster.put_object("config_map", hosts, key=f"{key}-svc")
 
     def on_job_delete(self, job, cluster):
         key = f"{job.namespace}/{job.name}"
-        cluster.services.pop(key, None)
-        cluster.config_maps.pop(f"{key}-svc", None)
+        cluster.delete_object("service", key)
+        cluster.delete_object("config_map", f"{key}-svc")
 
     def on_pod_create(self, pod, job):
         for spec in job.tasks:

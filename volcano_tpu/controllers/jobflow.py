@@ -45,6 +45,14 @@ class JobFlowController(Controller):
     def sync_flow(self, flow: JobFlow) -> None:
         if flow.phase in (JobFlowPhase.SUCCEED, JobFlowPhase.FAILED):
             return
+        before = (flow.phase, len(flow.deployed_jobs))
+        self._reconcile(flow)
+        if (flow.phase, len(flow.deployed_jobs)) != before:
+            # persist status — a wire-backed cluster won't see in-place
+            # mutation of the mirror copy
+            self.cluster.put_object("jobflow", flow)
+
+    def _reconcile(self, flow: JobFlow) -> None:
 
         job_phases: Dict[str, Optional[JobPhase]] = {}
         for step in flow.flows:

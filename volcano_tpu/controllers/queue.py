@@ -33,18 +33,22 @@ class QueueController(Controller):
                           if pg.phase not in (PodGroupPhase.COMPLETED,)]
                 if not active:
                     queue.state = QueueState.CLOSED
+                    self.cluster.put_object("queue", queue)
                     log.info("queue %s closed", queue.name)
             elif queue.state is QueueState.UNKNOWN:
                 queue.state = QueueState.OPEN
+                self.cluster.put_object("queue", queue)
 
     def close_queue(self, name: str) -> None:
         queue = self.cluster.queues.get(name)
         if queue is None:
             return
         queue.state = QueueState.CLOSING
+        self.cluster.put_object("queue", queue)
         self.sync()
 
     def open_queue(self, name: str) -> None:
         queue = self.cluster.queues.get(name)
         if queue is not None:
             queue.state = QueueState.OPEN
+            self.cluster.put_object("queue", queue)

@@ -60,12 +60,20 @@ class ShardingController(Controller):
                 (agent_nodes if node.name in agent_set
                  else batch_nodes).append(node.name)
 
-        self.cluster.nodeshards = {
+        desired = {
             "batch": NodeShard(name="batch", scheduler=BATCH_SCHEDULER,
                                nodes=batch_nodes),
             "agent": NodeShard(name="agent", scheduler=AGENT_SCHEDULER,
                                nodes=agent_nodes),
         }
+        # persist only real changes: shard churn invalidates both
+        # schedulers' candidate orderings (and crosses the wire)
+        current = getattr(self.cluster, "nodeshards", {}) or {}
+        for name, shard in desired.items():
+            old = current.get(name)
+            if old is None or old.scheduler != shard.scheduler or \
+                    list(old.nodes) != shard.nodes:
+                self.cluster.put_object("nodeshard", shard)
 
 
 def shard_nodes_for(cluster, scheduler_name: str) -> List[str]:

@@ -27,6 +27,9 @@ def remove_admission_gates(ssn) -> int:
             gates = task.pod.scheduling_gates
             if QUEUE_ADMISSION_GATE in gates:
                 gates.remove(QUEUE_ADMISSION_GATE)
+                # persist: the gate patch must cross the wire boundary
+                # (reference: SchGateManager PATCHes the pod)
+                ssn.cache.cluster.put_object("pod", task.pod)
                 removed += 1
     return removed
 

@@ -75,6 +75,9 @@ class NumaAwarePlugin(Plugin):
     name = "numaaware"
 
     def on_session_open(self, ssn):
+        from volcano_tpu import features
+        if not features.enabled("ResourceTopology"):
+            return   # feature-gated off (features.py)
         self._ssn = ssn
         self._topologies: Dict[str, Numatopology] = dict(
             getattr(ssn.cache.cluster, "numatopologies", {}) or {})

@@ -23,6 +23,9 @@ class PDBPlugin(Plugin):
     name = "pdb"
 
     def on_session_open(self, ssn):
+        from volcano_tpu import features
+        if not features.enabled("PodDisruptionBudgetsSupport"):
+            return   # feature-gated off (features.py)
         self.ssn = ssn
         ssn.add_preemptable_fn(self.name, self._filter)
         ssn.add_reclaimable_fn(self.name, self._filter)

@@ -39,6 +39,9 @@ class VolumeBindingPlugin(Plugin):
     name = "volumebinding"
 
     def on_session_open(self, ssn):
+        from volcano_tpu import features
+        if not features.enabled("VolumeBinding"):
+            return   # feature-gated off (features.py)
         self.ssn = ssn
         cluster = ssn.cache.cluster
         self.pvs: Dict[str, dict] = dict(

@@ -1,0 +1,5 @@
+"""State server: the apiserver analogue for multi-process deployments."""
+
+from volcano_tpu.server.state_server import StateServer, serve
+
+__all__ = ["StateServer", "serve"]

@@ -1,0 +1,71 @@
+"""Run the state server standalone.
+
+    python -m volcano_tpu.server --port 8700 --state cluster.pkl \
+        --tick-period 1.0
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import pickle
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="volcano-tpu-server")
+    parser.add_argument("--port", type=int, default=8700)
+    parser.add_argument("--state", default="",
+                        help="pickled FakeCluster to load/save")
+    parser.add_argument("--tick-period", type=float, default=0.0,
+                        help="self-tick the simulated kubelet every N "
+                             "seconds (0 = external /tick only)")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    log = logging.getLogger("volcano_tpu.server")
+
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    from volcano_tpu.server.state_server import serve
+    from volcano_tpu.webhooks import default_admission
+
+    cluster = None
+    if args.state and os.path.exists(args.state):
+        with open(args.state, "rb") as f:
+            cluster = pickle.load(f)
+        if cluster.admission is None:
+            cluster.admission = default_admission()
+        log.info("loaded state from %s (%d nodes, %d pods)",
+                 args.state, len(cluster.nodes), len(cluster.pods))
+
+    httpd, state = serve(port=args.port, cluster=cluster,
+                         tick_period=args.tick_period)
+    log.info("state server on http://127.0.0.1:%d",
+             httpd.server_address[1])
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+
+    state.tick_stop.set()   # no kubelet mutations during save
+    httpd.shutdown()
+    if args.state:
+        tmp = f"{args.state}.tmp"
+        # hold the store lock: a straggling handler thread must not
+        # mutate dicts mid-pickle ("dictionary changed size" -> lost save)
+        with state.cluster._lock, open(tmp, "wb") as f:
+            pickle.dump(state.cluster, f)
+        os.replace(tmp, args.state)
+        log.info("state saved to %s", args.state)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,301 @@
+"""HTTP/JSON state server — the apiserver analogue.
+
+This is the wire boundary the reference control plane is built around:
+scheduler, controller manager, agent scheduler and node agents run as
+separate OS processes and coordinate ONLY through this server, the way
+the reference components only meet at the Kubernetes apiserver
+(pkg/scheduler/cache/cache.go:109 informer wiring, cache.go:984 bind
+POST, event_handlers.go watch dispatch).
+
+Design:
+  * The authoritative store is a FakeCluster (same semantics in-process
+    and served — one implementation of truth).  The admission chain
+    runs server-side on create, like real webhooks at the apiserver.
+  * Every mutation appends to a monotonically-versioned event log; GET
+    /watch?since=rv long-polls it.  Clients that fall off the ring
+    re-list (resync), mirroring k8s watch/"too old resource version".
+  * Leases implement leader election (cmd/scheduler/app/server.go:99):
+    compare-and-swap on {name, holder, ttl} under the server lock.
+  * POST /tick advances the simulated kubelet (Bound->Running,
+    Releasing->deleted), or --tick-period makes the server self-tick.
+
+Stdlib-only (ThreadingHTTPServer + urllib on the client side).
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from volcano_tpu.api import codec
+from volcano_tpu.cache.fake_cluster import FakeCluster
+from volcano_tpu.cache.kinds import KINDS
+
+log = logging.getLogger(__name__)
+
+EVENT_RING = 100_000     # events kept for watchers before forcing resync
+
+
+class Lease:
+    __slots__ = ("holder", "expires")
+
+    def __init__(self, holder: str, expires: float):
+        self.holder = holder
+        self.expires = expires
+
+
+class StateServer:
+    """Owns the authoritative store + event log + leases."""
+
+    def __init__(self, cluster: Optional[FakeCluster] = None):
+        if cluster is None:
+            from volcano_tpu.webhooks import default_admission
+            cluster = FakeCluster()
+            cluster.admission = default_admission()
+        self.cluster = cluster
+        self._lock = threading.Lock()          # event log + leases
+        self._event_cv = threading.Condition(self._lock)
+        self._events: collections.deque = collections.deque(maxlen=EVENT_RING)
+        self._rv = 0
+        self._leases: Dict[str, Lease] = {}
+        cluster.watch(self._on_store_event)
+
+    # -- event log -----------------------------------------------------
+
+    def _on_store_event(self, kind: str, obj) -> None:
+        try:
+            payload = codec.encode(obj)
+        except TypeError:
+            log.exception("unencodable %s event dropped", kind)
+            return
+        with self._event_cv:
+            self._rv += 1
+            self._events.append((self._rv, kind, payload))
+            self._event_cv.notify_all()
+
+    def events_since(self, since: int, timeout: float = 25.0):
+        """(rv, events, resync) — blocks up to timeout for news."""
+        deadline = time.monotonic() + timeout
+        with self._event_cv:
+            while True:
+                if self._events and self._events[0][0] > since + 1:
+                    # client fell off the ring: it must re-list
+                    return self._rv, [], True
+                if self._rv > since and self._events:
+                    # rvs are contiguous: the suffix starts at a known
+                    # offset — never scan the whole (up to 100k) ring
+                    start = since - self._events[0][0] + 1
+                    news = list(itertools.islice(
+                        self._events, max(0, start), None))
+                    return self._rv, news, False
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    return self._rv, [], False
+                self._event_cv.wait(remain)
+
+    def snapshot_payload(self) -> dict:
+        """Full store dump + current rv (client list+watch bootstrap)."""
+        with self._event_cv:
+            rv = self._rv
+            stores = {}
+            with self.cluster._lock:
+                for kind, spec in KINDS.items():
+                    store = getattr(self.cluster, spec.attr, {})
+                    stores[kind] = {k: codec.encode(v)
+                                    for k, v in store.items()}
+                stores["_commands"] = codec.encode(
+                    list(self.cluster.commands))
+        return {"rv": rv, "stores": stores}
+
+    # -- leases (leader election) --------------------------------------
+
+    def lease(self, name: str, holder: str, ttl: float,
+              release: bool = False) -> dict:
+        now = time.time()
+        with self._lock:
+            cur = self._leases.get(name)
+            if release:
+                if cur and cur.holder == holder:
+                    del self._leases[name]
+                return {"acquired": False, "holder": "", "expires": 0}
+            if cur is None or cur.expires < now or cur.holder == holder:
+                self._leases[name] = Lease(holder, now + ttl)
+                return {"acquired": True, "holder": holder,
+                        "expires": now + ttl}
+            return {"acquired": False, "holder": cur.holder,
+                    "expires": cur.expires}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "volcano-tpu-state"
+    protocol_version = "HTTP/1.1"
+    state: StateServer = None          # injected by serve()
+
+    # quiet the default stderr access log
+    def log_message(self, fmt, *args):  # noqa: N802
+        log.debug("http: " + fmt, *args)
+
+    def _json(self, code: int, payload) -> None:
+        body = json.dumps(payload, separators=(",", ":")).encode()
+        try:
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            # client went away mid-response (killed scheduler, watch
+            # cancel) — routine during failover tests, not an error
+            self.close_connection = True
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if not length:
+            return {}
+        return json.loads(self.rfile.read(length))
+
+    # -- GET -----------------------------------------------------------
+
+    def do_GET(self):  # noqa: N802
+        url = urlparse(self.path)
+        st = self.state
+        if url.path == "/healthz":
+            return self._json(200, {"ok": True})
+        if url.path == "/snapshot":
+            return self._json(200, st.snapshot_payload())
+        if url.path == "/leases":
+            now = time.time()
+            with st._lock:
+                return self._json(200, {
+                    name: {"holder": l.holder,
+                           "expires_in": round(l.expires - now, 3)}
+                    for name, l in st._leases.items()})
+        if url.path == "/watch":
+            q = parse_qs(url.query)
+            since = int(q.get("since", ["0"])[0])
+            timeout = min(float(q.get("timeout", ["25"])[0]), 55.0)
+            rv, events, resync = st.events_since(since, timeout)
+            return self._json(200, {
+                "rv": rv, "resync": resync,
+                "events": [{"rv": r, "kind": k, "obj": o}
+                           for r, k, o in events]})
+        return self._json(404, {"error": f"no route {url.path}"})
+
+    # -- POST ----------------------------------------------------------
+
+    def do_POST(self):  # noqa: N802
+        url = urlparse(self.path)
+        st = self.state
+        cl = st.cluster
+        try:
+            body = self._body()
+        except (ValueError, json.JSONDecodeError) as e:
+            return self._json(400, {"error": str(e)})
+        try:
+            if url.path.startswith("/objects/"):
+                kind = url.path[len("/objects/"):]
+                if kind not in KINDS:
+                    return self._json(404, {"error": f"unknown kind {kind}"})
+                obj = codec.decode(body["obj"])
+                key = body.get("key")
+                stored = cl.put_object(kind, obj, key=key)
+                return self._json(200, {"obj": codec.encode(stored)})
+            if url.path == "/bind":
+                cl.bind_pod(body["namespace"], body["name"],
+                            body["node_name"])
+                return self._json(200, {"ok": True})
+            if url.path == "/evict":
+                cl.evict_pod(body["namespace"], body["name"],
+                             body.get("reason", ""))
+                return self._json(200, {"ok": True})
+            if url.path == "/nominate":
+                cl.nominate_pod(body["namespace"], body["name"],
+                                body["node_name"])
+                return self._json(200, {"ok": True})
+            if url.path == "/podgroup_status":
+                cl.update_podgroup_status(codec.decode(body["obj"]))
+                return self._json(200, {"ok": True})
+            if url.path == "/record_event":
+                cl.record_event(body["obj_key"], body["reason"],
+                                body.get("message", ""))
+                return self._json(200, {"ok": True})
+            if url.path == "/command":
+                cl.add_command(body["target"], body["action"])
+                return self._json(200, {"ok": True})
+            if url.path == "/drain_commands":
+                cmds = cl.drain_commands(body["target"])
+                return self._json(200, {"commands": cmds})
+            if url.path == "/lease":
+                return self._json(200, st.lease(
+                    body["name"], body["holder"],
+                    float(body.get("ttl", 15.0)),
+                    release=bool(body.get("release"))))
+            if url.path == "/tick":
+                cl.tick()
+                return self._json(200, {"ok": True})
+            if url.path == "/complete_pod":
+                cl.complete_pod(body["key"],
+                                succeeded=bool(body.get("succeeded", True)),
+                                exit_code=body.get("exit_code"))
+                return self._json(200, {"ok": True})
+            return self._json(404, {"error": f"no route {url.path}"})
+        except KeyError as e:
+            return self._json(404, {"error": str(e)})
+        except ValueError as e:
+            # discriminate by TYPE, never message wording: webhook
+            # rejection (AdmissionError) -> 422, anything else
+            # (bind conflicts etc.) -> 409
+            from volcano_tpu.webhooks.admission import AdmissionError
+            code = 422 if isinstance(e, AdmissionError) else 409
+            return self._json(code, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — surface, don't kill thread
+            log.exception("POST %s failed", url.path)
+            return self._json(500, {"error": str(e)})
+
+    # -- DELETE --------------------------------------------------------
+
+    def do_DELETE(self):  # noqa: N802
+        url = urlparse(self.path)
+        if not url.path.startswith("/objects/"):
+            return self._json(404, {"error": f"no route {url.path}"})
+        kind = url.path[len("/objects/"):]
+        if kind not in KINDS:
+            return self._json(404, {"error": f"unknown kind {kind}"})
+        key = parse_qs(url.query).get("key", [""])[0]
+        if not key:
+            return self._json(400, {"error": "missing key"})
+        self.state.cluster.delete_object(kind, key)
+        return self._json(200, {"ok": True})
+
+
+def serve(port: int = 0, cluster: Optional[FakeCluster] = None,
+          tick_period: float = 0.0
+          ) -> Tuple[ThreadingHTTPServer, StateServer]:
+    """Start the server on 127.0.0.1:port (0 = ephemeral); returns
+    (http_server, state).  Caller runs http_server.serve_forever()
+    or uses the background thread started here."""
+    state = StateServer(cluster)
+    handler = type("BoundHandler", (_Handler,), {"state": state})
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    httpd.daemon_threads = True
+    thread = threading.Thread(target=httpd.serve_forever,
+                              name="state-server", daemon=True)
+    thread.start()
+    state.tick_stop = threading.Event()
+    if tick_period > 0:
+        def tick_loop():
+            while not state.tick_stop.wait(tick_period):
+                try:
+                    state.cluster.tick()
+                except Exception:  # noqa: BLE001
+                    log.exception("tick failed")
+        threading.Thread(target=tick_loop, name="kubelet-tick",
+                         daemon=True).start()
+    return httpd, state
