@@ -160,11 +160,165 @@ def bench_agent_scheduler_throughput() -> float:
     return bound / dt
 
 
+def bench_gangpreempt_latency() -> float:
+    """p50 wall-clock for a high-priority 64-host hard-topology gang to
+    displace a low-priority tenant occupying a full v5p-256 slice: the
+    two-cycle evict -> nominate -> allocate handshake, measured from
+    submission to the 64th bind (VERDICT r1 item 3a; scenario shape
+    mirrors the reference's preempt benchmark, benchmark/README.md)."""
+    from volcano_tpu.api.podgroup import NetworkTopologySpec
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.api.types import NetworkTopologyMode, PodGroupPhase
+    from volcano_tpu.cache.cluster import PriorityClass
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.simulator import make_tpu_cluster
+    from volcano_tpu.uthelper import gang_job
+
+    conf = {
+        "actions": "enqueue, allocate, gangpreempt, backfill",
+        "tiers": [
+            {"plugins": [{"name": "priority"}, {"name": "gang"},
+                         {"name": "conformance"}]},
+            {"plugins": [{"name": "predicates"}, {"name": "proportion"},
+                         {"name": "nodeorder"}, {"name": "deviceshare"},
+                         {"name": "network-topology-aware"}]},
+        ],
+    }
+    latencies = []
+    for trial in range(max(3, TRIALS // 2)):
+        cluster = make_tpu_cluster([("target", "v5p-256"),   # 64 hosts
+                                    ("noise", "v5e-64")])
+        cluster.add_priority_class(PriorityClass("high", 1000))
+        # low-priority elastic tenant holds the whole target slice
+        pg_lo, pods_lo = gang_job(
+            "tenant", replicas=64, min_available=1,
+            requests={"cpu": 8, TPU: 4},
+            running_on=[f"target-w{i}" for i in range(64)],
+            pg_phase=PodGroupPhase.RUNNING)
+        cluster.add_podgroup(pg_lo)
+        for p in pods_lo:
+            cluster.add_pod(p)
+        pg_hi, pods_hi = gang_job(
+            "train-hi", replicas=64, requests={"cpu": 8, TPU: 4},
+            priority_class="high",
+            network_topology=NetworkTopologySpec(
+                NetworkTopologyMode.HARD, 1),
+            pg_phase=PodGroupPhase.INQUEUE)
+        sched = Scheduler(cluster, conf=conf, schedule_period=0)
+        sched.run_once()   # warm (tenant steady state)
+
+        t0 = time.perf_counter()
+        cluster.add_podgroup(pg_hi)
+        for p in pods_hi:
+            cluster.add_pod(p)
+        for _ in range(10):
+            sched.run_once()
+            cluster.tick()
+            hi = {k for k, _ in cluster.binds
+                  if k.startswith("default/train-hi")}
+            if len(hi) >= 64:
+                break
+        dt = time.perf_counter() - t0
+        assert len(hi) >= 64, f"gangpreempt stalled: {len(hi)}/64 bound"
+        latencies.append(dt)
+    return statistics.median(latencies)
+
+
+def bench_reclaim_convergence() -> float:
+    """Seconds for a 2-queue overcommit flip to converge: queue
+    'greedy' holds the whole 2-slice cluster; queue 'owed' submits
+    demand for its half; reclaim must evict greedy's surplus and bind
+    owed's jobs to its full deserved share (VERDICT r1 item 3b)."""
+    from volcano_tpu.api.queue import Queue
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.api.types import PodGroupPhase
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.simulator import make_tpu_cluster
+    from volcano_tpu.uthelper import gang_job
+
+    conf = {
+        "actions": "enqueue, allocate, reclaim, backfill",
+        "tiers": [
+            {"plugins": [{"name": "priority"}, {"name": "gang"},
+                         {"name": "conformance"}]},
+            {"plugins": [{"name": "predicates"}, {"name": "proportion"},
+                         {"name": "nodeorder"}, {"name": "deviceshare"}]},
+        ],
+    }
+    cluster = make_tpu_cluster([("sa", "v5e-64"), ("sb", "v5e-64")])
+    cluster.add_queue(Queue(name="greedy", weight=1))
+    cluster.add_queue(Queue(name="owed", weight=1))
+    # greedy: 8 elastic 4-host gangs = all 32 hosts
+    hosts = sorted(cluster.nodes)
+    for i in range(8):
+        mine = hosts[i * 4:(i + 1) * 4]
+        pg, pods = gang_job(f"greedy-{i}", queue="greedy", replicas=4,
+                            min_available=1, requests={"cpu": 8, TPU: 4},
+                            running_on=mine,
+                            pg_phase=PodGroupPhase.RUNNING)
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+    sched = Scheduler(cluster, conf=conf, schedule_period=0)
+    sched.run_once()
+
+    # the flip: owed demands exactly its deserved half (16 hosts)
+    t0 = time.perf_counter()
+    for i in range(4):
+        pg, pods = gang_job(f"owed-{i}", queue="owed", replicas=4,
+                            requests={"cpu": 8, TPU: 4})
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+    for _ in range(20):
+        sched.run_once()
+        cluster.tick()
+        owed = {k for k, _ in cluster.binds
+                if k.startswith("default/owed-")}
+        if len(owed) >= 16:
+            break
+    dt = time.perf_counter() - t0
+    assert len(owed) >= 16, f"reclaim stalled: {len(owed)}/16 bound"
+    return dt
+
+
+def bench_5k_host_scale() -> dict:
+    """5,000-host scale headroom: idle-cycle seconds + one-cycle
+    latency for a 1024-host gang (VERDICT r1 item 2)."""
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.uthelper import gang_job
+    from tests.test_scale import build_5k_cluster
+
+    cluster = build_5k_cluster()
+    sched = Scheduler(cluster, conf=BENCH_CONF, schedule_period=0)
+    sched.run_once()
+    t0 = time.perf_counter()
+    sched.run_once()
+    idle_s = time.perf_counter() - t0
+    pg, pods = gang_job("g1024", replicas=1024, min_available=1024,
+                        requests={"cpu": 8, TPU: 4})
+    cluster.add_podgroup(pg)
+    for p in pods:
+        cluster.add_pod(p)
+    t0 = time.perf_counter()
+    sched.run_once()
+    gang_s = time.perf_counter() - t0
+    bound = sum(1 for k, _ in cluster.binds
+                if k.startswith("default/g1024"))
+    assert bound == 1024, f"5k-scale gang bound {bound}/1024"
+    return {"idle_cycle_s": round(idle_s, 4),
+            "gang1024_cycle_s": round(gang_s, 4)}
+
+
 def main():
     p50 = bench_gang_allocate_latency()
     utilization = bench_utilization_under_contention()
     gang_shape_s = bench_reference_gang_shape()
     agent_pps = bench_agent_scheduler_throughput()
+    gangpreempt_p50 = bench_gangpreempt_latency()
+    reclaim_s = bench_reclaim_convergence()
+    scale = bench_5k_host_scale()
     print(json.dumps({
         "metric": "p50_gang_allocate_latency_256host_v5p1024",
         "value": round(p50, 4),
@@ -175,6 +329,9 @@ def main():
             "utilization_target": 0.95,
             "reference_gang_shape_1000pods_s": round(gang_shape_s, 4),
             "agent_scheduler_pods_per_s": round(agent_pps),
+            "gangpreempt_p50_64host_displace_s": round(gangpreempt_p50, 4),
+            "reclaim_convergence_2queue_flip_s": round(reclaim_s, 4),
+            "scale_5k_hosts": scale,
             "trials": TRIALS,
             "cluster_hosts": 256 + 64 + 16,
         },

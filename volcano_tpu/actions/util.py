@@ -52,27 +52,36 @@ def predicate_nodes(ssn, task: TaskInfo, nodes: List[NodeInfo],
 from volcano_tpu.api.types import QOS_BEST_EFFORT, QOS_LEVEL_ANNOTATION
 
 
-def split_by_fit(task: TaskInfo, nodes: List[NodeInfo]
-                 ) -> Tuple[List[NodeInfo], List[NodeInfo]]:
-    """Split candidates into (fits idle now, fits only future idle).
-
-    The second group drives pipelining onto releasing resources
-    (allocate.go idle/future-idle gradients).  Best-effort-QoS tasks
-    may additionally consume the node agent's REMAINING measured
-    oversubscription slack (already-overdrafted BE work is deducted)."""
+def fit_class(task: TaskInfo, node: NodeInfo) -> Optional[str]:
+    """Classify ONE node for *task*: "idle" (fits now), "future" (fits
+    only once releasing resources free up — drives pipelining), or None.
+    Best-effort-QoS tasks may additionally consume the node agent's
+    REMAINING measured oversubscription slack."""
     is_be = task.pod.annotations.get(QOS_LEVEL_ANNOTATION) == \
         QOS_BEST_EFFORT
+    idle = node.idle
+    future = node.future_idle()
+    if is_be and not node.oversubscription.is_empty():
+        slack = node.oversub_remaining()
+        idle = idle.clone().add(slack)
+        future = future.add(slack)
+    if task.init_resreq.less_equal(idle):
+        return "idle"
+    if task.init_resreq.less_equal(future):
+        return "future"
+    return None
+
+
+def split_by_fit(task: TaskInfo, nodes: List[NodeInfo]
+                 ) -> Tuple[List[NodeInfo], List[NodeInfo]]:
+    """Split candidates into (fits idle now, fits only future idle)
+    (allocate.go idle/future-idle gradients)."""
     idle_fit, future_fit = [], []
     for node in nodes:
-        idle = node.idle
-        future = node.future_idle()
-        if is_be and not node.oversubscription.is_empty():
-            slack = node.oversub_remaining()
-            idle = idle.clone().add(slack)
-            future = future.add(slack)
-        if task.init_resreq.less_equal(idle):
+        cls = fit_class(task, node)
+        if cls == "idle":
             idle_fit.append(node)
-        elif task.init_resreq.less_equal(future):
+        elif cls == "future":
             future_fit.append(node)
     return idle_fit, future_fit
 

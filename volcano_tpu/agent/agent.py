@@ -130,7 +130,7 @@ class NodeAgent:
         if cur is None:
             return
         if cur is not node:
-            ann_before, labels_before, _ = before
+            ann_before, labels_before, unsched_before = before
             for k, v in node.annotations.items():
                 if ann_before.get(k) != v:
                     cur.annotations[k] = v
@@ -139,7 +139,10 @@ class NodeAgent:
             for k, v in node.labels.items():
                 if labels_before.get(k) != v:
                     cur.labels[k] = v
-            cur.unschedulable = node.unschedulable
+            if node.unschedulable != unsched_before:
+                # only OUR cordon/uncordon is a delta; otherwise keep
+                # the freshest value (e.g. a concurrent admin cordon)
+                cur.unschedulable = node.unschedulable
         self.cluster.put_object("node", cur)
 
     def _persist_pod(self, pod, ann_before) -> None:

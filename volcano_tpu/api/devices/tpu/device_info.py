@@ -72,8 +72,11 @@ class TPUDevices(Devices):
                     f"of {self.slice.chips_per_host} chips, got {req:g}",
                     "tpu", resolvable=False)
             if self.chips_free_future < req:
+                # evicting the occupant frees the whole host — preempt/
+                # reclaim may wave this through and re-check post-evict
                 return unschedulable(
-                    "TPU host already occupied", "tpu")
+                    "TPU host already occupied", "tpu",
+                    evict_curable=True)
         else:
             if req not in _VALID_SUBHOST_CHIPS:
                 return unschedulable(
@@ -85,7 +88,8 @@ class TPUDevices(Devices):
                     f"node has only {self.chips_total:g} TPU chips",
                     "tpu", resolvable=False)
             if req > self.chips_free_future:
-                return unschedulable("not enough free TPU chips", "tpu")
+                return unschedulable("not enough free TPU chips", "tpu",
+                                     evict_curable=True)
         return None
 
     def score_node(self, task) -> float:

@@ -199,6 +199,13 @@ class HyperNodesInfo:
     def leaf_of_node(self, node_name: str) -> Optional[str]:
         return self.node_to_leaf.get(node_name)
 
+    def leaves(self) -> List[Optional[str]]:
+        """Distinct tier-1 leaf hypernodes, plus None for nodes outside
+        any hypernode (the per-leaf scoring key space)."""
+        out: List[Optional[str]] = sorted(set(self.node_to_leaf.values()))
+        out.append(None)
+        return out
+
     def ancestors(self, name: str) -> List[str]:
         """Path from *name* (inclusive) up to the virtual root.
 

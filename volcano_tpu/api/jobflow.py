@@ -51,6 +51,10 @@ class JobTemplate:
     uid: str = field(default_factory=new_uid)
     job: Optional[VCJob] = None   # the vcjob spec to stamp out
 
+    # status: names of live jobs stamped from this template
+    # (reference JobTemplateStatus.JobDependsOnList)
+    job_depends_on_list: List[str] = field(default_factory=list)
+
     @property
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"

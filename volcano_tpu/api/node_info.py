@@ -51,10 +51,21 @@ class NodeInfo:
     def __init__(self, node: Optional[Node] = None, name: str = ""):
         self.node: Optional[Node] = node
         self.name: str = node.name if node else name
-        self.allocatable = (Resource.from_resource_list(node.allocatable)
-                            if node else Resource())
-        self.capability = (Resource.from_resource_list(node.capacity or node.allocatable)
-                           if node else Resource())
+        if node is not None:
+            # memoize the quantity parse on the node object (replaced
+            # wholesale by watch events, so staleness is impossible);
+            # re-parsing every snapshot dominated 5k-host cycle cost
+            parsed = node.__dict__.get("_parsed_res")
+            if parsed is None:
+                parsed = (Resource.from_resource_list(node.allocatable),
+                          Resource.from_resource_list(
+                              node.capacity or node.allocatable))
+                node._parsed_res = parsed
+            self.allocatable = parsed[0].clone()
+            self.capability = parsed[1].clone()
+        else:
+            self.allocatable = Resource()
+            self.capability = Resource()
         self.idle = self.allocatable.clone()
         # reclaimable slack the node agent measured from real usage —
         # usable ONLY by best-effort-QoS tasks (reference
@@ -76,6 +87,10 @@ class NodeInfo:
         self.releasing = Resource()
         self.pipelined = Resource()
         self.tasks: Dict[str, "TaskInfo"] = {}
+        # host-port multiset (port -> holder count) maintained by
+        # add/remove_task so the ports predicate is O(task ports), not
+        # O(tasks on node) per check
+        self.occupied_ports: Dict[int, int] = {}
         # Conflict-aware binder optimistic-concurrency token
         # (reference api/node_info.go:100 BindGeneration).
         self.bind_generation: int = 0
@@ -174,11 +189,22 @@ class NodeInfo:
             self.used.add(req)
         task.node_name = self.name
         self.tasks[task.uid] = task.clone()
+        for c in task.pod.containers:
+            for port in c.ports:
+                self.occupied_ports[port] = \
+                    self.occupied_ports.get(port, 0) + 1
 
     def remove_task(self, task: "TaskInfo"):
         existing = self.tasks.pop(task.uid, None)
         if existing is None:
             return
+        for c in existing.pod.containers:
+            for port in c.ports:
+                left = self.occupied_ports.get(port, 0) - 1
+                if left > 0:
+                    self.occupied_ports[port] = left
+                else:
+                    self.occupied_ports.pop(port, None)
         req = existing.resreq
         if existing.status is TaskStatus.RELEASING:
             self.releasing.sub_unchecked(req)
@@ -212,6 +238,7 @@ class NodeInfo:
         c.pipelined = self.pipelined.clone()
         c.oversubscription = self.oversubscription.clone()
         c.tasks = dict(self.tasks)
+        c.occupied_ports = dict(self.occupied_ports)
         c.bind_generation = self.bind_generation
         c.others = dict(self.others)
         return c

@@ -58,6 +58,30 @@ class Container:
 
 
 @dataclass
+class PodAffinityTerm:
+    """One inter-pod (anti-)affinity term (k8s PodAffinityTerm
+    analogue, reference predicates.go:212-388 wrapping the upstream
+    interpodaffinity plugin).
+
+    selector: label -> allowed values (AND across keys, OR within a
+    key's list — same shape as Pod.affinity_node_terms).  A node
+    satisfies the term when a matching assigned pod exists in the same
+    topology domain (nodes sharing the node-label `topology_key`).
+    namespaces: where matching pods are searched; empty = the incoming
+    pod's own namespace.  weight: only meaningful for preferred terms.
+    """
+
+    selector: Dict[str, List[str]] = field(default_factory=dict)
+    topology_key: str = "kubernetes.io/hostname"
+    namespaces: List[str] = field(default_factory=list)
+    weight: int = 1
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        return all(labels.get(k) in vals
+                   for k, vals in self.selector.items())
+
+
+@dataclass
 class Pod:
     name: str
     namespace: str = "default"
@@ -74,6 +98,13 @@ class Pod:
     # ^ simplified nodeAffinity: OR over terms; each term is a map of
     #   label -> allowed values (AND within a term).
     tolerations: List[Toleration] = field(default_factory=list)
+    # inter-pod affinity (plugins/interpodaffinity.py)
+    pod_affinity: List[PodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity: List[PodAffinityTerm] = field(default_factory=list)
+    preferred_pod_affinity: List[PodAffinityTerm] = \
+        field(default_factory=list)
+    preferred_pod_anti_affinity: List[PodAffinityTerm] = \
+        field(default_factory=list)
     priority: int = 0
     priority_class: str = ""
     scheduler_name: str = "volcano-tpu"
@@ -90,13 +121,21 @@ class Pod:
 
     def resource_requests(self) -> Resource:
         """Aggregate container requests; init containers take per-dim max
-        (k8s effective-request semantics)."""
-        total = Resource()
-        for c in self.containers:
-            total.add(Resource.from_resource_list(c.requests))
-        for c in self.init_containers:
-            total.set_max(Resource.from_resource_list(c.requests))
-        return total
+        (k8s effective-request semantics).
+
+        The parse is memoized per pod object (requests are immutable
+        after creation; watch events replace the whole instance): at
+        5k-host scale the per-snapshot quantity re-parsing dominated
+        snapshot cost.  A clone is returned so callers can mutate."""
+        cached = self.__dict__.get("_resreq_cache")
+        if cached is None:
+            cached = Resource()
+            for c in self.containers:
+                cached.add(Resource.from_resource_list(c.requests))
+            for c in self.init_containers:
+                cached.set_max(Resource.from_resource_list(c.requests))
+            self._resreq_cache = cached
+        return cached.clone()
 
     @property
     def key(self) -> str:

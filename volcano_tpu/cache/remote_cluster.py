@@ -45,6 +45,7 @@ class RemoteCluster(Cluster):
         self._mlock = threading.RLock()        # mirror + watchers
         self._watchers: List[Callable[[str, object], None]] = []
         self._rv = 0
+        self._epoch = ""
         self._stop = threading.Event()
         # mirror stores, same attribute names as FakeCluster
         for spec in KINDS.values():
@@ -89,38 +90,62 @@ class RemoteCluster(Cluster):
     # -- mirror maintenance --------------------------------------------
 
     def resync(self) -> None:
-        """Full LIST: replace the mirror (bootstrap + ring fall-off)."""
+        """Full LIST: replace the mirror (bootstrap + ring fall-off +
+        server restart)."""
         payload = self._request("GET", "/snapshot")
         with self._mlock:
             self._rv = payload["rv"]
+            self._epoch = payload.get("epoch", "")
             stores = payload["stores"]
             for kind, spec in KINDS.items():
-                mirror = getattr(self, spec.attr)
-                mirror.clear()
-                for k, enc in stores.get(kind, {}).items():
-                    mirror[k] = codec.decode(enc)
+                # whole-store swap, never in-place clear: readers on
+                # other threads keep iterating their consistent copy
+                setattr(self, spec.attr, {
+                    k: codec.decode(enc)
+                    for k, enc in stores.get(kind, {}).items()})
             self.commands = codec.decode(stores.get("_commands", [])) or []
 
-    def _apply_event(self, kind: str, obj) -> None:
-        """Fold one watch event into the mirror."""
-        deleted = kind.endswith("_deleted")
-        base = kind[:-len("_deleted")] if deleted else kind
-        spec = KINDS.get(base)
-        if spec is not None:
-            if spec.key_of is None:
-                key, obj = obj["key"], obj["obj"]
-            else:
-                key = spec.key_of(obj)
-            with self._mlock:
-                store = getattr(self, spec.attr)
-                if deleted:
-                    store.pop(key, None)
-                else:
-                    store[key] = obj
-        elif base == "command":
-            with self._mlock:
-                self.commands.append(obj)
-        self._notify(kind, obj)
+    def _apply_batch(self, events) -> list:
+        """Fold a watch batch into the mirror copy-on-write: each
+        affected store is rebuilt as a fresh dict and swapped in, so a
+        controller iterating `cluster.pods` on another thread never
+        sees a dict mutate under it.  Returns (kind, obj) pairs for
+        watcher notification."""
+        decoded = []
+        for ev in events:
+            try:
+                decoded.append((ev["kind"], codec.decode(ev["obj"])))
+            except Exception:  # noqa: BLE001
+                log.exception("watch event %s undecodable", ev["kind"])
+        updated: dict = {}          # attr -> new dict
+        new_commands = None
+        notifications = []
+        with self._mlock:           # copies + swap atomic vs local echo
+            for kind, obj in decoded:
+                deleted = kind.endswith("_deleted")
+                base = kind[:-len("_deleted")] if deleted else kind
+                spec = KINDS.get(base)
+                if spec is not None:
+                    key = obj["key"] if spec.key_of is None \
+                        else spec.key_of(obj)
+                    store = updated.get(spec.attr)
+                    if store is None:
+                        store = dict(getattr(self, spec.attr))
+                        updated[spec.attr] = store
+                    if deleted:
+                        store.pop(key, None)
+                    else:
+                        store[key] = obj if spec.key_of else obj["obj"]
+                elif base == "command":
+                    if new_commands is None:
+                        new_commands = list(self.commands)
+                    new_commands.append(obj)
+                notifications.append((kind, obj))
+            for attr, store in updated.items():
+                setattr(self, attr, store)
+            if new_commands is not None:
+                self.commands = new_commands
+        return notifications
 
     def _watch_loop(self) -> None:
         while not self._stop.is_set():
@@ -132,21 +157,20 @@ class RemoteCluster(Cluster):
                 if self._stop.wait(1.0):
                     return
                 continue
-            if payload.get("resync") or payload["rv"] < self._rv:
-                # ring fall-off — or the server restarted and its rv
-                # counter reset below ours: either way the incremental
-                # stream is broken and only a full re-list recovers
+            epoch = payload.get("epoch", "")
+            if payload.get("resync") or payload["rv"] < self._rv or \
+                    (self._epoch and epoch and epoch != self._epoch):
+                # ring fall-off, rv regression, or a NEW server
+                # incarnation (epoch change — catches a restarted
+                # server whose counter already passed ours): only a
+                # full re-list recovers the stream
                 try:
                     self.resync()
                 except Exception:  # noqa: BLE001
                     log.exception("resync failed")
                 continue
-            for ev in payload["events"]:
-                self._rv = max(self._rv, ev["rv"])
-                try:
-                    self._apply_event(ev["kind"], codec.decode(ev["obj"]))
-                except Exception:  # noqa: BLE001
-                    log.exception("watch event %s failed", ev["kind"])
+            for kind, obj in self._apply_batch(payload["events"]):
+                self._notify(kind, obj)
             self._rv = max(self._rv, payload["rv"])
 
     def close(self) -> None:

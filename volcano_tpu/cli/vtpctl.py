@@ -307,12 +307,24 @@ def cmd_node_view(cluster, args):
 
 
 def cmd_tick(cluster, args):
-    """Run controllers + one scheduling cycle + kubelet tick."""
+    """Run controllers + one scheduling cycle + kubelet tick.
+
+    Against a live server (--server) the running control plane owns
+    scheduling and reconciliation — ticking locally with a stale,
+    watch-less mirror would push wrong status back — so only the
+    kubelet simulation is advanced there."""
+    if getattr(args, "server", ""):
+        for _ in range(args.cycles):
+            cluster.tick()
+        cluster.resync()
+        bound = sum(1 for p in cluster.pods.values() if p.node_name)
+        print(f"ticked {args.cycles} time(s): {bound} pods placed")
+        return
     from volcano_tpu.controllers import ControllerManager
     from volcano_tpu.scheduler import Scheduler
     mgr = ControllerManager(cluster, enabled=[
         "job", "podgroup", "queue", "hypernode", "garbagecollector",
-        "jobflow", "cronjob"])
+        "jobflow", "jobtemplate", "cronjob"])
     sched = Scheduler(cluster, schedule_period=0)
     for _ in range(args.cycles):
         mgr.sync_all()

@@ -50,6 +50,39 @@ def _match_field(spec: str, value: int, minimum: int = 0) -> bool:
     return False
 
 
+def cron_field_valid(spec: str, lo: int, hi: int) -> bool:
+    """Syntax + bounds check for one cron field ('*', '*/n', 'a',
+    'a-b', comma lists) — the admission-time twin of _match_field."""
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            return False
+        if part == "*":
+            continue
+        if part.startswith("*/"):
+            try:
+                step = int(part[2:])
+            except ValueError:
+                return False
+            if step <= 0:
+                return False
+        elif "-" in part:
+            try:
+                a, b = (int(x) for x in part.split("-", 1))
+            except ValueError:
+                return False
+            if not (lo <= a <= b <= hi):
+                return False
+        else:
+            try:
+                v = int(part)
+            except ValueError:
+                return False
+            if not lo <= v <= hi:
+                return False
+    return True
+
+
 def cron_matches(schedule: str, ts: Optional[float] = None) -> bool:
     """minute hour day-of-month month day-of-week."""
     fields = schedule.split()

@@ -33,10 +33,26 @@ class HyperJobPhase(enum.Enum):
 
 
 @dataclass
+class SplitPolicy:
+    """Multi-cluster splitting strategy (hyperjob.go:69-82).
+
+    static: each replica is split into member jobs of at most
+    `accelerators` chips each.  auto: the controller sizes splits from
+    the per-domain FREE accelerator capacity it observes (domains =
+    top-tier DCN-pod hypernodes, the TPU reading of silo clusters).
+    """
+
+    mode: str = "static"            # static | auto
+    accelerators: int = 0           # chips per split (static mode)
+    accelerator_type: str = "google.com/tpu"
+
+
+@dataclass
 class ReplicatedJob:
     name: str
     replicas: int = 1
     template: Optional[VCJob] = None
+    split_policy: Optional[SplitPolicy] = None
 
 
 @dataclass
@@ -48,6 +64,7 @@ class HyperJob:
     min_available: int = 1          # member jobs that must be Running
     max_domains: int = 0            # 0 = unlimited spread
     phase: HyperJobPhase = HyperJobPhase.PENDING
+    split_count: int = 0            # status.splitCount: jobs after split
     creation_time: float = field(default_factory=time.time)
 
     @property
@@ -58,14 +75,54 @@ class HyperJob:
         return f"{self.name}-{rj.name}-{index}"
 
 
+# pods/podgroups of a forwarded member job carry the target domain —
+# the TPU reading of batch.ForwardClusterKey (cache.go:400 podgroupBinder
+# annotates the silo cluster)
+FORWARD_DOMAIN_ANNOTATION = "volcano-tpu.io/forward-domain"
+
+
+class ForwardingBinder:
+    """Seam that pins a member job onto a topology domain.
+
+    Reference parity: the multi-cluster podgroupBinder
+    (pkg/scheduler/cache/cache.go:400) annotates pods + podgroup with
+    the silo cluster and forwards them; here the domain is a top-tier
+    (DCN-pod) hypernode, the annotation is FORWARD_DOMAIN_ANNOTATION,
+    and placement is enforced through node affinity on the domain
+    label.  Swap this class to forward to a REAL remote cluster (e.g.
+    push the job through a second state server).
+    """
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def forward(self, job: VCJob, domain: str) -> None:
+        from volcano_tpu.controllers.hypernode import DCN_POD_LABEL
+        job.annotations[FORWARD_DOMAIN_ANNOTATION] = domain
+        for spec in job.tasks:
+            template = spec.template_pod()
+            template.annotations[FORWARD_DOMAIN_ANNOTATION] = domain
+            template.affinity_node_terms = [{DCN_POD_LABEL: [domain]}]
+            spec.template = template
+        pg = self.cluster.podgroups.get(job.key)
+        if pg is not None:
+            pg.annotations[FORWARD_DOMAIN_ANNOTATION] = domain
+            self.cluster.update_podgroup_status(pg)
+
+
 @register_controller("hyperjob")
 class HyperJobController(Controller):
     name = "hyperjob"
+
+    def __init__(self, binder=None):
+        self.binder = binder
 
     def initialize(self, cluster):
         super().initialize(cluster)
         if not hasattr(cluster, "hyperjobs"):
             cluster.hyperjobs = {}
+        if self.binder is None:
+            self.binder = ForwardingBinder(cluster)
 
     def sync(self) -> None:
         for hj in list(self.cluster.hyperjobs.values()):
@@ -77,9 +134,9 @@ class HyperJobController(Controller):
     def sync_hyperjob(self, hj: HyperJob) -> None:
         if hj.phase in (HyperJobPhase.COMPLETED, HyperJobPhase.FAILED):
             return
-        before = hj.phase
+        before = (hj.phase, hj.split_count)
         self._reconcile(hj)
-        if hj.phase != before:
+        if (hj.phase, hj.split_count) != before:
             self.cluster.put_object("hyperjob", hj)
 
     def _reconcile(self, hj: HyperJob) -> None:
@@ -87,15 +144,25 @@ class HyperJobController(Controller):
         allowed_domains = self._allowed_domains(hj)
         phases: List[Optional[JobPhase]] = []
         member_index = 0
+        split_total = 0
         for rj in hj.replicated_jobs:
             for i in range(rj.replicas):
+                if rj.split_policy is not None and rj.template is not None:
+                    members = self._sync_split_replica(
+                        hj, rj, i, allowed_domains)
+                    phases.extend(m.phase for m in members)
+                    split_total += len(members)
+                    member_index += 1
+                    continue
                 key = f"{hj.namespace}/{hj.member_name(rj, i)}"
                 member = self.cluster.vcjobs.get(key)
                 if member is None and rj.template is not None:
                     member = self._deploy(hj, rj, i, member_index,
                                           allowed_domains)
                 member_index += 1
+                split_total += 1
                 phases.append(member.phase if member else None)
+        hj.split_count = split_total
 
         running = sum(1 for p in phases if p is JobPhase.RUNNING)
         completed = sum(1 for p in phases if p is JobPhase.COMPLETED)
@@ -118,6 +185,151 @@ class HyperJobController(Controller):
         tier2 = sorted(hn.name for hn in self.cluster.hypernodes.values()
                        if hn.tier == 2)
         return tier2[: hj.max_domains]
+
+    # -- multi-domain splitting (hyperjob.go:37-82) --------------------
+
+    def _sync_split_replica(self, hj: HyperJob, rj: ReplicatedJob,
+                            index: int,
+                            allowed_domains: List[str]) -> List[VCJob]:
+        """One replica of a split ReplicatedJob: returns its member
+        jobs, deploying them on first sight.  The split plan is
+        computed ONCE (at deploy time) — existing members are reused
+        as-is so a later capacity change can never rename or resize
+        live members."""
+        prefix = f"{hj.name}-{rj.name}-{index}-s"
+        existing = sorted(
+            (job for job in self.cluster.vcjobs.values()
+             if job.namespace == hj.namespace
+             and job.name.startswith(prefix)),
+            key=lambda j: j.name)
+        if existing:
+            return existing
+
+        plan = self._plan_splits(hj, rj, allowed_domains)
+        members: List[VCJob] = []
+        for j, (domain, per_task) in enumerate(plan):
+            job = copy.deepcopy(rj.template)
+            job.name = f"{prefix}{j}"
+            job.namespace = hj.namespace
+            job.uid = new_uid()
+            for spec, n in zip(job.tasks, per_task):
+                spec.replicas = n
+                spec.min_available = n
+            job.min_available = sum(per_task)
+            if job.network_topology is None:
+                from volcano_tpu.api.podgroup import NetworkTopologySpec
+                from volcano_tpu.api.types import NetworkTopologyMode
+                job.network_topology = NetworkTopologySpec(
+                    NetworkTopologyMode.HARD, 1)
+            if domain:
+                self.binder.forward(job, domain)
+            self.cluster.add_vcjob(job)
+            members.append(job)
+            log.info("hyperjob %s split member %s -> domain %s "
+                     "(%s pods)", hj.key, job.key, domain or "-",
+                     sum(per_task))
+        return members
+
+    def _plan_splits(self, hj: HyperJob, rj: ReplicatedJob,
+                     allowed_domains: List[str]):
+        """[(domain, [pods per task])] for one template replica.
+
+        static: chunks of at most split_policy.accelerators chips,
+        domains assigned round-robin.  auto: chunk sizes follow the
+        observed per-domain FREE accelerator capacity, largest first.
+        Pod counts are apportioned cumulatively so they sum exactly to
+        the template's replicas.
+        """
+        sp = rj.split_policy
+        tpl = rj.template
+        acc = sp.accelerator_type
+        chips_per_pod = [
+            (t.template_pod().resource_requests().get(acc)
+             if t.template is not None else 0.0) or 0.0
+            for t in tpl.tasks]
+        total_chips = sum(c * t.replicas
+                          for c, t in zip(chips_per_pod, tpl.tasks))
+        domains = allowed_domains or self._all_domains()
+        if total_chips <= 0:
+            return [(domains[0] if domains else "",
+                     [t.replicas for t in tpl.tasks])]
+
+        # chip budget per split
+        if sp.mode == "auto":
+            free = self._domain_free_chips(acc)
+            if allowed_domains:
+                free = {d: free.get(d, 0.0) for d in allowed_domains}
+            ordered = sorted(free.items(), key=lambda kv: (-kv[1], kv[0]))
+            budgets: List[tuple] = []
+            remaining = total_chips
+            for domain, cap in ordered:
+                if remaining <= 0:
+                    break
+                take = min(remaining, cap)
+                if take > 0:
+                    budgets.append((domain, take))
+                    remaining -= take
+            if remaining > 0:
+                # capacity shortfall: the tail member targets the
+                # largest domain and waits there (gang pending)
+                fallback = ordered[0][0] if ordered else ""
+                budgets.append((fallback, remaining))
+        else:   # static
+            per_split = sp.accelerators if sp.accelerators > 0 \
+                else total_chips
+            n = max(1, -(-int(total_chips) // int(per_split)))
+            budgets = []
+            remaining = total_chips
+            for j in range(n):
+                take = min(per_split, remaining)
+                domain = domains[j % len(domains)] if domains else ""
+                budgets.append((domain, take))
+                remaining -= take
+
+        # cumulative apportionment: per-task pod counts per split sum
+        # exactly to the template replicas
+        plan = []
+        cum = 0.0
+        prev_marks = [0] * len(tpl.tasks)
+        for domain, chips in budgets:
+            cum += chips
+            per_task = []
+            for k, t in enumerate(tpl.tasks):
+                mark = round(t.replicas * cum / total_chips)
+                per_task.append(mark - prev_marks[k])
+                prev_marks[k] = mark
+            if any(per_task):
+                plan.append((domain, per_task))
+        return plan
+
+    def _all_domains(self) -> List[str]:
+        from volcano_tpu.controllers.hypernode import DCN_POD_LABEL
+        return sorted({n.labels.get(DCN_POD_LABEL)
+                       for n in self.cluster.nodes.values()
+                       if n.labels.get(DCN_POD_LABEL)})
+
+    def _domain_free_chips(self, acc: str):
+        """FREE accelerator capacity per DCN-pod domain: allocatable
+        minus requests of pods assigned to each node."""
+        from volcano_tpu.api.resource import Resource
+        from volcano_tpu.api.types import TaskStatus
+        from volcano_tpu.controllers.hypernode import DCN_POD_LABEL
+        free: dict = {}
+        node_domain = {}
+        for node in self.cluster.nodes.values():
+            domain = node.labels.get(DCN_POD_LABEL)
+            if not domain:
+                continue
+            node_domain[node.name] = domain
+            free[domain] = free.get(domain, 0.0) + \
+                Resource.from_resource_list(node.allocatable).get(acc)
+        for pod in self.cluster.pods.values():
+            domain = node_domain.get(pod.node_name)
+            if domain and pod.phase in (TaskStatus.RUNNING,
+                                        TaskStatus.BOUND,
+                                        TaskStatus.BINDING):
+                free[domain] -= pod.resource_requests().get(acc)
+        return {d: max(0.0, v) for d, v in free.items()}
 
     def _deploy(self, hj: HyperJob, rj: ReplicatedJob, index: int,
                 member_index: int, allowed_domains: List[str]) -> VCJob:

@@ -188,6 +188,10 @@ class JobController(Controller):
                     network_topology=None))
         pg = PodGroup(
             name=job.name, namespace=job.namespace,
+            # podgroup inherits the job's annotations (reference
+            # pg_controller_handler.go:301 inherit-upward; carries e.g.
+            # the hyperjob forward-domain pin)
+            annotations=dict(job.annotations),
             min_member=job.min_available,
             min_task_member={t.name: t.min_available for t in job.tasks
                              if t.min_available is not None},

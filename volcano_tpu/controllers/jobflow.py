@@ -19,6 +19,37 @@ from volcano_tpu.controllers.framework import Controller, register_controller
 
 log = logging.getLogger(__name__)
 
+# label stamped onto every job created from a JobTemplate
+# (reference pkg/controllers/jobtemplate CreatedByJobTemplate)
+CREATED_BY_TEMPLATE_LABEL = "volcano-tpu.io/created-by-template"
+
+
+@register_controller("jobtemplate")
+class JobTemplateController(Controller):
+    """Reconcile JobTemplate status from the jobs stamped out of it.
+
+    Reference parity: pkg/controllers/jobtemplate
+    (jobtemplate_controller_action.go:30 syncJobTemplate — list jobs
+    labelled created-by-template, publish their names as
+    status.jobDependsOnList).
+    """
+
+    name = "jobtemplate"
+
+    def sync(self) -> None:
+        # invert once: template key -> [job names]
+        by_template = {}
+        for job in self.cluster.vcjobs.values():
+            ref = job.labels.get(CREATED_BY_TEMPLATE_LABEL)
+            if ref:
+                by_template.setdefault(ref, []).append(job.name)
+        for tmpl in list(self.cluster.jobtemplates.values()):
+            want = sorted(by_template.get(
+                f"{tmpl.namespace}.{tmpl.name}", []))
+            if want != sorted(tmpl.job_depends_on_list):
+                tmpl.job_depends_on_list = want
+                self.cluster.put_object("jobtemplate", tmpl)
+
 
 @register_controller("jobflow")
 class JobFlowController(Controller):
@@ -113,6 +144,8 @@ class JobFlowController(Controller):
         job: VCJob = copy.deepcopy(template.job)
         job.name = flow.job_name(step.name)
         job.namespace = flow.namespace
+        job.labels[CREATED_BY_TEMPLATE_LABEL] = \
+            f"{template.namespace}.{template.name}"
         from volcano_tpu.api.pod import new_uid
         job.uid = new_uid()
         for attr, value in (step.patch or {}).items():

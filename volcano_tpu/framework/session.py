@@ -133,6 +133,11 @@ class Session:
     def add_predicate_fn(self, p, fn):        self.add_fn("predicate", p, fn)
     def add_node_order_fn(self, p, fn):       self.add_fn("nodeOrder", p, fn)
     def add_batch_node_order_fn(self, p, fn): self.add_fn("batchNodeOrder", p, fn)
+    def add_grouped_batch_node_order_fn(self, p, fn):
+        # optional leaf-grouped twin of a BatchNodeOrder fn: scores are
+        # per node-group (session.node_group), letting allocate keep its
+        # heap fast path when every batch scorer provides this form
+        self.add_fn("groupedBatchNodeOrder", p, fn)
     def add_hyper_node_order_fn(self, p, fn): self.add_fn("hyperNodeOrder", p, fn)
     def add_allocatable_fn(self, p, fn):      self.add_fn("allocatable", p, fn)
     def add_overused_fn(self, p, fn):         self.add_fn("overused", p, fn)
@@ -345,6 +350,27 @@ class Session:
             for _, fn in tier_fns:
                 score += fn(task, node)
         return score
+
+    def fn_plugin_names(self, point: str) -> set:
+        """Names of plugins with enabled registrations at *point*."""
+        return {opt.name for tier in self._enabled_fns(point)
+                for opt, _ in tier}
+
+    def node_group(self, node_name: str):
+        """Grouping key for grouped batch scoring: the node's leaf
+        hypernode (None outside any hypernode / no topology)."""
+        if self.hypernodes is None:
+            return None
+        return self.hypernodes.leaf_of_node(node_name)
+
+    def grouped_batch_node_order(self, task: TaskInfo):
+        """Accumulated per-group batch scores ({group: score})."""
+        totals: Dict[object, float] = defaultdict(float)
+        for tier_fns in self._enabled_fns("groupedBatchNodeOrder"):
+            for _, fn in tier_fns:
+                for group, s in fn(task).items():
+                    totals[group] += s
+        return totals
 
     def batch_node_order(self, task: TaskInfo,
                          nodes: List[NodeInfo]) -> Dict[str, float]:

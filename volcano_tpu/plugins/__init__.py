@@ -11,6 +11,7 @@ import volcano_tpu.plugins.drf           # noqa: F401
 import volcano_tpu.plugins.proportion    # noqa: F401
 import volcano_tpu.plugins.overcommit    # noqa: F401
 import volcano_tpu.plugins.predicates    # noqa: F401
+import volcano_tpu.plugins.interpodaffinity  # noqa: F401
 import volcano_tpu.plugins.nodeorder     # noqa: F401
 import volcano_tpu.plugins.binpack       # noqa: F401
 import volcano_tpu.plugins.deviceshare   # noqa: F401

@@ -72,13 +72,11 @@ class PredicatesPlugin(Plugin):
         if cap and len(node.tasks) >= cap:
             return unschedulable("node(s) had too many pods", "predicates")
 
-        # host-port conflicts
-        ports = {p for c in pod.containers for p in c.ports}
-        if ports:
-            for other in node.tasks.values():
-                other_ports = {p for c in other.pod.containers
-                               for p in c.ports}
-                if ports & other_ports:
+        # host-port conflicts — O(task ports) against the node's
+        # maintained port multiset (node_info.occupied_ports)
+        for c in pod.containers:
+            for port in c.ports:
+                if node.occupied_ports.get(port):
                     return unschedulable(
                         "node(s) didn't have free ports", "predicates")
 

@@ -185,16 +185,19 @@ class ProportionPlugin(Plugin):
                 attr = self.attrs.get(job.queue)
                 if attr is None or not attr.queue.reclaimable:
                     continue
-                would_be = attr.allocated.clone() \
-                    .sub_unchecked(evicted[job.queue]) \
-                    .sub_unchecked(t.resreq)
-                # Reclaim only while the queue stays at/above deserved.
-                if not attr.deserved.less_equal(would_be, zero="defaultZero"):
-                    # taking this victim would dip the queue below its
-                    # deserved share in some dimension — not reclaimable
-                    # unless it is still over in the contended dims.
-                    if would_be.less_partly(attr.deserved):
-                        continue
+                # Reference semantics (proportion.go reclaimFn): a
+                # victim may be taken while the queue is still OVER its
+                # deserved share in at least one dimension (progressive
+                # decrement; the last victim may overshoot).  Requiring
+                # the queue to stay >= deserved in EVERY dimension
+                # deadlocks mixed-dimension shares: a queue hoarding
+                # all the chips but little cpu would never be
+                # reclaimable because evictions also lower its
+                # (already-under-deserved) cpu.
+                current = attr.allocated.clone() \
+                    .sub_unchecked(evicted[job.queue])
+                if current.less_equal(attr.deserved, zero="defaultZero"):
+                    continue   # at/under deserved everywhere: protected
                 victims.append(t)
                 evicted[job.queue].add(t.resreq)
             return victims

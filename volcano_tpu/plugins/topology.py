@@ -40,6 +40,7 @@ class NetworkTopologyAwarePlugin(Plugin):
         self.ssn = ssn
         ssn.add_hyper_node_order_fn(self.name, self._hyper_node_order)
         ssn.add_batch_node_order_fn(self.name, self._batch_node_order)
+        ssn.add_grouped_batch_node_order_fn(self.name, self._group_scores)
 
     # -- domain scoring (for topology_alloc gradients) -----------------
 
@@ -97,35 +98,45 @@ class NetworkTopologyAwarePlugin(Plugin):
 
     # -- node scoring (keep the gang ICI-close) ------------------------
 
-    def _batch_node_order(self, task: TaskInfo,
-                          nodes: List[NodeInfo]) -> Dict[str, float]:
+    def _group_scores(self, task: TaskInfo) -> Dict[Optional[str], float]:
+        """Per-LEAF affinity pull: the score is a function of the
+        node's leaf hypernode only (LCA tiers are leaf-pair facts), so
+        it is computed once per leaf — O(leaves x placed-leaves), never
+        O(nodes) — and shared by every node in that leaf.  This is the
+        grouped BatchNodeOrder form allocate's heap fast path consumes."""
         ssn = self.ssn
         hns = ssn.hypernodes
-        scores: Dict[str, float] = {}
         if hns is None:
-            return scores
+            return {}
         job = ssn.jobs.get(task.job)
         if job is None:
-            return scores
+            return {}
         placed = [t.node_name for t in job.tasks.values()
                   if t.node_name and t.occupies_resources()]
         if not placed:
-            return scores
+            return {}
         max_tier = max(hns.tiers, default=1) + 1
-        # group placed peers by their leaf hypernode: the LCA tier is a
-        # function of leaf pairs only, so cost drops from O(nodes x
-        # placed) to O(nodes x distinct leaves) with memoized pairs
         placed_leaves = Counter(hns.leaf_of_node(p) for p in placed)
-
-        for node in nodes:
-            node_leaf = hns.leaf_of_node(node.name)
+        leaf_scores: Dict[Optional[str], float] = {}
+        for node_leaf in hns.leaves():
             total_tier = 0.0
             for leaf, count in placed_leaves.items():
-                total_tier += count * hns.lca_tier_of_leaves(node_leaf, leaf)
+                total_tier += count * hns.lca_tier_of_leaves(node_leaf,
+                                                             leaf)
             mean_tier = total_tier / len(placed)
             if max_tier > 1:
                 closeness = (max_tier - mean_tier) / (max_tier - 1)
             else:
                 closeness = 1.0
-            scores[node.name] = self.weight * MAX_SCORE * closeness
-        return scores
+            leaf_scores[node_leaf] = self.weight * MAX_SCORE * closeness
+        return leaf_scores
+
+    def _batch_node_order(self, task: TaskInfo,
+                          nodes: List[NodeInfo]) -> Dict[str, float]:
+        hns = self.ssn.hypernodes
+        leaf_scores = self._group_scores(task)
+        if not leaf_scores:
+            return {}
+        return {node.name: leaf_scores.get(hns.leaf_of_node(node.name),
+                                           0.0)
+                for node in nodes}

@@ -60,6 +60,12 @@ class StateServer:
             cluster = FakeCluster()
             cluster.admission = default_admission()
         self.cluster = cluster
+        # incarnation token: rv counters reset on restart, so clients
+        # must detect a different server lifetime and re-list — an rv
+        # ordering check alone misses a restarted server whose counter
+        # has already passed the client's position
+        import uuid
+        self.epoch = uuid.uuid4().hex[:12]
         self._lock = threading.Lock()          # event log + leases
         self._event_cv = threading.Condition(self._lock)
         self._events: collections.deque = collections.deque(maxlen=EVENT_RING)
@@ -112,7 +118,7 @@ class StateServer:
                                     for k, v in store.items()}
                 stores["_commands"] = codec.encode(
                     list(self.cluster.commands))
-        return {"rv": rv, "stores": stores}
+        return {"rv": rv, "stores": stores, "epoch": self.epoch}
 
     # -- leases (leader election) --------------------------------------
 
@@ -183,7 +189,7 @@ class _Handler(BaseHTTPRequestHandler):
             timeout = min(float(q.get("timeout", ["25"])[0]), 55.0)
             rv, events, resync = st.events_since(since, timeout)
             return self._json(200, {
-                "rv": rv, "resync": resync,
+                "rv": rv, "resync": resync, "epoch": st.epoch,
                 "events": [{"rv": r, "kind": k, "obj": o}
                            for r, k, o in events]})
         return self._json(404, {"error": f"no route {url.path}"})

@@ -146,12 +146,163 @@ def validate_hypernode(hn) -> None:
             raise AdmissionError("member selector must be set")
 
 
+# -- pods (reference admission/pods/{validate,mutate}) ----------------
+
+# disruption-budget annotations (JDBMinAvailable/JDBMaxUnavailable
+# analogues; consumed by plugins/pdb.py)
+PDB_MIN_AVAILABLE_ANNOTATION = "volcano-tpu.io/min-available"
+PDB_MAX_UNAVAILABLE_ANNOTATION = "volcano-tpu.io/max-unavailable"
+# opt-in for the queue-admission scheduling gate (pods/mutate)
+GATE_OPT_IN_ANNOTATION = "volcano-tpu.io/queue-admission-gate"
+
+
+def _validate_int_or_percentage(key: str, value: str) -> None:
+    """Positive integer, or '1%'..'99%' (admit_pod.go
+    validateIntPercentageStr)."""
+    s = str(value).strip()
+    if s.endswith("%"):
+        try:
+            v = int(s[:-1])
+        except ValueError:
+            raise AdmissionError(
+                f"invalid value {value!r} for {key}") from None
+        if not 0 < v < 100:
+            raise AdmissionError(
+                f"invalid value {value!r} for {key}: percentage must be "
+                f"between 1% and 99%")
+        return
+    try:
+        v = int(s)
+    except ValueError:
+        raise AdmissionError(
+            f"invalid value {value!r} for {key}: neither int nor "
+            f"percentage") from None
+    if v <= 0:
+        raise AdmissionError(
+            f"invalid value {value!r} for {key}: must be a positive "
+            f"integer")
+
+
+def validate_pod(pod) -> None:
+    """Budget-annotation sanity for scheduler-managed pods
+    (admit_pod.go:99-141): each must be int-or-percentage, and the two
+    keys are mutually exclusive."""
+    if pod.scheduler_name not in ("volcano-tpu", "volcano-tpu-agent"):
+        return
+    present = 0
+    for key in (PDB_MIN_AVAILABLE_ANNOTATION,
+                PDB_MAX_UNAVAILABLE_ANNOTATION):
+        value = pod.annotations.get(key)
+        if value is not None:
+            present += 1
+            _validate_int_or_percentage(key, value)
+    if present > 1:
+        raise AdmissionError(
+            f"not allowed to configure both "
+            f"{PDB_MIN_AVAILABLE_ANNOTATION} and "
+            f"{PDB_MAX_UNAVAILABLE_ANNOTATION}")
+
+
+def mutate_pod(pod):
+    """Add the queue-admission scheduling gate for opted-in pods when
+    the feature gate is on (mutate_pod.go:156-180; idempotent)."""
+    from volcano_tpu import features
+    if features.enabled("SchedulingGatesQueueAdmission") and \
+            pod.annotations.get(GATE_OPT_IN_ANNOTATION) == "enable":
+        from volcano_tpu.framework.job_updater import QUEUE_ADMISSION_GATE
+        if QUEUE_ADMISSION_GATE not in pod.scheduling_gates:
+            pod.scheduling_gates.append(QUEUE_ADMISSION_GATE)
+    return pod
+
+
+# -- jobflows (reference admission/jobflows/validate) -----------------
+
+def validate_jobflow(flow) -> None:
+    """DAG sanity: DNS names, unique steps, known dependency targets,
+    no cycles (validate_jobflow.go:94)."""
+    if not DNS1123.match(flow.name) or len(flow.name) > MAX_NAME_LEN:
+        raise AdmissionError(f"jobflow name {flow.name!r} invalid")
+    names = [s.name for s in flow.flows]
+    if len(set(names)) != len(names):
+        raise AdmissionError(f"duplicate flow steps: {names}")
+    known = set(names)
+    deps = {}
+    for step in flow.flows:
+        if not DNS1123.match(step.name):
+            raise AdmissionError(f"flow step name {step.name!r} invalid")
+        targets = step.depends_on.targets if step.depends_on else []
+        for t in targets:
+            if t not in known:
+                raise AdmissionError(
+                    f"flow step {step.name!r} depends on unknown "
+                    f"target {t!r}")
+        deps[step.name] = list(targets)
+    # cycle detection (iterative DFS, 3-color)
+    state: dict = {}
+
+    def visit(n):
+        stack = [(n, iter(deps.get(n, ())))]
+        state[n] = 1
+        while stack:
+            cur, it = stack[-1]
+            for nxt in it:
+                if state.get(nxt) == 1:
+                    raise AdmissionError(
+                        f"jobflow DAG cycle through {nxt!r}")
+                if nxt not in state:
+                    state[nxt] = 1
+                    stack.append((nxt, iter(deps.get(nxt, ()))))
+                    break
+            else:
+                state[cur] = 2
+                stack.pop()
+
+    for n in deps:
+        if n not in state:
+            visit(n)
+
+
+# -- cronjobs (reference admission/cronjobs/validate) -----------------
+
+def validate_cronjob(cron, cluster=None) -> None:
+    from volcano_tpu.controllers.cronjob import cron_field_valid
+
+    if not DNS1123.match(cron.name) or len(cron.name) > MAX_NAME_LEN:
+        raise AdmissionError(f"cronjob name {cron.name!r} invalid")
+    fields = (cron.schedule or "").split()
+    if len(fields) != 5:
+        raise AdmissionError(
+            f"schedule {cron.schedule!r} must have 5 cron fields")
+    bounds = ((0, 59), (0, 23), (1, 31), (1, 12), (0, 6))
+    for spec, (lo, hi) in zip(fields, bounds):
+        if not cron_field_valid(spec, lo, hi):
+            raise AdmissionError(
+                f"invalid cron field {spec!r} in {cron.schedule!r}")
+    if cron.concurrency_policy not in ("Allow", "Forbid", "Replace"):
+        raise AdmissionError(
+            f"invalid concurrencyPolicy {cron.concurrency_policy!r}")
+    if cron.successful_jobs_history_limit < 0:
+        raise AdmissionError("successfulJobsHistoryLimit must be >= 0")
+    if cron.job_template is not None:
+        job = mutate_job(cron.job_template)
+        validate_job(job, cluster)
+
+
 class AdmissionChain:
-    """The webhook pipeline a Cluster applies on create."""
+    """The webhook pipeline a Cluster applies on create (and the
+    validate-only half on spec updates)."""
 
     def admit_job(self, job: VCJob, cluster=None) -> VCJob:
         job = mutate_job(job)
         validate_job(job, cluster)
+        return job
+
+    def admit_job_update(self, job: VCJob, cluster=None) -> VCJob:
+        """Update path: spec sanity re-validated, but create-only gates
+        (queue open/exists) are NOT re-applied — a controller flushing
+        status on a job whose queue has since closed must not be
+        rejected."""
+        validate_job(job, cluster=None)
         return job
 
     def admit_queue(self, queue, cluster=None):
@@ -165,6 +316,19 @@ class AdmissionChain:
     def admit_hypernode(self, hn, cluster=None):
         validate_hypernode(hn)
         return hn
+
+    def admit_pod(self, pod, cluster=None):
+        pod = mutate_pod(pod)
+        validate_pod(pod)
+        return pod
+
+    def admit_jobflow(self, flow, cluster=None):
+        validate_jobflow(flow)
+        return flow
+
+    def admit_cronjob(self, cron, cluster=None):
+        validate_cronjob(cron, cluster)
+        return cron
 
 
 def default_admission() -> AdmissionChain:
