@@ -311,6 +311,94 @@ def bench_5k_host_scale() -> dict:
             "gang1024_cycle_s": round(gang_s, 4)}
 
 
+def _flash_child():
+    """Runs in a SUBPROCESS on the real TPU (the axon tunnel hangs at
+    backend init when dead — the parent enforces the timeout): time the
+    Pallas flash-attention kernel vs the jnp reference, fwd and
+    fwd+bwd, and report rough MFU."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    b, t, h, d = 4, 2048, 8, 128
+    from volcano_tpu.workloads.ops.flash_attention import (
+        _reference, flash_attention)
+
+    q, k, v = (jax.random.normal(jax.random.key(i), (b, t, h, d),
+                                 dtype=jnp.bfloat16) for i in range(3))
+
+    def time_fn(fn, *args, iters=20):
+        out = fn(*args)
+        jax.block_until_ready(out)          # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    pallas_fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v))
+    ref_fwd = jax.jit(lambda q, k, v: _reference(q, k, v, True))
+    loss_p = jax.jit(jax.grad(
+        lambda q, k, v: flash_attention(q, k, v).astype(
+            jnp.float32).sum()))
+    loss_r = jax.jit(jax.grad(
+        lambda q, k, v: _reference(q, k, v, True).astype(
+            jnp.float32).sum()))
+
+    fwd_flops = 4.0 * b * h * t * t * d / 2    # causal: half the pairs
+    peak = {"TPU v5e": 394e12, "TPU v5 lite": 394e12,
+            "TPU v5p": 459e12, "TPU v4": 275e12,
+            "TPU v6e": 918e12}.get(dev.device_kind)
+    t_p = time_fn(pallas_fwd, q, k, v)
+    t_r = time_fn(ref_fwd, q, k, v)
+    t_pb = time_fn(loss_p, q, k, v, iters=10)
+    t_rb = time_fn(loss_r, q, k, v, iters=10)
+    print(json.dumps({
+        "tpu_available": True, "device_kind": dev.device_kind,
+        "shape_bthd": [b, t, h, d],
+        "pallas_fwd_ms": round(t_p * 1e3, 3),
+        "jnp_fwd_ms": round(t_r * 1e3, 3),
+        "pallas_fwd_bwd_ms": round(t_pb * 1e3, 3),
+        "jnp_fwd_bwd_ms": round(t_rb * 1e3, 3),
+        "fwd_speedup": round(t_r / t_p, 2),
+        "fwd_bwd_speedup": round(t_rb / t_pb, 2),
+        "pallas_fwd_tflops": round(fwd_flops / t_p / 1e12, 1),
+        "pallas_fwd_mfu": (round(fwd_flops / t_p / peak, 3)
+                           if peak else None),
+    }))
+
+
+def bench_flash_attention_tpu(timeout_s: float = 240.0) -> dict:
+    """Attempt the real-TPU Pallas kernel timing in a subprocess with a
+    hard timeout (VERDICT r1 item 7: the axon tunnel is known to hang
+    at backend init when dead — record the attempt either way so the
+    gap is visible, never silent)."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)      # let the TPU platform load
+    env.pop("XLA_FLAGS", None)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--flash-child"],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return {"tpu_available": False, "attempted": True,
+                "error": f"TPU backend init exceeded {timeout_s:g}s "
+                         f"(axon tunnel dead/hung)"}
+    for line in reversed(proc.stdout.strip().splitlines() or [""]):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                break
+    return {"tpu_available": False, "attempted": True,
+            "error": (proc.stderr or proc.stdout or "no output")
+            .strip()[-400:]}
+
+
 def main():
     p50 = bench_gang_allocate_latency()
     utilization = bench_utilization_under_contention()
@@ -319,6 +407,7 @@ def main():
     gangpreempt_p50 = bench_gangpreempt_latency()
     reclaim_s = bench_reclaim_convergence()
     scale = bench_5k_host_scale()
+    flash = bench_flash_attention_tpu()
     print(json.dumps({
         "metric": "p50_gang_allocate_latency_256host_v5p1024",
         "value": round(p50, 4),
@@ -332,6 +421,7 @@ def main():
             "gangpreempt_p50_64host_displace_s": round(gangpreempt_p50, 4),
             "reclaim_convergence_2queue_flip_s": round(reclaim_s, 4),
             "scale_5k_hosts": scale,
+            "flash_attention_tpu": flash,
             "trials": TRIALS,
             "cluster_hosts": 256 + 64 + 16,
         },
@@ -339,4 +429,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    if "--flash-child" in sys.argv:
+        _flash_child()
+    else:
+        main()

@@ -102,14 +102,16 @@ def test_volumebinding_zone_affinity_and_assume_cache():
                             "tiers": [{"plugins": [
                                 {"name": "gang"}, {"name": "predicates"},
                                 {"name": "volumebinding"}]}]})
-    ctx.cluster.persistent_volumes = {
-        "pv-1": {"capacity_gi": 100, "zone": "z-b", "claimed_by": ""}}
-    ctx.cluster.pvcs = {"pvc-data": {"request_gi": 10, "bound_pv": ""}}
+    ctx.cluster.put_object(
+        "pv", {"capacity_gi": 100, "zone": "z-b", "claimed_by": ""},
+        key="pv-1")
+    ctx.cluster.put_object(
+        "pvc", {"request_gi": 10, "bound_pv": ""}, key="pvc-data")
     ctx.run()
     ctx.expect_bind("default/dbjob-0", "zb")   # volume gravity
     # binding committed at session close
     assert ctx.cluster.pvcs["pvc-data"]["bound_pv"] == "pv-1"
-    assert ctx.cluster.persistent_volumes["pv-1"]["claimed_by"] == "pvc-data"
+    assert ctx.cluster.pvs["pv-1"]["claimed_by"] == "pvc-data"
 
 
 def test_pod_topology_spread():

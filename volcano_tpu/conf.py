@@ -67,8 +67,18 @@ DEFAULT_SCHEDULER_CONF = {
     "tiers": [
         {"plugins": [{"name": "priority"}, {"name": "gang"},
                      {"name": "conformance"}]},
+        # tier 2 mirrors the reference default's predicates wrap
+        # (predicates.go:37 bundles nodeaffinity, podaffinity, taints,
+        # ports, volume + spread): here those are separate plugins, so
+        # the default enables the full set — each is a cheap no-op for
+        # pods that don't use its feature
         {"plugins": [{"name": "overcommit"}, {"name": "drf"},
-                     {"name": "predicates"}, {"name": "proportion"},
+                     {"name": "predicates"},
+                     {"name": "interpodaffinity"},
+                     {"name": "pod-topology-spread"},
+                     {"name": "volumebinding"},
+                     {"name": "deviceshare"},
+                     {"name": "proportion"},
                      {"name": "nodeorder"}, {"name": "binpack"}]},
     ],
 }

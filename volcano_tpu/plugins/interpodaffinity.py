@@ -69,6 +69,8 @@ class InterPodAffinityPlugin(Plugin):
         # assigned pods carrying required anti-affinity terms
         # (symmetry index): uid -> (namespace, labels, node, terms)
         self._anti_holders: Dict[str, tuple] = {}
+        self._anti_version = 0          # bumped on holder index change
+        self._repel_cache: Dict[str, tuple] = {}
         any_terms = False
         for job in ssn.jobs.values():
             for t in job.tasks.values():
@@ -101,10 +103,12 @@ class InterPodAffinityPlugin(Plugin):
             self._anti_holders[task.uid] = (
                 pod.namespace, pod.labels, task.node_name,
                 pod.pod_anti_affinity)
+            self._anti_version += 1
 
     def _index_remove(self, task: TaskInfo) -> None:
         self._assigned[task.pod.namespace].pop(task.uid, None)
-        self._anti_holders.pop(task.uid, None)
+        if self._anti_holders.pop(task.uid, None) is not None:
+            self._anti_version += 1
 
     def _on_allocate(self, task: TaskInfo) -> None:
         if task.node_name:
@@ -172,23 +176,39 @@ class InterPodAffinityPlugin(Plugin):
                     return unschedulable(
                         "pod would violate anti-affinity", self.name,
                         evict_curable=True)
-        # SYMMETRIC required anti-affinity (existing repel incoming)
-        for uid, (ns, labels, holder_node, terms) in \
+        # SYMMETRIC required anti-affinity (existing repel incoming):
+        # which holders' terms match the incoming pod is NODE-
+        # INDEPENDENT, so that prefilter is memoized per task — the
+        # per-node loop pays only the two domain lookups
+        for holder_node, topology_key in self._repelling_terms(task):
+            domain = self._domain_of(node.name, topology_key)
+            if domain is not None and domain == \
+                    self._domain_of(holder_node, topology_key):
+                return unschedulable(
+                    "existing pod's anti-affinity repels this pod",
+                    self.name, evict_curable=True)
+        return None
+
+    def _repelling_terms(self, task: TaskInfo):
+        """(holder_node, topology_key) pairs whose anti-affinity terms
+        match *task*'s pod — recomputed only when the holder index
+        changed (a placement/eviction bumps _anti_version)."""
+        cached = self._repel_cache.get(task.uid)
+        if cached is not None and cached[0] == self._anti_version:
+            return cached[1]
+        pod = task.pod
+        pairs = []
+        for uid, (ns, _labels, holder_node, terms) in \
                 self._anti_holders.items():
             if uid == task.uid:
                 continue
             for term in terms:
                 if pod.namespace not in (term.namespaces or [ns]):
                     continue
-                if not term.matches(pod.labels):
-                    continue
-                domain = self._domain_of(node.name, term.topology_key)
-                if domain is not None and domain == \
-                        self._domain_of(holder_node, term.topology_key):
-                    return unschedulable(
-                        "existing pod's anti-affinity repels this pod",
-                        self.name, evict_curable=True)
-        return None
+                if term.matches(pod.labels):
+                    pairs.append((holder_node, term.topology_key))
+        self._repel_cache[task.uid] = (self._anti_version, pairs)
+        return pairs
 
     # -- preferred terms (scorer) --------------------------------------
 

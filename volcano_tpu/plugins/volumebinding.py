@@ -1,27 +1,39 @@
 """Volumebinding plugin — PV/PVC zone-affine binding.
 
 Reference parity: pkg/scheduler/capabilities/volumebinding (forked k8s
-volume binder with assume-cache and scorer).  Standalone model:
+volume binder with assume-cache, PASSIVE assume-cache and scorer, plus
+dynamic provisioning).  Standalone model:
 
-- persistent volumes live on the cluster:
-    cluster.persistent_volumes[name] = {
-        "capacity_gi": 100, "zone": "us-central2-b",
-        "claimed_by": ""            # pvc key once bound
-    }
+- persistent volumes live in the cluster's "pv" store:
+    cluster.put_object("pv", {"capacity_gi": 100,
+                              "zone": "us-central2-b",
+                              "claimed_by": ""}, key="pv-1")
 - pods claim via annotation  volume.volcano-tpu.io/claims: "pvc-a,pvc-b"
-  and pvc specs via          cluster.pvcs[name] = {"request_gi": 10,
-                                                    "bound_pv": ""}
+  and pvc specs via the "pvc" store:
+    {"request_gi": 10, "bound_pv": "",
+     "storage_class": "standard"}     # storage_class => provisionable
 
 Predicate: every claimed PVC must be bound (then its PV's zone must
-match the node) or bindable to an unclaimed PV in the node's zone.
-Score: prefer nodes whose zone already holds the PVs (data gravity).
-An assume-cache of in-session bindings prevents two pods binding the
-same PV in one cycle; bindings commit at session close (PreBind
-analogue).
+match the node) or bindable to an unclaimed PV in the node's zone — or
+carry a storage class, in which case a volume is DYNAMICALLY
+PROVISIONED in the chosen node's zone at commit time
+(WaitForFirstConsumer semantics, capabilities/volumebinding/binder.go).
+Score: prefer nodes whose zone already holds the PVs (data gravity);
+provisionable claims score neutral (no gravity yet).
+
+Assume caches: the ACTIVE cache records this scheduler's own in-session
+reservations the moment a claiming task is placed; the PASSIVE cache
+(capabilities/volumebinding/passive_assume_cache.go — multi-scheduler
+safety) folds pvc/pv bind events observed over the cluster watch DURING
+the session, so a volume bound by the agent scheduler or a second
+batch scheduler mid-cycle can't be double-assumed here.  Bindings and
+provisioned volumes commit through put_object at session close
+(PreBind analogue), so they persist across the wire boundary.
 """
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, List, Optional
 
 from volcano_tpu.api.fit_error import unschedulable
@@ -29,9 +41,12 @@ from volcano_tpu.api.job_info import TaskInfo
 from volcano_tpu.api.node_info import NodeInfo
 from volcano_tpu.framework.plugins import Plugin, register_plugin
 
+log = logging.getLogger(__name__)
+
 CLAIMS_ANNOTATION = "volume.volcano-tpu.io/claims"
 ZONE_LABEL = "topology.kubernetes.io/zone"
 MAX_SCORE = 100.0
+PROVISION = "<provision>"     # sentinel PV name for dynamic claims
 
 
 @register_plugin("volumebinding")
@@ -44,15 +59,22 @@ class VolumeBindingPlugin(Plugin):
             return   # feature-gated off (features.py)
         self.ssn = ssn
         cluster = ssn.cache.cluster
-        self.pvs: Dict[str, dict] = dict(
-            getattr(cluster, "persistent_volumes", {}) or {})
-        self.pvcs: Dict[str, dict] = dict(
-            getattr(cluster, "pvcs", {}) or {})
-        # assume-cache: pv -> pvc assumed this session (populated at
-        # ALLOCATION time so two pods can't pass the predicate against
-        # the same free PV in one cycle)
+        self.cluster = cluster
+        self.pvs: Dict[str, dict] = {
+            k: dict(v) for k, v in
+            (getattr(cluster, "pvs", {}) or {}).items()}
+        self.pvcs: Dict[str, dict] = {
+            k: dict(v) for k, v in
+            (getattr(cluster, "pvcs", {}) or {}).items()}
+        # ACTIVE assume-cache: pv -> pvc assumed this session (populated
+        # at ALLOCATION time so two pods can't pass the predicate
+        # against the same free PV in one cycle)
         self.assumed: Dict[str, str] = {}
-        self._task_pvs: Dict[str, list] = {}     # task uid -> [(pvc, pv)]
+        # task uid -> [(pvc, pv-or-PROVISION sentinel)]
+        self._task_pvs: Dict[str, list] = {}
+        # PASSIVE assume-cache: pv/pvc binds observed on the watch
+        # stream mid-session (another scheduler's work)
+        cluster.watch(self._passive_observe)
         # always register: a pod claiming an unknown PVC must be gated
         # even when the cluster has no PVCs at all
         ssn.add_predicate_fn(self.name, self._predicate)
@@ -62,6 +84,29 @@ class VolumeBindingPlugin(Plugin):
             allocate_fn=self._on_allocate,
             deallocate_fn=self._on_deallocate))
 
+    # -- passive assume cache ------------------------------------------
+
+    def _passive_observe(self, kind: str, obj) -> None:
+        """Fold externally-observed pvc/pv binds into the session view
+        (passive_assume_cache.go: learn bindings you didn't make)."""
+        if kind not in ("pvc", "pv") or not isinstance(obj, dict):
+            return
+        key, payload = obj.get("key"), obj.get("obj")
+        if not key or not isinstance(payload, dict):
+            return
+        if kind == "pv":
+            claimed = payload.get("claimed_by")
+            if claimed and self.assumed.get(key) is None:
+                self.assumed[key] = claimed
+            self.pvs[key] = dict(payload)
+        else:
+            self.pvcs[key] = dict(payload)
+            bound = payload.get("bound_pv")
+            if bound:
+                self.assumed.setdefault(bound, key)
+
+    # -- binding logic -------------------------------------------------
+
     @staticmethod
     def _claims(task: TaskInfo) -> List[str]:
         raw = task.pod.annotations.get(CLAIMS_ANNOTATION, "")
@@ -69,6 +114,8 @@ class VolumeBindingPlugin(Plugin):
 
     def _bindable_pv(self, pvc_name: str, zone: str,
                      exclude: Optional[set] = None) -> Optional[str]:
+        """An existing PV for the claim, or the PROVISION sentinel for
+        a dynamic (storage-classed) claim, or None."""
         pvc = self.pvcs.get(pvc_name)
         if pvc is None:
             return None
@@ -85,6 +132,10 @@ class VolumeBindingPlugin(Plugin):
                 continue
             if pv.get("capacity_gi", 0) >= pvc.get("request_gi", 0):
                 return name
+        if pvc.get("storage_class"):
+            # dynamic provisioning: volume will be created in the
+            # selected node's zone at commit (WaitForFirstConsumer)
+            return PROVISION
         return None
 
     def _predicate(self, task: TaskInfo, node: NodeInfo):
@@ -105,7 +156,8 @@ class VolumeBindingPlugin(Plugin):
                 return unschedulable(
                     f"no bindable volume for PVC {pvc_name!r} in zone "
                     f"{zone or '<none>'}", "volumebinding")
-            taken_here.add(pv)
+            if pv is not PROVISION:
+                taken_here.add(pv)
         return None
 
     def _score(self, task: TaskInfo, node: NodeInfo) -> float:
@@ -113,7 +165,11 @@ class VolumeBindingPlugin(Plugin):
         if not claims:
             return 0.0
         zone = node.labels.get(ZONE_LABEL, "")
-        ok = sum(1 for c in claims if self._bindable_pv(c, zone))
+        ok = 0
+        for c in claims:
+            pv = self._bindable_pv(c, zone)
+            if pv is not None and pv is not PROVISION:
+                ok += 1   # existing data gravity only
         return MAX_SCORE * ok / len(claims)
 
     def _on_allocate(self, event):
@@ -133,29 +189,42 @@ class VolumeBindingPlugin(Plugin):
                     self.pvcs[pvc_name].get("bound_pv"):
                 continue
             pv = self._bindable_pv(pvc_name, zone,
-                                   exclude={p for _, p in reserved})
+                                   exclude={p for _, p, _z in reserved
+                                            if p is not PROVISION})
             if pv is None:
                 # never leave a claim partially unbound: release this
                 # task's reservations and let resync handle it
-                import logging
-                logging.getLogger(__name__).warning(
+                log.warning(
                     "volumebinding: PVC %s lost its PV on %s at "
                     "allocate time; releasing task reservations",
                     pvc_name, task.node_name)
-                for _, prev_pv in reserved:
-                    self.assumed.pop(prev_pv, None)
+                for _, prev_pv, _z in reserved:
+                    if prev_pv is not PROVISION:
+                        self.assumed.pop(prev_pv, None)
                 return
-            self.assumed[pv] = pvc_name
-            reserved.append((pvc_name, pv))
+            if pv is not PROVISION:
+                self.assumed[pv] = pvc_name
+            reserved.append((pvc_name, pv, zone))
         if reserved:
             self._task_pvs[task.uid] = reserved
 
     def _on_deallocate(self, event):
-        for _pvc_name, pv in self._task_pvs.pop(event.task.uid, []):
-            self.assumed.pop(pv, None)
+        for _pvc_name, pv, _zone in self._task_pvs.pop(
+                event.task.uid, []):
+            if pv is not PROVISION:
+                self.assumed.pop(pv, None)
 
     def on_session_close(self, ssn):
-        if not getattr(self, "_task_pvs", None):
+        cluster = getattr(self, "cluster", None)
+        if cluster is None:
+            return
+        try:
+            self._commit(ssn, cluster)
+        finally:
+            cluster.unwatch(self._passive_observe)
+
+    def _commit(self, ssn, cluster):
+        if not self._task_pvs:
             return
         # commit bindings whose tasks actually went to bind
         from volcano_tpu.api.types import TaskStatus
@@ -163,15 +232,33 @@ class VolumeBindingPlugin(Plugin):
             t.uid for job in ssn.jobs.values()
             for t in job.tasks.values()
             if t.status in (TaskStatus.BINDING, TaskStatus.BOUND)}
-        cluster = ssn.cache.cluster
         for uid, reserved in self._task_pvs.items():
             if uid not in committed_uids:
                 continue
-            for pvc_name, pv_name in reserved:
+            for pvc_name, pv_name, zone in reserved:
                 live_pvc = getattr(cluster, "pvcs", {}).get(pvc_name)
-                live_pv = getattr(cluster, "persistent_volumes",
-                                  {}).get(pv_name)
-                if live_pvc is not None and live_pv is not None and \
-                        not live_pvc.get("bound_pv"):
-                    live_pvc["bound_pv"] = pv_name
+                if live_pvc is None or live_pvc.get("bound_pv"):
+                    continue
+                if pv_name is PROVISION:
+                    # dynamic provisioning: create the volume in the
+                    # consumer's zone, sized to the request
+                    pv_name = f"pv-{pvc_name}-dyn"
+                    suffix = 0
+                    while pv_name in getattr(cluster, "pvs", {}):
+                        suffix += 1
+                        pv_name = f"pv-{pvc_name}-dyn{suffix}"
+                    cluster.put_object("pv", {
+                        "capacity_gi": live_pvc.get("request_gi", 0),
+                        "zone": zone,
+                        "claimed_by": pvc_name,
+                        "storage_class": live_pvc.get("storage_class"),
+                        "provisioned": True,
+                    }, key=pv_name)
+                else:
+                    live_pv = dict(getattr(cluster, "pvs",
+                                           {}).get(pv_name) or {})
                     live_pv["claimed_by"] = pvc_name
+                    cluster.put_object("pv", live_pv, key=pv_name)
+                new_pvc = dict(live_pvc)
+                new_pvc["bound_pv"] = pv_name
+                cluster.put_object("pvc", new_pvc, key=pvc_name)
