@@ -291,6 +291,14 @@ def test_agent_gated_pod_parks():
     assert sched.run_until_drained() == 0
     assert "default/gated" in sched.queue.unschedulable
 
+    # lifting the gate updates the pod; the watch event must reactivate
+    # the parked pod even with no node churn
+    gated.scheduling_gates = []
+    cluster.put_object("pod", gated)
+    assert "default/gated" not in sched.queue.unschedulable
+    sched.run_until_drained()
+    assert cluster.pods["default/gated"].node_name == "n0"
+
 
 def test_agent_custom_plugin_chain():
     """Operators can extend the fast path: a custom scorer flips node

@@ -115,3 +115,38 @@ def test_manifest_validation_errors():
             "kind": "Job", "metadata": {"name": "x"},
             "spec": {"tasks": [{"name": "w"}],
                      "networkTopology": {"mode": "quantum"}}})
+
+
+def test_task_level_network_topology_overrides_tpu_default():
+    """A task-level networkTopology block wins over the controller's
+    ICI-local default for TPU subgroups; absent highestTierAllowed at
+    task level means unbounded (prefer-lowest-tier)."""
+    from volcano_tpu.api.types import NetworkTopologyMode
+
+    job = job_from_manifest({
+        "kind": "Job", "metadata": {"name": "x"},
+        "spec": {"tasks": [
+            {"name": "w", "subGroup": "g0",
+             "networkTopology": {"mode": "soft"},
+             "template": {"spec": {"containers": [
+                 {"name": "c",
+                  "resources": {"requests": {"google.com/tpu": 4}}}]}}},
+        ]}})
+    nt = job.tasks[0].network_topology
+    assert nt is not None
+    assert nt.mode is NetworkTopologyMode.SOFT
+    assert nt.highest_tier_allowed is None
+
+    from volcano_tpu.controllers.job.controller import JobController
+    assert JobController._subgroup_topology(job, "g0") is nt
+
+    # no TPU request and no explicit block -> unconstrained subgroup
+    cpu_job = job_from_manifest({
+        "kind": "Job", "metadata": {"name": "y"},
+        "spec": {"tasks": [
+            {"name": "w", "subGroup": "g0",
+             "template": {"spec": {"containers": [
+                 {"name": "c",
+                  "resources": {"requests": {"cpu": 1}}}]}}},
+        ]}})
+    assert JobController._subgroup_topology(cpu_job, "g0") is None

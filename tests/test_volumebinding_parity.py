@@ -124,3 +124,76 @@ def test_multi_claim_pod_binds_two_pvs():
     bound = {ctx.cluster.pvcs[p]["bound_pv"] for p in ("pvc-a", "pvc-b")}
     assert bound == {"pv-1", "pv-2"}
     assert ctx.cluster.pvs["pv-1"]["claimed_by"] in ("pvc-a", "pvc-b")
+
+
+def test_commit_never_steals_externally_claimed_pv_and_rebinds():
+    """A PV bound by another scheduler between reservation and commit
+    is NOT stolen; the claim rebinds to another live in-zone PV (and a
+    deleted PV is never resurrected as a phantom)."""
+    from volcano_tpu.api.types import TaskStatus
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    from volcano_tpu.plugins.volumebinding import VolumeBindingPlugin
+
+    cluster = FakeCluster()
+    cluster.put_object("pv", {"capacity_gi": 10, "zone": "z",
+                              "claimed_by": "pvc-other"}, key="pv-1")
+    cluster.put_object("pv", {"capacity_gi": 10, "zone": "z",
+                              "claimed_by": ""}, key="pv-2")
+    cluster.put_object("pvc", {"request_gi": 5, "bound_pv": ""},
+                       key="pvc-a")
+    plug = VolumeBindingPlugin()
+    plug._init_state(cluster)
+
+    class Tsk:
+        uid = "t1"
+        status = TaskStatus.BINDING
+
+    class Job:
+        tasks = {"x": Tsk()}
+
+    class Ssn:
+        jobs = {"j": Job()}
+
+    plug._task_pvs = {"t1": [("pvc-a", "pv-1", "z")]}
+    plug._commit(Ssn, cluster)
+    assert cluster.pvs["pv-1"]["claimed_by"] == "pvc-other"
+    assert cluster.pvs["pv-2"]["claimed_by"] == "pvc-a"
+    assert cluster.pvcs["pvc-a"]["bound_pv"] == "pv-2"
+
+    # deleted PV, no replacement, no storage class => claim left
+    # unbound and the phantom PV is NOT recreated
+    cluster.put_object("pvc", {"request_gi": 5, "bound_pv": ""},
+                       key="pvc-b")
+    plug._task_pvs = {"t1": [("pvc-b", "pv-gone", "z")]}
+    plug._commit(Ssn, cluster)
+    assert "pv-gone" not in cluster.pvs
+    assert not cluster.pvcs["pvc-b"]["bound_pv"]
+
+
+def test_task_topology_admission_validation():
+    """Task-level networkTopology needs a subGroup and a sane tier."""
+    import pytest
+
+    from volcano_tpu.cli.manifest import job_from_manifest
+    from volcano_tpu.webhooks.admission import (AdmissionError,
+                                                validate_job)
+
+    def mk(task_patch):
+        task = {"name": "w",
+                "template": {"spec": {"containers": [
+                    {"name": "c",
+                     "resources": {"requests": {"cpu": 1}}}]}}}
+        task.update(task_patch)
+        return job_from_manifest({
+            "kind": "Job", "metadata": {"name": "x"},
+            "spec": {"tasks": [task]}})
+
+    with pytest.raises(AdmissionError, match="requires subGroup"):
+        validate_job(mk({"networkTopology": {"mode": "hard"}}))
+    with pytest.raises(AdmissionError, match="must be >= 1"):
+        validate_job(mk({"subGroup": "g0",
+                         "networkTopology": {"mode": "hard",
+                                             "highestTierAllowed": 0}}))
+    validate_job(mk({"subGroup": "g0",
+                     "networkTopology": {"mode": "hard",
+                                         "highestTierAllowed": 2}}))

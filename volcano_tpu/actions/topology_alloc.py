@@ -31,8 +31,9 @@ def candidate_domains(ssn, job: JobInfo,
     closest tier first, best-scored first within a tier."""
     if max_tier is None:
         nt = job.network_topology
-        max_tier = nt.highest_tier_allowed if nt else max(
-            ssn.hypernodes.tiers, default=1)
+        max_tier = nt.highest_tier_allowed if nt else None
+    if max_tier is None:    # unbounded: every tier, lowest first
+        max_tier = max(ssn.hypernodes.tiers, default=1)
     gradients = []
     for tier in ssn.hypernodes.tiers:
         if tier > max_tier:
@@ -118,7 +119,14 @@ def _allocate_per_subjob(ssn, queue, job: JobInfo,
         if not pending:
             continue  # nothing to place; keep its allocated_hypernode
         nt = sub.network_topology or job.network_topology
-        max_tier = nt.highest_tier_allowed if nt else None
+        # nt present but tier None = explicitly unbounded; resolve here
+        # so candidate_domains doesn't fall back to the job-level cap
+        if nt is None:
+            max_tier = None
+        elif nt.highest_tier_allowed is None:
+            max_tier = max(ssn.hypernodes.tiers, default=1)
+        else:
+            max_tier = nt.highest_tier_allowed
         placed = False
         gradients = candidate_domains(ssn, job, max_tier=max_tier)
         # sticky placement: an already-allocated subgroup scales up in
@@ -193,10 +201,15 @@ def _fail(ssn, job: JobInfo, subjob: str = "") -> bool:
         sub.nominated_hypernode = ""
     job.persist_nominations()
     nt = job.network_topology
+    if subjob:
+        sub = job.sub_jobs.get(subjob)
+        if sub is not None and sub.network_topology is not None:
+            nt = sub.network_topology   # the binding constraint
     where = f"subgroup {subjob} of " if subjob else ""
+    tier = nt.highest_tier_allowed if nt else None
+    cap = "at any tier" if tier is None else f"within tier {tier}"
     ssn.set_job_pending_reason(
         job, "Unschedulable",
-        f"no hypernode domain within tier "
-        f"{nt.highest_tier_allowed if nt else '?'} can hold {where}job "
+        f"no hypernode domain {cap} can hold {where}job "
         f"{job.key} (minAvailable={job.min_available})")
     return False

@@ -140,6 +140,7 @@ class SchedulingQueue:
         self.active: deque = deque()
         self.backoff: List[Tuple[float, object]] = []   # (ready_at, pod)
         self.unschedulable: Dict[str, object] = {}
+        self._parked_gates: Dict[str, list] = {}   # gates at park time
         self._seen: set = set()
 
     def push(self, pod, urgent: bool = False):
@@ -147,9 +148,21 @@ class SchedulingQueue:
             self._push_locked(pod, urgent)
 
     def _push_locked(self, pod, urgent: bool = False):
-        # a parked pod is re-activated only via activate_unschedulable,
-        # never duplicated into both pools
-        if pod.key in self._seen or pod.key in self.unschedulable:
+        if pod.key in self.unschedulable:
+            # reactivate only on a schedulability-relevant change (a
+            # lifted gate, compared against the gates recorded at park
+            # time); immaterial status writes must not turn N parked
+            # pods into a continuous full-rescan loop —
+            # capacity-driven retries stay on activate_unschedulable
+            if self._parked_gates.get(pod.key) == \
+                    list(getattr(pod, "scheduling_gates", [])):
+                # keep the freshest object so a later
+                # activate_unschedulable retries the updated spec
+                self.unschedulable[pod.key] = pod
+                return
+            del self.unschedulable[pod.key]
+            self._parked_gates.pop(pod.key, None)
+        if pod.key in self._seen:
             return
         self._seen.add(pod.key)
         if urgent:
@@ -166,6 +179,8 @@ class SchedulingQueue:
     def park_unschedulable(self, pod):
         with self._lock:
             self.unschedulable[pod.key] = pod
+            self._parked_gates[pod.key] = \
+                list(getattr(pod, "scheduling_gates", []))
             self._seen.discard(pod.key)
 
     def _flush_ready_locked(self):
@@ -182,6 +197,7 @@ class SchedulingQueue:
         """Cluster changed: give parked pods another chance."""
         with self._lock:
             parked, self.unschedulable = self.unschedulable, {}
+            self._parked_gates.clear()
             for pod in parked.values():
                 self._push_locked(pod)
 

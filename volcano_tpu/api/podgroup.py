@@ -26,10 +26,13 @@ class NetworkTopologySpec:
     mode=hard: all tasks must land within one hypernode domain at tier
     <= highest_tier_allowed.  mode=soft: prefer lower tiers, allow spill.
     On TPU, tier 0 is a single ICI slice; tier 1+ crosses DCN.
+    highest_tier_allowed=None means unbounded: the gradient search still
+    prefers the lowest tier that fits, so the group stays ICI-local when
+    possible but never becomes unschedulable by spanning.
     """
 
     mode: NetworkTopologyMode = NetworkTopologyMode.HARD
-    highest_tier_allowed: int = 1
+    highest_tier_allowed: Optional[int] = 1
 
 
 @dataclass

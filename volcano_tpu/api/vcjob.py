@@ -64,6 +64,9 @@ class TaskSpec:
     depends_on: Optional[DependsOn] = None
     max_retry: int = 3
     subgroup: str = ""                  # subGroupPolicy membership
+    # explicit subgroup topology (scheduling/v1beta1 types.go:217-223);
+    # None + TPU requests => controller defaults to ICI-local hard
+    network_topology: Optional["NetworkTopologySpec"] = None
 
     def template_pod(self) -> Pod:
         if self.template is not None:

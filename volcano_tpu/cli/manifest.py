@@ -99,6 +99,22 @@ def _pod_template(raw: dict) -> Pod:
                priority_class=spec.get("priorityClassName", ""))
 
 
+def _task_topology(nt, default_tier=None):
+    """Parse a networkTopology block (job- or task-level).
+
+    Job level defaults highestTierAllowed to 1 (webhook-mutate parity);
+    task level defaults to None = unbounded (prefer-lowest-tier)."""
+    if not nt:
+        return None
+    try:
+        raw_tier = nt.get("highestTierAllowed", default_tier)
+        return NetworkTopologySpec(
+            mode=NetworkTopologyMode(nt.get("mode", "hard")),
+            highest_tier_allowed=None if raw_tier is None else int(raw_tier))
+    except (TypeError, ValueError) as e:
+        raise ManifestError(f"invalid networkTopology {nt!r}") from e
+
+
 def job_from_manifest(data: dict) -> VCJob:
     if data.get("kind") != "Job":
         raise ManifestError(f"kind must be Job, got {data.get('kind')!r}")
@@ -124,6 +140,7 @@ def job_from_manifest(data: dict) -> VCJob:
                 iteration=depends.get("iteration", "any"))
             if depends else None,
             subgroup=t.get("subGroup", ""),
+            network_topology=_task_topology(t.get("networkTopology")),
         ))
 
     if not tasks:
@@ -132,14 +149,7 @@ def job_from_manifest(data: dict) -> VCJob:
         raise ManifestError("total task replicas must be > 0")
 
     nt = spec.get("networkTopology")
-    network_topology = None
-    if nt:
-        try:
-            network_topology = NetworkTopologySpec(
-                mode=NetworkTopologyMode(nt.get("mode", "hard")),
-                highest_tier_allowed=int(nt.get("highestTierAllowed", 1)))
-        except (TypeError, ValueError) as e:
-            raise ManifestError(f"invalid networkTopology {nt!r}") from e
+    network_topology = _task_topology(nt, default_tier=1)
 
     plugins = spec.get("plugins", {})
     if not isinstance(plugins, dict):

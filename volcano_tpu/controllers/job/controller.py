@@ -16,7 +16,9 @@ from collections import defaultdict
 from typing import Dict, List, Optional
 
 from volcano_tpu.api.pod import Pod
-from volcano_tpu.api.podgroup import PodGroup, SubGroupPolicy
+from volcano_tpu.api.podgroup import (NetworkTopologySpec, PodGroup,
+                                      SubGroupPolicy)
+from volcano_tpu.api.resource import TPU
 from volcano_tpu.api.types import (
     FINISHED_JOB_PHASES,
     GROUP_NAME_ANNOTATION,
@@ -27,6 +29,7 @@ from volcano_tpu.api.types import (
     JobAction,
     JobEvent,
     JobPhase,
+    NetworkTopologyMode,
     PodGroupPhase,
     TaskStatus,
 )
@@ -185,7 +188,8 @@ class JobController(Controller):
                 sub_groups.append(SubGroupPolicy(
                     name=spec.subgroup,
                     min_member=spec.min_available or spec.replicas,
-                    network_topology=None))
+                    network_topology=self._subgroup_topology(job,
+                                                             spec.subgroup)))
         pg = PodGroup(
             name=job.name, namespace=job.namespace,
             # podgroup inherits the job's annotations (reference
@@ -202,6 +206,30 @@ class JobController(Controller):
         )
         self.cluster.add_podgroup(pg)
         job.controlled_resources["podgroup"] = pg.key
+
+    @staticmethod
+    def _subgroup_topology(job: VCJob, subgroup: str):
+        """Topology constraint for one subgroup gang.
+
+        Explicit task-level networkTopology wins.  Otherwise a subgroup
+        whose tasks request TPU chips defaults to ICI-local hard
+        placement with no tier cap: each replica-gang lands in the
+        smallest hypernode domain (one slice when it fits), which is
+        what a multi-slice data-parallel job wants (subGroupPolicy +
+        networkTopology, scheduling/v1beta1 types.go:217-223; the
+        reference leaves nil unconstrained — TPU-first divergence)."""
+        wants_tpu = False
+        for spec in job.tasks:
+            if spec.subgroup != subgroup:
+                continue
+            if spec.network_topology is not None:
+                return spec.network_topology
+            if spec.template_pod().resource_requests().get(TPU):
+                wants_tpu = True
+        if wants_tpu:
+            return NetworkTopologySpec(mode=NetworkTopologyMode.HARD,
+                                       highest_tier_allowed=None)
+        return None
 
     def _run_job_add_plugins(self, job: VCJob) -> None:
         if job.controlled_resources.get("plugins-applied"):

@@ -34,6 +34,7 @@ provisioned volumes commit through put_object at session close
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Dict, List, Optional
 
 from volcano_tpu.api.fit_error import unschedulable
@@ -59,6 +60,20 @@ class VolumeBindingPlugin(Plugin):
             return   # feature-gated off (features.py)
         self.ssn = ssn
         cluster = ssn.cache.cluster
+        self._init_state(cluster)
+        cluster.watch(self._passive_observe)
+        # always register: a pod claiming an unknown PVC must be gated
+        # even when the cluster has no PVCs at all
+        ssn.add_predicate_fn(self.name, self._predicate)
+        ssn.add_node_order_fn(self.name, self._score)
+        from volcano_tpu.framework.session import EventHandler
+        ssn.add_event_handler(EventHandler(
+            allocate_fn=self._on_allocate,
+            deallocate_fn=self._on_deallocate))
+
+    def _init_state(self, cluster) -> None:
+        """Session-scoped state (also the seam tests use to exercise
+        commit paths without a full session)."""
         self.cluster = cluster
         self.pvs: Dict[str, dict] = {
             k: dict(v) for k, v in
@@ -73,16 +88,10 @@ class VolumeBindingPlugin(Plugin):
         # task uid -> [(pvc, pv-or-PROVISION sentinel)]
         self._task_pvs: Dict[str, list] = {}
         # PASSIVE assume-cache: pv/pvc binds observed on the watch
-        # stream mid-session (another scheduler's work)
-        cluster.watch(self._passive_observe)
-        # always register: a pod claiming an unknown PVC must be gated
-        # even when the cluster has no PVCs at all
-        ssn.add_predicate_fn(self.name, self._predicate)
-        ssn.add_node_order_fn(self.name, self._score)
-        from volcano_tpu.framework.session import EventHandler
-        ssn.add_event_handler(EventHandler(
-            allocate_fn=self._on_allocate,
-            deallocate_fn=self._on_deallocate))
+        # stream mid-session (another scheduler's work).  Watch events
+        # arrive on the RemoteCluster watch thread, concurrent with the
+        # scheduling thread iterating these dicts — hence the lock.
+        self._cache_lock = threading.Lock()
 
     # -- passive assume cache ------------------------------------------
 
@@ -94,16 +103,17 @@ class VolumeBindingPlugin(Plugin):
         key, payload = obj.get("key"), obj.get("obj")
         if not key or not isinstance(payload, dict):
             return
-        if kind == "pv":
-            claimed = payload.get("claimed_by")
-            if claimed and self.assumed.get(key) is None:
-                self.assumed[key] = claimed
-            self.pvs[key] = dict(payload)
-        else:
-            self.pvcs[key] = dict(payload)
-            bound = payload.get("bound_pv")
-            if bound:
-                self.assumed.setdefault(bound, key)
+        with self._cache_lock:
+            if kind == "pv":
+                claimed = payload.get("claimed_by")
+                if claimed and self.assumed.get(key) is None:
+                    self.assumed[key] = claimed
+                self.pvs[key] = dict(payload)
+            else:
+                self.pvcs[key] = dict(payload)
+                bound = payload.get("bound_pv")
+                if bound:
+                    self.assumed.setdefault(bound, key)
 
     # -- binding logic -------------------------------------------------
 
@@ -116,6 +126,12 @@ class VolumeBindingPlugin(Plugin):
                      exclude: Optional[set] = None) -> Optional[str]:
         """An existing PV for the claim, or the PROVISION sentinel for
         a dynamic (storage-classed) claim, or None."""
+        with self._cache_lock:
+            return self._bindable_pv_locked(pvc_name, zone, exclude)
+
+    def _bindable_pv_locked(self, pvc_name: str, zone: str,
+                            exclude: Optional[set] = None
+                            ) -> Optional[str]:
         pvc = self.pvcs.get(pvc_name)
         if pvc is None:
             return None
@@ -137,6 +153,19 @@ class VolumeBindingPlugin(Plugin):
             # selected node's zone at commit (WaitForFirstConsumer)
             return PROVISION
         return None
+
+    def _try_assume(self, pvc_name: str, zone: str,
+                    exclude: set) -> Optional[str]:
+        """Scan + assume atomically, so a passive-cache write between
+        our scan and our assume can't be clobbered."""
+        with self._cache_lock:
+            if pvc_name not in self.pvcs or \
+                    self.pvcs[pvc_name].get("bound_pv"):
+                return None
+            pv = self._bindable_pv_locked(pvc_name, zone, exclude)
+            if pv is not None and pv is not PROVISION:
+                self.assumed[pv] = pvc_name
+            return pv
 
     def _predicate(self, task: TaskInfo, node: NodeInfo):
         claims = self._claims(task)
@@ -185,34 +214,36 @@ class VolumeBindingPlugin(Plugin):
         zone = node.labels.get(ZONE_LABEL, "")
         reserved = []
         for pvc_name in claims:
-            if pvc_name not in self.pvcs or \
-                    self.pvcs[pvc_name].get("bound_pv"):
-                continue
-            pv = self._bindable_pv(pvc_name, zone,
-                                   exclude={p for _, p, _z in reserved
-                                            if p is not PROVISION})
+            pv = self._try_assume(pvc_name, zone,
+                                  exclude={p for _, p, _z in reserved
+                                           if p is not PROVISION})
             if pv is None:
+                if pvc_name not in self.pvcs or \
+                        self.pvcs[pvc_name].get("bound_pv"):
+                    continue   # already bound: nothing to reserve
                 # never leave a claim partially unbound: release this
                 # task's reservations and let resync handle it
                 log.warning(
                     "volumebinding: PVC %s lost its PV on %s at "
                     "allocate time; releasing task reservations",
                     pvc_name, task.node_name)
-                for _, prev_pv, _z in reserved:
-                    if prev_pv is not PROVISION:
-                        self.assumed.pop(prev_pv, None)
+                with self._cache_lock:
+                    for prev_pvc, prev_pv, _z in reserved:
+                        if prev_pv is not PROVISION and \
+                                self.assumed.get(prev_pv) == prev_pvc:
+                            del self.assumed[prev_pv]
                 return
-            if pv is not PROVISION:
-                self.assumed[pv] = pvc_name
             reserved.append((pvc_name, pv, zone))
         if reserved:
             self._task_pvs[task.uid] = reserved
 
     def _on_deallocate(self, event):
-        for _pvc_name, pv, _zone in self._task_pvs.pop(
-                event.task.uid, []):
-            if pv is not PROVISION:
-                self.assumed.pop(pv, None)
+        reserved = self._task_pvs.pop(event.task.uid, [])
+        with self._cache_lock:
+            for pvc_name, pv, _zone in reserved:
+                if pv is not PROVISION and \
+                        self.assumed.get(pv) == pvc_name:
+                    del self.assumed[pv]
 
     def on_session_close(self, ssn):
         cluster = getattr(self, "cluster", None)
@@ -240,25 +271,76 @@ class VolumeBindingPlugin(Plugin):
                 if live_pvc is None or live_pvc.get("bound_pv"):
                     continue
                 if pv_name is PROVISION:
-                    # dynamic provisioning: create the volume in the
-                    # consumer's zone, sized to the request
-                    pv_name = f"pv-{pvc_name}-dyn"
-                    suffix = 0
-                    while pv_name in getattr(cluster, "pvs", {}):
-                        suffix += 1
-                        pv_name = f"pv-{pvc_name}-dyn{suffix}"
-                    cluster.put_object("pv", {
-                        "capacity_gi": live_pvc.get("request_gi", 0),
-                        "zone": zone,
-                        "claimed_by": pvc_name,
-                        "storage_class": live_pvc.get("storage_class"),
-                        "provisioned": True,
-                    }, key=pv_name)
+                    pv_name = self._provision(cluster, live_pvc,
+                                              pvc_name, zone)
                 else:
-                    live_pv = dict(getattr(cluster, "pvs",
-                                           {}).get(pv_name) or {})
-                    live_pv["claimed_by"] = pvc_name
-                    cluster.put_object("pv", live_pv, key=pv_name)
+                    live_pv = getattr(cluster, "pvs", {}).get(pv_name)
+                    claimed = (live_pv or {}).get("claimed_by")
+                    if live_pv is None or (claimed and
+                                           claimed != pvc_name):
+                        # reserved PV was deleted or bound by another
+                        # scheduler mid-session (we never steal it);
+                        # the pod is already committed to this zone, so
+                        # rebind to another live in-zone PV — or
+                        # provision — rather than strand the claim
+                        pv_name = self._rebind_live(cluster, live_pvc,
+                                                    pvc_name, zone)
+                        if pv_name is None:
+                            log.warning(
+                                "volumebinding: PVC %s lost its PV and "
+                                "zone %s has no replacement; claim left "
+                                "unbound", pvc_name, zone)
+                            continue
+                    else:
+                        live_pv = dict(live_pv)
+                        live_pv["claimed_by"] = pvc_name
+                        cluster.put_object("pv", live_pv, key=pv_name)
                 new_pvc = dict(live_pvc)
                 new_pvc["bound_pv"] = pv_name
                 cluster.put_object("pvc", new_pvc, key=pvc_name)
+
+    @staticmethod
+    def _provision(cluster, live_pvc: dict, pvc_name: str,
+                   zone: str) -> str:
+        """Dynamically provision a volume in the consumer's zone, sized
+        to the request (WaitForFirstConsumer)."""
+        pv_name = f"pv-{pvc_name}-dyn"
+        suffix = 0
+        while pv_name in getattr(cluster, "pvs", {}):
+            suffix += 1
+            pv_name = f"pv-{pvc_name}-dyn{suffix}"
+        cluster.put_object("pv", {
+            "capacity_gi": live_pvc.get("request_gi", 0),
+            "zone": zone,
+            "claimed_by": pvc_name,
+            "storage_class": live_pvc.get("storage_class"),
+            "provisioned": True,
+        }, key=pv_name)
+        return pv_name
+
+    def _rebind_live(self, cluster, live_pvc: dict, pvc_name: str,
+                     zone: str) -> Optional[str]:
+        """Claim a replacement PV from LIVE cluster state for a claim
+        whose session reservation evaporated; provision as last
+        resort."""
+        need = live_pvc.get("request_gi", 0)
+        for name, pv in sorted(getattr(cluster, "pvs", {}).items()):
+            if pv.get("claimed_by") or pv.get("zone") != zone:
+                continue
+            if pv.get("capacity_gi", 0) < need:
+                continue
+            with self._cache_lock:
+                assumed_for = self.assumed.get(name)
+                fresh = self.pvs.get(name, pv)
+            if assumed_for and assumed_for != pvc_name:
+                continue   # reserved for another claim in this session
+            if fresh.get("claimed_by") and \
+                    fresh.get("claimed_by") != pvc_name:
+                continue   # passive cache saw an external claim
+            taken = dict(pv)
+            taken["claimed_by"] = pvc_name
+            cluster.put_object("pv", taken, key=name)
+            return name
+        if live_pvc.get("storage_class"):
+            return self._provision(cluster, live_pvc, pvc_name, zone)
+        return None

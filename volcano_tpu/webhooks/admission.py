@@ -91,8 +91,31 @@ def validate_job(job: VCJob, cluster=None) -> None:
         if policy.exit_code == 0:
             raise AdmissionError("policy exitCode 0 is not allowed")
     if job.network_topology is not None and \
+            job.network_topology.highest_tier_allowed is not None and \
             job.network_topology.highest_tier_allowed < 1:
         raise AdmissionError("networkTopology.highestTierAllowed must be >= 1")
+    subgroup_nts = {}
+    for task in job.tasks:
+        nt = getattr(task, "network_topology", None)
+        if nt is None:
+            continue
+        if not task.subgroup:
+            raise AdmissionError(
+                f"task {task.name!r}: networkTopology requires subGroup "
+                "(per-task topology binds a subgroup gang to a domain)")
+        if nt.highest_tier_allowed is not None and \
+                nt.highest_tier_allowed < 1:
+            raise AdmissionError(
+                f"task {task.name!r}: networkTopology.highestTierAllowed "
+                "must be >= 1")
+        prev = subgroup_nts.setdefault(task.subgroup, nt)
+        if prev is not nt and (prev.mode is not nt.mode or
+                               prev.highest_tier_allowed !=
+                               nt.highest_tier_allowed):
+            raise AdmissionError(
+                f"task {task.name!r}: conflicting networkTopology for "
+                f"subGroup {task.subgroup!r} (one constraint per "
+                "subgroup gang)")
     if cluster is not None and job.queue:
         if job.queue not in cluster.queues:
             raise AdmissionError(f"queue {job.queue!r} does not exist")
