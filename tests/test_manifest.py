@@ -150,3 +150,17 @@ def test_task_level_network_topology_overrides_tpu_default():
                   "resources": {"requests": {"cpu": 1}}}]}}},
         ]}})
     assert JobController._subgroup_topology(cpu_job, "g0") is None
+
+    # an explicit JOB-level constraint is never shadowed by the TPU
+    # default: the subgroup inherits it at allocation time
+    capped = job_from_manifest({
+        "kind": "Job", "metadata": {"name": "z"},
+        "spec": {"networkTopology": {"mode": "hard",
+                                     "highestTierAllowed": 1},
+                 "tasks": [
+            {"name": "w", "subGroup": "g0",
+             "template": {"spec": {"containers": [
+                 {"name": "c",
+                  "resources": {"requests": {"google.com/tpu": 4}}}]}}},
+        ]}})
+    assert JobController._subgroup_topology(capped, "g0") is None

@@ -226,7 +226,9 @@ class JobController(Controller):
                 return spec.network_topology
             if spec.template_pod().resource_requests().get(TPU):
                 wants_tpu = True
-        if wants_tpu:
+        # the default must never shadow an explicit job-level
+        # constraint (subgroups fall back to it at allocation time)
+        if wants_tpu and job.network_topology is None:
             return NetworkTopologySpec(mode=NetworkTopologyMode.HARD,
                                        highest_tier_allowed=None)
         return None
