@@ -430,3 +430,74 @@ def test_cli_node_list_and_view(tmp_path, capsys):
     import pytest
     with pytest.raises(SystemExit):
         main(["--state", state, "node", "view", "-N", "nosuch"])
+
+
+def test_slurm_shortcuts_and_agent_healthz(tmp_path):
+    """vsub/vcancel/vsuspend/vresume aliases (reference standalone
+    binaries, Makefile:281) + the node agent /healthz endpoint
+    (reference pkg/agent/healthcheck)."""
+    state = str(tmp_path / "cluster.pkl")
+    env = dict(os.environ, PYTHONPATH=os.getcwd())
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, "-m", "volcano_tpu.cli.vtpctl",
+             "--state", state, *args],
+            capture_output=True, text=True, env=env, check=True).stdout
+
+    run("init", "--slices", "sa=v5e-16")
+    run("vsub", "-N", "train", "--replicas", "2", "--tpu", "4")
+    run("tick", "--cycles", "3")
+    assert "Running" in run("vjobs")
+    run("vsuspend", "-N", "train")
+    run("tick", "--cycles", "2")
+    assert "Abort" in run("vjobs")
+    run("vresume", "-N", "train")
+    run("vcancel", "-N", "train")
+    assert "train" not in run("vjobs")
+
+    # agent healthz: 503 before first sync, 200 after
+    import json as _json
+    import urllib.request
+
+    from volcano_tpu.agent import NodeAgent
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    agent = NodeAgent(cluster, "sa-w0")
+    server = agent.serve_health(port=0)
+    port = server.server_address[1]
+    try:
+        try:
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz")
+            raise AssertionError("expected 503 before first sync")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+        agent.sync()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz") as resp:
+            body = _json.loads(resp.read())
+        assert body["healthy"] and body["node"] == "sa-w0"
+    finally:
+        server.shutdown()
+
+
+def test_mpi_admission_mutate_adds_depends_on():
+    """The MPI mutating admission plugin defaults the master task's
+    dependsOn to the worker task (reference admission/jobs/plugins/
+    mpi)."""
+    from volcano_tpu.api.vcjob import TaskSpec, VCJob
+    from volcano_tpu.webhooks.admission import mutate_job
+
+    job = VCJob(name="mpijob", plugins={"mpi": []}, tasks=[
+        TaskSpec(name="master", replicas=1),
+        TaskSpec(name="worker", replicas=4),
+    ])
+    mutate_job(job)
+    assert job.tasks[0].depends_on is not None
+    assert job.tasks[0].depends_on.name == ["worker"]
+    # explicit dependsOn is left alone; custom names honored
+    job2 = VCJob(name="m2", plugins={"mpi": ["--master=launcher",
+                                             "--worker=trainer"]},
+                 tasks=[TaskSpec(name="launcher", replicas=1),
+                        TaskSpec(name="trainer", replicas=2)])
+    mutate_job(job2)
+    assert job2.tasks[0].depends_on.name == ["trainer"]

@@ -219,3 +219,15 @@ def test_commands_and_dict_kinds(wire):
     assert b.pvcs["pvc-a"]["request_gi"] == 10
     a.delete_object("pvc", "pvc-a")
     wait_for(lambda: "pvc-a" not in b.pvcs, msg="pvc deletion")
+
+
+def test_server_metrics_endpoint(wire):
+    """The state server exposes Prometheus-format /metrics alongside
+    /healthz (per-binary registry parity)."""
+    import urllib.request
+    with urllib.request.urlopen(f"{wire.url}/metrics") as resp:
+        assert resp.status == 200
+        assert "text/plain" in resp.headers["Content-Type"]
+        resp.read()
+    with urllib.request.urlopen(f"{wire.url}/healthz") as resp:
+        assert resp.status == 200

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import abc
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -87,10 +88,53 @@ class NodeAgent:
         self.provider = provider or FakeUsageProvider()
         self.oversub_factor = oversub_factor
         self.eviction_threshold = eviction_threshold
+        self.last_sync: float = 0.0          # health-check freshness
+
+    def serve_health(self, port: int = 0, stale_after: float = 30.0):
+        """Expose /healthz (reference pkg/agent/healthcheck): 200 with
+        {healthy, node, last_sync_age_s} while the agent syncs, 503
+        once the last sync is older than *stale_after* seconds (size
+        this to ~3x the daemon's sync period) or never happened.
+        Returns the server; port 0 picks a free one."""
+        import http.server
+        import json as _json
+        import threading
+
+        agent = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib API
+                if self.path != "/healthz":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                age = (time.time() - agent.last_sync
+                       if agent.last_sync else None)
+                healthy = age is not None and age < stale_after
+                body = _json.dumps({
+                    "healthy": healthy, "node": agent.node_name,
+                    "last_sync_age_s": (round(age, 3)
+                                        if age is not None else None),
+                }).encode()
+                self.send_response(200 if healthy else 503)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet
+                pass
+
+        server = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                                 Handler)
+        threading.Thread(target=server.serve_forever,
+                         daemon=True).start()
+        return server
 
     # -- one reporting cycle ------------------------------------------
 
     def sync(self) -> None:
+        self.last_sync = time.time()
         node = self.cluster.nodes.get(self.node_name)
         if node is None:
             return

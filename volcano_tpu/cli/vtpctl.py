@@ -335,6 +335,20 @@ def cmd_tick(cluster, args):
     print(f"ran {args.cycles} cycle(s): {bound} pods placed")
 
 
+def _add_job_run_args(p) -> None:
+    """Shared by `job run` and its slurm-style alias `vsub`."""
+    p.add_argument("-N", "--name", required=True)
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--replicas", type=int, default=1)
+    p.add_argument("--min-available", type=int, default=None)
+    p.add_argument("--task-name", default="worker")
+    p.add_argument("--queue", default="default")
+    p.add_argument("--image", default="busybox")
+    p.add_argument("--cpu", default="1")
+    p.add_argument("--tpu", type=int, default=0)
+    p.add_argument("--plugins", default="")
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="vtpctl",
@@ -356,16 +370,7 @@ def build_parser() -> argparse.ArgumentParser:
     job = sub.add_parser("job", help="job operations").add_subparsers(
         dest="job_cmd", required=True)
     p = job.add_parser("run")
-    p.add_argument("-N", "--name", required=True)
-    p.add_argument("-n", "--namespace", default="default")
-    p.add_argument("--replicas", type=int, default=1)
-    p.add_argument("--min-available", type=int, default=None)
-    p.add_argument("--task-name", default="worker")
-    p.add_argument("--queue", default="default")
-    p.add_argument("--image", default="busybox")
-    p.add_argument("--cpu", default="1")
-    p.add_argument("--tpu", type=int, default=0)
-    p.add_argument("--plugins", default="")
+    _add_job_run_args(p)
     p.set_defaults(fn=cmd_job_run)
     p = job.add_parser("create", help="create job(s) from a YAML manifest")
     p.add_argument("-f", "--filename", required=True)
@@ -446,12 +451,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cycles", type=int, default=1)
     p.set_defaults(fn=cmd_tick)
 
-    # slurm-style shortcuts (vsub/vjobs/vqueues/vcancel)
+    # slurm-style shortcuts (reference standalone binaries vsub/vjobs/
+    # vqueues/vcancel/vsuspend/vresume, Makefile:281)
     p = sub.add_parser("vjobs", help="alias of: job list")
     p.add_argument("-n", "--namespace", default=None)
     p.set_defaults(fn=cmd_job_list)
     p = sub.add_parser("vqueues", help="alias of: queue list")
     p.set_defaults(fn=cmd_queue_list)
+    p = sub.add_parser("vsub", help="alias of: job run")
+    _add_job_run_args(p)
+    p.set_defaults(fn=cmd_job_run)
+    p = sub.add_parser("vcancel", help="alias of: job delete")
+    p.add_argument("-N", "--name", required=True)
+    p.add_argument("-n", "--namespace", default="default")
+    p.set_defaults(fn=cmd_job_delete)
+    for verb, action in (("vsuspend", "AbortJob"),
+                         ("vresume", "ResumeJob")):
+        p = sub.add_parser(verb, help=f"alias of: job {verb[1:]}")
+        p.add_argument("-N", "--name", required=True)
+        p.add_argument("-n", "--namespace", default="default")
+        p.set_defaults(fn=lambda c, a, _act=action: cmd_job_command(
+            c, a, _act))
 
     return parser
 

@@ -70,6 +70,17 @@ def reset():
         _gauges.clear()
 
 
+def write_exposition(handler) -> None:
+    """Write the Prometheus text exposition as an HTTP response on a
+    BaseHTTPRequestHandler (shared by serve() and the state server)."""
+    body = dump().encode()
+    handler.send_response(200)
+    handler.send_header("Content-Type", "text/plain; version=0.0.4")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
 def serve(port: int = 0):
     """Expose /metrics over HTTP (Prometheus scrape endpoint analogue;
     reference: per-binary Prometheus registries).  Returns the server —
@@ -84,12 +95,7 @@ def serve(port: int = 0):
                 self.send_response(404)
                 self.end_headers()
                 return
-            body = dump().encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            write_exposition(self)
 
         def log_message(self, *args):  # quiet
             pass

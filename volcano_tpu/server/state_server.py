@@ -174,6 +174,9 @@ class _Handler(BaseHTTPRequestHandler):
         st = self.state
         if url.path == "/healthz":
             return self._json(200, {"ok": True})
+        if url.path == "/metrics":
+            from volcano_tpu import metrics
+            return metrics.write_exposition(self)
         if url.path == "/snapshot":
             return self._json(200, st.snapshot_payload())
         if url.path == "/leases":

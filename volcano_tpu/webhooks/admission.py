@@ -38,7 +38,28 @@ def mutate_job(job: VCJob) -> VCJob:
             task.min_available = task.replicas
     if job.min_available <= 0:
         job.min_available = job.total_replicas()
+    _mutate_mpi(job)
     return job
+
+
+def _mutate_mpi(job: VCJob) -> None:
+    """MPI mutating plugin (reference admission/jobs/plugins/mpi):
+    the launcher must not start before the workers exist, so default
+    the master task's dependsOn to the worker task."""
+    if "mpi" not in job.plugins:
+        return
+    master, worker = "master", "worker"
+    for arg in job.plugins.get("mpi") or []:
+        if arg.startswith("--master="):
+            master = arg.split("=", 1)[1]
+        elif arg.startswith("--worker="):
+            worker = arg.split("=", 1)[1]
+    from volcano_tpu.api.vcjob import DependsOn
+    if worker not in {t.name for t in job.tasks}:
+        return   # never inject a dependency on a task that isn't there
+    for task in job.tasks:
+        if task.name == master and task.depends_on is None:
+            task.depends_on = DependsOn(name=[worker])
 
 
 def validate_job(job: VCJob, cluster=None) -> None:
