@@ -89,3 +89,137 @@ def test_unknown_claim_rejected():
     ctx = dra_ctx(claims={}, slices={"n0": []}, pods_claims=[["ghost"]])
     ctx.run()
     ctx.expect_bind_num(0)
+
+
+def test_device_taints_require_tolerations():
+    """DRADeviceTaints: a tainted device is invisible to claims without
+    a matching toleration and usable with one."""
+    base = {"count": 1, "allocated_node": "", "allocated_devices": []}
+    ctx = dra_ctx(
+        claims={"plain": dict(base, **{"class": "accel"}),
+                "tol": dict(base, **{
+                    "class": "accel",
+                    "tolerations": [{"key": "maintenance"}]})},
+        slices={"n0": [{"name": "d0", "class": "accel",
+                        "taints": [{"key": "maintenance",
+                                    "value": "fw-upgrade"}]}]},
+        pods_claims=[["plain"], ["tol"]])
+    ctx.run()
+    ctx.expect_bind_num(1)
+    assert ctx.cluster.resource_claims["tol"]["allocated_devices"] == ["d0"]
+    assert not ctx.cluster.resource_claims["plain"]["allocated_node"]
+
+
+def test_prioritized_class_list_first_available():
+    """DRAPrioritizedList: the claim prefers v5p devices but falls back
+    to v5e where none exist; the winning class is recorded."""
+    ctx = dra_ctx(
+        claims={"flex": {"class_priorities": ["v5p-accel", "v5e-accel"],
+                         "count": 1, "allocated_node": "",
+                         "allocated_devices": []}},
+        slices={"n0": [{"name": "e0", "class": "v5e-accel"}],
+                "n1": []},
+        pods_claims=[["flex"]])
+    ctx.run()
+    ctx.expect_bind("default/j0-0", "n0")
+    claim = ctx.cluster.resource_claims["flex"]
+    assert claim["allocated_class"] == "v5e-accel"
+
+    # preferred class present on another node -> it wins over fallback
+    ctx2 = dra_ctx(
+        claims={"flex": {"class_priorities": ["v5p-accel", "v5e-accel"],
+                         "count": 1, "allocated_node": "",
+                         "allocated_devices": []}},
+        slices={"n0": [{"name": "e0", "class": "v5e-accel"}],
+                "n1": [{"name": "p0", "class": "v5p-accel"}]},
+        pods_claims=[["flex"]])
+    ctx2.run()
+    # both nodes pass the predicate; scoring ties — either is legal,
+    # but the allocated class must match the node's device class
+    claim = ctx2.cluster.resource_claims["flex"]
+    node = claim["allocated_node"]
+    assert claim["allocated_class"] == (
+        "v5p-accel" if node == "n1" else "v5e-accel")
+
+
+def test_admin_access_attaches_without_consuming_capacity():
+    """DRAAdminAccess (gated off by default): an admin claim from a
+    flagged namespace rides along on an owned device; a regular claim
+    still gets the device."""
+    from volcano_tpu import features
+
+    base = {"count": 1, "allocated_node": "", "allocated_devices": []}
+    ctx = dra_ctx(
+        claims={"work": dict(base, **{"class": "accel"}),
+                "probe": dict(base, **{"class": "accel",
+                                       "admin_access": True,
+                                       "namespace": "monitoring"})},
+        slices={"n0": [{"name": "d0", "class": "accel"}]},
+        pods_claims=[["work"], ["probe"]])
+    ctx.cluster.admin_namespaces = {"monitoring"}
+    features.set_gate("DRAAdminAccess", True)
+    try:
+        ctx.run()
+    finally:
+        features.reset("DRAAdminAccess")
+    ctx.expect_bind_num(2)
+    work = ctx.cluster.resource_claims["work"]
+    probe = ctx.cluster.resource_claims["probe"]
+    assert work["allocated_devices"] == ["d0"]
+    assert probe["allocated_node"] == "n0"
+    assert probe["allocated_devices"] == ["d0"]   # rides along
+
+
+def test_admin_access_denied_without_gate_or_namespace():
+    """Admin access requires BOTH the feature gate and the namespace
+    flag; otherwise the claim competes normally (and loses a taken
+    device)."""
+    base = {"count": 1, "allocated_node": "", "allocated_devices": []}
+    ctx = dra_ctx(
+        claims={"work": dict(base, **{"class": "accel"}),
+                "probe": dict(base, **{"class": "accel",
+                                       "admin_access": True,
+                                       "namespace": "monitoring"})},
+        slices={"n0": [{"name": "d0", "class": "accel"}]},
+        pods_claims=[["work"], ["probe"]])
+    # gate off (default): admin flag is inert -> normal contention
+    ctx.run()
+    ctx.expect_bind_num(1)
+
+
+def test_taints_ignored_when_gate_off():
+    """DRADeviceTaints=false restores pre-feature semantics: taints are
+    ignored, tainted devices stay usable by toleration-less claims."""
+    from volcano_tpu import features
+    ctx = dra_ctx(
+        claims={"plain": {"class": "accel", "count": 1,
+                          "allocated_node": "", "allocated_devices": []}},
+        slices={"n0": [{"name": "d0", "class": "accel",
+                        "taints": [{"key": "maintenance"}]}]},
+        pods_claims=[["plain"]])
+    features.set_gate("DRADeviceTaints", False)
+    try:
+        ctx.run()
+    finally:
+        features.reset("DRADeviceTaints")
+    ctx.expect_bind("default/j0-0", "n0")
+
+
+def test_prioritized_class_respects_queue_quota_consistently():
+    """A quota-exhausted preferred class falls through to the fallback
+    class in BOTH predicate and allocation (the same picker runs in
+    both, so allocated_class can never violate the quota the predicate
+    enforced)."""
+    ctx = dra_ctx(
+        claims={"flex": {"class_priorities": ["v5p-accel", "v5e-accel"],
+                         "count": 1, "allocated_node": "",
+                         "allocated_devices": []}},
+        slices={"n0": [{"name": "p0", "class": "v5p-accel"},
+                       {"name": "e0", "class": "v5e-accel"}]},
+        pods_claims=[["flex"]], queues=("q1",),
+        queue_ann={"q1": {"dra.volcano-tpu.io/quota.v5p-accel": "0"}})
+    ctx.run()
+    ctx.expect_bind("default/j0-0", "n0")
+    claim = ctx.cluster.resource_claims["flex"]
+    assert claim["allocated_class"] == "v5e-accel"
+    assert claim["allocated_devices"] == ["e0"]

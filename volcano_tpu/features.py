@@ -34,6 +34,13 @@ _DEFINITIONS: Dict[str, tuple] = {
                             "(CSIStorage analogue)"),
     "TPUDeviceAtomicity": (True, "whole-host chip atomicity on "
                                  "multi-host slices"),
+    # DRA feature-gate surface (reference predicates.go:154-162)
+    "DRADeviceTaints": (True, "devices may carry taints; claims need "
+                              "matching tolerations"),
+    "DRAPrioritizedList": (True, "claims may list device classes in "
+                                 "preference order (firstAvailable)"),
+    "DRAAdminAccess": (False, "admin claims attach to owned devices "
+                              "without consuming capacity"),
 }
 
 _lock = threading.Lock()
