@@ -443,12 +443,14 @@ def _train_child():
 
     sizes = jax.tree.map(lambda x: x.size, params)
     total = sum(jax.tree.leaves(sizes))
-    nonemb = total - cfg.vocab_size * cfg.d_model * 2
+    # standard MFU accounting (PaLM appendix): the input embedding is a
+    # lookup (excluded); the output head IS a matmul (included)
+    matmul_params = total - cfg.vocab_size * cfg.d_model
     tokens = b * t
     # 6ND matmul flops + causal attention (fwd 4bht^2*hd/2, bwd ~2x)
     attn_fwd = cfg.n_layers * 4.0 * b * cfg.n_heads * t * t * \
         cfg.head_dim / 2
-    flops = 6.0 * nonemb * tokens + 3.0 * attn_fwd
+    flops = 6.0 * matmul_params * tokens + 3.0 * attn_fwd
     peak = TPU_PEAK_FLOPS.get(dev.device_kind)
     print(json.dumps({
         "tpu_available": True, "device_kind": dev.device_kind,
@@ -490,6 +492,7 @@ def _tpu_subprocess(flag: str, timeout_s: float) -> dict:
             cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
         return {"tpu_available": False, "attempted": True,
+                "tpu_unreachable": True,
                 "error": f"TPU backend init exceeded {timeout_s:g}s "
                          f"(axon tunnel dead/hung)"}
     for line in reversed(proc.stdout.strip().splitlines() or [""]):
@@ -512,13 +515,14 @@ def main():
     reclaim_s = bench_reclaim_convergence()
     scale = bench_5k_host_scale()
     flash = bench_flash_attention_tpu()
-    if flash.get("tpu_available"):
-        train_tpu = bench_train_step_tpu()
-    else:
+    if flash.get("tpu_unreachable"):
         # the flash probe just proved the tunnel is dead; don't burn
-        # another 7 minutes reproving it
+        # another 7 minutes reproving it.  A flash-side FAILURE with a
+        # live TPU must NOT skip the training benchmark.
         train_tpu = {"tpu_available": False, "attempted": False,
-                     "skipped": "flash probe found no TPU"}
+                     "skipped": "flash probe timed out reaching the TPU"}
+    else:
+        train_tpu = bench_train_step_tpu()
     print(json.dumps({
         "metric": "p50_gang_allocate_latency_256host_v5p1024",
         "value": round(p50, 4),
