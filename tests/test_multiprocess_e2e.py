@@ -241,3 +241,58 @@ def test_leader_election_failover(plane):
         assert plane.leases()["scheduler"]["holder"] == "sched-2"
     finally:
         kubectl.close()
+
+
+def test_full_topology_with_agent_processes(plane):
+    """The complete deployment shape from deploy/README.md: state
+    server + batch scheduler + controller manager + an AGENT-ONLY
+    process (--components none --agent-scheduler --node-agents all).
+    A bare agent-routed pod fast-path binds over the wire while the
+    batch path gang-schedules, and the node agents' usage reports
+    cross the wire onto every node (chip-health cordons need real
+    telemetry, so they stay off with the default provider)."""
+    from volcano_tpu.api.shard import AGENT_SCHEDULER
+
+    plane.start_server()
+    kubectl = RemoteCluster(plane.url)
+    try:
+        for node in slice_nodes(slice_for("sa", "v5e-16"),
+                                dcn_pod="dcn-0"):
+            kubectl.add_node(node)
+
+        plane.start_controllers()
+        plane.start_scheduler()
+        plane.spawn("agents", "-m", "volcano_tpu",
+                    "--cluster-url", plane.url,
+                    "--components", "none", "--agent-scheduler",
+                    "--node-agents", "all", "--period", "0.1")
+
+        # batch path: whole-slice gang
+        kubectl.add_vcjob(tpu_job("batchjob"))
+        # fast path: bare pod routed at the agent scheduler
+        bare = make_pod("bare", requests={"cpu": 1})
+        bare.scheduler_name = AGENT_SCHEDULER
+        kubectl.add_pod(bare)
+
+        try:
+            wait_for(lambda: running_count(kubectl, "batchjob") == 4,
+                     45, "batch gang running")
+            wait_for(lambda: bool(
+                kubectl.pods.get("default/bare")
+                and kubectl.pods["default/bare"].node_name),
+                30, "bare pod fast-path bound")
+        except AssertionError:
+            raise AssertionError(plane.dump_logs())
+
+        # node agents sync'd every node: usage reports crossed the
+        # wire (chip-health cordons need real telemetry and stay off
+        # with the default provider — never cordon on absent data)
+        try:
+            wait_for(lambda: all(
+                "usage.volcano-tpu.io/cpu" in n.annotations
+                for n in kubectl.nodes.values()),
+                30, "node agent usage report over the wire")
+        except AssertionError:
+            raise AssertionError(plane.dump_logs())
+    finally:
+        kubectl.close()

@@ -18,6 +18,8 @@ reference binaries only meet at the apiserver:
         --components scheduler --leader-elect --holder sched-1 &
     python -m volcano_tpu --cluster-url http://127.0.0.1:8700 \
         --components controllers &
+    python -m volcano_tpu --cluster-url http://127.0.0.1:8700 \
+        --components none --agent-scheduler --node-agents all &
 
 --leader-elect takes a server lease before scheduling and renews it
 each cycle; losing the lease pauses the component until re-acquired
@@ -45,7 +47,9 @@ def main(argv=None) -> int:
                              "components against the wire instead of an "
                              "in-memory cluster")
     parser.add_argument("--components", default="scheduler,controllers",
-                        help="comma list: scheduler,controllers")
+                        help="comma list: scheduler,controllers — or "
+                             "'none' for an agent-only process "
+                             "(--agent-scheduler / --node-agents)")
     parser.add_argument("--leader-elect", action="store_true",
                         help="gate the scheduler on a server lease")
     parser.add_argument("--holder", default="",
@@ -109,10 +113,18 @@ def main(argv=None) -> int:
         cluster.admission = default_admission()
 
     components = {c.strip() for c in args.components.split(",") if c}
-    unknown = components - {"scheduler", "controllers"}
+    unknown = components - {"scheduler", "controllers", "none"}
     if unknown or not components:
         parser.error(f"--components must be a non-empty subset of "
-                     f"scheduler,controllers (got {args.components!r})")
+                     f"scheduler,controllers (or 'none' for an "
+                     f"agent-only process; got {args.components!r})")
+    if "none" in components and len(components) > 1:
+        parser.error("--components none excludes other components")
+    if components == {"none"} and not (args.agent_scheduler or
+                                       args.node_agents):
+        parser.error("--components none needs --agent-scheduler "
+                     "and/or --node-agents")
+    components -= {"none"}
     run_sched = "scheduler" in components
     run_ctrls = "controllers" in components
 
@@ -129,6 +141,9 @@ def main(argv=None) -> int:
     if args.leader_elect:
         if not remote:
             parser.error("--leader-elect requires --cluster-url")
+        if not components:
+            parser.error("--leader-elect needs scheduler/controllers "
+                         "(agent processes are per-node, not elected)")
         from volcano_tpu.leaderelection import LeaderElector
         holder = args.holder or f"pid-{os.getpid()}"
         # one lease per component set: scheduler replicas contend on
