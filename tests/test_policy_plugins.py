@@ -634,3 +634,138 @@ def test_reclaim_cross_queue_numa_cure_and_rollback():
                                      qb_weight=3), conf=conf)
     ctx2.run()
     ctx2.expect_evict_num(0)
+
+
+# -- nodeorder scorer parity (nodeorder.go:51-66) ----------------------
+
+def test_nodeorder_preferred_node_affinity():
+    from volcano_tpu.api.pod import PreferredNodeTerm
+    pg, pods = gang_job("pref", replicas=1, requests={"cpu": 1})
+    pods[0].preferred_node_affinity = [
+        PreferredNodeTerm(weight=10, term={"disk": ["ssd"]})]
+    ns = nodes(3)
+    ns[2].labels["disk"] = "ssd"
+    ctx = TestContext(nodes=ns, podgroups=[pg], pods=pods,
+                      conf=conf_with())
+    ctx.run()
+    ctx.expect_bind("default/pref-0", "n2")
+
+
+def test_nodeorder_taint_toleration_prefers_untainted():
+    from volcano_tpu.api.pod import Taint, Toleration
+    pg, pods = gang_job("tt", replicas=1, requests={"cpu": 1})
+    ns = nodes(2)
+    ns[0].taints = [Taint(key="maint", value="yes",
+                          effect="PreferNoSchedule")]
+    ctx = TestContext(nodes=ns, podgroups=[pg], pods=pods,
+                      conf=conf_with())
+    ctx.run()
+    ctx.expect_bind("default/tt-0", "n1")
+
+    # a toleration neutralizes the penalty: the scorer ranks the
+    # tainted node at full score for a tolerating pod
+    from volcano_tpu.api.node_info import NodeInfo
+    from volcano_tpu.plugins.nodeorder import MAX_SCORE, NodeOrderPlugin
+    plug = NodeOrderPlugin({})
+    tainted = NodeInfo(Node(name="t", allocatable={"cpu": "8"},
+                            taints=[Taint(key="maint", value="yes",
+                                          effect="PreferNoSchedule")]))
+    pg2, pods2 = gang_job("tt2", replicas=1, requests={"cpu": 1})
+    from volcano_tpu.api.job_info import TaskInfo
+    task = TaskInfo(pods2[0])
+    assert plug._taint_toleration_score(task, tainted) == 0.0
+    pods2[0].tolerations = [Toleration(key="maint", value="yes",
+                                       effect="PreferNoSchedule")]
+    assert plug._taint_toleration_score(TaskInfo(pods2[0]),
+                                        tainted) == MAX_SCORE
+
+
+def test_nodeorder_image_locality():
+    pg, pods = gang_job("img", replicas=1, requests={"cpu": 1})
+    pods[0].containers[0].image = "trainer:v3"
+    ns = nodes(3)
+    ns[1].images = ["trainer:v3", "base:latest"]
+    ctx = TestContext(
+        nodes=ns, podgroups=[pg], pods=pods,
+        conf=conf_with({"name": "nodeorder",
+                        "arguments": {"imagelocality.weight": 50}}))
+    ctx.run()
+    ctx.expect_bind("default/img-0", "n1")
+
+
+def test_sra_keeps_cpu_pods_off_tpu_hosts():
+    pg, pods = gang_job("cpuonly", replicas=1, requests={"cpu": 1})
+    tpu_host = Node(name="tpuhost",
+                    allocatable={"cpu": "8", "pods": 110, TPU: "4"})
+    cpu_host = Node(name="cpuhost",
+                    allocatable={"cpu": "8", "pods": 110})
+    ctx = TestContext(
+        nodes=[tpu_host, cpu_host], podgroups=[pg], pods=pods,
+        conf=conf_with({"name": "resource-strategy-fit",
+                        "arguments": {"sra.weight": 20,
+                                      "sra.resources": TPU}}))
+    ctx.run()
+    ctx.expect_bind("default/cpuonly-0", "cpuhost")
+
+
+def test_pod_topology_spread_scorer_prefers_sparse_domain():
+    # BOTH replicas pending: after sp-0 lands in one zone, the scorer
+    # must steer sp-1 to the other — this exercises the in-session
+    # placement sensitivity that a cached per-spec NodeOrder score
+    # would get wrong (the scorer is a per-task BatchNodeOrder fn)
+    pg, pods = gang_job("sp", replicas=2, requests={"cpu": 1})
+    for p in pods:
+        p.annotations["spread.volcano-tpu.io/topology-key"] = "zone"
+        p.annotations["spread.volcano-tpu.io/max-skew"] = "2"
+    ns = nodes(4)
+    for i, n in enumerate(ns):
+        n.labels["zone"] = "a" if i < 2 else "b"
+    ctx = TestContext(
+        nodes=ns, podgroups=[pg], pods=pods,
+        conf=conf_with({"name": "pod-topology-spread",
+                        "arguments": {"podtopologyspread.weight": 50}}))
+    ctx.run()
+    zones = {"n0": "a", "n1": "a", "n2": "b", "n3": "b"}
+    bound = [zones[ctx.bind_map[f"default/sp-{i}"]] for i in range(2)]
+    assert sorted(bound) == ["a", "b"]
+
+
+def test_normal_pod_hypernode_binpack_packs_busy_slice():
+    from volcano_tpu.api.hypernode import HyperNode
+    # two 2-host slices under one pod-tier domain; slice s0 is busy
+    ns = nodes(4, cpu="8")
+    filler_pg, filler = gang_job("filler", replicas=1,
+                                 requests={"cpu": 4},
+                                 running_on=["n0"],
+                                 pg_phase=PodGroupPhase.RUNNING)
+    pg, pods = gang_job("normal", replicas=1, requests={"cpu": 1})
+    hns = [HyperNode.of_nodes("s0", 1, ["n0", "n1"]),
+           HyperNode.of_nodes("s1", 1, ["n2", "n3"]),
+           HyperNode.of_children("pod0", 2, ["s0", "s1"])]
+    ctx = TestContext(
+        nodes=ns, podgroups=[filler_pg, pg], pods=filler + pods,
+        hypernodes=hns,
+        conf=conf_with({"name": "network-topology-aware",
+                        "arguments": {"weight": 50}}))
+    ctx.run()
+    assert ctx.bind_map["default/normal-0"] in ("n0", "n1")
+
+    # disabled -> the normal-pod scorer contributes nothing
+    from volcano_tpu.plugins.topology import NetworkTopologyAwarePlugin
+    off = NetworkTopologyAwarePlugin(
+        {"hypernode.binpack.normal-pod.enable": False})
+    off.ssn = ctx.last_session
+    assert off._normal_pod_binpack_scores() == {}
+    on = NetworkTopologyAwarePlugin({})
+    on.ssn = ctx.last_session
+    scores = on._normal_pod_binpack_scores()
+    assert scores["s0"] > scores["s1"]
+
+
+def test_binpack_reference_key_aliases():
+    from volcano_tpu.plugins.binpack import BinpackPlugin
+    p = BinpackPlugin({"binpack.cpu": 7, "binpack.memory": 3,
+                       "binpack.resources.google.com/tpu": 11})
+    assert p.dim_weights["cpu"] == 7.0
+    assert p.dim_weights["memory"] == 3.0
+    assert p.dim_weights[TPU] == 11.0

@@ -38,6 +38,10 @@ class Node:
     taints: List[Taint] = field(default_factory=list)
     ready: bool = True
     unschedulable: bool = False
+    images: List[str] = field(default_factory=list)
+    # ^ container images present in the node's local cache
+    #   (corev1.NodeStatus.Images analogue; scored by nodeorder's
+    #   imagelocality.weight scorer)
 
 
 class NodeInfo:

@@ -82,6 +82,24 @@ class PodAffinityTerm:
 
 
 @dataclass
+class PreferredNodeTerm:
+    """One preferredDuringScheduling node-affinity term (k8s
+    PreferredSchedulingTerm analogue; scored by nodeorder's
+    nodeaffinity.weight scorer, reference nodeorder.go:51-52).
+
+    term: label -> allowed values, same shape as one entry of
+    Pod.affinity_node_terms.  weight: added to the node's score when
+    the term matches.
+    """
+
+    weight: int = 1
+    term: Dict[str, List[str]] = field(default_factory=dict)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        return all(labels.get(k) in vals for k, vals in self.term.items())
+
+
+@dataclass
 class Pod:
     name: str
     namespace: str = "default"
@@ -97,6 +115,8 @@ class Pod:
     affinity_node_terms: Optional[List[Dict[str, List[str]]]] = None
     # ^ simplified nodeAffinity: OR over terms; each term is a map of
     #   label -> allowed values (AND within a term).
+    preferred_node_affinity: List[PreferredNodeTerm] = \
+        field(default_factory=list)
     tolerations: List[Toleration] = field(default_factory=list)
     # inter-pod affinity (plugins/interpodaffinity.py)
     pod_affinity: List[PodAffinityTerm] = field(default_factory=list)

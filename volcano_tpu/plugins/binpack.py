@@ -26,6 +26,11 @@ class BinpackPlugin(Plugin):
         super().__init__(arguments)
         self.weight = float(self.arguments.get("binpack.weight", 1))
         self.dim_weights = {CPU: 1.0, MEMORY: 1.0, TPU: 5.0}
+        # reference key aliases (binpack.go:40,42)
+        if "binpack.cpu" in self.arguments:
+            self.dim_weights[CPU] = float(self.arguments["binpack.cpu"])
+        if "binpack.memory" in self.arguments:
+            self.dim_weights[MEMORY] = float(self.arguments["binpack.memory"])
         for key, val in self.arguments.items():
             if key.startswith("binpack.resources."):
                 self.dim_weights[key[len("binpack.resources."):]] = float(val)

@@ -59,7 +59,10 @@ class InterPodAffinityPlugin(Plugin):
 
     def __init__(self, arguments=None):
         super().__init__(arguments)
-        self.weight = float(self.arguments.get("weight", 1))
+        # "podaffinity.weight" is the reference conf key
+        # (nodeorder.go:54); "weight" kept for back-compat
+        self.weight = float(self.arguments.get(
+            "podaffinity.weight", self.arguments.get("weight", 1)))
 
     def on_session_open(self, ssn):
         self.ssn = ssn

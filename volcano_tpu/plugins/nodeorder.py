@@ -1,7 +1,14 @@
 """Nodeorder plugin — node scoring.
 
-Reference parity: plugins/nodeorder/nodeorder.go:191,197 (leastalloc,
-mostalloc, balancedalloc scorers with weights).
+Reference parity: plugins/nodeorder/nodeorder.go:51-66,191,197.  The
+reference wraps eight upstream k8s scorers behind per-scorer weights;
+here the resource-shape scorers (leastrequested / mostrequested /
+balancedresource) plus nodeaffinity (preferred terms), tainttoleration
+(PreferNoSchedule) and imagelocality are computed natively.  The two
+remaining reference keys live in their dedicated plugins, which score
+independently on the same session: podaffinity.weight ->
+plugins/interpodaffinity.py, podtopologyspread.weight ->
+plugins/predicates.py (pod-topology-spread).
 """
 
 from __future__ import annotations
@@ -24,11 +31,30 @@ class NodeOrderPlugin(Plugin):
         self.most_weight = float(self.arguments.get("mostrequested.weight", 0))
         self.balanced_weight = float(self.arguments.get(
             "balancedresource.weight", 1))
+        self.node_affinity_weight = float(self.arguments.get(
+            "nodeaffinity.weight", 1))
+        self.taint_toleration_weight = float(self.arguments.get(
+            "tainttoleration.weight", 1))
+        self.image_locality_weight = float(self.arguments.get(
+            "imagelocality.weight", 1))
 
     def on_session_open(self, ssn):
         ssn.add_node_order_fn(self.name, self._score)
 
     def _score(self, task: TaskInfo, node: NodeInfo) -> float:
+        score = self._resource_score(task, node)
+        if self.node_affinity_weight:
+            score += self.node_affinity_weight * \
+                self._node_affinity_score(task, node)
+        if self.taint_toleration_weight:
+            score += self.taint_toleration_weight * \
+                self._taint_toleration_score(task, node)
+        if self.image_locality_weight:
+            score += self.image_locality_weight * \
+                self._image_locality_score(task, node)
+        return score
+
+    def _resource_score(self, task: TaskInfo, node: NodeInfo) -> float:
         score = 0.0
         fracs = []
         for dim, alloc in node.allocatable.res.items():
@@ -46,3 +72,38 @@ class NodeOrderPlugin(Plugin):
             variance = sum((f - mean) ** 2 for f in fracs) / len(fracs)
             score += self.balanced_weight * MAX_SCORE * (1.0 - variance)
         return score
+
+    def _node_affinity_score(self, task: TaskInfo, node: NodeInfo) -> float:
+        """Preferred node-affinity terms: fraction of total term weight
+        satisfied by this node's labels (k8s NodeAffinity priority)."""
+        terms = task.pod.preferred_node_affinity
+        if not terms:
+            return 0.0
+        total = sum(max(0, t.weight) for t in terms)
+        if total <= 0:
+            return 0.0
+        got = sum(max(0, t.weight) for t in terms
+                  if t.matches(node.labels))
+        return MAX_SCORE * got / total
+
+    def _taint_toleration_score(self, task: TaskInfo, node: NodeInfo) -> float:
+        """Fewer intolerable PreferNoSchedule taints -> higher score
+        (k8s TaintToleration priority; NoSchedule taints are handled by
+        the predicates plugin as a hard filter)."""
+        prefer = [t for t in node.taints if t.effect == "PreferNoSchedule"]
+        if not prefer:
+            return MAX_SCORE
+        tols = task.pod.tolerations
+        intolerable = sum(
+            1 for taint in prefer
+            if not any(tol.tolerates(taint) for tol in tols))
+        return MAX_SCORE * (1.0 - intolerable / len(prefer))
+
+    def _image_locality_score(self, task: TaskInfo, node: NodeInfo) -> float:
+        """Fraction of the pod's container images already present on the
+        node (k8s ImageLocality priority over NodeStatus.Images)."""
+        images = {c.image for c in task.pod.containers if c.image}
+        if not images or node.node is None or not node.node.images:
+            return 0.0
+        present = images.intersection(node.node.images)
+        return MAX_SCORE * len(present) / len(images)

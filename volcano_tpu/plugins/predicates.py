@@ -97,9 +97,61 @@ SPREAD_SKEW_ANNOTATION = "spread.volcano-tpu.io/max-skew"
 class PodTopologySpreadPlugin(Plugin):
     name = "pod-topology-spread"
 
+    def __init__(self, arguments=None):
+        super().__init__(arguments)
+        # reference conf key (nodeorder.go:66); scorer prefers the
+        # least-crowded spread domain even when skew is within bounds
+        self.weight = float(self.arguments.get("podtopologyspread.weight", 1))
+
     def on_session_open(self, ssn):
         self.ssn = ssn
         ssn.add_predicate_fn(self.name, self._predicate)
+        # MUST be a batch (per-task) scorer: the score depends on the
+        # job's placements across the whole cluster, which allocate's
+        # per-spec NodeOrder cache would go stale on (the cache only
+        # invalidates the node a task landed on).  Registered only when
+        # a pending pod actually opts in, because an ungrouped batch
+        # scorer forces allocate off its heap fast path.
+        from volcano_tpu.api.types import TaskStatus
+        if self.weight and any(
+                t.pod.annotations.get(SPREAD_KEY_ANNOTATION)
+                for job in ssn.jobs.values()
+                for t in job.tasks_in_status(TaskStatus.PENDING)):
+            ssn.add_batch_node_order_fn(self.name, self._batch_score)
+
+    def _domain_counts(self, task: TaskInfo, key: str) -> dict:
+        """Job's occupying-task count per spread-domain value, computed
+        once per call (shared by predicate and scorer)."""
+        counts: dict = {}
+        for n in self.ssn.nodes.values():
+            value = n.labels.get(key)
+            if value is None:
+                continue
+            counts.setdefault(value, 0)
+            for t in n.tasks.values():
+                if t.job == task.job and t.occupies_resources():
+                    counts[value] += 1
+        return counts
+
+    def _batch_score(self, task: TaskInfo, nodes) -> dict:
+        """Prefer domains holding fewer of the job's tasks (k8s
+        PodTopologySpread ScoreExtension analogue, linear in
+        crowding).  Counts are computed once per task, not per node."""
+        key = task.pod.annotations.get(SPREAD_KEY_ANNOTATION)
+        if not key:
+            return {}
+        counts = self._domain_counts(task, key)
+        worst = max(counts.values(), default=0)
+        if worst == 0:
+            return {}
+        scores = {}
+        for node in nodes:
+            my_value = node.labels.get(key)
+            if my_value is None or my_value not in counts:
+                continue
+            scores[node.name] = self.weight * 100.0 * \
+                (worst - counts[my_value]) / worst
+        return scores
 
     def _predicate(self, task: TaskInfo, node: NodeInfo):
         key = task.pod.annotations.get(SPREAD_KEY_ANNOTATION)
@@ -116,20 +168,10 @@ class PodTopologySpreadPlugin(Plugin):
                 f"node missing spread topology key {key!r}",
                 "pod-topology-spread", resolvable=False)
 
-        # count the job's occupying tasks per topology value
-        counts: dict = {}
-        domains = set()
-        for n in self.ssn.nodes.values():
-            value = n.labels.get(key)
-            if value is None:
-                continue
-            domains.add(value)
-            for t in n.tasks.values():
-                if t.job == task.job and t.occupies_resources():
-                    counts[value] = counts.get(value, 0) + 1
-        if not domains:
+        counts = self._domain_counts(task, key)
+        if not counts:
             return None
-        global_min = min(counts.get(d, 0) for d in domains)
+        global_min = min(counts.values())
         if counts.get(my_value, 0) + 1 - global_min > max_skew:
             return unschedulable(
                 f"placing here would exceed max skew {max_skew} "

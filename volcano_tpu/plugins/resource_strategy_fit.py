@@ -8,6 +8,14 @@ Arguments:
   resources:
     google.com/tpu: {type: MostAllocated, weight: 2}
     cpu:            {type: LeastAllocated, weight: 1}
+
+Scarce-resource avoidance (sra.go:94-142): nodes carrying configured
+scarce resources score LOWER, steering pods that don't need them away
+— on TPU clusters, keeps CPU-only pods off TPU hosts so whole slices
+stay free for gangs.  Arguments:
+  sra.weight: 5
+  sra.resources: "google.com/tpu"
+  sra.resources.google.com/tpu: 1       # per-resource weight
 """
 
 from __future__ import annotations
@@ -39,11 +47,35 @@ class ResourceStrategyFitPlugin(Plugin):
                 "type": spec.get("type", "LeastAllocated"),
                 "weight": float(spec.get("weight", 1)),
             }
+        # scarce-resource avoidance (reference sra.go calculateSraWeight)
+        self.sra_weight = float(self.arguments.get("sra.weight", 0))
+        self.sra_resources = {}
+        for dim in str(self.arguments.get("sra.resources", "")).split(","):
+            dim = dim.strip()
+            if dim:
+                w = float(self.arguments.get(f"sra.resources.{dim}", 1))
+                self.sra_resources[dim] = w if w >= 0 else 1.0
 
     def on_session_open(self, ssn):
         ssn.add_node_order_fn(self.name, self._score)
 
     def _score(self, task: TaskInfo, node: NodeInfo) -> float:
+        return self._fit_score(task, node) + self._sra_score(task, node)
+
+    def _sra_score(self, task: TaskInfo, node: NodeInfo) -> float:
+        """1 - (present scarce-resource weight / total weight), scaled
+        (reference sra.go:94-142 sraScore/resourceSraScore)."""
+        if not self.sra_weight or not self.sra_resources:
+            return 0.0
+        weight_sum = sum(self.sra_resources.values())
+        if weight_sum <= 0:
+            return 0.0
+        present = sum(
+            w for dim, w in self.sra_resources.items()
+            if node.allocatable.get(dim) > MIN_RESOURCE)
+        return self.sra_weight * MAX_SCORE * (1.0 - present / weight_sum)
+
+    def _fit_score(self, task: TaskInfo, node: NodeInfo) -> float:
         total, weights = 0.0, 0.0
         for dim, req in task.resreq.res.items():
             strategy = self.strategies.get(dim)

@@ -36,6 +36,11 @@ def _parse_pairs(raw: str) -> List[Tuple[str, str]]:
 class TaskTopologyPlugin(Plugin):
     name = "task-topology"
 
+    def __init__(self, arguments=None):
+        super().__init__(arguments)
+        # reference conf key "task-topology.weight" (topology.go)
+        self.weight = float(self.arguments.get("task-topology.weight", 1))
+
     def on_session_open(self, ssn):
         self.ssn = ssn
         ssn.add_task_order_fn(self.name, self._task_order)
@@ -81,4 +86,4 @@ class TaskTopologyPlugin(Plugin):
                     score -= MAX_SCORE
             elif partner in specs_on_node:
                 score -= MAX_SCORE
-        return score
+        return self.weight * score

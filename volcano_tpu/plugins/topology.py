@@ -35,6 +35,16 @@ class NetworkTopologyAwarePlugin(Plugin):
             self.arguments.get("hypernode.binpack.weight", 1))
         self.affinity_weight = float(
             self.arguments.get("hypernode.affinity.weight", 2))
+        # hypernode binpacking for pods WITHOUT topology constraints
+        # (network_topology_aware.go:49-63,479): pack normal pods into
+        # already-busy domains so empty slices stay whole for gangs.
+        # fading^(tier-1) discounts higher tiers; fading 0 = only the
+        # leaf-slice utilization counts.
+        self.normal_pod_enable = bool(self.arguments.get(
+            "hypernode.binpack.normal-pod.enable", True))
+        fading = float(self.arguments.get(
+            "hypernode.binpack.normal-pod.fading", 0.8))
+        self.normal_pod_fading = fading if fading >= 0 else 0.8
 
     def on_session_open(self, ssn):
         self.ssn = ssn
@@ -110,10 +120,15 @@ class NetworkTopologyAwarePlugin(Plugin):
             return {}
         job = ssn.jobs.get(task.job)
         if job is None:
-            return {}
+            return self._normal_pod_binpack_scores()
         placed = [t.node_name for t in job.tasks.values()
                   if t.node_name and t.occupies_resources()]
         if not placed:
+            # first placement of a topology-free job: binpack it into
+            # busy domains; once tasks land, the affinity pull below
+            # keeps the rest of the job ICI-close to them
+            if self._is_normal_pod(job):
+                return self._normal_pod_binpack_scores()
             return {}
         max_tier = max(hns.tiers, default=1) + 1
         placed_leaves = Counter(hns.leaf_of_node(p) for p in placed)
@@ -129,6 +144,50 @@ class NetworkTopologyAwarePlugin(Plugin):
             else:
                 closeness = 1.0
             leaf_scores[node_leaf] = self.weight * MAX_SCORE * closeness
+        return leaf_scores
+
+    @staticmethod
+    def _is_normal_pod(job: JobInfo) -> bool:
+        """No topology constraint at the job or sub-job level."""
+        return (job.network_topology is None
+                and not any(sub.network_topology
+                            for sub in job.sub_jobs.values()))
+
+    def _normal_pod_binpack_scores(self) -> Dict[Optional[str], float]:
+        """Per-leaf score for topology-free pods: tier-fading-weighted
+        mean used fraction of the leaf's enclosing domains (reference
+        batchNodeOrderFnForNormalPods, network_topology_aware.go:479)."""
+        if not self.normal_pod_enable:
+            return {}
+        hns = self.ssn.hypernodes
+        if hns is None:
+            return {}
+        tiers = hns.tiers
+        if not tiers:
+            return {}
+        tier_weights = {t: self.normal_pod_fading ** (t - 1)
+                        for t in range(min(tiers), max(tiers) + 1)}
+        total_weight = sum(tier_weights.values())
+        if total_weight <= 0:
+            return {}
+        frac_cache: Dict[str, float] = {}
+        leaf_scores: Dict[Optional[str], float] = {}
+        for leaf in hns.leaves():
+            if leaf is None:
+                leaf_scores[None] = 0.0
+                continue
+            score = 0.0
+            for anc in hns.ancestors(leaf):
+                info = hns.members.get(anc)
+                if info is None or info.tier not in tier_weights:
+                    continue        # skips the virtual root
+                frac = frac_cache.get(anc)
+                if frac is None:
+                    frac = self._domain_used_fraction(info)
+                    frac_cache[anc] = frac
+                score += tier_weights[info.tier] * frac
+            leaf_scores[leaf] = \
+                self.weight * MAX_SCORE * score / total_weight
         return leaf_scores
 
     def _batch_node_order(self, task: TaskInfo,

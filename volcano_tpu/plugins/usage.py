@@ -40,6 +40,8 @@ class UsagePlugin(Plugin):
                                                   DEFAULT_CPU_THRESHOLD))
         self.mem_threshold = float(thresholds.get("mem",
                                                   DEFAULT_MEM_THRESHOLD))
+        # reference conf key "usage.weight" (usage.go)
+        self.weight = float(self.arguments.get("usage.weight", 1))
 
     def on_session_open(self, ssn):
         ssn.add_predicate_fn(self.name, self._predicate)
@@ -55,4 +57,4 @@ class UsagePlugin(Plugin):
     def _score(self, task: TaskInfo, node: NodeInfo) -> float:
         used = max(node_usage(node, CPU_USAGE_ANNOTATION),
                    node_usage(node, MEM_USAGE_ANNOTATION))
-        return MAX_SCORE * (1.0 - min(1.0, used))
+        return self.weight * MAX_SCORE * (1.0 - min(1.0, used))
