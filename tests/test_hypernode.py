@@ -65,3 +65,119 @@ def test_regex_members_and_uncovered_nodes():
     assert info.real_nodes("sl") == {"n0", "n1"}
     assert info.leaf_of_node("stray") is None
     assert "stray" in info.real_nodes(VIRTUAL_ROOT)
+
+
+# -- fabric-inventory discovery (UFM analogue, discovery/ufm/ufm.go) ---
+
+def _fabric_records():
+    return [
+        # slice-a: h0-h1-h2 chained ici links, consistent fabric name
+        {"kind": "ici", "a": "h0", "b": "h1", "fabric": "slice-a"},
+        {"kind": "ici", "a": "h1", "b": "h2", "fabric": "slice-a"},
+        # slice with conflicting fabric names -> named by smallest host
+        {"kind": "ici", "a": "h3", "b": "h4", "fabric": "x"},
+        {"kind": "ici", "a": "h4", "b": "h5", "fabric": "y"},
+        # dcn attachments: slice-a majority pod-1, other slice pod-2
+        {"kind": "dcn", "host": "h0", "pod": "pod-1"},
+        {"kind": "dcn", "host": "h1", "pod": "pod-1"},
+        {"kind": "dcn", "host": "h2", "pod": "pod-2"},
+        {"kind": "dcn", "host": "h3", "pod": "pod-2"},
+        # malformed records are skipped
+        {"kind": "ici", "a": "h9"},
+        "not-a-dict",
+    ]
+
+
+def test_fabric_discoverer_builds_components():
+    from volcano_tpu.controllers.hypernode import FabricDiscoverer
+    hns = {hn.name: hn for hn in FabricDiscoverer.build(_fabric_records())}
+    a = hns["slice-a"]
+    assert a.tier == 1
+    assert sorted(m.exact for m in a.members) == ["h0", "h1", "h2"]
+    b = hns["fabric-h3"]          # conflicting names -> smallest host
+    assert sorted(m.exact for m in b.members) == ["h3", "h4", "h5"]
+    p1, p2 = hns["pod-1"], hns["pod-2"]
+    assert p1.tier == p2.tier == 2
+    assert [m.exact for m in p1.members] == ["slice-a"]
+    assert [m.exact for m in p2.members] == ["fabric-h3"]
+
+
+def test_fabric_discoverer_live_endpoint_and_reconcile():
+    import http.server
+    import json as _json
+    import threading
+
+    class FabricAPI(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path != "/fabric/v1/links":
+                self.send_response(404); self.end_headers(); return
+            assert self.headers.get("Authorization") == "Bearer s3cret"
+            body = _json.dumps(_fabric_records()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), FabricAPI)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        from volcano_tpu.cache.fake_cluster import FakeCluster
+        from volcano_tpu.controllers.hypernode import (
+            HyperNodeController, make_discoverer,
+        )
+        disc = make_discoverer(
+            f"fabric:http://127.0.0.1:{server.server_port}#s3cret")
+        cluster = FakeCluster()
+        ctrl = HyperNodeController(discoverer=disc)
+        ctrl.initialize(cluster)
+        ctrl.sync()
+        names = {hn.name for hn in cluster.list_all().hypernodes}
+        assert {"slice-a", "fabric-h3", "pod-1", "pod-2"} <= names
+    finally:
+        server.shutdown()
+
+
+def test_fabric_discoverer_degrades_without_gc():
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    from volcano_tpu.api.hypernode import HyperNode
+    from volcano_tpu.controllers.hypernode import (
+        FabricDiscoverer, HyperNodeController,
+    )
+    cluster = FakeCluster()
+    cluster.add_hypernode(HyperNode.of_nodes("slice-z", 1, ["h9"],
+                                             tier_name="ici-slice"))
+    # endpoint that never answers: sync must NOT GC the existing tree
+    ctrl = HyperNodeController(
+        discoverer=FabricDiscoverer("http://127.0.0.1:1", timeout_s=0.2))
+    ctrl.initialize(cluster)
+    try:
+        ctrl.sync()
+    except RuntimeError:
+        pass                       # expected: no data yet
+    assert [hn.name for hn in cluster.list_all().hypernodes] == ["slice-z"]
+
+
+def test_fabric_duplicate_names_stay_distinct():
+    from volcano_tpu.controllers.hypernode import FabricDiscoverer
+    hns = FabricDiscoverer.build([
+        {"kind": "ici", "a": "h0", "b": "h1", "fabric": "f"},
+        {"kind": "ici", "a": "h2", "b": "h3", "fabric": "f"},
+        {"kind": "dcn", "host": "h0", "pod": "f"},   # pod collides too
+    ])
+    names = [hn.name for hn in hns]
+    assert len(names) == len(set(names)), names
+    hosts = sorted(m.exact for hn in hns if hn.tier == 1
+                   for m in hn.members)
+    assert hosts == ["h0", "h1", "h2", "h3"]
+
+
+def test_make_discoverer_rejects_empty_endpoint():
+    import pytest
+    from volcano_tpu.controllers.hypernode import make_discoverer
+    with pytest.raises(ValueError):
+        make_discoverer("fabric:")
+    with pytest.raises(ValueError):
+        make_discoverer("fabric:#tok")

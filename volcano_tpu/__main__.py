@@ -77,6 +77,10 @@ def main(argv=None) -> int:
     parser.add_argument("--usage-source", default="",
                         help="agent usage backend: prometheus:URL or "
                              "es:URL (default: static zeros)")
+    parser.add_argument("--hypernode-discovery", default="label",
+                        help="topology provider: 'label' (node labels) "
+                             "or 'fabric:ENDPOINT[#TOKEN]' (fabric-"
+                             "inventory HTTP API, the UFM analogue)")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -134,8 +138,21 @@ def main(argv=None) -> int:
                           schedule_period=args.period)
     mgr = None
     if run_ctrls:
+        ctrl_overrides = {}
+        if args.hypernode_discovery != "label":
+            from volcano_tpu.controllers import hypernode as hn_mod
+            try:
+                disc = hn_mod.make_discoverer(args.hypernode_discovery)
+            except ValueError as e:
+                parser.error(str(e))
+            ctrl_overrides["hypernode"] = \
+                lambda: hn_mod.HyperNodeController(discoverer=disc)
         mgr = ControllerManager(
-            cluster, enabled=[c for c in args.controllers.split(",") if c])
+            cluster, enabled=[c for c in args.controllers.split(",") if c],
+            overrides=ctrl_overrides)
+    elif args.hypernode_discovery != "label":
+        log.warning("--hypernode-discovery has no effect without "
+                    "the controllers component")
 
     elector = None
     if args.leader_elect:

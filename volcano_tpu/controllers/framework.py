@@ -46,10 +46,16 @@ class ControllerManager:
     """Runs controllers (reference: cmd/controller-manager)."""
 
     def __init__(self, cluster: Cluster,
-                 enabled: Optional[List[str]] = None):
+                 enabled: Optional[List[str]] = None,
+                 overrides: Optional[Dict[str, Callable[[], Controller]]]
+                 = None):
+        """overrides: per-instance builder replacements (e.g. a
+        hypernode controller with a non-default discoverer) — scoped to
+        this manager, never the process-global registry."""
         self.cluster = cluster
         self.controllers: List[Controller] = []
         names = enabled if enabled is not None else list(CONTROLLERS)
+        overrides = overrides or {}
         # controller-level feature gates (pkg/features/volcano_features.go)
         from volcano_tpu import features
         gated = {"cronjob": "CronVolcanoJobSupport",
@@ -61,7 +67,7 @@ class ControllerManager:
                 log.info("controller %s disabled by feature gate %s",
                          name, gate)
                 continue
-            builder = CONTROLLERS.get(name)
+            builder = overrides.get(name) or CONTROLLERS.get(name)
             if builder is None:
                 log.warning("unknown controller %s", name)
                 continue
