@@ -13,8 +13,7 @@ remaining tasks toward the slice already hosting its placed tasks.
 
 from __future__ import annotations
 
-from collections import Counter
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from volcano_tpu.api.job_info import JobInfo, TaskInfo
 from volcano_tpu.api.node_info import NodeInfo
@@ -22,6 +21,50 @@ from volcano_tpu.api.resource import TPU
 from volcano_tpu.framework.plugins import Plugin, register_plugin
 
 MAX_SCORE = 100.0
+_MISSING = object()
+
+# numpy accelerates the per-leaf tier vectors when present, but the
+# control plane stays stdlib-only (pyproject dependencies = []): the
+# fallbacks below are plain-list equivalents of the three vector ops
+# the affinity state needs.
+try:
+    import numpy as _np
+except ImportError:  # pragma: no cover - exercised on minimal installs
+    _np = None
+
+if _np is not None:
+    def _vec_zeros(n):
+        return _np.zeros(n)
+
+    def _vec(vals):
+        return _np.array(vals, dtype=_np.float64)
+
+    def _vec_iadd(a, b):
+        a += b
+
+    def _vec_isub(a, b):
+        a -= b
+
+    def _vec_closeness(total, n_placed, max_tier):
+        return ((max_tier - total / n_placed) / (max_tier - 1)).tolist()
+else:
+    def _vec_zeros(n):
+        return [0.0] * n
+
+    def _vec(vals):
+        return [float(v) for v in vals]
+
+    def _vec_iadd(a, b):
+        for i, v in enumerate(b):
+            a[i] += v
+
+    def _vec_isub(a, b):
+        for i, v in enumerate(b):
+            a[i] -= v
+
+    def _vec_closeness(total, n_placed, max_tier):
+        return [(max_tier - t / n_placed) / (max_tier - 1)
+                for t in total]
 
 
 @register_plugin("network-topology-aware")
@@ -51,6 +94,75 @@ class NetworkTopologyAwarePlugin(Plugin):
         ssn.add_hyper_node_order_fn(self.name, self._hyper_node_order)
         ssn.add_batch_node_order_fn(self.name, self._batch_node_order)
         ssn.add_grouped_batch_node_order_fn(self.name, self._group_scores)
+        # incremental affinity state (see _job_affinity): leaf index +
+        # lazily-built per-leaf LCA-tier rows, shared by all jobs; and
+        # per-job placed-leaf totals maintained by allocate/deallocate
+        # events so a 1024-task gang doesn't rescan its placements per
+        # task (profiled: this was the dominant cycle cost at 5k hosts)
+        hns = ssn.hypernodes
+        self._leaf_names = hns.leaves() if hns is not None else []
+        self._tier_rows: Dict[Optional[str], object] = {}
+        self._jobs_aff: Dict[str, dict] = {}
+        from volcano_tpu.framework.session import EventHandler
+        ssn.add_event_handler(EventHandler(
+            allocate_fn=self._on_allocate,
+            deallocate_fn=self._on_deallocate))
+
+    # -- incremental per-job affinity state ----------------------------
+
+    def _tier_row(self, leaf):
+        """Vector of LCA tiers between *leaf* and every leaf (memoized;
+        one O(leaves) build per distinct placed leaf per session)."""
+        row = self._tier_rows.get(leaf)
+        if row is None:
+            hns = self.ssn.hypernodes
+            row = _vec([hns.lca_tier_of_leaves(other, leaf)
+                        for other in self._leaf_names])
+            self._tier_rows[leaf] = row
+        return row
+
+    def _job_affinity(self, job: JobInfo) -> dict:
+        """{'added': uid -> leaf, 'total': per-leaf tier sums} —
+        initialized by one full scan, then event-maintained."""
+        state = self._jobs_aff.get(job.uid)
+        if state is None:
+            state = {"added": {},
+                     "total": _vec_zeros(len(self._leaf_names))}
+            hns = self.ssn.hypernodes
+            for t in job.tasks.values():
+                if t.node_name and t.occupies_resources():
+                    leaf = hns.leaf_of_node(t.node_name)
+                    state["added"][t.uid] = leaf
+                    _vec_iadd(state["total"], self._tier_row(leaf))
+            self._jobs_aff[job.uid] = state
+        return state
+
+    def _on_allocate(self, event):
+        task = event.task
+        state = self._jobs_aff.get(task.job)
+        if state is None:       # not scored yet; init scan will see it
+            return
+        # pipeline() also fires this handler, but PIPELINED tasks do
+        # not occupy resources and are excluded from the placed set
+        if task.uid in state["added"] or not task.occupies_resources():
+            return
+        leaf = self.ssn.hypernodes.leaf_of_node(task.node_name)
+        state["added"][task.uid] = leaf
+        _vec_iadd(state["total"], self._tier_row(leaf))
+
+    def _on_deallocate(self, event):
+        task = event.task
+        state = self._jobs_aff.get(task.job)
+        if state is None:
+            return
+        # evict() fires this too, but a RELEASING task still occupies
+        # its node (mirrors the occupies_resources() scan semantics)
+        if task.occupies_resources():
+            return
+        leaf = state["added"].pop(task.uid, _MISSING)
+        if leaf is _MISSING:
+            return
+        _vec_isub(state["total"], self._tier_row(leaf))
 
     # -- domain scoring (for topology_alloc gradients) -----------------
 
@@ -111,9 +223,12 @@ class NetworkTopologyAwarePlugin(Plugin):
     def _group_scores(self, task: TaskInfo) -> Dict[Optional[str], float]:
         """Per-LEAF affinity pull: the score is a function of the
         node's leaf hypernode only (LCA tiers are leaf-pair facts), so
-        it is computed once per leaf — O(leaves x placed-leaves), never
-        O(nodes) — and shared by every node in that leaf.  This is the
-        grouped BatchNodeOrder form allocate's heap fast path consumes."""
+        it is computed once per leaf and shared by every node in that
+        leaf.  This is the grouped BatchNodeOrder form allocate's heap
+        fast path consumes.  The placed-leaf tier totals are maintained
+        incrementally by allocate/deallocate events (O(leaves) per
+        placement), so scoring a task is one vectorized pass over the
+        leaf totals instead of an O(placed x leaves) rescan."""
         ssn = self.ssn
         hns = ssn.hypernodes
         if hns is None:
@@ -121,9 +236,9 @@ class NetworkTopologyAwarePlugin(Plugin):
         job = ssn.jobs.get(task.job)
         if job is None:
             return self._normal_pod_binpack_scores()
-        placed = [t.node_name for t in job.tasks.values()
-                  if t.node_name and t.occupies_resources()]
-        if not placed:
+        state = self._job_affinity(job)
+        n_placed = len(state["added"])
+        if n_placed == 0:
             # first placement of a topology-free job: binpack it into
             # busy domains; once tasks land, the affinity pull below
             # keeps the rest of the job ICI-close to them
@@ -131,20 +246,13 @@ class NetworkTopologyAwarePlugin(Plugin):
                 return self._normal_pod_binpack_scores()
             return {}
         max_tier = max(hns.tiers, default=1) + 1
-        placed_leaves = Counter(hns.leaf_of_node(p) for p in placed)
-        leaf_scores: Dict[Optional[str], float] = {}
-        for node_leaf in hns.leaves():
-            total_tier = 0.0
-            for leaf, count in placed_leaves.items():
-                total_tier += count * hns.lca_tier_of_leaves(node_leaf,
-                                                             leaf)
-            mean_tier = total_tier / len(placed)
-            if max_tier > 1:
-                closeness = (max_tier - mean_tier) / (max_tier - 1)
-            else:
-                closeness = 1.0
-            leaf_scores[node_leaf] = self.weight * MAX_SCORE * closeness
-        return leaf_scores
+        if max_tier > 1:
+            closeness = _vec_closeness(state["total"], n_placed, max_tier)
+        else:
+            closeness = [1.0] * len(self._leaf_names)
+        factor = self.weight * MAX_SCORE
+        return {name: factor * c
+                for name, c in zip(self._leaf_names, closeness)}
 
     @staticmethod
     def _is_normal_pod(job: JobInfo) -> bool:
