@@ -1,6 +1,7 @@
 """Session/Statement transaction semantics (reference: statement_test.go)."""
 
 from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.resource import TPU
 from volcano_tpu.api.types import TaskStatus
 from volcano_tpu.framework.framework import open_session
 from volcano_tpu.uthelper import TestContext, gang_job
@@ -107,3 +108,85 @@ def test_event_handlers_fire_on_allocate_and_deallocate():
     stmt.allocate(t, ssn.nodes["n0"])
     stmt.discard()
     assert ("alloc", t.name) in seen and ("dealloc", t.name) in seen
+
+
+def test_metrics_families_exported_by_cycle():
+    """The reference's queue/job/session metric families
+    (metrics/queue.go, job.go, metrics.go) are emitted by one cycle."""
+    from volcano_tpu import metrics
+    from volcano_tpu.api.queue import Queue
+
+    metrics.reset()
+    pg, pods = gang_job("m", replicas=2, requests={"cpu": 1, TPU: 1})
+    ctx = TestContext(
+        nodes=[Node(name="n0", allocatable={"cpu": "8", "pods": 110,
+                                            TPU: "8"})],
+        queues=[Queue(name="default", weight=2)],
+        podgroups=[pg], pods=pods,
+        conf={"actions": "enqueue, allocate, backfill",
+              "tiers": [{"plugins": [
+                  {"name": "gang"}, {"name": "drf"},
+                  {"name": "predicates"}, {"name": "proportion"},
+                  {"name": "capacity"}, {"name": "nodeorder"}]}]})
+    ctx.run()
+    ctx.cache.flush_binds()
+    ctx.run()   # queue gauges export at session open -> 1-cycle lag
+
+    assert metrics.get_gauge("queue_share", queue="default") >= 0
+    assert metrics.get_gauge("queue_weight", queue="default") == 2
+    assert metrics.get_gauge("queue_deserved_milli_cpu",
+                             queue="default") > 0
+    assert metrics.get_gauge("queue_allocated_scalar_resources",
+                             queue="default",
+                             resource=TPU) == 2
+    # capacity families (synthetic root included)
+    assert metrics.get_gauge("queue_real_capacity_milli_cpu",
+                             queue="default") > 0
+    # session + per-task latency summaries
+    assert metrics.get_observations("open_session_duration_seconds")
+    assert metrics.get_observations("plugin_latency_seconds",
+                                    plugin="proportion", point="open")
+    assert len(metrics.get_observations(
+        "task_scheduling_latency_seconds", action="allocate")) == 2
+    # bind results
+    assert metrics.get_counter("schedule_attempts_total",
+                               result="scheduled") == 2
+    # job share exported and cleared for vanished jobs
+    assert metrics.get_gauge("job_share", job="default/m") >= 0
+
+
+def test_metrics_observation_retention_capped():
+    from volcano_tpu import metrics
+    metrics.reset()
+    for i in range(metrics.MAX_OBSERVATIONS + 100):
+        metrics.observe("cap_test", float(i))
+    assert len(metrics.get_observations("cap_test")) <= \
+        metrics.MAX_OBSERVATIONS
+    # the most recent samples survive
+    assert metrics.get_observations("cap_test")[-1] == \
+        metrics.MAX_OBSERVATIONS + 99
+
+
+def test_metrics_monotonic_and_series_cleanup():
+    from volcano_tpu import metrics
+    metrics.reset()
+    # exposition count/sum stays cumulative across window trims
+    for i in range(metrics.MAX_OBSERVATIONS + 100):
+        metrics.observe("mono_test", 1.0)
+    assert f"mono_test_count {metrics.MAX_OBSERVATIONS + 100}" \
+        in metrics.dump()
+    # per-object deletion drops gauges, counters AND summaries
+    metrics.inc("job_retry_counts", job="ns/dead")
+    metrics.set_gauge("job_share", 0.5, job="ns/dead")
+    metrics.observe("some_latency", 0.1, job="ns/dead")
+    metrics.inc("job_retry_counts", job="ns/alive")
+    metrics.delete_labeled(job="ns/dead")
+    body = metrics.dump()
+    assert "ns/dead" not in body
+    assert 'job_retry_counts{job="ns/alive"} 1.0' in body
+    # stale queues vanish on re-export
+    metrics.set_gauge("queue_share", 0.3, queue="gone")
+    metrics.clear_gauge_series("queue_share")
+    metrics.set_gauge("queue_share", 0.7, queue="kept")
+    body = metrics.dump()
+    assert 'queue="gone"' not in body and 'queue="kept"' in body

@@ -12,8 +12,10 @@ from __future__ import annotations
 
 import heapq
 import logging
+import time
 from typing import Dict, List, Optional
 
+from volcano_tpu import metrics
 from volcano_tpu.api.fit_error import FitError, FitErrors
 from volcano_tpu.api.job_info import JobInfo, TaskInfo
 from volcano_tpu.api.types import PodGroupPhase, TaskStatus
@@ -280,6 +282,7 @@ class AllocateAction(Action):
             return entry["fits"][best[1]] if best else None
 
         for task in tasks:
+            t_task = time.perf_counter()
             if task.task_spec in failed_specs:
                 # identical spec already failed everywhere this round
                 # (fit-error memoization, allocate.go TaskHasFitErrors)
@@ -338,6 +341,9 @@ class AllocateAction(Action):
                 else:
                     stmt.allocate(task, node)
                 placed += 1
+                metrics.observe("task_scheduling_latency_seconds",
+                                time.perf_counter() - t_task,
+                                action="allocate")
                 if spec_cache:
                     invalidate(node)
                 continue

@@ -193,18 +193,21 @@ class SchedulerCache:
         (reference: resyncTask queue)."""
         with self._lock:
             queue, self._bind_queue = self._bind_queue, []
+        from volcano_tpu import metrics
         bound = 0
         for ctx in queue:
             try:
                 self.cluster.bind_pod(ctx.task.namespace, ctx.task.name,
                                       ctx.node_name)
                 bound += 1
+                metrics.inc("schedule_attempts_total", result="scheduled")
             except Exception as e:  # noqa: BLE001 - record any bind failure
                 log.warning("bind failed for %s on %s: %s",
                             ctx.task.key, ctx.node_name, e)
                 self.bind_failures.append((ctx.task.key, str(e)))
                 self.cluster.record_event(
                     ctx.task.key, "FailedBinding", str(e))
+                metrics.inc("schedule_attempts_total", result="error")
         return bound
 
     def nominate(self, task: TaskInfo, node_name: str):

@@ -35,3 +35,7 @@ class GarbageCollector(Controller):
                 for pod in list(self.cluster.pods.values()):
                     if pod.owner == job.uid:
                         self.cluster.delete_pod(pod.key)
+                # drop the job's labeled metric series (job_retry_counts
+                # etc.) with the object, reference metrics/job.go delete
+                from volcano_tpu import metrics
+                metrics.delete_labeled(job=job.key)

@@ -414,6 +414,9 @@ class JobController(Controller):
                 return
             job.retry_count += 1
             job.version += 1
+            from volcano_tpu import metrics
+            metrics.inc("job_retry_counts",
+                        job=f"{job.namespace}/{job.name}")
             self._transition(job, JobPhase.RESTARTING, "policy: restart")
         elif action in (JobAction.RESTART_TASK, JobAction.RESTART_POD):
             self.cluster.delete_pod(pod.key)

@@ -3,7 +3,9 @@
 from __future__ import annotations
 
 import logging
+import time
 
+from volcano_tpu import metrics
 from volcano_tpu.conf import SchedulerConf
 from volcano_tpu.framework import job_updater
 from volcano_tpu.framework.plugins import get_plugin_builder
@@ -13,6 +15,7 @@ log = logging.getLogger(__name__)
 
 
 def open_session(cache, conf: SchedulerConf) -> Session:
+    t0 = time.perf_counter()
     snapshot = cache.snapshot()
     ssn = Session(cache, snapshot, conf)
     for tier in conf.tiers:
@@ -23,7 +26,13 @@ def open_session(cache, conf: SchedulerConf) -> Session:
                 continue
             plugin = builder(opt.arguments)
             ssn.plugins[opt.name] = plugin
+            tp = time.perf_counter()
             plugin.on_session_open(ssn)
+            metrics.observe("plugin_latency_seconds",
+                            time.perf_counter() - tp,
+                            plugin=opt.name, point="open")
+    metrics.observe("open_session_duration_seconds",
+                    time.perf_counter() - t0)
     return ssn
 
 

@@ -18,15 +18,32 @@ _observations: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], List[float]] = \
 _counters: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = \
     defaultdict(float)
 _gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+# Cumulative (count, sum) per summary series: the exposition must stay
+# monotonic even though the quantile window below is trimmed, or
+# scrapers' rate()/increase() see phantom counter resets.
+_obs_totals: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                  Tuple[int, float]] = defaultdict(lambda: (0, 0.0))
 
 
 def _key(name: str, labels: dict) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
     return name, tuple(sorted(labels.items()))
 
 
+# Per-series retention cap: summaries keep a sliding window so a
+# long-running daemon emitting per-task latencies can't grow without
+# bound (the exposition reports count/sum over the window).
+MAX_OBSERVATIONS = 16384
+
+
 def observe(name: str, value: float, **labels):
     with _lock:
-        _observations[_key(name, labels)].append(value)
+        key = _key(name, labels)
+        series = _observations[key]
+        series.append(value)
+        count, total = _obs_totals[key]
+        _obs_totals[key] = (count + 1, total + value)
+        if len(series) > MAX_OBSERVATIONS:
+            del series[:len(series) // 2]
 
 
 def inc(name: str, value: float = 1.0, **labels):
@@ -43,6 +60,40 @@ def set_gauge(name: str, value: float, **labels):
 def get_gauge(name: str, **labels) -> float:
     with _lock:
         return _gauges.get(_key(name, labels), 0.0)
+
+
+def clear_gauge_series(name: str):
+    """Drop every labeled gauge of *name* — used before re-exporting a
+    per-object family (e.g. job_share) so objects that disappeared
+    don't linger as stale series (reference metrics/job.go delete)."""
+    with _lock:
+        for key in [k for k in _gauges if k[0] == name]:
+            del _gauges[key]
+
+
+def delete_labeled(**labels):
+    """Drop every series (gauge/counter/summary) carrying ALL of the
+    given labels — the analogue of the reference's per-object metric
+    deletion when a job/queue is removed (metrics/job.go)."""
+    match = set(labels.items())
+    with _lock:
+        for store in (_gauges, _counters, _observations, _obs_totals):
+            for key in [k for k in store if match <= set(k[1])]:
+                del store[key]
+
+
+def set_resource_gauges(prefix: str, res, **labels):
+    """Export one resource vector as the reference's per-dimension
+    queue gauge triple: <prefix>_milli_cpu, <prefix>_memory_bytes, and
+    <prefix>_scalar_resources{resource=...} for every scalar dimension
+    (metrics/queue.go)."""
+    set_gauge(f"{prefix}_milli_cpu", res.milli_cpu, **labels)
+    set_gauge(f"{prefix}_memory_bytes", res.memory, **labels)
+    for dim, val in res.res.items():
+        if dim in ("cpu", "memory", "pods"):
+            continue
+        set_gauge(f"{prefix}_scalar_resources", val,
+                  resource=dim, **labels)
 
 
 def get_observations(name: str, **labels) -> List[float]:
@@ -68,6 +119,7 @@ def reset():
         _observations.clear()
         _counters.clear()
         _gauges.clear()
+        _obs_totals.clear()
 
 
 def write_exposition(handler) -> None:
@@ -121,6 +173,7 @@ def dump() -> str:
         for (name, labels), obs in sorted(_observations.items()):
             lbl = ",".join(f'{k}="{v}"' for k, v in labels)
             suffix = f"{{{lbl}}}" if lbl else ""
-            lines.append(f"{name}_count{suffix} {len(obs)}")
-            lines.append(f"{name}_sum{suffix} {sum(obs)}")
+            count, total = _obs_totals[(name, labels)]
+            lines.append(f"{name}_count{suffix} {count}")
+            lines.append(f"{name}_sum{suffix} {total}")
     return "\n".join(lines) + "\n"

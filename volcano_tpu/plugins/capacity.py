@@ -66,6 +66,7 @@ class CapacityPlugin(Plugin):
     def on_session_open(self, ssn):
         total = ssn.total_resource
         self._build_attrs(ssn, total)
+        self._export_queue_metrics()
 
         ssn.add_queue_order_fn(self.name, self._queue_order)
         ssn.add_victim_queue_order_fn(self.name, self._victim_queue_order)
@@ -167,9 +168,36 @@ class CapacityPlugin(Plugin):
                 return False
         return True
 
+    def _export_queue_metrics(self):
+        """Per-queue capacity/real-capacity/inqueue/overused gauges
+        (reference metrics/queue.go, updated by the capacity plugin).
+        Families are cleared first so deleted queues don't linger."""
+        from volcano_tpu import metrics
+        for family in ("queue_overused", "queue_real_capacity",
+                       "queue_inqueue", "queue_capacity"):
+            metrics.clear_gauge_series(family)
+            for suffix in ("_milli_cpu", "_memory_bytes",
+                           "_scalar_resources"):
+                metrics.clear_gauge_series(family + suffix)
+        for name, a in self.attrs.items():
+            metrics.set_gauge("queue_overused",
+                              1.0 if self._share_overused(a) else 0.0,
+                              queue=name)
+            pairs = [("real_capacity", a.real_capability),
+                     ("inqueue", a.inqueue)]
+            if a.capability is not None:
+                pairs.append(("capacity", a.capability))
+            for metric, res in pairs:
+                metrics.set_resource_gauges(f"queue_{metric}", res,
+                                            queue=name)
+
+    @staticmethod
+    def _share_overused(attr) -> bool:
+        return attr.share() >= 1.0 - 1e-9
+
     def _overused(self, queue: QueueInfo) -> bool:
         attr = self.attrs.get(queue.name)
-        return attr is not None and attr.share() >= 1.0 - 1e-9
+        return attr is not None and self._share_overused(attr)
 
     def _preemptive(self, queue: QueueInfo, task: TaskInfo) -> bool:
         """May this queue absorb *task* via reclaim?  Checks the

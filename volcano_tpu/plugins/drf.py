@@ -53,6 +53,15 @@ class DRFPlugin(Plugin):
                     qattr.allocated.add(attr.allocated)
         for qattr in self.queue_attrs.values():
             self._update_share(qattr)
+        # job_share gauges (reference metrics/job.go, drf-updated)
+        from volcano_tpu import metrics
+        metrics.clear_gauge_series("job_share")
+        for uid, attr in self.attrs.items():
+            job = ssn.jobs.get(uid)
+            if job is not None:
+                metrics.set_gauge("job_share", attr.share,
+                                  job=f"{job.namespace}/{job.name}"
+                                  if job.name else uid)
 
         ssn.add_job_order_fn(self.name, self._job_order)
         if self.hierarchy:

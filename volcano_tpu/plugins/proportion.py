@@ -90,6 +90,7 @@ class ProportionPlugin(Plugin):
                 attr.inqueue.add(job.min_request())
 
         self._compute_deserved(total)
+        self._export_queue_metrics()
 
         ssn.add_queue_order_fn(self.name, self._queue_order)
         ssn.add_allocatable_fn(self.name, self._allocatable)
@@ -101,6 +102,28 @@ class ProportionPlugin(Plugin):
         ssn.add_event_handler(EventHandler(
             allocate_fn=lambda e: self._on_allocate(ssn, e),
             deallocate_fn=lambda e: self._on_deallocate(ssn, e)))
+
+    def _export_queue_metrics(self):
+        """Per-queue share/weight/deserved/allocated/request gauges
+        (reference metrics/queue.go, updated by the proportion
+        plugin).  Families are cleared first so deleted queues don't
+        linger as stale series."""
+        from volcano_tpu import metrics
+        for family in ("queue_share", "queue_weight",
+                       "queue_deserved", "queue_allocated",
+                       "queue_request"):
+            metrics.clear_gauge_series(family)
+            for suffix in ("_milli_cpu", "_memory_bytes",
+                           "_scalar_resources"):
+                metrics.clear_gauge_series(family + suffix)
+        for name, a in self.attrs.items():
+            metrics.set_gauge("queue_share", a.share(), queue=name)
+            metrics.set_gauge("queue_weight", a.weight, queue=name)
+            for metric, res in (("deserved", a.deserved),
+                                ("allocated", a.allocated),
+                                ("request", a.request)):
+                metrics.set_resource_gauges(f"queue_{metric}", res,
+                                            queue=name)
 
     def _compute_deserved(self, total: Resource):
         """Per-dimension weighted max-min fair share."""
