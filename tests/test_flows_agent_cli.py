@@ -501,3 +501,68 @@ def test_mpi_admission_mutate_adds_depends_on():
                         TaskSpec(name="trainer", replicas=2)])
     mutate_job(job2)
     assert job2.tasks[0].depends_on.name == ["trainer"]
+
+
+def test_cli_get_describe_delete_verbs(tmp_path):
+    """queue get/delete + jobflow/jobtemplate get/describe/delete
+    (reference pkg/cli/{queue,jobflow,jobtemplate}/{get,describe,delete}.go)."""
+    state = str(tmp_path / "c.pkl")
+    env = dict(os.environ, PYTHONPATH=os.getcwd())
+
+    def run(*args, ok=True):
+        r = subprocess.run(
+            [sys.executable, "-m", "volcano_tpu.cli.vtpctl",
+             "--state", state, *args],
+            capture_output=True, text=True, env=env)
+        if ok:
+            assert r.returncode == 0, r.stderr
+        return r
+
+    run("init", "--slices", "sa=v5e-4")
+    run("queue", "create", "-N", "research", "--weight", "3")
+    out = run("queue", "get", "-N", "research").stdout
+    assert "weight: 3" in out and "state: Open" in out
+
+    manifest = tmp_path / "t.yaml"
+    manifest.write_text("""
+kind: Job
+metadata: {name: step}
+spec:
+  minAvailable: 1
+  tasks:
+    - name: w
+      replicas: 2
+      template:
+        spec:
+          containers:
+            - resources:
+                requests: {cpu: 1}
+""")
+    run("jobtemplate", "create", "-f", str(manifest))
+    out = run("jobtemplate", "describe", "-N", "step").stdout
+    assert "replicas: 2" in out
+    out = run("jobtemplate", "get", "-N", "step").stdout
+    assert "step" in out
+
+    run("jobflow", "create", "-N", "fl", "--flows", "step")
+    out = run("jobflow", "describe", "-N", "fl").stdout
+    assert "name: step" in out and "state: pending" in out
+    # tick lets the jobflow controller deploy the dependency-free step;
+    # describe must report it deployed (keys are "<ns>/<flow>-<step>")
+    run("tick", "--cycles", "2")
+    out = run("jobflow", "describe", "-N", "fl").stdout
+    assert "state: deployed" in out
+    assert "fl" in run("jobflow", "get", "-N", "fl").stdout
+
+    # queue with podgroups refuses delete without --force
+    run("job", "run", "-N", "j1", "--replicas", "1", "--cpu", "1",
+        "--queue", "research")
+    r = run("queue", "delete", "-N", "research", ok=False)
+    assert r.returncode != 0 and "podgroup" in r.stderr
+    run("queue", "delete", "-N", "research", "--force")
+    assert "research" not in run("queue", "list").stdout
+
+    run("jobflow", "delete", "-N", "fl")
+    assert "fl" not in run("jobflow", "list").stdout
+    run("jobtemplate", "delete", "-N", "step")
+    assert "step" not in run("jobtemplate", "list").stdout

@@ -196,6 +196,77 @@ def cmd_jobflow_list(cluster, args):
     print(_table(rows, ["NAMESPACE", "NAME", "PHASE", "DEPLOYED"]))
 
 
+def _find_flow(cluster, args):
+    flow = getattr(cluster, "jobflows", {}).get(
+        f"{args.namespace}/{args.name}")
+    if flow is None:
+        sys.exit(f"jobflow {args.namespace}/{args.name} not found")
+    return flow
+
+
+def cmd_jobflow_get(cluster, args):
+    flow = _find_flow(cluster, args)
+    print(_table([[flow.namespace, flow.name, flow.phase.value,
+                   f"{len(flow.deployed_jobs)}/{len(flow.flows)}"]],
+                 ["NAMESPACE", "NAME", "PHASE", "DEPLOYED"]))
+
+
+def cmd_jobflow_describe(cluster, args):
+    """Full flow detail (reference cli/jobflow/describe.go YAML dump)."""
+    flow = _find_flow(cluster, args)
+    print(f"name: {flow.name}")
+    print(f"namespace: {flow.namespace}")
+    print(f"phase: {flow.phase.value}")
+    print("flows:")
+    for f in flow.flows:
+        deps = "+".join(f.depends_on.targets) if f.depends_on and \
+            f.depends_on.targets else "-"
+        # deployed_jobs holds job keys "<ns>/<flow>-<step>"
+        job_key = f"{flow.namespace}/{flow.job_name(f.name)}"
+        state = "deployed" if job_key in flow.deployed_jobs \
+            else "pending"
+        print(f"  - name: {f.name}\n    dependsOn: {deps}\n"
+              f"    state: {state}")
+
+
+def cmd_jobflow_delete(cluster, args):
+    _find_flow(cluster, args)
+    cluster.delete_object("jobflow", f"{args.namespace}/{args.name}")
+    print(f"jobflow {args.namespace}/{args.name} deleted")
+
+
+def cmd_jobtemplate_get(cluster, args):
+    tmpl = getattr(cluster, "jobtemplates", {}).get(
+        f"{args.namespace}/{args.name}")
+    if tmpl is None:
+        sys.exit(f"jobtemplate {args.namespace}/{args.name} not found")
+    tasks = tmpl.job.tasks if tmpl.job else []
+    print(_table([[tmpl.namespace, tmpl.name,
+                   ",".join(t.name for t in tasks)]],
+                 ["NAMESPACE", "NAME", "TASKS"]))
+
+
+def cmd_jobtemplate_describe(cluster, args):
+    tmpl = getattr(cluster, "jobtemplates", {}).get(
+        f"{args.namespace}/{args.name}")
+    if tmpl is None:
+        sys.exit(f"jobtemplate {args.namespace}/{args.name} not found")
+    print(f"name: {tmpl.name}\nnamespace: {tmpl.namespace}")
+    if tmpl.job:
+        print(f"minAvailable: {tmpl.job.min_available}")
+        print("tasks:")
+        for t in tmpl.job.tasks:
+            print(f"  - name: {t.name}\n    replicas: {t.replicas}")
+
+
+def cmd_jobtemplate_delete(cluster, args):
+    key = f"{args.namespace}/{args.name}"
+    if key not in getattr(cluster, "jobtemplates", {}):
+        sys.exit(f"jobtemplate {key} not found")
+    cluster.delete_object("jobtemplate", key)
+    print(f"jobtemplate {key} deleted")
+
+
 def cmd_queue_create(cluster, args):
     from volcano_tpu.api.resource import Resource
     queue = Queue(name=args.name, weight=args.weight, parent=args.parent)
@@ -231,6 +302,51 @@ def cmd_queue_list(cluster, args):
     rows = [[q.name, q.weight, q.state.value, q.parent or "-"]
             for q in cluster.queues.values()]
     print(_table(rows, ["NAME", "WEIGHT", "STATE", "PARENT"]))
+
+
+def cmd_queue_get(cluster, args):
+    """Detailed queue view (reference cli/queue/get.go)."""
+    q = cluster.queues.get(args.name)
+    if q is None:
+        sys.exit(f"queue {args.name} not found")
+    pgs = [pg for pg in cluster.podgroups.values()
+           if pg.queue == q.name]
+    by_phase = {}
+    for pg in pgs:
+        by_phase[pg.phase.value] = by_phase.get(pg.phase.value, 0) + 1
+    print(f"name: {q.name}")
+    print(f"weight: {q.weight}")
+    print(f"state: {q.state.value}")
+    print(f"parent: {q.parent or '-'}")
+    print(f"reclaimable: {q.reclaimable}")
+    if q.capability is not None:
+        print(f"capability: {q.capability}")
+    if q.guarantee is not None and not q.guarantee.is_empty():
+        print(f"guarantee: {q.guarantee}")
+    if pgs:
+        detail = ", ".join(f"{k}={v}"
+                           for k, v in sorted(by_phase.items()))
+        print(f"podGroups: {len(pgs)} ({detail})")
+    else:
+        print("podGroups: 0")
+
+
+def cmd_queue_delete(cluster, args):
+    """Delete a queue; refuses while podgroups still reference it
+    (reference cli/queue/delete.go requires the queue drained)."""
+    if args.name not in cluster.queues:
+        sys.exit(f"queue {args.name} not found")
+    holders = {pg.key for pg in cluster.podgroups.values()
+               if pg.queue == args.name}
+    holders |= {j.key for j in getattr(cluster, "vcjobs", {}).values()
+                if j.queue == args.name}
+    if holders and not args.force:
+        sample = sorted(holders)[0]
+        sys.exit(f"queue {args.name} still has {len(holders)} "
+                 f"podgroup(s)/job(s) (e.g. {sample}); drain it "
+                 f"or pass --force")
+    cluster.delete_object("queue", args.name)
+    print(f"queue {args.name} deleted")
 
 
 def cmd_pod_list(cluster, args):
@@ -403,6 +519,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_jobtemplate_create)
     p = jobtemplate.add_parser("list")
     p.set_defaults(fn=cmd_jobtemplate_list)
+    for verb, fn in (("get", cmd_jobtemplate_get),
+                     ("describe", cmd_jobtemplate_describe),
+                     ("delete", cmd_jobtemplate_delete)):
+        p = jobtemplate.add_parser(verb)
+        p.add_argument("-N", "--name", required=True)
+        p.add_argument("-n", "--namespace", default="default")
+        p.set_defaults(fn=fn)
 
     jobflow = sub.add_parser("jobflow",
                              help="jobflow operations").add_subparsers(
@@ -415,6 +538,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=cmd_jobflow_create)
     p = jobflow.add_parser("list")
     p.set_defaults(fn=cmd_jobflow_list)
+    for verb, fn in (("get", cmd_jobflow_get),
+                     ("describe", cmd_jobflow_describe),
+                     ("delete", cmd_jobflow_delete)):
+        p = jobflow.add_parser(verb)
+        p.add_argument("-N", "--name", required=True)
+        p.add_argument("-n", "--namespace", default="default")
+        p.set_defaults(fn=fn)
 
     queue = sub.add_parser("queue", help="queue operations").add_subparsers(
         dest="queue_cmd", required=True)
@@ -431,6 +561,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-N", "--name", required=True)
     p.add_argument("--action", choices=["open", "close"], required=True)
     p.set_defaults(fn=cmd_queue_operate)
+    p = queue.add_parser("get", help="detailed queue view")
+    p.add_argument("-N", "--name", required=True)
+    p.set_defaults(fn=cmd_queue_get)
+    p = queue.add_parser("delete")
+    p.add_argument("-N", "--name", required=True)
+    p.add_argument("--force", action="store_true",
+                   help="delete even with podgroups still enqueued")
+    p.set_defaults(fn=cmd_queue_delete)
 
     pod = sub.add_parser("pod", help="pod operations").add_subparsers(
         dest="pod_cmd", required=True)

@@ -37,8 +37,12 @@ def open_session(cache, conf: SchedulerConf) -> Session:
 
 
 def close_session(ssn: Session) -> None:
-    for plugin in reversed(list(ssn.plugins.values())):
+    for name, plugin in reversed(list(ssn.plugins.items())):
+        tp = time.perf_counter()
         plugin.on_session_close(ssn)
+        metrics.observe("plugin_latency_seconds",
+                        time.perf_counter() - tp,
+                        plugin=name, point="close")
     job_updater.update_job_statuses(ssn)
     job_updater.remove_admission_gates(ssn)
     ssn.cache.flush_binds()

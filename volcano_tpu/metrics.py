@@ -82,18 +82,33 @@ def delete_labeled(**labels):
                 del store[key]
 
 
-def set_resource_gauges(prefix: str, res, **labels):
-    """Export one resource vector as the reference's per-dimension
-    queue gauge triple: <prefix>_milli_cpu, <prefix>_memory_bytes, and
-    <prefix>_scalar_resources{resource=...} for every scalar dimension
-    (metrics/queue.go)."""
-    set_gauge(f"{prefix}_milli_cpu", res.milli_cpu, **labels)
-    set_gauge(f"{prefix}_memory_bytes", res.memory, **labels)
+def swap_gauge_families(families, rows):
+    """Atomically replace whole gauge families: under ONE lock, drop
+    every existing series whose metric name is in *families* (one scan
+    of the registry), then install *rows* ([(name, labels-dict, value)]).
+    A concurrent /metrics scrape sees either the old or the new export,
+    never a half-cleared family."""
+    families = set(families)
+    with _lock:
+        for key in [k for k in _gauges if k[0] in families]:
+            del _gauges[key]
+        for name, labels, value in rows:
+            _gauges[_key(name, labels)] = value
+
+
+def resource_gauge_rows(prefix: str, res, **labels):
+    """Rows for one resource vector in the reference's per-dimension
+    queue gauge shape: <prefix>_milli_cpu, <prefix>_memory_bytes, and
+    <prefix>_scalar_resources{resource=...} per scalar dimension
+    (metrics/queue.go).  Feed to swap_gauge_families."""
+    rows = [(f"{prefix}_milli_cpu", dict(labels), res.milli_cpu),
+            (f"{prefix}_memory_bytes", dict(labels), res.memory)]
     for dim, val in res.res.items():
         if dim in ("cpu", "memory", "pods"):
             continue
-        set_gauge(f"{prefix}_scalar_resources", val,
-                  resource=dim, **labels)
+        rows.append((f"{prefix}_scalar_resources",
+                     dict(labels, resource=dim), val))
+    return rows
 
 
 def get_observations(name: str, **labels) -> List[float]:

@@ -171,25 +171,26 @@ class CapacityPlugin(Plugin):
     def _export_queue_metrics(self):
         """Per-queue capacity/real-capacity/inqueue/overused gauges
         (reference metrics/queue.go, updated by the capacity plugin).
-        Families are cleared first so deleted queues don't linger."""
+        Whole families are swapped atomically — see proportion's
+        exporter for the rationale."""
         from volcano_tpu import metrics
-        for family in ("queue_overused", "queue_real_capacity",
-                       "queue_inqueue", "queue_capacity"):
-            metrics.clear_gauge_series(family)
+        families = {"queue_overused"}
+        for metric in ("real_capacity", "inqueue", "capacity"):
             for suffix in ("_milli_cpu", "_memory_bytes",
                            "_scalar_resources"):
-                metrics.clear_gauge_series(family + suffix)
+                families.add(f"queue_{metric}{suffix}")
+        rows = []
         for name, a in self.attrs.items():
-            metrics.set_gauge("queue_overused",
-                              1.0 if self._share_overused(a) else 0.0,
-                              queue=name)
+            rows.append(("queue_overused", {"queue": name},
+                         1.0 if self._share_overused(a) else 0.0))
             pairs = [("real_capacity", a.real_capability),
                      ("inqueue", a.inqueue)]
             if a.capability is not None:
                 pairs.append(("capacity", a.capability))
             for metric, res in pairs:
-                metrics.set_resource_gauges(f"queue_{metric}", res,
-                                            queue=name)
+                rows.extend(metrics.resource_gauge_rows(
+                    f"queue_{metric}", res, queue=name))
+        metrics.swap_gauge_families(families, rows)
 
     @staticmethod
     def _share_overused(attr) -> bool:

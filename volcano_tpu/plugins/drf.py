@@ -53,15 +53,18 @@ class DRFPlugin(Plugin):
                     qattr.allocated.add(attr.allocated)
         for qattr in self.queue_attrs.values():
             self._update_share(qattr)
-        # job_share gauges (reference metrics/job.go, drf-updated)
+        # job_share gauges (reference metrics/job.go, drf-updated);
+        # swapped atomically so vanished jobs drop without a scrape
+        # ever seeing a half-cleared family
         from volcano_tpu import metrics
-        metrics.clear_gauge_series("job_share")
+        rows = []
         for uid, attr in self.attrs.items():
             job = ssn.jobs.get(uid)
             if job is not None:
-                metrics.set_gauge("job_share", attr.share,
-                                  job=f"{job.namespace}/{job.name}"
-                                  if job.name else uid)
+                rows.append(("job_share",
+                             {"job": f"{job.namespace}/{job.name}"
+                              if job.name else uid}, attr.share))
+        metrics.swap_gauge_families({"job_share"}, rows)
 
         ssn.add_job_order_fn(self.name, self._job_order)
         if self.hierarchy:

@@ -106,24 +106,25 @@ class ProportionPlugin(Plugin):
     def _export_queue_metrics(self):
         """Per-queue share/weight/deserved/allocated/request gauges
         (reference metrics/queue.go, updated by the proportion
-        plugin).  Families are cleared first so deleted queues don't
-        linger as stale series."""
+        plugin).  Whole families are swapped atomically so deleted
+        queues don't linger and a concurrent scrape never sees a
+        half-cleared family."""
         from volcano_tpu import metrics
-        for family in ("queue_share", "queue_weight",
-                       "queue_deserved", "queue_allocated",
-                       "queue_request"):
-            metrics.clear_gauge_series(family)
+        families = {"queue_share", "queue_weight"}
+        rows = []
+        for metric in ("deserved", "allocated", "request"):
             for suffix in ("_milli_cpu", "_memory_bytes",
                            "_scalar_resources"):
-                metrics.clear_gauge_series(family + suffix)
+                families.add(f"queue_{metric}{suffix}")
         for name, a in self.attrs.items():
-            metrics.set_gauge("queue_share", a.share(), queue=name)
-            metrics.set_gauge("queue_weight", a.weight, queue=name)
+            rows.append(("queue_share", {"queue": name}, a.share()))
+            rows.append(("queue_weight", {"queue": name}, a.weight))
             for metric, res in (("deserved", a.deserved),
                                 ("allocated", a.allocated),
                                 ("request", a.request)):
-                metrics.set_resource_gauges(f"queue_{metric}", res,
-                                            queue=name)
+                rows.extend(metrics.resource_gauge_rows(
+                    f"queue_{metric}", res, queue=name))
+        metrics.swap_gauge_families(families, rows)
 
     def _compute_deserved(self, total: Resource):
         """Per-dimension weighted max-min fair share."""
