@@ -405,7 +405,9 @@ def _train_child():
     from volcano_tpu.workloads import train
 
     dev = jax.devices()[0]
-    b, t = 8, 2048
+    import os
+    b = int(os.environ.get("BENCH_TRAIN_BATCH", "8"))
+    t = 2048
     cfg = model_lib.ModelConfig(
         vocab_size=32000, d_model=1024, n_layers=8, n_heads=8,
         d_ff=4096, max_seq=t, dtype=jnp.bfloat16,

@@ -141,8 +141,12 @@ def param_shardings(params, mesh: Mesh):
 # -- forward ----------------------------------------------------------
 
 def _rms_norm(x, scale, eps=1e-6):
+    # normalize in f32, but cast back LAST: multiplying the bf16 result
+    # by the f32 scale param would silently upcast the residual stream
+    # (and every downstream matmul) to f32 — ~4x off MXU peak
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
-    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
+    return ((x * jax.lax.rsqrt(var + eps))
+            * scale.astype(jnp.float32)).astype(x.dtype)
 
 
 def _rotary(x, positions):
@@ -225,7 +229,10 @@ def forward_with_aux(params, tokens, cfg: ModelConfig,
         aux_total = aux_total + aux
 
     x = _rms_norm(x, params["final_norm"])
-    logits = (x @ params["head"].astype(cfg.dtype)).astype(jnp.float32)
+    # logits stay in model dtype; consumers upcast inside fused
+    # reductions (next_token_loss) so the [b,t,V] tensor is never
+    # stored at f32 width
+    logits = x @ params["head"].astype(cfg.dtype)
     return logits, aux_total
 
 
@@ -237,10 +244,18 @@ def forward(params, tokens, cfg: ModelConfig,
 
 def next_token_loss(logits, tokens) -> jnp.ndarray:
     """Shared next-token CE: logits [b, t, V], tokens [b, t] -> scalar.
-    The last position predicts the rolled-around token and is masked."""
+    The last position predicts the rolled-around token and is masked.
+
+    Written as logsumexp - picked (not materialized log_softmax): the
+    [b, t, V] log-probability tensor never hits HBM — the f32 upcast
+    fuses into the reduction, so bf16 logits stay bf16-sized on the
+    fwd AND the softmax-minus-onehot bwd."""
     targets = jnp.roll(tokens, -1, axis=1)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    lse = jax.scipy.special.logsumexp(
+        logits.astype(jnp.float32), axis=-1)                 # [b, t]
+    picked = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0].astype(jnp.float32)
+    nll = lse - picked
     mask = jnp.ones_like(nll).at[:, -1].set(0.0)
     return jnp.sum(nll * mask) / jnp.sum(mask)
 
