@@ -566,3 +566,29 @@ spec:
     assert "fl" not in run("jobflow", "list").stdout
     run("jobtemplate", "delete", "-N", "step")
     assert "step" not in run("jobtemplate", "list").stdout
+
+
+def test_jobflow_delete_reaps_jobs_with_delete_retain_policy():
+    """Deleting a flow whose job_retain_policy is 'delete' reaps the
+    stamped jobs/podgroups/pods (ownerReference-GC analogue); 'retain'
+    (the default) leaves them running."""
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+
+    def build(retain):
+        cluster = FakeCluster()
+        cluster.put_object("jobtemplate", template("step"))
+        flow = JobFlow(name="fl", flows=[Flow(name="step")],
+                       job_retain_policy=retain)
+        cluster.put_object("jobflow", flow)
+        mgr = ControllerManager(cluster, enabled=["job", "jobflow"])
+        mgr.sync_all()
+        assert "default/fl-step" in cluster.vcjobs
+        cluster.delete_object("jobflow", "default/fl")
+        mgr.stop()
+        return cluster
+
+    reaped = build("delete")
+    assert "default/fl-step" not in reaped.vcjobs
+    assert "default/fl-step" not in reaped.podgroups
+    retained = build("retain")
+    assert "default/fl-step" in retained.vcjobs

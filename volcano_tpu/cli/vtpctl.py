@@ -183,7 +183,8 @@ def cmd_jobflow_create(cluster, args):
                 targets=deps.split("+"))))
         else:
             flows.append(Flow(name=spec))
-    flow = JobFlow(name=args.name, namespace=args.namespace, flows=flows)
+    flow = JobFlow(name=args.name, namespace=args.namespace, flows=flows,
+                   job_retain_policy=args.retain_policy)
     cluster.put_object("jobflow", flow)
     print(f"jobflow {flow.key} created ({len(flows)} steps)")
 
@@ -230,16 +231,28 @@ def cmd_jobflow_describe(cluster, args):
 
 
 def cmd_jobflow_delete(cluster, args):
-    _find_flow(cluster, args)
-    cluster.delete_object("jobflow", f"{args.namespace}/{args.name}")
-    print(f"jobflow {args.namespace}/{args.name} deleted")
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    from volcano_tpu.controllers.jobflow import reap_deleted_flow
+    flow = _find_flow(cluster, args)
+    cluster.delete_object("jobflow", flow.key)
+    if isinstance(cluster, FakeCluster):
+        # no controller process is watching a pickled cluster; apply
+        # the retain policy inline (wire mode leaves it to the
+        # controller's jobflow_deleted watch handler)
+        reap_deleted_flow(cluster, flow)
+    print(f"jobflow {flow.key} deleted")
 
 
-def cmd_jobtemplate_get(cluster, args):
+def _find_tmpl(cluster, args):
     tmpl = getattr(cluster, "jobtemplates", {}).get(
         f"{args.namespace}/{args.name}")
     if tmpl is None:
         sys.exit(f"jobtemplate {args.namespace}/{args.name} not found")
+    return tmpl
+
+
+def cmd_jobtemplate_get(cluster, args):
+    tmpl = _find_tmpl(cluster, args)
     tasks = tmpl.job.tasks if tmpl.job else []
     print(_table([[tmpl.namespace, tmpl.name,
                    ",".join(t.name for t in tasks)]],
@@ -247,10 +260,7 @@ def cmd_jobtemplate_get(cluster, args):
 
 
 def cmd_jobtemplate_describe(cluster, args):
-    tmpl = getattr(cluster, "jobtemplates", {}).get(
-        f"{args.namespace}/{args.name}")
-    if tmpl is None:
-        sys.exit(f"jobtemplate {args.namespace}/{args.name} not found")
+    tmpl = _find_tmpl(cluster, args)
     print(f"name: {tmpl.name}\nnamespace: {tmpl.namespace}")
     if tmpl.job:
         print(f"minAvailable: {tmpl.job.min_available}")
@@ -260,11 +270,9 @@ def cmd_jobtemplate_describe(cluster, args):
 
 
 def cmd_jobtemplate_delete(cluster, args):
-    key = f"{args.namespace}/{args.name}"
-    if key not in getattr(cluster, "jobtemplates", {}):
-        sys.exit(f"jobtemplate {key} not found")
-    cluster.delete_object("jobtemplate", key)
-    print(f"jobtemplate {key} deleted")
+    tmpl = _find_tmpl(cluster, args)
+    cluster.delete_object("jobtemplate", tmpl.key)
+    print(f"jobtemplate {tmpl.key} deleted")
 
 
 def cmd_queue_create(cluster, args):
@@ -535,6 +543,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-n", "--namespace", default="default")
     p.add_argument("--flows", nargs="+", required=True,
                    help='steps as "template" or "template:dep1+dep2"')
+    p.add_argument("--retain-policy", choices=["retain", "delete"],
+                   default="retain",
+                   help="what happens to stamped jobs when the flow "
+                        "succeeds or is deleted (jobRetainPolicy)")
     p.set_defaults(fn=cmd_jobflow_create)
     p = jobflow.add_parser("list")
     p.set_defaults(fn=cmd_jobflow_list)

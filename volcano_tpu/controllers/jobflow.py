@@ -71,6 +71,15 @@ class JobFlowController(Controller):
             except Exception:  # noqa: BLE001
                 log.exception("jobflow %s sync failed", flow.key)
 
+    def on_event(self, kind: str, obj) -> None:
+        # flow deleted with retain policy "delete": reap the jobs it
+        # stamped out (reference: ownerReference GC on flow deletion);
+        # "retain" leaves them running, matching the reference default
+        if kind != "jobflow_deleted":
+            return
+        flow = obj.get("obj") if isinstance(obj, dict) else obj
+        reap_deleted_flow(self.cluster, flow)
+
     # -- reconcile ----------------------------------------------------
 
     def sync_flow(self, flow: JobFlow) -> None:
@@ -155,3 +164,25 @@ class JobFlowController(Controller):
         flow.deployed_jobs.append(job.key)
         log.info("jobflow %s deployed %s", flow.key, job.key)
         return True
+
+
+def reap_deleted_flow(cluster, flow) -> None:
+    """Delete the jobs a flow stamped out, per its retain policy.
+    Called from the controller's watch handler (wire mode) and from
+    the CLI directly for in-memory clusters, where no controller
+    process is alive to see the deletion event."""
+    if flow is None or getattr(flow, "job_retain_policy",
+                               "retain") != "delete":
+        return
+    for step in flow.flows:
+        key = f"{flow.namespace}/{flow.job_name(step.name)}"
+        job = cluster.vcjobs.get(key)
+        if job is None:
+            continue
+        log.info("jobflow %s deleted: reaping stamped job %s",
+                 flow.key, key)
+        cluster.delete_vcjob(key)
+        cluster.delete_podgroup(key)
+        for pod in list(cluster.pods.values()):
+            if pod.owner == job.uid:
+                cluster.delete_pod(pod.key)
