@@ -231,3 +231,55 @@ def test_server_metrics_endpoint(wire):
         resp.read()
     with urllib.request.urlopen(f"{wire.url}/healthz") as resp:
         assert resp.status == 200
+
+
+def test_audit_trail_and_latency_exporter(wire):
+    """The state server's /audit trail + AuditExporter derive pod
+    scheduling latency the way the reference's audit exporter derives
+    it from apiserver audit logs (exporter/metrics.go:32-38) — no
+    scheduler cooperation needed."""
+    import time as _time
+
+    from volcano_tpu import metrics
+    from volcano_tpu.api.node_info import Node
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.vcjob import VCJob
+    from volcano_tpu.server.audit_exporter import AuditExporter
+    from volcano_tpu.api.types import JobPhase
+
+    metrics.reset()
+    exp = AuditExporter(wire.url)
+    exp.poll()        # first poll enables server-side collection
+    c = wire.client()
+    c.add_node(Node(name="n0", allocatable={"cpu": "8", "pods": 110}))
+    c.add_pod(make_pod("p0", requests={"cpu": 1}))
+    _time.sleep(0.05)
+    c.bind_pod("default", "p0", "n0")
+
+    from volcano_tpu.api.vcjob import TaskSpec
+    from volcano_tpu.api.pod import Container, Pod
+    job = VCJob(name="j0", tasks=[TaskSpec(
+        name="w", replicas=1,
+        template=Pod(name="t", containers=[Container(requests={"cpu": 1})]))])
+    c.put_object("vcjob", job)
+    job.phase = JobPhase.COMPLETED
+    c.put_object("vcjob", job, key=job.key)
+
+    assert exp.poll() > 0
+    lats = exp.pod_latencies()
+    assert "default/p0" in lats and lats["default/p0"] >= 0.04
+    assert exp.quantile(0.5) == lats["default/p0"]
+    assert metrics.get_observations("pod_scheduling_latency_seconds")
+    assert exp.job_completion_latencies().get("default/j0", -1) >= 0
+    # incremental: nothing new -> no records refolded
+    assert exp.poll() == 0
+    assert exp.lost_records is False
+    # deletion resets the episode: a recreated same-key pod re-measures
+    c.delete_pod("default/p0")
+    _time.sleep(0.02)
+    c.add_pod(make_pod("p0", requests={"cpu": 1}))
+    _time.sleep(0.03)
+    c.bind_pod("default", "p0", "n0")
+    exp.poll()
+    lats2 = exp.pod_latencies()
+    assert 0 < lats2["default/p0"] < lats["default/p0"], (lats, lats2)

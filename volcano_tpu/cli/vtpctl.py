@@ -237,9 +237,10 @@ def cmd_jobflow_delete(cluster, args):
     cluster.delete_object("jobflow", flow.key)
     if isinstance(cluster, FakeCluster):
         # no controller process is watching a pickled cluster; apply
-        # the retain policy inline (wire mode leaves it to the
-        # controller's jobflow_deleted watch handler)
-        reap_deleted_flow(cluster, flow)
+        # the retain policy inline, including the job controller's
+        # full delete path (wire mode leaves both to the watching
+        # controller processes)
+        reap_deleted_flow(cluster, flow, run_job_cleanup=True)
     print(f"jobflow {flow.key} deleted")
 
 

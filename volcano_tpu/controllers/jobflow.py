@@ -166,14 +166,21 @@ class JobFlowController(Controller):
         return True
 
 
-def reap_deleted_flow(cluster, flow) -> None:
+def reap_deleted_flow(cluster, flow, run_job_cleanup: bool = False) -> None:
     """Delete the jobs a flow stamped out, per its retain policy.
-    Called from the controller's watch handler (wire mode) and from
-    the CLI directly for in-memory clusters, where no controller
-    process is alive to see the deletion event."""
+    Called from the controller's watch handler (wire mode, where
+    delete_vcjob's vcjob_deleted event routes pod/podgroup/plugin
+    cleanup through JobController._on_job_delete) and from the CLI
+    directly for in-memory clusters with run_job_cleanup=True, where
+    no controller process is alive to see the event."""
     if flow is None or getattr(flow, "job_retain_policy",
                                "retain") != "delete":
         return
+    job_ctrl = None
+    if run_job_cleanup:
+        from volcano_tpu.controllers.job.controller import JobController
+        job_ctrl = JobController()
+        job_ctrl.initialize(cluster)
     for step in flow.flows:
         key = f"{flow.namespace}/{flow.job_name(step.name)}"
         job = cluster.vcjobs.get(key)
@@ -182,7 +189,7 @@ def reap_deleted_flow(cluster, flow) -> None:
         log.info("jobflow %s deleted: reaping stamped job %s",
                  flow.key, key)
         cluster.delete_vcjob(key)
-        cluster.delete_podgroup(key)
-        for pod in list(cluster.pods.values()):
-            if pod.owner == job.uid:
-                cluster.delete_pod(pod.key)
+        if job_ctrl is not None:
+            # full delete path: plugin on_job_delete hooks + pods +
+            # podgroup (controllers/job/controller.py _on_job_delete)
+            job_ctrl.on_event("vcjob_deleted", job)

@@ -41,6 +41,7 @@ from volcano_tpu.cache.kinds import KINDS
 log = logging.getLogger(__name__)
 
 EVENT_RING = 100_000     # events kept for watchers before forcing resync
+AUDIT_RING = 200_000     # audit records kept for the latency exporter
 
 
 class Lease:
@@ -71,6 +72,15 @@ class StateServer:
         self._events: collections.deque = collections.deque(maxlen=EVENT_RING)
         self._rv = 0
         self._leases: Dict[str, Lease] = {}
+        # audit trail: wall-clock-stamped mutation records, the
+        # apiserver-audit-log analogue the latency exporter scrapes
+        # (reference third_party/kube-apiserver-audit-exporter derives
+        # pods/binding latency from audit timestamps).  Lazily enabled
+        # by the first GET /audit so deployments that never poll pay
+        # nothing on the mutation hot path.
+        self._audit: collections.deque = collections.deque(maxlen=AUDIT_RING)
+        self._audit_idx = 0
+        self._audit_enabled = False
         cluster.watch(self._on_store_event)
 
     # -- event log -----------------------------------------------------
@@ -84,7 +94,41 @@ class StateServer:
         with self._event_cv:
             self._rv += 1
             self._events.append((self._rv, kind, payload))
+            if self._audit_enabled:
+                self._audit_idx += 1
+                self._audit.append(self._audit_record(
+                    self._audit_idx, kind, obj))
             self._event_cv.notify_all()
+
+    @staticmethod
+    def _audit_record(idx: int, kind: str, obj) -> dict:
+        rec = {"i": idx, "ts": time.time(), "kind": kind,
+               "key": getattr(obj, "key", None) or
+               (obj.get("key") if isinstance(obj, dict) else None)}
+        # the two signals the latency exporter needs: pod binding
+        # (node set) and job completion (phase terminal)
+        node = getattr(obj, "node_name", None)
+        if node is not None:
+            rec["node"] = node
+        phase = getattr(obj, "phase", None)
+        if phase is not None:
+            rec["phase"] = getattr(phase, "value", str(phase))
+        return rec
+
+    def audit_since(self, since: int) -> Tuple[int, List[dict], bool]:
+        """(idx, records with index > since, lost) — no long-poll, the
+        exporter batches.  The first call enables collection.  lost is
+        True when the client's position fell off the ring (records were
+        evicted unseen) — like events_since's resync signal."""
+        with self._event_cv:
+            self._audit_enabled = True
+            if not self._audit:
+                return self._audit_idx, [], False
+            first = self._audit[0]["i"]
+            lost = since < first - 1
+            start = max(0, since - first + 1)
+            return self._audit_idx, list(
+                itertools.islice(self._audit, start, None)), lost
 
     def events_since(self, since: int, timeout: float = 25.0):
         """(rv, events, resync) — blocks up to timeout for news."""
@@ -195,6 +239,12 @@ class _Handler(BaseHTTPRequestHandler):
                 "rv": rv, "resync": resync, "epoch": st.epoch,
                 "events": [{"rv": r, "kind": k, "obj": o}
                            for r, k, o in events]})
+        if url.path == "/audit":
+            q = parse_qs(url.query)
+            since = int(q.get("since", ["0"])[0])
+            idx, records, lost = st.audit_since(since)
+            return self._json(200, {"idx": idx, "records": records,
+                                    "lost": lost})
         return self._json(404, {"error": f"no route {url.path}"})
 
     # -- POST ----------------------------------------------------------
