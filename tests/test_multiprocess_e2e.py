@@ -296,3 +296,61 @@ def test_full_topology_with_agent_processes(plane):
             raise AssertionError(plane.dump_logs())
     finally:
         kubectl.close()
+
+
+def test_external_webhook_manager_process(plane):
+    """Admission as its OWN process (vc-webhook-manager analogue): the
+    state server calls out to the webhook manager for every create;
+    vetoes reject over the wire, mutations flow back, and
+    failurePolicy=Fail rejects writes while the webhook is down."""
+    webhook_port = free_port()
+    webhook_url = f"http://127.0.0.1:{webhook_port}"
+    # order: server first (webhook mirrors it), but server must not
+    # receive creates until the webhook is up
+    plane.spawn("server", "-m", "volcano_tpu.server",
+                "--port", str(plane.port), "--tick-period", "0.1",
+                "--webhook-url", webhook_url)
+    wait_for(plane._server_up, 15, "server /healthz")
+    plane.spawn("webhook", "-m", "volcano_tpu.webhooks.server",
+                "--port", str(webhook_port),
+                "--cluster-url", plane.url)
+
+    def webhook_up():
+        try:
+            with urllib.request.urlopen(webhook_url + "/healthz",
+                                        timeout=1):
+                return True
+        except OSError:
+            return False
+    wait_for(webhook_up, 15, "webhook /healthz")
+
+    from volcano_tpu.api.vcjob import TaskSpec, VCJob
+    from volcano_tpu.api.pod import Container, Pod
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.webhooks.admission import AdmissionError
+
+    c = RemoteCluster(plane.url)
+    try:
+        # invalid job: vetoed BY THE EXTERNAL PROCESS
+        with pytest.raises(AdmissionError):
+            c.add_vcjob(VCJob(name="bad"))       # no tasks
+
+        # valid job: mutated by the external process (queue defaulted)
+        job = VCJob(name="ok", tasks=[TaskSpec(
+            name="w", replicas=1,
+            template=Pod(name="t",
+                         containers=[Container(requests={"cpu": 1})]))])
+        job.queue = ""
+        c.add_vcjob(job)
+        wait_for(lambda: "default/ok" in c.vcjobs, 10, "job mirrored")
+        assert c.vcjobs["default/ok"].queue == "default"
+
+        # webhook killed + failurePolicy=Fail -> creates are rejected
+        plane.kill("webhook")
+        with pytest.raises(AdmissionError, match="unreachable"):
+            c.add_vcjob(VCJob(name="later", tasks=[TaskSpec(
+                name="w", replicas=1,
+                template=Pod(name="t", containers=[
+                    Container(requests={"cpu": 1})]))]))
+    finally:
+        c.close()

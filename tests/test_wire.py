@@ -282,4 +282,8 @@ def test_audit_trail_and_latency_exporter(wire):
     c.bind_pod("default", "p0", "n0")
     exp.poll()
     lats2 = exp.pod_latencies()
-    assert 0 < lats2["default/p0"] < lats["default/p0"], (lats, lats2)
+    # without delete handling the exporter would keep the first
+    # episode's measurement frozen (bind already recorded); a fresh
+    # episode yields a new value covering at least its 30ms sleep
+    assert lats2["default/p0"] != lats["default/p0"], (lats, lats2)
+    assert lats2["default/p0"] >= 0.02, lats2

@@ -193,3 +193,12 @@ def reap_deleted_flow(cluster, flow, run_job_cleanup: bool = False) -> None:
             # full delete path: plugin on_job_delete hooks + pods +
             # podgroup (controllers/job/controller.py _on_job_delete)
             job_ctrl.on_event("vcjob_deleted", job)
+        else:
+            # a co-resident JobController reacts to vcjob_deleted with
+            # the same (idempotent) cleanup; do it inline too so pods
+            # and podgroups never leak when the job controller is
+            # disabled or feature-gated off
+            cluster.delete_podgroup(key)
+            for pod in list(cluster.pods.values()):
+                if pod.owner == job.uid:
+                    cluster.delete_pod(pod.key)

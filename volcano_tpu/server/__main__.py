@@ -23,6 +23,12 @@ def main(argv=None) -> int:
     parser.add_argument("--tick-period", type=float, default=0.0,
                         help="self-tick the simulated kubelet every N "
                              "seconds (0 = external /tick only)")
+    parser.add_argument("--webhook-url", default="",
+                        help="external webhook-manager to call for "
+                             "admission instead of the embedded chain "
+                             "(vc-webhook-manager analogue)")
+    parser.add_argument("--webhook-failure-policy",
+                        choices=["Fail", "Ignore"], default="Fail")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -43,6 +49,25 @@ def main(argv=None) -> int:
             cluster.admission = default_admission()
         log.info("loaded state from %s (%d nodes, %d pods)",
                  args.state, len(cluster.nodes), len(cluster.pods))
+
+    from volcano_tpu.webhooks.server import RemoteAdmission
+    if args.webhook_url:
+        if cluster is None:
+            cluster = FakeCluster()
+        cluster.admission = RemoteAdmission(
+            args.webhook_url,
+            failure_policy=args.webhook_failure_policy)
+        log.info("admission delegated to webhook manager at %s "
+                 "(failurePolicy=%s)", args.webhook_url,
+                 args.webhook_failure_policy)
+    elif cluster is not None and \
+            isinstance(cluster.admission, RemoteAdmission):
+        # a RemoteAdmission pickled into the state file must not
+        # outlive the flag: restarting without --webhook-url means
+        # embedded admission, not a (likely dead) webhook endpoint
+        log.info("state file carried a webhook admission proxy; "
+                 "reverting to the embedded chain (no --webhook-url)")
+        cluster.admission = default_admission()
 
     httpd, state = serve(port=args.port, cluster=cluster,
                          tick_period=args.tick_period)

@@ -50,27 +50,36 @@ class AuditExporter:
     # -- collection ----------------------------------------------------
 
     def poll(self) -> int:
-        """Fetch and fold new audit records; returns how many.  The
-        server enables audit collection on the first poll, so start
-        the exporter BEFORE the workload you want measured."""
-        url = f"{self.base_url}/audit?since={self._since}"
-        try:
-            with urllib.request.urlopen(url,
-                                        timeout=self.timeout) as resp:
-                payload = json.load(resp)
-        except Exception as e:  # noqa: BLE001 - exporter must not die
-            log.warning("audit poll of %s failed: %s", url, e)
-            return 0
-        if payload.get("lost"):
-            self.lost_records = True
-            log.warning("audit ring wrapped between polls: some "
-                        "records were lost; latencies may undercount")
-        records = payload.get("records", [])
-        for rec in records:
-            self._fold(rec)
-        self._since = payload.get("idx", self._since)
+        """Fetch and fold new audit records (paging until drained);
+        returns how many.  The server enables audit collection on the
+        first poll, so start the exporter BEFORE the workload you want
+        measured."""
+        total = 0
+        while True:
+            url = f"{self.base_url}/audit?since={self._since}"
+            try:
+                with urllib.request.urlopen(url,
+                                            timeout=self.timeout) as resp:
+                    payload = json.load(resp)
+            except Exception as e:  # noqa: BLE001 - exporter must not die
+                log.warning("audit poll of %s failed: %s", url, e)
+                break
+            if payload.get("lost"):
+                self.lost_records = True
+                log.warning("audit ring wrapped between polls: some "
+                            "records were lost; latencies may "
+                            "undercount")
+            records = payload.get("records", [])
+            for rec in records:
+                self._fold(rec)
+            total += len(records)
+            new_since = payload.get("idx", self._since)
+            if not records or new_since <= self._since:
+                self._since = new_since
+                break
+            self._since = new_since
         self._trim()
-        return len(records)
+        return total
 
     def _fold(self, rec: dict) -> None:
         kind, key, ts = rec.get("kind"), rec.get("key"), rec.get("ts")

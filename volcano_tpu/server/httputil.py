@@ -1,0 +1,35 @@
+"""Shared HTTP plumbing for the control-plane servers (state server,
+webhook manager): JSON responses with broken-pipe tolerance and the
+bound-handler + threaded-server bootstrap."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import ThreadingHTTPServer
+
+
+def json_response(handler, code: int, payload) -> None:
+    """Write a JSON response; a client that went away mid-response
+    (killed scheduler, cancelled watch) is routine, not an error."""
+    body = json.dumps(payload, separators=(",", ":")).encode()
+    try:
+        handler.send_response(code)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)
+    except (BrokenPipeError, ConnectionResetError):
+        handler.close_connection = True
+
+
+def serve_threaded(handler_base: type, attrs: dict, port: int,
+                   name: str) -> ThreadingHTTPServer:
+    """Bind per-server state onto a handler subclass and serve it on
+    127.0.0.1:port (0 = ephemeral) from a daemon thread."""
+    handler = type("BoundHandler", (handler_base,), attrs)
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, name=name,
+                     daemon=True).start()
+    return httpd

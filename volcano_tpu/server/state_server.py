@@ -115,11 +115,15 @@ class StateServer:
             rec["phase"] = getattr(phase, "value", str(phase))
         return rec
 
-    def audit_since(self, since: int) -> Tuple[int, List[dict], bool]:
+    def audit_since(self, since: int,
+                    limit: int = 10_000) -> Tuple[int, List[dict], bool]:
         """(idx, records with index > since, lost) — no long-poll, the
-        exporter batches.  The first call enables collection.  lost is
-        True when the client's position fell off the ring (records were
-        evicted unseen) — like events_since's resync signal."""
+        exporter pages with `since` until a short batch comes back.
+        The first call enables collection.  lost is True when the
+        client's position fell off the ring (records were evicted
+        unseen) — like events_since's resync signal.  limit bounds the
+        copy made under the store lock so a lagging exporter can't
+        stall mutations for a 200k-record copy."""
         with self._event_cv:
             self._audit_enabled = True
             if not self._audit:
@@ -127,8 +131,10 @@ class StateServer:
             first = self._audit[0]["i"]
             lost = since < first - 1
             start = max(0, since - first + 1)
-            return self._audit_idx, list(
-                itertools.islice(self._audit, start, None)), lost
+            records = list(itertools.islice(
+                self._audit, start, start + max(1, limit)))
+            idx = records[-1]["i"] if records else self._audit_idx
+            return idx, records, lost
 
     def events_since(self, since: int, timeout: float = 25.0):
         """(rv, events, resync) — blocks up to timeout for news."""
@@ -193,17 +199,8 @@ class _Handler(BaseHTTPRequestHandler):
         log.debug("http: " + fmt, *args)
 
     def _json(self, code: int, payload) -> None:
-        body = json.dumps(payload, separators=(",", ":")).encode()
-        try:
-            self.send_response(code)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-        except (BrokenPipeError, ConnectionResetError):
-            # client went away mid-response (killed scheduler, watch
-            # cancel) — routine during failover tests, not an error
-            self.close_connection = True
+        from volcano_tpu.server.httputil import json_response
+        json_response(self, code, payload)
 
     def _body(self) -> dict:
         length = int(self.headers.get("Content-Length") or 0)
@@ -340,13 +337,10 @@ def serve(port: int = 0, cluster: Optional[FakeCluster] = None,
     """Start the server on 127.0.0.1:port (0 = ephemeral); returns
     (http_server, state).  Caller runs http_server.serve_forever()
     or uses the background thread started here."""
+    from volcano_tpu.server.httputil import serve_threaded
     state = StateServer(cluster)
-    handler = type("BoundHandler", (_Handler,), {"state": state})
-    httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
-    httpd.daemon_threads = True
-    thread = threading.Thread(target=httpd.serve_forever,
-                              name="state-server", daemon=True)
-    thread.start()
+    httpd = serve_threaded(_Handler, {"state": state}, port,
+                           "state-server")
     state.tick_stop = threading.Event()
     if tick_period > 0:
         def tick_loop():

@@ -1,0 +1,168 @@
+"""Standalone webhook-manager process — the vc-webhook-manager binary.
+
+Reference parity: cmd/webhook-manager + pkg/webhooks/router
+(admission.go:35).  In the reference, admission runs as its OWN
+deployment: the apiserver calls out to it over HTTPS for every create.
+Here the state server does the same when started with --webhook-url:
+instead of running the embedded chain, it POSTs the object to this
+process's /admit route and stores whatever comes back (mutations
+included), rejecting on a webhook veto.
+
+The webhook process holds its own read-only LIST+WATCH mirror of the
+state server (a RemoteCluster), the analogue of the reference
+webhooks' informer-backed listers — cross-object checks (queue
+exists/open, hierarchy cycles) read the mirror, never call back into
+the serving request.
+
+Run:  volcano-tpu-webhook --cluster-url http://HOST:PORT --port 7443
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler
+
+from volcano_tpu.api import codec
+from volcano_tpu.server.httputil import json_response, serve_threaded
+from volcano_tpu.webhooks.admission import AdmissionError, default_admission
+
+log = logging.getLogger(__name__)
+
+ADMIT_METHODS = frozenset({
+    "admit_job", "admit_job_update", "admit_queue", "admit_podgroup",
+    "admit_hypernode", "admit_pod", "admit_jobflow", "admit_cronjob",
+})
+
+
+class WebhookServer:
+    """Admission chain + a read-only cluster mirror for cross-object
+    validation."""
+
+    def __init__(self, cluster=None):
+        self.chain = default_admission()
+        self.cluster = cluster          # RemoteCluster mirror or None
+
+    def admit(self, method: str, obj):
+        if method not in ADMIT_METHODS:
+            raise AdmissionError(f"unknown admission method {method!r}")
+        return getattr(self.chain, method)(obj, self.cluster)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "volcano-tpu-webhook"
+    protocol_version = "HTTP/1.1"
+    hooks: WebhookServer = None          # injected by serve_webhooks()
+
+    def _json(self, code: int, payload: dict):
+        json_response(self, code, payload)
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/healthz":
+            return self._json(200, {"ok": True})
+        return self._json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):  # noqa: N802
+        if self.path != "/admit":
+            return self._json(404, {"error": f"no route {self.path}"})
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            payload = json.loads(self.rfile.read(length))
+            obj = codec.decode(payload["obj"])
+            out = self.hooks.admit(payload["method"], obj)
+            return self._json(200, {"ok": True,
+                                    "obj": codec.encode(out)})
+        except AdmissionError as e:
+            return self._json(200, {"ok": False, "error": str(e)})
+        except Exception as e:  # noqa: BLE001 - malformed request
+            log.exception("webhook request failed")
+            return self._json(400, {"ok": False, "error": str(e)})
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+def serve_webhooks(port: int = 0, cluster=None):
+    """Start the webhook HTTP server (daemon thread); returns httpd."""
+    return serve_threaded(_Handler, {"hooks": WebhookServer(cluster)},
+                          port, "webhook-server")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="volcano-tpu-webhook")
+    parser.add_argument("--port", type=int, default=7443)
+    parser.add_argument("--cluster-url", default="",
+                        help="state server to mirror for cross-object "
+                             "validation (informer-lister analogue)")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    cluster = None
+    if args.cluster_url:
+        from volcano_tpu.cache.remote_cluster import RemoteCluster
+        cluster = RemoteCluster(args.cluster_url)
+    httpd = serve_webhooks(args.port, cluster)
+    log.info("webhook manager listening on :%d",
+             httpd.server_address[1])
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        if cluster is not None:
+            cluster.close()
+    return 0
+
+
+class RemoteAdmission:
+    """Admission proxy the STATE SERVER uses when --webhook-url is set:
+    every create/update POSTs to the external webhook manager, exactly
+    like the apiserver calling a registered webhook.
+
+    failure_policy: "Fail" rejects when the webhook is unreachable
+    (the reference default), "Ignore" admits unvalidated.
+    """
+
+    def __init__(self, url: str, timeout: float = 5.0,
+                 failure_policy: str = "Fail"):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+        self.failure_policy = failure_policy
+
+    def _call(self, method: str, obj, cluster=None):
+        import urllib.request
+        del cluster   # the webhook process uses its own mirror
+        body = json.dumps({"method": method,
+                           "obj": codec.encode(obj)}).encode()
+        req = urllib.request.Request(
+            self.url + "/admit", data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                payload = json.loads(resp.read())
+        except Exception as e:  # noqa: BLE001 - webhook down/unreachable
+            if self.failure_policy == "Ignore":
+                log.warning("webhook %s unreachable (%s); admitting "
+                            "per failurePolicy=Ignore", self.url, e)
+                return obj
+            raise AdmissionError(
+                f"admission webhook unreachable: {e}") from None
+        if not payload.get("ok"):
+            raise AdmissionError(payload.get("error", "webhook denied"))
+        return codec.decode(payload["obj"])
+
+    def __getattr__(self, name: str):
+        if name in ADMIT_METHODS:
+            return lambda obj, cluster=None: self._call(name, obj,
+                                                        cluster)
+        raise AttributeError(name)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
