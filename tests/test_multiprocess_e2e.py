@@ -20,7 +20,8 @@ import urllib.request
 import pytest
 
 from volcano_tpu.api.resource import TPU
-from volcano_tpu.api.types import NetworkTopologyMode, TaskStatus
+from volcano_tpu.api.types import (JobPhase, NetworkTopologyMode,
+                                   RUN_TICKS_ANNOTATION, TaskStatus)
 from volcano_tpu.api.podgroup import NetworkTopologySpec
 from volcano_tpu.api.vcjob import TaskSpec, VCJob
 from volcano_tpu.api.pod import make_pod
@@ -44,6 +45,13 @@ def wait_for(cond, timeout=30.0, msg="condition"):
             return
         time.sleep(0.05)
     raise AssertionError(f"timed out waiting for {msg}")
+
+
+def job_phase_histogram(cluster):
+    hist = {}
+    for j in cluster.vcjobs.values():
+        hist[j.phase.value] = hist.get(j.phase.value, 0) + 1
+    return hist
 
 
 class Plane:
@@ -126,15 +134,23 @@ def plane(tmp_path):
         p.shutdown()
 
 
-def tpu_job(name: str) -> VCJob:
-    """4-host whole-slice gang, hard ICI locality (tier 1)."""
+def tpu_job(name: str, run_ticks: int = 0) -> VCJob:
+    """4-host whole-slice gang, hard ICI locality (tier 1).
+
+    run_ticks > 0 gives the workers a finite workload (the e2e-stress
+    busybox-sleep analogue) so the job can actually COMPLETE; the
+    default runs forever, which the crash-recovery/failover tests rely
+    on (their slices must stay occupied)."""
+    annotations = {RUN_TICKS_ANNOTATION: str(run_ticks)} if run_ticks \
+        else None
     return VCJob(
         name=name, min_available=4,
         network_topology=NetworkTopologySpec(
             NetworkTopologyMode.HARD, highest_tier_allowed=1),
         tasks=[TaskSpec(
             name="worker", replicas=4,
-            template=make_pod("t", requests={"cpu": 8, TPU: 4}))],
+            template=make_pod("t", requests={"cpu": 8, TPU: 4},
+                              annotations=annotations))],
         plugins={"jax": [], "svc": []},
     )
 
@@ -376,15 +392,20 @@ def test_wire_churn_stress(plane):
 
         N = 24
         for i in range(N):
-            kubectl.add_vcjob(tpu_job(f"churn-{i}"))
+            kubectl.add_vcjob(tpu_job(f"churn-{i}", run_ticks=3))
 
         def all_done():
             jobs = kubectl.vcjobs
             return sum(1 for j in jobs.values()
                        if j.name.startswith("churn-")
                        and j.phase is JobPhase.COMPLETED) == N
-        wait_for(all_done, 120, f"{N} churn jobs completed"
-                 f" (phases: %s)" % {})
+
+        try:
+            wait_for(all_done, 120, f"{N} churn jobs completed")
+        except AssertionError:
+            raise AssertionError(
+                f"churn stall, phases: {job_phase_histogram(kubectl)}\n"
+                + plane.dump_logs())
 
         # ground truth from the audit trail: every pod measured, and
         # no node ever held more chips than it has
@@ -396,5 +417,57 @@ def test_wire_churn_stress(plane):
         assert not exp.lost_records
         comp = exp.job_completion_latencies()
         assert sum(1 for k in comp if "churn-" in k) == N
+    finally:
+        kubectl.close()
+
+
+def test_wire_churn_100_jobs(plane):
+    """100-job churn over the wire: small 2-worker cpu gangs whose
+    aggregate demand exceeds the slice, so completion waves must free
+    capacity for later jobs.  Every job completes; the audit trail has
+    a completion record per job and never loses a bind."""
+    from volcano_tpu.server.audit_exporter import AuditExporter
+
+    plane.start_server(tick=0.05)
+    exp = AuditExporter(plane.url)
+    exp.poll()
+    kubectl = RemoteCluster(plane.url)
+    try:
+        for node in slice_nodes(slice_for("sa", "v5e-16"),
+                                dcn_pod="dcn-0"):
+            kubectl.add_node(node)
+        plane.start_controllers()
+        plane.start_scheduler()
+
+        N = 100
+        for i in range(N):
+            kubectl.add_vcjob(VCJob(
+                name=f"wave-{i}", min_available=2,
+                tasks=[TaskSpec(
+                    name="w", replicas=2,
+                    template=make_pod(
+                        "t", requests={"cpu": 16},
+                        annotations={RUN_TICKS_ANNOTATION: "2"}))],
+            ))
+
+        def done_count():
+            return sum(1 for j in kubectl.vcjobs.values()
+                       if j.name.startswith("wave-")
+                       and j.phase is JobPhase.COMPLETED)
+        try:
+            wait_for(lambda: done_count() == N, 180,
+                     f"{N} wave jobs completed")
+        except AssertionError:
+            raise AssertionError(
+                f"stalled at {done_count()}/{N}, "
+                f"phases: {job_phase_histogram(kubectl)}\n"
+                + plane.dump_logs())
+
+        exp.poll()
+        assert not exp.lost_records
+        lats = exp.pod_latencies()
+        assert sum(1 for k in lats if "wave-" in k) >= 2 * N
+        comp = exp.job_completion_latencies()
+        assert sum(1 for k in comp if "wave-" in k) == N
     finally:
         kubectl.close()

@@ -137,6 +137,12 @@ class NetworkTopologyMode(enum.Enum):
 GROUP_NAME_ANNOTATION = "scheduling.volcano-tpu.io/group-name"
 QUEUE_NAME_ANNOTATION = "scheduling.volcano-tpu.io/queue-name"
 PREEMPTABLE_ANNOTATION = "volcano-tpu.io/preemptable"
+# Simulated workload duration: a RUNNING pod carrying this annotation
+# succeeds after N kubelet-sim ticks — the stand-in for a batch
+# container that exits (the reference e2e stress jobs run busybox
+# `sleep N`; a pod with no terminating workload never completes, in
+# real Kubernetes too).  Absent = runs until evicted/deleted.
+RUN_TICKS_ANNOTATION = "volcano-tpu.io/run-ticks"
 REVOCABLE_ZONE_ANNOTATION = "volcano-tpu.io/revocable-zone"
 JOB_NAME_LABEL = "volcano-tpu.io/job-name"
 JOB_NAMESPACE_LABEL = "volcano-tpu.io/job-namespace"

@@ -20,7 +20,8 @@ from volcano_tpu.api.node_info import Node
 from volcano_tpu.api.pod import Pod
 from volcano_tpu.api.podgroup import PodGroup
 from volcano_tpu.api.queue import Queue
-from volcano_tpu.api.types import DEFAULT_QUEUE, TaskStatus
+from volcano_tpu.api.types import (DEFAULT_QUEUE, RUN_TICKS_ANNOTATION,
+                                   TaskStatus)
 from volcano_tpu.cache.cluster import Cluster, ClusterSnapshot, PriorityClass
 
 
@@ -48,6 +49,7 @@ class FakeCluster(Cluster):
         self.pvs: Dict[str, dict] = {}            # volumebinding volumes
         self.datasources: Dict[str, dict] = {}    # datadependency/v1alpha1
         self.events: List[Tuple[str, str, str]] = []
+        self._run_progress: Dict[str, int] = {}   # pod uid -> ticks run
         self.binds: List[Tuple[str, str]] = []    # (pod key, node) history
         self.evictions: List[str] = []
         # admission chain applied on vcjob/queue create (webhooks)
@@ -70,6 +72,7 @@ class FakeCluster(Cluster):
         # stores added after old state files were written
         from volcano_tpu.cache.kinds import KINDS
         self.__dict__.setdefault("commands", [])
+        self.__dict__.setdefault("_run_progress", {})
         for spec in KINDS.values():
             self.__dict__.setdefault(spec.attr, {})
 
@@ -96,6 +99,8 @@ class FakeCluster(Cluster):
     def delete_pod(self, key: str):
         with self._lock:
             pod = self.pods.pop(key, None)
+            if pod:
+                self._run_progress.pop(pod.uid, None)
         if pod:
             self._notify("pod_deleted", pod)
 
@@ -295,17 +300,43 @@ class FakeCluster(Cluster):
 
     def tick(self):
         """Advance simulated pod lifecycle one step:
-        Bound -> Running; Releasing -> deleted."""
+        Bound -> Running; Releasing -> deleted; and a RUNNING pod whose
+        spec declares a finite workload (RUN_TICKS_ANNOTATION) succeeds
+        once it has run that many ticks — the kubelet running a batch
+        container to completion (reference: kubelet drives the pod
+        phase, the job controller reacts,
+        job_controller.go:415-542)."""
         with self._lock:
             to_delete = []
             started = []
+            completed = []
+            progress = self._run_progress
             for key, pod in self.pods.items():
                 if pod.phase is TaskStatus.BOUND:
                     pod.phase = TaskStatus.RUNNING
                     started.append(pod)
+                elif pod.phase is TaskStatus.RUNNING:
+                    spec = pod.annotations.get(RUN_TICKS_ANNOTATION)
+                    if spec is None:
+                        continue
+                    try:
+                        run_ticks = int(spec)
+                    except ValueError:
+                        continue            # malformed: run forever
+                    ran = progress.get(pod.uid, 0) + 1
+                    if ran >= run_ticks:
+                        pod.phase = TaskStatus.SUCCEEDED
+                        pod.exit_code = 0
+                        progress.pop(pod.uid, None)
+                        completed.append(pod)
+                    else:
+                        progress[pod.uid] = ran
                 elif pod.phase is TaskStatus.RELEASING:
+                    progress.pop(pod.uid, None)
                     to_delete.append(key)
         for pod in started:
+            self._notify("pod", pod)
+        for pod in completed:
             self._notify("pod", pod)
         for key in to_delete:
             self.delete_pod(key)
