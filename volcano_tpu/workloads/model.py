@@ -211,11 +211,36 @@ def _block(x, blk, cfg: ModelConfig, positions, mesh):
     return x + _mlp(h, blk), jnp.float32(0.0)
 
 
+def _constrain_residual(x, mesh: Optional[Mesh]):
+    """Anchor the residual stream [b, t, d] to batch-over-(dp,fsdp),
+    seq-over-sp, d_model unsharded (Megatron-style: only the qkv/ff
+    intermediates shard over tp).  Without this anchor GSPMD mixes the
+    embed table's tp sharding into the stream, and the remat backward
+    adds gradient contributions under DIFFERENT shardings — an
+    involuntary full rematerialization per block (MULTICHIP_r02)."""
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(("dp", "fsdp"), "sp", None)))
+
+
 def forward_with_aux(params, tokens, cfg: ModelConfig,
                      mesh: Optional[Mesh] = None):
     """tokens [b, t] -> (logits [b, t, vocab], moe aux loss scalar)."""
     b, t = tokens.shape
-    x = params["embed"].astype(cfg.dtype)[tokens]
+    table = params["embed"].astype(cfg.dtype)
+    if mesh is not None:
+        # replicate the table for the lookup: gathering from the
+        # (tp,fsdp)-sharded table makes GSPMD emit a d-sharded gather
+        # it then can't reshape to the batch-sharded residual stream
+        # without a full rematerialization; the explicit all-gather is
+        # the same bytes, scheduled well
+        from jax.sharding import NamedSharding
+        table = jax.lax.with_sharding_constraint(
+            table, NamedSharding(mesh, P(None, None)))
+    x = table[tokens]
+    x = _constrain_residual(x, mesh)
     positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
 
     block_fn = _block
@@ -226,6 +251,7 @@ def forward_with_aux(params, tokens, cfg: ModelConfig,
     aux_total = jnp.float32(0.0)
     for blk in params["blocks"]:
         x, aux = block_fn(x, blk, cfg, positions, mesh)
+        x = _constrain_residual(x, mesh)
         aux_total = aux_total + aux
 
     x = _rms_norm(x, params["final_norm"])

@@ -53,17 +53,18 @@ def init_sharded(rng, cfg: ModelConfig, mesh: Mesh, optimizer):
     p_shardings = model_lib.param_shardings(abstract, mesh)
     params = jax.jit(model_lib.init_params, static_argnums=(1,),
                      out_shardings=p_shardings)(rng, cfg)
-    opt_state = jax.jit(optimizer.init)(params)
-    # optimizer scalars (step counts etc.) come out single-device;
-    # replicate them onto the mesh so every consumer — including a
-    # checkpoint restore using this state as the shape/sharding "like"
-    # — sees one consistent device assignment
-    replicated = NamedSharding(mesh, P())
-    opt_state = jax.tree.map(
-        lambda x: jax.device_put(x, replicated)
-        if isinstance(getattr(x, "sharding", None),
-                      jax.sharding.SingleDeviceSharding) else x,
-        opt_state)
+    # The optimizer state gets EXPLICIT out_shardings too: adam's mu/nu
+    # mirror the param tree (leaf paths end in the same names, so the
+    # name-keyed param spec rules apply), scalars (step counts) fall to
+    # the replicated default.  Letting XLA pick here used to produce a
+    # layer-stacked layout that disagreed with the train step's specs —
+    # an involuntary full rematerialization on every step.
+    abstract_opt = jax.eval_shape(optimizer.init, abstract)
+    opt_shardings = jax.tree.map(
+        lambda leaf, sh: NamedSharding(mesh, P()) if leaf.ndim == 0 else sh,
+        abstract_opt, model_lib.param_shardings(abstract_opt, mesh))
+    opt_state = jax.jit(optimizer.init,
+                        out_shardings=opt_shardings)(params)
     return params, opt_state, p_shardings
 
 
