@@ -18,6 +18,7 @@ candidates; soft mode prefers them (allocate.go:886-919 analogue).
 
 from __future__ import annotations
 
+import heapq
 import logging
 import threading
 import time
@@ -63,9 +64,21 @@ def register_agent_plugin(name: str):
 
 
 class AgentPlugin:
-    """Filter/score extension point for the fast path."""
+    """Filter/score extension point for the fast path.
+
+    filter_static: spec-vs-node checks that cannot change as pods bind
+    (selector/affinity/taints) — the scheduler memoizes them per
+    (pod-spec, node) between cache refreshes, the fast-path analogue of
+    the batch path's per-spec fit-error cache (actions/allocate.py:185,
+    reference predicates/cache.go).  filter: occupancy-dependent checks,
+    re-run on every placement attempt.  A plugin that can't split
+    leaves everything in filter — slower but always correct."""
 
     name = "agent-plugin"
+
+    def filter_static(self, task: TaskInfo, node: NodeInfo):
+        """None = node passes; a Status-like truthy value rejects."""
+        return None
 
     def filter(self, task: TaskInfo, node: NodeInfo):
         """None = node passes; a Status-like truthy value rejects."""
@@ -77,13 +90,17 @@ class AgentPlugin:
 
 @register_agent_plugin("predicates")
 class AgentPredicatesPlugin(AgentPlugin):
-    """Node-local batch predicates: ready, nodeSelector, affinity
-    terms, taints, pod-count capacity, host ports — the SAME static
-    verdict function the batch path runs."""
+    """Node-local batch predicates — the SAME verdict functions the
+    batch path runs, split along the memoization boundary: selector/
+    affinity/taints are static, pod-count/ports re-check every bind."""
+
+    def filter_static(self, task, node):
+        from volcano_tpu.plugins.predicates import PredicatesPlugin
+        return PredicatesPlugin._predicate_static(task, node)
 
     def filter(self, task, node):
         from volcano_tpu.plugins.predicates import PredicatesPlugin
-        return PredicatesPlugin._predicate(task, node)
+        return PredicatesPlugin._predicate_dynamic(task, node)
 
 
 @register_agent_plugin("resources")
@@ -126,6 +143,37 @@ class AgentLeastAllocPlugin(AgentPlugin):
 
 DEFAULT_AGENT_PLUGINS = ["predicates", "resources", "deviceshare",
                          "leastalloc"]
+
+SPEC_CACHE_MAX = 512     # heterogeneous-workload safety valve
+
+
+def _spec_signature(pod) -> tuple:
+    """Everything the filter/score chain reads off the POD (vs the
+    node): two pods with equal signatures get identical verdicts, so
+    static filtering + score ordering is shared across a burst
+    (reference: per-spec fit-error memoization, job_info.go
+    TaskHasFitErrors; batch analogue actions/allocate.py:185)."""
+    return (
+        tuple(sorted(pod.node_selector.items())),
+        repr(pod.affinity_node_terms),
+        tuple((t.key, t.operator, t.value, t.effect)
+              for t in pod.tolerations),
+        tuple(sorted(pod.resource_requests().res.items())),
+        tuple(sorted(port for c in pod.containers for port in c.ports)),
+    )
+
+
+class _SpecEntry:
+    """Per-spec candidate state: the statically-feasible nodes ordered
+    by a lazily-revalidated max-heap.  scores holds the authoritative
+    last-computed score per node; heap entries whose score disagrees
+    are stale duplicates and are dropped on pop."""
+
+    __slots__ = ("heap", "scores")
+
+    def __init__(self):
+        self.heap: List[Tuple[float, str]] = []     # (-score, node name)
+        self.scores: Dict[str, float] = {}
 
 
 class SchedulingQueue:
@@ -238,6 +286,8 @@ class AgentScheduler:
         self.queue = SchedulingQueue()
         self.nodes: Dict[str, NodeInfo] = {}
         self._attempts: Dict[str, int] = {}
+        self._spec_cache: Dict[tuple, _SpecEntry] = {}
+        self._shard: frozenset = frozenset()
         self._lock = threading.Lock()
         cluster.watch(self._on_event)
         self.refresh()
@@ -247,7 +297,11 @@ class AgentScheduler:
     def refresh(self):
         from volcano_tpu.cache.cache import REGISTERED_DEVICES
         snap = self.cluster.list_all()
+        shard = frozenset(shard_nodes_for(self.cluster,
+                                          self.scheduler_name))
         with self._lock:
+            self._shard = shard
+            self._spec_cache.clear()     # node set/labels may have changed
             self.nodes = {n.name: NodeInfo(n) for n in snap.nodes}
             # device enrichment: the fast path enforces the same TPU
             # shape rules as the batch path
@@ -272,7 +326,8 @@ class AgentScheduler:
                 self.scheduler_name and obj.phase is TaskStatus.PENDING \
                 and not obj.node_name:
             self.queue.push(obj)
-        elif kind in ("pod_deleted", "node", "node_deleted"):
+        elif kind in ("pod_deleted", "node", "node_deleted",
+                      "nodeshard", "nodeshard_deleted"):
             # keep the incremental cache honest: rebuild node state
             # before reconsidering parked pods (a new node must be a
             # candidate; a dead node must stop being one)
@@ -281,28 +336,66 @@ class AgentScheduler:
 
     # -- scheduling ----------------------------------------------------
 
-    def _candidate_nodes(self, task: TaskInfo) -> List[NodeInfo]:
-        shard = set(shard_nodes_for(self.cluster, self.scheduler_name))
-        nodes = list(self.nodes.values())
-        if shard and self.shard_mode == SHARD_MODE_HARD:
-            nodes = [n for n in nodes if n.name in shard]
+    def _score(self, task: TaskInfo, node: NodeInfo) -> float:
+        s = sum(p.score(task, node) for p in self.plugins)
+        if self._shard and self.shard_mode == SHARD_MODE_SOFT and \
+                node.name in self._shard:
+            s += 100.0   # strong shard preference
+        return s
 
-        feasible = []
-        for node in nodes:
-            if any(p.filter(task, node) is not None
+    def _spec_entry(self, task: TaskInfo) -> _SpecEntry:
+        sig = _spec_signature(task.pod)
+        entry = self._spec_cache.get(sig)
+        if entry is not None:
+            return entry
+        if len(self._spec_cache) >= SPEC_CACHE_MAX:
+            self._spec_cache.clear()
+        entry = _SpecEntry()
+        for node in self.nodes.values():
+            if self._shard and self.shard_mode == SHARD_MODE_HARD and \
+                    node.name not in self._shard:
+                continue
+            if any(p.filter_static(task, node) is not None
                    for p in self.plugins):
                 continue
-            feasible.append(node)
+            s = self._score(task, node)
+            entry.scores[node.name] = s
+            entry.heap.append((-s, node.name))
+        heapq.heapify(entry.heap)
+        self._spec_cache[sig] = entry
+        return entry
 
-        def score(node: NodeInfo):
-            s = sum(p.score(task, node) for p in self.plugins)
-            if shard and self.shard_mode == SHARD_MODE_SOFT and \
-                    node.name in shard:
-                s += 100.0   # strong shard preference
-            return s
-
-        feasible.sort(key=lambda n: (-score(n), n.name))
-        return feasible[: self.candidates]
+    def _candidate_nodes(self, task: TaskInfo) -> List[NodeInfo]:
+        """Top-K dynamically-feasible nodes for the task, best score
+        first.  Static filtering + ordering come from the per-spec
+        heap; entries are revalidated lazily on pop (a bind only moves
+        the bound node's score, so a burst of same-spec pods pays
+        O(K log N) each instead of O(N * plugins))."""
+        entry = self._spec_entry(task)
+        heap = entry.heap
+        result: List[NodeInfo] = []
+        repush: List[Tuple[float, str]] = []
+        while heap and len(result) < self.candidates:
+            neg, name = heapq.heappop(heap)
+            if entry.scores.get(name) != -neg:
+                continue                       # stale duplicate
+            node = self.nodes.get(name)
+            if node is None:                   # node gone since refresh
+                del entry.scores[name]
+                continue
+            s = self._score(task, node)
+            if s != -neg:                      # occupancy moved: freshen
+                entry.scores[name] = s
+                heapq.heappush(heap, (-s, name))
+                continue
+            repush.append((neg, name))
+            if any(p.filter(task, node) is not None
+                   for p in self.plugins):
+                continue                       # infeasible right now
+            result.append(node)
+        for item in repush:
+            heapq.heappush(heap, item)
+        return result
 
     def _select_candidates(self, task) -> List[Tuple[NodeInfo, int]]:
         """Top-K feasible nodes with their generation at selection time

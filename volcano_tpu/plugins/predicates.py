@@ -34,7 +34,11 @@ class PredicatesPlugin(Plugin):
         return None
 
     @staticmethod
-    def _predicate(task: TaskInfo, node: NodeInfo):
+    def _predicate_static(task: TaskInfo, node: NodeInfo):
+        """The spec-vs-node checks that cannot change as pods bind:
+        readiness, nodeSelector, nodeAffinity, taints.  The agent fast
+        path memoizes this half per (spec, node) between cache
+        refreshes (reference predicate error cache, predicates/cache.go)."""
         if not node.ready:
             return unschedulable("node is not ready", "predicates",
                                  resolvable=False)
@@ -66,6 +70,13 @@ class PredicatesPlugin(Plugin):
                 return unschedulable(
                     f"node(s) had untolerated taint {{{taint.key}: "
                     f"{taint.value}}}", "predicates", resolvable=False)
+        return None
+
+    @staticmethod
+    def _predicate_dynamic(task: TaskInfo, node: NodeInfo):
+        """The occupancy-dependent half: pod-count capacity and host
+        ports — must be re-checked every time a bind may have landed."""
+        pod = task.pod
 
         # pod-count capacity
         cap = node.capability.get(PODS)
@@ -79,8 +90,12 @@ class PredicatesPlugin(Plugin):
                 if node.occupied_ports.get(port):
                     return unschedulable(
                         "node(s) didn't have free ports", "predicates")
-
         return None
+
+    @staticmethod
+    def _predicate(task: TaskInfo, node: NodeInfo):
+        return (PredicatesPlugin._predicate_static(task, node)
+                or PredicatesPlugin._predicate_dynamic(task, node))
 
 
 # pod topology spread: pods opt in via annotations
