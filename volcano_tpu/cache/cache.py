@@ -87,6 +87,12 @@ class SchedulerCache:
         self._lock = threading.Lock()
         self._bind_queue: List[BindContext] = []
         self.bind_failures: List[Tuple[str, str]] = []   # (task key, error)
+        # cross-session scratch for plugins (rate limiters etc.), keyed
+        # by plugin name.  Plugin INSTANCES are rebuilt every session
+        # (framework.open_session), so state that must survive cycles
+        # lives here — scoped to this scheduler, never module-global
+        # (two schedulers in one process must not share a limiter).
+        self.plugin_state: Dict[str, dict] = {}
 
     # -- snapshot ------------------------------------------------------
 

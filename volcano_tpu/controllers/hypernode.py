@@ -159,6 +159,12 @@ class FabricDiscoverer:
                 host, pod = rec.get("host"), rec.get("pod")
                 if host and pod:
                     host_pod[host] = pod
+                    # a host reported only via its DCN uplink (a
+                    # single-host slice has no ici links) must still be
+                    # in the topology: seed a union-find singleton (the
+                    # reference UFM provider groups every inventoried
+                    # node, discovery/ufm/)
+                    find(host)
 
         comps: Dict[str, List[str]] = defaultdict(list)
         for host in parent:

@@ -18,8 +18,6 @@ from volcano_tpu.api.job_info import TaskInfo
 from volcano_tpu.api.resource import MIN_RESOURCE
 from volcano_tpu.framework.plugins import Plugin, register_plugin
 
-_last_run = {"ts": 0.0}
-
 
 @register_plugin("rescheduling")
 class ReschedulingPlugin(Plugin):
@@ -44,15 +42,20 @@ class ReschedulingPlugin(Plugin):
         return frac
 
     def _victims(self) -> List[TaskInfo]:
+        # interval limiter survives sessions on the cache's per-
+        # scheduler scratch (plugin instances are per-session; a module
+        # global would couple unrelated schedulers in one process)
+        state = self.ssn.cache.plugin_state.setdefault(
+            self.name, {"ts": 0.0})
         now = time.time()
-        if now - _last_run["ts"] < self.interval:
+        if now - state["ts"] < self.interval:
             return []
         nodes = [n for n in self.ssn.nodes.values() if n.ready]
         low = [n for n in nodes if self._utilization(n) < self.low]
         high = [n for n in nodes if self._utilization(n) > self.high]
         if not low or not high:
             return []
-        _last_run["ts"] = now
+        state["ts"] = now
         victims = []
         for node in high:
             for t in node.tasks.values():

@@ -37,14 +37,21 @@ MAX_TRACKED = 100_000
 
 
 class AuditExporter:
-    def __init__(self, base_url: str, timeout: float = 5.0):
+    def __init__(self, base_url: str, timeout: float = 5.0,
+                 ca_cert: str = "", insecure: bool = False):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        from volcano_tpu.server.tlsutil import client_ssl_context
+        self._ssl_ctx = client_ssl_context(ca_cert, insecure)
         self._since = 0
         self._pod_created: Dict[str, float] = {}
         self._pod_bound: Dict[str, float] = {}
         self._job_created: Dict[str, float] = {}
         self._job_done: Dict[str, float] = {}
+        # jobs first seen ALREADY terminal (exporter attached mid-run):
+        # creation ts was seeded by the same record, so a completion
+        # latency would read ~0 — excluded from observations/results
+        self._seeded_terminal: set = set()
         self.lost_records = False   # sticky: a poll fell off the ring
 
     # -- collection ----------------------------------------------------
@@ -58,8 +65,9 @@ class AuditExporter:
         while True:
             url = f"{self.base_url}/audit?since={self._since}"
             try:
-                with urllib.request.urlopen(url,
-                                            timeout=self.timeout) as resp:
+                with urllib.request.urlopen(url, timeout=self.timeout,
+                                            context=self._ssl_ctx
+                                            ) as resp:
                     payload = json.load(resp)
             except Exception as e:  # noqa: BLE001 - exporter must not die
                 log.warning("audit poll of %s failed: %s", url, e)
@@ -100,15 +108,21 @@ class AuditExporter:
             self._pod_created.pop(key, None)
             self._pod_bound.pop(key, None)
         elif kind == "vcjob":
+            first_sighting = key not in self._job_created
             self._job_created.setdefault(key, ts)
             if rec.get("phase") in TERMINAL_JOB_PHASES and \
                     key not in self._job_done:
                 self._job_done[key] = ts
-                metrics.observe("batchjob_completion_latency_seconds",
-                                ts - self._job_created[key])
+                if first_sighting:
+                    self._seeded_terminal.add(key)
+                else:
+                    metrics.observe(
+                        "batchjob_completion_latency_seconds",
+                        ts - self._job_created[key])
         elif kind == "vcjob_deleted":
             self._job_created.pop(key, None)
             self._job_done.pop(key, None)
+            self._seeded_terminal.discard(key)
 
     def _trim(self) -> None:
         for store in (self._pod_created, self._pod_bound,
@@ -125,7 +139,9 @@ class AuditExporter:
 
     def job_completion_latencies(self) -> Dict[str, float]:
         return {k: self._job_done[k] - self._job_created[k]
-                for k in self._job_done if k in self._job_created}
+                for k in self._job_done
+                if k in self._job_created
+                and k not in self._seeded_terminal}
 
     def quantile(self, q: float) -> float:
         import math
