@@ -421,6 +421,152 @@ def test_wire_churn_stress(plane):
         kubectl.close()
 
 
+def test_tls_bearer_auth_control_plane(plane, tmp_path):
+    """The secured deployment shape (reference: TLS webhook manager,
+    cmd/webhook-manager/, pkg/webhooks/config/): state server speaks
+    TLS only and requires a bearer token on writes; scheduler +
+    controllers authenticate over the wire and a job still completes
+    end-to-end.  Plaintext and bad-token clients are refused."""
+    import ssl
+
+    from volcano_tpu.server.tlsutil import generate_self_signed
+    from volcano_tpu.cache.remote_cluster import RemoteError
+
+    cert = str(tmp_path / "server.crt")
+    key = str(tmp_path / "server.key")
+    generate_self_signed(cert, key)
+    token = "test-cluster-token"
+    https_url = f"https://127.0.0.1:{plane.port}"
+
+    plane.spawn("server", "-m", "volcano_tpu.server",
+                "--port", str(plane.port), "--tick-period", "0.05",
+                "--tls-cert", cert, "--tls-key", key,
+                "--token", token)
+
+    ctx = ssl.create_default_context(cafile=cert)
+
+    def server_up():
+        try:
+            with urllib.request.urlopen(https_url + "/healthz",
+                                        timeout=1, context=ctx):
+                return True
+        except OSError:
+            return False
+    wait_for(server_up, 15, "TLS server /healthz")
+
+    kubectl = RemoteCluster(https_url, token=token, ca_cert=cert)
+    try:
+        for node in slice_nodes(slice_for("sa", "v5e-16"),
+                                dcn_pod="dcn-0"):
+            kubectl.add_node(node)
+        for name, comps in (("controllers", "controllers"),
+                            ("scheduler", "scheduler")):
+            plane.spawn(name, "-m", "volcano_tpu",
+                        "--cluster-url", https_url,
+                        "--components", comps, "--period", "0.1",
+                        "--token", token, "--ca-cert", cert)
+
+        kubectl.add_vcjob(tpu_job("secure-job", run_ticks=3))
+        try:
+            wait_for(lambda: (
+                kubectl.vcjobs.get("default/secure-job") is not None
+                and kubectl.vcjobs["default/secure-job"].phase
+                is JobPhase.COMPLETED), 60,
+                "job completed over TLS+auth wire")
+        except AssertionError:
+            raise AssertionError(
+                f"phases: {job_phase_histogram(kubectl)}\n"
+                + plane.dump_logs())
+
+        # plaintext client against the TLS port: refused at handshake
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{plane.port}/healthz", timeout=2):
+                raise AssertionError("plaintext accepted on TLS port")
+        except OSError:
+            pass
+
+        # authenticated reads, unauthenticated writes: 401
+        bad = RemoteCluster(https_url, start_watch=False,
+                            token="wrong-token", ca_cert=cert)
+        try:
+            with pytest.raises(RemoteError) as err:
+                bad.bind_pod("default", "nope", "sa-w0")
+            assert err.value.code == 401
+        finally:
+            bad.close()
+    finally:
+        kubectl.close()
+
+
+def test_tls_webhook_manager_callout(plane, tmp_path):
+    """State server -> webhook manager callout over TLS with the
+    shared cluster token: vetoes and mutations still flow, and the
+    webhook refuses a caller without the token."""
+    import ssl
+
+    from volcano_tpu.server.tlsutil import generate_self_signed
+    from volcano_tpu.webhooks.admission import AdmissionError
+    from volcano_tpu.api.pod import Container, Pod
+
+    cert = str(tmp_path / "wh.crt")
+    key = str(tmp_path / "wh.key")
+    generate_self_signed(cert, key)
+    token = "join-token"
+    webhook_port = free_port()
+    webhook_url = f"https://127.0.0.1:{webhook_port}"
+
+    plane.spawn("server", "-m", "volcano_tpu.server",
+                "--port", str(plane.port), "--tick-period", "0.1",
+                "--token", token,
+                "--webhook-url", webhook_url,
+                "--webhook-ca-cert", cert)
+    wait_for(plane._server_up, 15, "server /healthz")
+    plane.spawn("webhook", "-m", "volcano_tpu.webhooks.server",
+                "--port", str(webhook_port),
+                "--cluster-url", plane.url,
+                "--tls-cert", cert, "--tls-key", key,
+                "--token", token, "--verbose")
+
+    ctx = ssl.create_default_context(cafile=cert)
+
+    def webhook_up():
+        try:
+            with urllib.request.urlopen(webhook_url + "/healthz",
+                                        timeout=1, context=ctx):
+                return True
+        except OSError:
+            return False
+    wait_for(webhook_up, 15, "TLS webhook /healthz")
+
+    c = RemoteCluster(plane.url, token=token)
+    try:
+        # veto crosses server -> TLS webhook -> back
+        with pytest.raises(AdmissionError):
+            c.add_vcjob(VCJob(name="bad"))       # no tasks
+        # mutation flows back (queue defaulted by the webhook process)
+        job = VCJob(name="ok", tasks=[TaskSpec(
+            name="w", replicas=1,
+            template=Pod(name="t",
+                         containers=[Container(requests={"cpu": 1})]))])
+        job.queue = ""
+        c.add_vcjob(job)
+        wait_for(lambda: "default/ok" in c.vcjobs, 10, "job mirrored")
+        assert c.vcjobs["default/ok"].queue == "default"
+
+        # the webhook itself requires the token on /admit
+        req = urllib.request.Request(
+            webhook_url + "/admit", data=b"{}", method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=2, context=ctx):
+                raise AssertionError("unauthenticated /admit accepted")
+        except urllib.error.HTTPError as e:
+            assert e.code == 401, e.code
+    finally:
+        c.close()
+
+
 def test_wire_churn_100_jobs(plane):
     """100-job churn over the wire: small 2-worker cpu gangs whose
     aggregate demand exceeds the slice, so completion waves must free

@@ -46,6 +46,14 @@ def main(argv=None) -> int:
                         help="state-server URL; the process runs its "
                              "components against the wire instead of an "
                              "in-memory cluster")
+    parser.add_argument("--token", default="",
+                        help="bearer token for state-server writes")
+    parser.add_argument("--token-file", default="")
+    parser.add_argument("--ca-cert", default="",
+                        help="CA bundle to verify an https state "
+                             "server")
+    parser.add_argument("--insecure", action="store_true",
+                        help="skip state-server cert verification")
     parser.add_argument("--components", default="scheduler,controllers",
                         help="comma list: scheduler,controllers — or "
                              "'none' for an agent-only process "
@@ -77,6 +85,12 @@ def main(argv=None) -> int:
     parser.add_argument("--usage-source", default="",
                         help="agent usage backend: prometheus:URL or "
                              "es:URL (default: static zeros)")
+    parser.add_argument("--enforcer", default="none",
+                        help="node-agent OS enforcement: 'none' "
+                             "(publish only), 'record' (in-memory "
+                             "ledger), or a comma list of "
+                             "'cgroup:ROOT' and 'tc:IFACE' "
+                             "(agent/enforcer.py)")
     parser.add_argument("--hypernode-discovery", default="label",
                         help="topology provider: 'label' (node labels) "
                              "or 'fabric:ENDPOINT[#TOKEN]' (fabric-"
@@ -104,7 +118,11 @@ def main(argv=None) -> int:
     remote = bool(args.cluster_url)
     if remote:
         from volcano_tpu.cache.remote_cluster import RemoteCluster
-        cluster = RemoteCluster(args.cluster_url)
+        from volcano_tpu.server.tlsutil import load_token
+        cluster = RemoteCluster(
+            args.cluster_url,
+            token=load_token(args.token, args.token_file),
+            ca_cert=args.ca_cert, insecure=args.insecure)
     elif args.state:
         try:
             with open(args.state, "rb") as f:
@@ -202,6 +220,11 @@ def main(argv=None) -> int:
             provider = FakeUsageProvider()
             agent_kwargs = {"oversub_factor": 0.0}
         wanted = args.node_agents
+        # ONE enforcer shared by every agent in this process: per-agent
+        # TcEnforcers would hand out colliding class ids on the same
+        # interface (real deployments run one agent per host anyway)
+        from volcano_tpu.agent.enforcer import build_enforcer
+        shared_enforcer = build_enforcer(args.enforcer)
 
         def sync_node_agents():
             # refreshes happen on the background thread below: a slow
@@ -212,8 +235,9 @@ def main(argv=None) -> int:
                            if n.strip()])
             for name in names:
                 if name not in node_agents and name in cluster.nodes:
-                    node_agents[name] = NodeAgent(cluster, name, provider,
-                                                  **agent_kwargs)
+                    node_agents[name] = NodeAgent(
+                        cluster, name, provider,
+                        enforcer=shared_enforcer, **agent_kwargs)
             for agent in node_agents.values():
                 agent.sync()
     else:

@@ -39,9 +39,13 @@ class RemoteError(RuntimeError):
 
 class RemoteCluster(Cluster):
     def __init__(self, base_url: str, start_watch: bool = True,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, token: str = "",
+                 ca_cert: str = "", insecure: bool = False):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.token = token
+        from volcano_tpu.server.tlsutil import client_ssl_context
+        self._ssl_ctx = client_ssl_context(ca_cert, insecure)
         self._mlock = threading.RLock()        # mirror + watchers
         self._watchers: List[Callable[[str, object], None]] = []
         self._rv = 0
@@ -66,12 +70,16 @@ class RemoteCluster(Cluster):
         data = None
         if payload is not None:
             data = json.dumps(payload, separators=(",", ":")).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(
             self.base_url + path, data=data, method=method,
-            headers={"Content-Type": "application/json"})
+            headers=headers)
         try:
             with urllib.request.urlopen(
-                    req, timeout=timeout or self.timeout) as resp:
+                    req, timeout=timeout or self.timeout,
+                    context=self._ssl_ctx) as resp:
                 return json.loads(resp.read())
         except urllib.error.HTTPError as e:
             try:

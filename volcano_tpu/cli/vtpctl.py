@@ -484,6 +484,13 @@ def build_parser() -> argparse.ArgumentParser:
                         help="state-server URL (kubectl mode: talk to "
                              "the live control plane instead of a "
                              "state file)")
+    parser.add_argument("--token", default="",
+                        help="bearer token for state-server writes")
+    parser.add_argument("--token-file", default="")
+    parser.add_argument("--ca-cert", default="",
+                        help="CA bundle to verify an https server")
+    parser.add_argument("--insecure", action="store_true",
+                        help="skip server cert verification")
     sub = parser.add_subparsers(dest="cmd", required=True)
 
     p = sub.add_parser("init", help="provision simulated TPU slices")
@@ -633,7 +640,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         # kubectl mode: reads come from the watch-bootstrapped mirror,
         # writes hit the live server; no state file is touched
         from volcano_tpu.cache.remote_cluster import RemoteCluster
-        cluster = RemoteCluster(args.server, start_watch=False)
+        from volcano_tpu.server.tlsutil import load_token
+        cluster = RemoteCluster(
+            args.server, start_watch=False,
+            token=load_token(args.token, args.token_file),
+            ca_cert=args.ca_cert, insecure=args.insecure)
     else:
         cluster = _load(args.state)
     from volcano_tpu.webhooks import AdmissionError

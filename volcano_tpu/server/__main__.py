@@ -29,6 +29,20 @@ def main(argv=None) -> int:
                              "(vc-webhook-manager analogue)")
     parser.add_argument("--webhook-failure-policy",
                         choices=["Fail", "Ignore"], default="Fail")
+    parser.add_argument("--tls-cert", default="",
+                        help="serve TLS with this certificate (PEM); "
+                             "plaintext clients are refused")
+    parser.add_argument("--tls-key", default="")
+    parser.add_argument("--gen-tls", action="store_true",
+                        help="generate a self-signed cert/key at the "
+                             "--tls-cert/--tls-key paths first")
+    parser.add_argument("--token", default="",
+                        help="bearer token required on mutating routes"
+                             " (also presented on webhook callouts)")
+    parser.add_argument("--token-file", default="")
+    parser.add_argument("--webhook-ca-cert", default="",
+                        help="CA bundle for --webhook-url callouts")
+    parser.add_argument("--webhook-insecure", action="store_true")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
@@ -39,7 +53,16 @@ def main(argv=None) -> int:
 
     from volcano_tpu.cache.fake_cluster import FakeCluster
     from volcano_tpu.server.state_server import serve
+    from volcano_tpu.server.tlsutil import generate_self_signed, load_token
     from volcano_tpu.webhooks import default_admission
+
+    token = load_token(args.token, args.token_file)
+    if args.gen_tls:
+        if not (args.tls_cert and args.tls_key):
+            parser.error("--gen-tls needs --tls-cert and --tls-key paths")
+        generate_self_signed(args.tls_cert, args.tls_key)
+        log.info("self-signed TLS material written to %s / %s",
+                 args.tls_cert, args.tls_key)
 
     cluster = None
     if args.state and os.path.exists(args.state):
@@ -56,7 +79,9 @@ def main(argv=None) -> int:
             cluster = FakeCluster()
         cluster.admission = RemoteAdmission(
             args.webhook_url,
-            failure_policy=args.webhook_failure_policy)
+            failure_policy=args.webhook_failure_policy,
+            token=token, ca_cert=args.webhook_ca_cert,
+            insecure=args.webhook_insecure)
         log.info("admission delegated to webhook manager at %s "
                  "(failurePolicy=%s)", args.webhook_url,
                  args.webhook_failure_policy)
@@ -70,9 +95,13 @@ def main(argv=None) -> int:
         cluster.admission = default_admission()
 
     httpd, state = serve(port=args.port, cluster=cluster,
-                         tick_period=args.tick_period)
-    log.info("state server on http://127.0.0.1:%d",
-             httpd.server_address[1])
+                         tick_period=args.tick_period,
+                         tls_cert=args.tls_cert, tls_key=args.tls_key,
+                         token=token)
+    log.info("state server on %s://127.0.0.1:%d%s",
+             "https" if args.tls_cert else "http",
+             httpd.server_address[1],
+             " (bearer auth on writes)" if token else "")
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())

@@ -24,11 +24,27 @@ def json_response(handler, code: int, payload) -> None:
 
 
 def serve_threaded(handler_base: type, attrs: dict, port: int,
-                   name: str) -> ThreadingHTTPServer:
+                   name: str, tls_cert: str = "",
+                   tls_key: str = "") -> ThreadingHTTPServer:
     """Bind per-server state onto a handler subclass and serve it on
-    127.0.0.1:port (0 = ephemeral) from a daemon thread."""
+    127.0.0.1:port (0 = ephemeral) from a daemon thread.  With
+    tls_cert/tls_key the listener speaks TLS only — a plaintext client
+    is refused during the handshake (reference: the webhook manager is
+    TLS-only, cmd/webhook-manager/)."""
+    # per-connection timeout (handler.setup applies it to the socket):
+    # a silent peer must pin at most one worker thread, and must be
+    # longer than the /watch long-poll ceiling (55s)
+    attrs = dict(attrs, timeout=65)
     handler = type("BoundHandler", (handler_base,), attrs)
     httpd = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    if tls_cert:
+        from volcano_tpu.server.tlsutil import server_ssl_context
+        # handshake lazily on first read IN THE WORKER THREAD — with
+        # do_handshake_on_connect a stalled client would block the
+        # single accept loop and take down the whole listener
+        httpd.socket = server_ssl_context(tls_cert, tls_key).wrap_socket(
+            httpd.socket, server_side=True,
+            do_handshake_on_connect=False)
     httpd.daemon_threads = True
     threading.Thread(target=httpd.serve_forever, name=name,
                      daemon=True).start()

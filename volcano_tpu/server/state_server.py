@@ -193,10 +193,21 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "volcano-tpu-state"
     protocol_version = "HTTP/1.1"
     state: StateServer = None          # injected by serve()
+    token: str = ""                    # bearer token for mutating routes
 
     # quiet the default stderr access log
     def log_message(self, fmt, *args):  # noqa: N802
         log.debug("http: " + fmt, *args)
+
+    def _authorized(self) -> bool:
+        """Mutating routes require the cluster bearer token when one
+        is configured (reads stay open, like anonymous GET on a
+        kube-apiserver behind authz for writes)."""
+        from volcano_tpu.server.tlsutil import token_ok
+        if token_ok(self.token, self.headers.get("Authorization")):
+            return True
+        self._json(401, {"error": "missing or invalid bearer token"})
+        return False
 
     def _json(self, code: int, payload) -> None:
         from volcano_tpu.server.httputil import json_response
@@ -247,6 +258,8 @@ class _Handler(BaseHTTPRequestHandler):
     # -- POST ----------------------------------------------------------
 
     def do_POST(self):  # noqa: N802
+        if not self._authorized():
+            return None
         url = urlparse(self.path)
         st = self.state
         cl = st.cluster
@@ -318,6 +331,8 @@ class _Handler(BaseHTTPRequestHandler):
     # -- DELETE --------------------------------------------------------
 
     def do_DELETE(self):  # noqa: N802
+        if not self._authorized():
+            return None
         url = urlparse(self.path)
         if not url.path.startswith("/objects/"):
             return self._json(404, {"error": f"no route {url.path}"})
@@ -332,15 +347,18 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def serve(port: int = 0, cluster: Optional[FakeCluster] = None,
-          tick_period: float = 0.0
+          tick_period: float = 0.0, tls_cert: str = "",
+          tls_key: str = "", token: str = ""
           ) -> Tuple[ThreadingHTTPServer, StateServer]:
     """Start the server on 127.0.0.1:port (0 = ephemeral); returns
     (http_server, state).  Caller runs http_server.serve_forever()
-    or uses the background thread started here."""
+    or uses the background thread started here.  tls_cert/tls_key
+    make the listener TLS-only; token guards mutating routes."""
     from volcano_tpu.server.httputil import serve_threaded
     state = StateServer(cluster)
-    httpd = serve_threaded(_Handler, {"state": state}, port,
-                           "state-server")
+    httpd = serve_threaded(_Handler, {"state": state, "token": token},
+                           port, "state-server",
+                           tls_cert=tls_cert, tls_key=tls_key)
     state.tick_stop = threading.Event()
     if tick_period > 0:
         def tick_loop():

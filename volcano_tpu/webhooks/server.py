@@ -55,6 +55,7 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "volcano-tpu-webhook"
     protocol_version = "HTTP/1.1"
     hooks: WebhookServer = None          # injected by serve_webhooks()
+    token: str = ""                      # bearer token for /admit
 
     def _json(self, code: int, payload: dict):
         json_response(self, code, payload)
@@ -65,6 +66,10 @@ class _Handler(BaseHTTPRequestHandler):
         return self._json(404, {"error": f"no route {self.path}"})
 
     def do_POST(self):  # noqa: N802
+        from volcano_tpu.server.tlsutil import token_ok
+        if not token_ok(self.token, self.headers.get("Authorization")):
+            return self._json(401, {"error": "missing or invalid "
+                                             "bearer token"})
         if self.path != "/admit":
             return self._json(404, {"error": f"no route {self.path}"})
         try:
@@ -84,10 +89,13 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
 
-def serve_webhooks(port: int = 0, cluster=None):
+def serve_webhooks(port: int = 0, cluster=None, tls_cert: str = "",
+                   tls_key: str = "", token: str = ""):
     """Start the webhook HTTP server (daemon thread); returns httpd."""
-    return serve_threaded(_Handler, {"hooks": WebhookServer(cluster)},
-                          port, "webhook-server")
+    return serve_threaded(_Handler, {"hooks": WebhookServer(cluster),
+                                     "token": token},
+                          port, "webhook-server",
+                          tls_cert=tls_cert, tls_key=tls_key)
 
 
 def main(argv=None) -> int:
@@ -96,16 +104,34 @@ def main(argv=None) -> int:
     parser.add_argument("--cluster-url", default="",
                         help="state server to mirror for cross-object "
                              "validation (informer-lister analogue)")
+    parser.add_argument("--tls-cert", default="",
+                        help="serve TLS with this certificate (PEM)")
+    parser.add_argument("--tls-key", default="")
+    parser.add_argument("--token", default="",
+                        help="cluster bearer token: required of "
+                             "callers of /admit AND presented to the "
+                             "state server")
+    parser.add_argument("--token-file", default="")
+    parser.add_argument("--ca-cert", default="",
+                        help="CA bundle for the state-server mirror")
+    parser.add_argument("--insecure", action="store_true",
+                        help="skip state-server cert verification")
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    from volcano_tpu.server.tlsutil import load_token
+    token = load_token(args.token, args.token_file)
     cluster = None
     if args.cluster_url:
         from volcano_tpu.cache.remote_cluster import RemoteCluster
-        cluster = RemoteCluster(args.cluster_url)
-    httpd = serve_webhooks(args.port, cluster)
+        cluster = RemoteCluster(args.cluster_url, token=token,
+                                ca_cert=args.ca_cert,
+                                insecure=args.insecure)
+    httpd = serve_webhooks(args.port, cluster,
+                           tls_cert=args.tls_cert,
+                           tls_key=args.tls_key, token=token)
     log.info("webhook manager listening on :%d",
              httpd.server_address[1])
     try:
@@ -128,23 +154,50 @@ class RemoteAdmission:
     (the reference default), "Ignore" admits unvalidated.
     """
 
+    # class-level defaults so instances unpickled from PRE-auth state
+    # files resolve these without tripping __getattr__
+    token = ""
+    _tls = ("", False)
+
     def __init__(self, url: str, timeout: float = 5.0,
-                 failure_policy: str = "Fail"):
+                 failure_policy: str = "Fail", token: str = "",
+                 ca_cert: str = "", insecure: bool = False):
         self.url = url.rstrip("/")
         self.timeout = timeout
         self.failure_policy = failure_policy
+        self.token = token
+        self._tls = (ca_cert, insecure)
+
+    # the ssl context is rebuilt after unpickling (state files may
+    # carry a RemoteAdmission; contexts don't pickle)
+    @property
+    def _ssl_ctx(self):
+        ctx = self.__dict__.get("_ssl_ctx_cached")
+        if ctx is None and any(getattr(self, "_tls", ("", False))):
+            from volcano_tpu.server.tlsutil import client_ssl_context
+            ctx = client_ssl_context(*self._tls)
+            self.__dict__["_ssl_ctx_cached"] = ctx
+        return ctx
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_ssl_ctx_cached", None)
+        return state
 
     def _call(self, method: str, obj, cluster=None):
         import urllib.request
         del cluster   # the webhook process uses its own mirror
         body = json.dumps({"method": method,
                            "obj": codec.encode(obj)}).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(
             self.url + "/admit", data=body, method="POST",
-            headers={"Content-Type": "application/json"})
+            headers=headers)
         try:
-            with urllib.request.urlopen(req,
-                                        timeout=self.timeout) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=self._ssl_ctx) as resp:
                 payload = json.loads(resp.read())
         except Exception as e:  # noqa: BLE001 - webhook down/unreachable
             if self.failure_policy == "Ignore":
