@@ -82,12 +82,19 @@ class NodeAgent:
     def __init__(self, cluster, node_name: str,
                  provider: Optional[UsageProvider] = None,
                  oversub_factor: float = 0.6,
-                 eviction_threshold: float = 0.95):
+                 eviction_threshold: float = 0.95,
+                 enforcer=None):
+        from volcano_tpu.agent.enforcer import NullEnforcer
         self.cluster = cluster
         self.node_name = node_name
         self.provider = provider or FakeUsageProvider()
         self.oversub_factor = oversub_factor
         self.eviction_threshold = eviction_threshold
+        # kernel-facing half: cgroup/tc mutations driven from the
+        # decisions below (enforcer.py; default publishes only)
+        self.enforcer = enforcer if enforcer is not None \
+            else NullEnforcer()
+        self._enforced_uids: set = set()
         self.last_sync: float = 0.0          # health-check freshness
 
     def serve_health(self, port: int = 0, stale_after: float = 30.0):
@@ -154,6 +161,13 @@ class NodeAgent:
         self._report_oversubscription(node, usage)
         self._apply_cpu_qos(node, usage, pods)
         self._apply_network_qos(node, usage, pods)
+        # revert enforcement for pods that left the node (completed,
+        # evicted, deleted): decision -> OS mutation -> revert is one
+        # observable loop
+        current_uids = {p.uid for p in pods}
+        for uid in self._enforced_uids - current_uids:
+            self.enforcer.remove_pod(uid)
+        self._enforced_uids = current_uids
         self._refresh_numatopology(pods)
         if max(usage.cpu_fraction, usage.memory_fraction) >= \
                 self.eviction_threshold:
@@ -261,12 +275,14 @@ class NodeAgent:
         per-pod burst quota / throttle decisions from real usage and
         publish them as pod annotations; a kubelet-side enforcer would
         program cgroup cpu.cfs_burst_us / cfs_quota_us from these."""
+        from volcano_tpu.agent.enforcer import PodQoSDecision
         idle_frac = max(0.0, 1.0 - usage.cpu_fraction)
         node_idle_m = self._allocatable(node).milli_cpu * idle_frac
         throttled = usage.cpu_fraction > self.eviction_threshold * 0.9
         for pod in pods:
             qos = pod.annotations.get(PREEMPTABLE_QOS_ANNOTATION)
-            request_m = pod.resource_requests().milli_cpu
+            request = pod.resource_requests()
+            request_m = request.milli_cpu
             if qos == QOS_BEST_EFFORT:
                 # BE pods burst into the node's measured idle (requests
                 # are often 0 for true best-effort — the reference sizes
@@ -276,11 +292,19 @@ class NodeAgent:
                 pod.annotations[CPU_BURST_ANNOTATION] = str(burst)
                 pod.annotations[CPU_THROTTLE_ANNOTATION] = (
                     "true" if throttled else "false")
+                # memory.high soft cap for BE pods with a request
+                # (reference memoryqos handler)
+                mem = int(request.memory) or None
+                self.enforcer.apply_pod_qos(PodQoSDecision(
+                    pod.key, pod.uid, burst, throttled, int(request_m),
+                    memory_high_bytes=mem))
             else:
                 # guaranteed pods: fixed burst headroom, never throttled
-                pod.annotations[CPU_BURST_ANNOTATION] = \
-                    str(int(request_m * 0.2))
+                burst = int(request_m * 0.2)
+                pod.annotations[CPU_BURST_ANNOTATION] = str(burst)
                 pod.annotations.pop(CPU_THROTTLE_ANNOTATION, None)
+                self.enforcer.apply_pod_qos(PodQoSDecision(
+                    pod.key, pod.uid, burst, False, int(request_m)))
 
     def _apply_network_qos(self, node, usage: NodeUsage, pods) -> None:
         """networkqos handler (reference: pkg/networkqos — clsact qdisc
@@ -311,13 +335,17 @@ class NodeAgent:
         node.annotations[DCN_OFFLINE_LIMIT_ANNOTATION] = str(offline_mbps)
         node.annotations[DCN_ONLINE_GUARANTEE_ANNOTATION] = \
             str(int(total_mbps - offline_mbps))
+        pod_limits = {}
         if be_pods:
             per_pod = offline_mbps // len(be_pods)
             for pod in be_pods:
                 pod.annotations[DCN_POD_LIMIT_ANNOTATION] = str(per_pod)
+                pod_limits[pod.uid] = per_pod
         for pod in other_pods:
             # a pod promoted out of BE must not keep a stale cap
             pod.annotations.pop(DCN_POD_LIMIT_ANNOTATION, None)
+        self.enforcer.apply_network(int(total_mbps - offline_mbps),
+                                    offline_mbps, pod_limits)
 
     def _refresh_numatopology(self, pods) -> None:
         """Exporter half of the Numatopology contract
