@@ -34,6 +34,9 @@ _DEFINITIONS: Dict[str, tuple] = {
                             "(CSIStorage analogue)"),
     "TPUDeviceAtomicity": (True, "whole-host chip atomicity on "
                                  "multi-host slices"),
+    "IncrementalSnapshot": (True, "dirty-tracked snapshot reuse "
+                                  "between cycles (16k-host headroom); "
+                                  "false = full rebuild every cycle"),
     # DRA feature-gate surface (reference predicates.go:154-162)
     "DRADeviceTaints": (True, "devices may carry taints; claims need "
                               "matching tolerations"),

@@ -45,4 +45,9 @@ def close_session(ssn: Session) -> None:
                         plugin=name, point="close")
     job_updater.update_job_statuses(ssn)
     job_updater.remove_admission_gates(ssn)
+    # session mutations invalidate snapshot reuse for the objects they
+    # touched, whether the ops committed or were discarded
+    note = getattr(ssn.cache, "note_touched", None)
+    if note is not None:
+        note(ssn.touched_nodes, ssn.touched_jobs)
     ssn.cache.flush_binds()

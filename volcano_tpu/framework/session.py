@@ -81,6 +81,14 @@ class Session:
         # PodGroup phases dirtied this session, flushed by job_updater.
         self.dirty_jobs: Set[str] = set()
 
+        # Objects MUTATED by this session's scheduling attempts (the 5
+        # state primitives below record here).  The cache consumes
+        # these at close: a touched node/job must be rebuilt from
+        # cluster truth next cycle, committed or discarded — the
+        # incremental snapshot's correctness hinge.
+        self.touched_nodes: Set[str] = set()
+        self.touched_jobs: Set[str] = set()
+
         # gangpreempt nominations made this session (job uid -> subjob
         # name -> hypernode), consumed by allocate next cycle.
         self.nominations: Dict[str, Dict[str, str]] = {}
@@ -432,6 +440,8 @@ class Session:
             job.update_task_status(task, TaskStatus.ALLOCATED)
             node.add_task(task)
         self.dirty_jobs.add(job.uid)
+        self.touched_jobs.add(job.uid)
+        self.touched_nodes.add(node.name)
         for h in self.event_handlers:
             if h.allocate_fn:
                 h.allocate_fn(Event(task))
@@ -443,6 +453,8 @@ class Session:
         job.update_task_status(task, TaskStatus.PIPELINED)
         node.add_task(task)
         self.dirty_jobs.add(job.uid)
+        self.touched_jobs.add(job.uid)
+        self.touched_nodes.add(node.name)
         for h in self.event_handlers:
             if h.allocate_fn:
                 h.allocate_fn(Event(task))
@@ -454,7 +466,9 @@ class Session:
         node = self.nodes.get(task.node_name)
         if node is not None:
             node.update_task_status(task, TaskStatus.RELEASING)
+            self.touched_nodes.add(node.name)
         self.dirty_jobs.add(job.uid)
+        self.touched_jobs.add(job.uid)
         for h in self.event_handlers:
             if h.deallocate_fn:
                 h.deallocate_fn(Event(task))
@@ -465,7 +479,9 @@ class Session:
         node = self.nodes.get(task.node_name)
         if node is not None:
             node.remove_task(task)
+            self.touched_nodes.add(node.name)
         job.update_task_status(task, TaskStatus.PENDING)
+        self.touched_jobs.add(job.uid)
         task.node_name = ""
         for h in self.event_handlers:
             if h.deallocate_fn:
@@ -480,6 +496,8 @@ class Session:
         node = self.nodes.get(task.node_name)
         if node is not None:
             node.update_task_status(task, restore)
+            self.touched_nodes.add(node.name)
+        self.touched_jobs.add(job.uid)
         for h in self.event_handlers:
             if h.allocate_fn:
                 h.allocate_fn(Event(task))
