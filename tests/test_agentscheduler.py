@@ -320,3 +320,40 @@ def test_agent_custom_plugin_chain():
     cluster.add_pod(agent_pod("picky"))
     sched.run_until_drained()
     assert cluster.pods["default/picky"].node_name == "n1"
+
+
+def test_agent_plugin_signature_extra_prevents_verdict_leak():
+    """A plugin whose filter_static reads a field OUTSIDE the default
+    spec signature must not share verdicts between pods that differ
+    there (ADVICE r3: memoization contract escape hatch)."""
+    from volcano_tpu.agentscheduler import AgentPlugin, \
+        register_agent_plugin
+
+    @register_agent_plugin("label-gate")
+    class LabelGate(AgentPlugin):
+        """Rejects every node for pods labeled blocked=yes — a field
+        the default signature does NOT cover."""
+        name = "label-gate"
+
+        def signature_extra(self, pod):
+            return (pod.labels.get("blocked", ""),)
+
+        def filter_static(self, task, node):
+            if task.pod.labels.get("blocked") == "yes":
+                return "blocked by label"
+            return None
+
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="n0", allocatable={"cpu": 8, "pods": 10}))
+    sched = AgentScheduler(cluster, plugins=["predicates", "resources",
+                                             "label-gate"])
+    # identical spec except the label: first pod primes the cache
+    ok = agent_pod("ok")
+    blocked = agent_pod("blocked")
+    blocked.labels["blocked"] = "yes"
+    cluster.add_pod(ok)
+    cluster.add_pod(blocked)
+    sched.run_until_drained()
+    assert cluster.pods["default/ok"].node_name == "n0"
+    assert cluster.pods["default/blocked"].node_name == "", \
+        "blocked pod reused the ok pod's memoized verdict"

@@ -157,6 +157,33 @@ def test_incremental_idle_cycles_reuse_everything():
     assert all(second.jobs[j] is first.jobs[j] for j in first.jobs)
 
 
+def test_control_kind_deletion_forces_rebuild():
+    """Deleting a priority class (or any control kind) must invalidate
+    steady jobs — a stale job.priority would skew preemption ordering
+    indefinitely (ADVICE r3 medium)."""
+    from volcano_tpu.cache.cluster import PriorityClass
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    cluster.add_priority_class(PriorityClass("gold", value=1000))
+    pg, pods = gang_job("vip", replicas=2, requests={"cpu": 2},
+                        priority_class="gold")
+    cluster.add_podgroup(pg)
+    for p in pods:
+        cluster.add_pod(p)
+    sched = Scheduler(cluster)
+    sched.run_once()
+    cluster.tick()
+    snap = sched.cache.snapshot()
+    job = next(j for j in snap.jobs.values() if j.name == "vip")
+    assert job.priority == 1000
+
+    cluster.delete_object("priority_class", "gold")
+    snap2 = sched.cache.snapshot()
+    job2 = next(j for j in snap2.jobs.values() if j.name == "vip")
+    assert job2.priority == 0, \
+        "priority_class deletion left a stale job.priority"
+    assert_equivalent(cluster, sched, "priority_class deletion")
+
+
 def test_incremental_gate_off_matches():
     """The escape hatch: IncrementalSnapshot=false forces full rebuild
     every cycle."""

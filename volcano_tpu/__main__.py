@@ -222,8 +222,20 @@ def main(argv=None) -> int:
         wanted = args.node_agents
         # ONE enforcer shared by every agent in this process: per-agent
         # TcEnforcers would hand out colliding class ids on the same
-        # interface (real deployments run one agent per host anyway)
+        # interface (real deployments run one agent per host anyway).
+        # A tc enforcer shaping one interface CANNOT serve multiple
+        # simulated nodes — each agent would program a different
+        # per-node pod set and the programs would ping-pong every sync
+        # — so refuse that combination outright.
         from volcano_tpu.agent.enforcer import build_enforcer
+        multi_agent = (wanted == "all"
+                       or len([n for n in wanted.split(",")
+                               if n.strip()]) > 1)
+        if multi_agent and "tc" in [item.partition(":")[0] for item
+                                    in (args.enforcer or "").split(",")]:
+            parser.error("--enforcer tc:IFACE shapes ONE interface and "
+                         "cannot serve multiple --node-agents; run one "
+                         "agent per host or drop the tc enforcer")
         shared_enforcer = build_enforcer(args.enforcer)
 
         def sync_node_agents():

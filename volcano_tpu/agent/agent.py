@@ -94,7 +94,11 @@ class NodeAgent:
         # decisions below (enforcer.py; default publishes only)
         self.enforcer = enforcer if enforcer is not None \
             else NullEnforcer()
-        self._enforced_uids: set = set()
+        # seed from the enforcer's leftover state so pods that left
+        # the node while the agent was DOWN are reverted on the first
+        # sync (stale cgroup dirs / tc classes must not survive a
+        # restart — ADVICE r3)
+        self._enforced_uids: set = set(self.enforcer.enforced_uids())
         self.last_sync: float = 0.0          # health-check freshness
 
     def serve_health(self, port: int = 0, stale_after: float = 30.0):

@@ -72,9 +72,23 @@ class AgentPlugin:
     the batch path's per-spec fit-error cache (actions/allocate.py:185,
     reference predicates/cache.go).  filter: occupancy-dependent checks,
     re-run on every placement attempt.  A plugin that can't split
-    leaves everything in filter — slower but always correct."""
+    leaves everything in filter — slower but always correct.
+
+    MEMOIZATION CONTRACT: filter_static verdicts (and score ordering)
+    are shared between pods whose _spec_signature is equal — by
+    default that covers selector/affinity/tolerations/requests/ports
+    ONLY.  A plugin whose filter_static or score reads any other pod
+    field (labels, annotations, priority, ...) MUST return those
+    fields from signature_extra() so pods differing there get their
+    own cache entry; otherwise verdicts silently leak across pods."""
 
     name = "agent-plugin"
+
+    def signature_extra(self, pod):
+        """Hashable tuple of every pod field this plugin's
+        filter_static/score reads BEYOND the default signature
+        (see class docstring).  None = nothing extra."""
+        return None
 
     def filter_static(self, task: TaskInfo, node: NodeInfo):
         """None = node passes; a Status-like truthy value rejects."""
@@ -344,7 +358,9 @@ class AgentScheduler:
         return s
 
     def _spec_entry(self, task: TaskInfo) -> _SpecEntry:
-        sig = _spec_signature(task.pod)
+        extras = [(p.name, e) for p in self.plugins
+                  if (e := p.signature_extra(task.pod)) is not None]
+        sig = _spec_signature(task.pod) + tuple(extras)
         entry = self._spec_cache.get(sig)
         if entry is not None:
             return entry

@@ -136,10 +136,16 @@ class SchedulerCache:
                     self._dirty_nodes.add(name)
             elif kind in ("podgroup", "podgroup_deleted"):
                 self._dirty_jobs.add(obj.key)
-            elif kind in ("node_deleted", "priority_class", "queue"):
+            elif kind in ("node_deleted", "priority_class", "queue") \
+                    or kind.endswith("_deleted"):
                 # membership shrank / priorities shifted / queue specs
                 # changed: queue+priority feed job construction, so
-                # rebuild everything (all are rare control events)
+                # rebuild everything (all are rare control events).
+                # Unrecognized *_deleted kinds (priority_class_deleted,
+                # queue_deleted, future control kinds) take this branch
+                # conservatively: a deletion the incremental model does
+                # not track must not leave stale priorities/queues in
+                # steady jobs.
                 self._needs_full = True
             # hypernode/numatopology/vcjob/command/...: not part of
             # the reused model (hypernodes rebuild every snapshot;
