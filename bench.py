@@ -570,16 +570,50 @@ def run_tpu_benchmarks() -> Tuple[dict, dict, dict]:
     OWN subprocess with their OWN retry — a flash-side failure can
     never erase the train-step evidence again (r2 shipped with
     train_step_tpu: skipped because the probe serialized them)."""
-    probe = _with_retry(_tpu_subprocess, "--probe-child", 120.0)
+    probe = _with_retry(_tpu_subprocess, "--probe-child", 150.0)
     if not probe.get("tpu_available"):
         down = {"tpu_available": False, "attempted": True,
                 "tpu_unreachable": True,
                 "error": "liveness probe failed twice: "
                          + str(probe.get("error", "timeout"))}
-        return probe, dict(down), dict(down)
+        flash, train = dict(down), dict(down)
+        # the tunnel dies for hours at a time (r02+r03 both hit it):
+        # carry the committed last-known-good capture from the
+        # tpu_watch daemon so a dead tunnel at bench time can never
+        # erase real-chip evidence again (VERDICT r3 next-round #1)
+        lkg = _last_known_good()
+        if lkg:
+            flash["last_known_good"] = lkg["flash"]
+            train["last_known_good"] = lkg["train"]
+            probe = dict(probe)
+            probe["last_known_good"] = lkg["meta"]
+        return probe, flash, train
     flash = _with_retry(bench_flash_attention_tpu)
     train = _with_retry(bench_train_step_tpu)
     return probe, flash, train
+
+
+def _last_known_good() -> dict:
+    """Summarize TPU_RESULTS.json (tools/tpu_watch.py capture) for
+    embedding when the live tunnel is dead.  Everything is marked
+    stale=true; the raw evidence stays in the committed artifact."""
+    import os
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "TPU_RESULTS.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            art = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    meta = {"stale": True, "captured_utc": art.get("captured_utc"),
+            "git_head": art.get("git_head"),
+            "device_kind": art.get("device_kind"),
+            "evidence": "TPU_RESULTS.json"}
+    flash = dict((art.get("flash_attention") or {}).get("parsed") or {})
+    train = dict((art.get("train_step") or {}).get("parsed") or {})
+    flash.update(meta)
+    train.update(meta)
+    return {"meta": meta, "flash": flash, "train": train}
 
 
 def _tpu_subprocess(flag: str, timeout_s: float) -> dict:

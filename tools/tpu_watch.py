@@ -1,0 +1,125 @@
+"""Background TPU capture daemon (VERDICT r3 weak #1 / next-round #1).
+
+The axon tunnel to the real chip dies for hours at a time — both the
+r02 and r03 driver bench runs found it dead, so three rounds shipped
+with zero driver-verifiable TPU evidence.  This watcher turns any
+mid-round window of tunnel liveness into a COMMITTED artifact:
+
+  loop:
+    probe (cheap matmul, bounded)            -- bench.py --probe-child
+    on success:
+      run flash + train benches               -- bench.py --*-child
+      write TPU_RESULTS.json with RAW timestamped subprocess output
+      exit 0 (the builder commits the artifact)
+    on failure: sleep with capped backoff, try again
+
+bench.py embeds TPU_RESULTS.json as `last_known_good` (marked stale)
+whenever its own live probe fails, so the driver's end-of-round bench
+always carries the freshest real-chip numbers that existed this round.
+
+Run detached:  nohup python tools/tpu_watch.py > /tmp/tpu_watch.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+OUT = os.path.join(REPO, "TPU_RESULTS.json")
+
+PROBE_TIMEOUT_S = 150.0
+FLASH_TIMEOUT_S = 420.0
+TRAIN_TIMEOUT_S = 900.0
+SLEEP_MIN_S, SLEEP_MAX_S = 120, 600
+
+
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+
+def _child(flag: str, timeout_s: float) -> dict:
+    """One bench.py child on the real TPU: returns the parsed JSON
+    line plus the raw stdout/stderr and wall time (the raw output IS
+    the evidence — the artifact preserves it verbatim)."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    t0 = time.time()
+    started = _utcnow()
+    try:
+        proc = subprocess.run(
+            [sys.executable, BENCH, flag], capture_output=True,
+            text=True, timeout=timeout_s, env=env, cwd=REPO)
+        raw_out, raw_err, rc = proc.stdout, proc.stderr, proc.returncode
+    except subprocess.TimeoutExpired as e:
+        raw_out = (e.stdout or b"").decode() if isinstance(
+            e.stdout, bytes) else (e.stdout or "")
+        raw_err = f"timeout after {timeout_s:g}s"
+        rc = -1
+    wall = round(time.time() - t0, 1)
+    parsed = None
+    for line in reversed((raw_out or "").strip().splitlines() or [""]):
+        if line.startswith("{"):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                pass
+            break
+    return {"flag": flag, "started_utc": started, "wall_s": wall,
+            "rc": rc, "parsed": parsed,
+            "raw_stdout": (raw_out or "")[-4000:],
+            "raw_stderr": (raw_err or "")[-2000:]}
+
+
+def capture() -> dict | None:
+    """One full capture attempt; returns the artifact on success."""
+    probe = _child("--probe-child", PROBE_TIMEOUT_S)
+    if not (probe["parsed"] or {}).get("tpu_available"):
+        print(f"[{_utcnow()}] probe down: rc={probe['rc']} "
+              f"err={probe['raw_stderr'][-120:]!r}", flush=True)
+        return None
+    print(f"[{_utcnow()}] TPU ALIVE ({probe['parsed'].get('device_kind')})"
+          f" — running benches", flush=True)
+    flash = _child("--flash-child", FLASH_TIMEOUT_S)
+    train = _child("--train-child", TRAIN_TIMEOUT_S)
+    head = subprocess.run(["git", "rev-parse", "HEAD"], cwd=REPO,
+                          capture_output=True, text=True).stdout.strip()
+    return {
+        "captured_utc": _utcnow(),
+        "git_head": head,
+        "device_kind": probe["parsed"].get("device_kind"),
+        "probe": probe, "flash_attention": flash, "train_step": train,
+    }
+
+
+def main() -> int:
+    once = "--once" in sys.argv
+    sleep_s = SLEEP_MIN_S
+    while True:
+        art = capture()
+        if art is not None:
+            tmp = OUT + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(art, f, indent=1)
+            os.replace(tmp, OUT)
+            flash_p = art["flash_attention"]["parsed"] or {}
+            train_p = art["train_step"]["parsed"] or {}
+            print(f"[{_utcnow()}] captured -> {OUT}: "
+                  f"flash_mfu={flash_p.get('pallas_fwd_mfu')} "
+                  f"train_mfu={train_p.get('mfu')} "
+                  f"tokens/s={train_p.get('tokens_per_s')}", flush=True)
+            return 0
+        if once:
+            return 1
+        time.sleep(sleep_s)
+        sleep_s = min(SLEEP_MAX_S, int(sleep_s * 1.7))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

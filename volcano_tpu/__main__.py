@@ -231,11 +231,18 @@ def main(argv=None) -> int:
         multi_agent = (wanted == "all"
                        or len([n for n in wanted.split(",")
                                if n.strip()]) > 1)
-        if multi_agent and "tc" in [item.partition(":")[0] for item
-                                    in (args.enforcer or "").split(",")]:
-            parser.error("--enforcer tc:IFACE shapes ONE interface and "
-                         "cannot serve multiple --node-agents; run one "
-                         "agent per host or drop the tc enforcer")
+        kernel_kinds = {item.partition(":")[0] for item
+                        in (args.enforcer or "").split(",")} & \
+            {"tc", "cgroup"}
+        if multi_agent and kernel_kinds:
+            # tc shapes ONE interface (per-node programs would
+            # ping-pong); a shared cgroup root would make each agent's
+            # restart-reconcile sweep away the OTHER agents' live pod
+            # state.  Real deployments run one agent per host.
+            parser.error(f"--enforcer {','.join(sorted(kernel_kinds))} "
+                         "mutates one host's kernel and cannot serve "
+                         "multiple --node-agents; run one agent per "
+                         "host or use --enforcer record/none")
         shared_enforcer = build_enforcer(args.enforcer)
 
         def sync_node_agents():

@@ -185,15 +185,22 @@ class CgroupV2Enforcer(Enforcer):
     for offline pods — net_cls.classid (the classification half of
     the DCN split: packets from the pod's cgroup carry 1:<class> and
     TcEnforcer's cgroup filter delivers them to that HTB class).  On
-    a real node root is the kubepods slice; tests point it at a
-    tmpdir and assert the actual file contents (the write path has
-    no fake)."""
+    a real node root is a DEDICATED volcano-managed subtree (a co-
+    mounted v1 net_cls hierarchy for the tag; kubelet-owned *.slice
+    entries under a shared root are never claimed); tests point it at
+    a tmpdir and assert the actual file contents (the write path has
+    no fake).  A failed kernel write degrades that one knob with a
+    warning — enforcement must never kill the agent's sync loop."""
 
     def __init__(self, root: str,
                  classids: Optional[OfflineClassAllocator] = None):
         self.root = root
         self.classids = classids if classids is not None \
             else OfflineClassAllocator()
+        # uids whose net_cls.classid WE tagged non-zero: the
+        # promotion-clear path below must only touch our own writes,
+        # never sweep every dir under a possibly-shared root
+        self._tagged: set = set()
         os.makedirs(root, exist_ok=True)
 
     def _dir(self, uid: str) -> str:
@@ -201,8 +208,14 @@ class CgroupV2Enforcer(Enforcer):
 
     @staticmethod
     def _write(path: str, value: str) -> None:
-        with open(path, "w", encoding="ascii") as f:
-            f.write(value + "\n")
+        try:
+            with open(path, "w", encoding="ascii") as f:
+                f.write(value + "\n")
+        except OSError as e:
+            # e.g. net_cls.classid on a v2-only hierarchy, or a knob
+            # the kernel rejects: degrade THIS knob, keep the sync
+            # cycle (eviction + stale-pod revert still must run)
+            log.warning("cgroup write %s failed: %s", path, e)
 
     def apply_pod_qos(self, decision: PodQoSDecision) -> None:
         d = self._dir(decision.uid)
@@ -226,26 +239,37 @@ class CgroupV2Enforcer(Enforcer):
         d = self._dir(uid)
         if os.path.isdir(d):
             shutil.rmtree(d, ignore_errors=True)
+        self.classids.release(uid)      # cgroup-only deployments leak
+        self._tagged.discard(uid)       # the allocator otherwise
 
     def apply_network(self, online_mbps, offline_mbps, pod_limits):
         """Classification half of the DCN split: tag each offline
-        pod's cgroup with its HTB class; clear the tag from pods that
-        were promoted out of the offline set (a stale classid would
-        keep capping a now-guaranteed pod)."""
+        pod's cgroup with its HTB class; clear the tag from pods WE
+        tagged that were promoted out of the offline set (a stale
+        classid would keep capping a now-guaranteed pod).  Keyed on
+        our own write ledger — never a sweep of the root, which may
+        hold other owners' dirs."""
         for uid in pod_limits:
             d = self._dir(uid)
             os.makedirs(d, exist_ok=True)
             self._write(os.path.join(d, "net_cls.classid"),
                         net_cls_value(self.classids.classid(uid)))
-        for uid in self.enforced_uids() - set(pod_limits):
+            self._tagged.add(uid)
+        for uid in self._tagged - set(pod_limits):
             path = os.path.join(self._dir(uid), "net_cls.classid")
             if os.path.exists(path):
                 self._write(path, "0x00000000")   # default (online) class
+            self._tagged.discard(uid)
+            self.classids.release(uid)
 
     def enforced_uids(self) -> set:
+        """Dirs under the root that are plausibly ours: kubelet-owned
+        systemd slices (*.slice) under a shared root are excluded —
+        reconciling those away would wipe live pods' enforcement."""
         try:
             return {e for e in os.listdir(self.root)
-                    if os.path.isdir(os.path.join(self.root, e))}
+                    if os.path.isdir(os.path.join(self.root, e))
+                    and not e.endswith(".slice")}
         except OSError:
             return set()
 
@@ -283,6 +307,11 @@ class TcEnforcer(Enforcer):
         self.classids = classids if classids is not None \
             else OfflineClassAllocator()
         self._program: Optional[list] = None
+        # uid -> class minor actually programmed into the kernel; OUR
+        # removal ledger, independent of the shared allocator (the
+        # cgroup half may release an allocation first — the kernel
+        # class still must be deleted)
+        self._programmed: Dict[str, int] = {}
         self._cleared_stale = False
 
     @staticmethod
@@ -294,7 +323,8 @@ class TcEnforcer(Enforcer):
     def apply_pod_qos(self, decision): pass     # cpu is cgroup's job
 
     def remove_pod(self, uid: str) -> None:
-        cls = self.classids.release(uid)
+        self.classids.release(uid)
+        cls = self._programmed.pop(uid, None)
         if cls is not None:
             try:
                 self.runner(["class", "del", "dev", self.iface,
@@ -306,8 +336,7 @@ class TcEnforcer(Enforcer):
                       pod_limits: Dict[str, int]) -> None:
         # a pod promoted OUT of the offline set while staying on the
         # node must lose its cap class, not keep a stale kernel ceil
-        for uid in [u for u in self.classids.uids()
-                    if u not in pod_limits]:
+        for uid in [u for u in self._programmed if u not in pod_limits]:
             self.remove_pod(uid)
         if not self._cleared_stale:
             # first program after start: tear down whatever a previous
@@ -334,11 +363,13 @@ class TcEnforcer(Enforcer):
             ["filter", "replace", "dev", self.iface, "parent", "1:",
              "protocol", "ip", "prio", "10", "handle", "1:", "cgroup"],
         ]
+        classes = {uid: self.classids.classid(uid)
+                   for uid in pod_limits}
         for uid in sorted(pod_limits):
             prog.append(
                 ["class", "replace", "dev", self.iface, "parent",
-                 "1:20", "classid", f"1:{self.classids.classid(uid)}",
-                 "htb", "rate", f"{max(1, pod_limits[uid])}mbit",
+                 "1:20", "classid", f"1:{classes[uid]}", "htb",
+                 "rate", f"{max(1, pod_limits[uid])}mbit",
                  "ceil", f"{max(1, pod_limits[uid])}mbit"])
         if prog == self._program:
             return                      # unchanged: no kernel churn
@@ -349,9 +380,10 @@ class TcEnforcer(Enforcer):
                 log.warning("tc %s failed", " ".join(argv))
                 return                  # keep old program marker
         self._program = prog
+        self._programmed.update(classes)
 
     def enforced_uids(self) -> set:
-        return self.classids.uids()
+        return set(self._programmed)
 
 
 class CompositeEnforcer(Enforcer):

@@ -136,16 +136,16 @@ class SchedulerCache:
                     self._dirty_nodes.add(name)
             elif kind in ("podgroup", "podgroup_deleted"):
                 self._dirty_jobs.add(obj.key)
-            elif kind in ("node_deleted", "priority_class", "queue") \
-                    or kind.endswith("_deleted"):
+            elif kind in ("node_deleted", "priority_class",
+                          "priority_class_deleted", "queue",
+                          "queue_deleted"):
                 # membership shrank / priorities shifted / queue specs
-                # changed: queue+priority feed job construction, so
-                # rebuild everything (all are rare control events).
-                # Unrecognized *_deleted kinds (priority_class_deleted,
-                # queue_deleted, future control kinds) take this branch
-                # conservatively: a deletion the incremental model does
-                # not track must not leave stale priorities/queues in
-                # steady jobs.
+                # changed or vanished: queue+priority feed job
+                # construction, so rebuild everything (all are rare
+                # control events).  Deliberately NOT a *_deleted
+                # catch-all: vcjob_deleted/jobflow_deleted fire on
+                # routine job churn and their cascaded pod/podgroup
+                # deletions already dirty the right objects.
                 self._needs_full = True
             # hypernode/numatopology/vcjob/command/...: not part of
             # the reused model (hypernodes rebuild every snapshot;
