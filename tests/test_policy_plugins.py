@@ -797,3 +797,62 @@ def test_binpack_reference_key_aliases():
     assert p.dim_weights["cpu"] == 7.0
     assert p.dim_weights["memory"] == 3.0
     assert p.dim_weights[TPU] == 11.0
+
+
+def test_rescheduling_tpu_fragmentation_defrag():
+    """tpuFragmentation strategy: two half-used TPU hosts exist; the
+    emptier donor's sub-host pack is victimized so re-allocation can
+    pack the receiver and free a whole host for slice gangs."""
+    hosts = [Node(name=f"h{i}", allocatable={"cpu": 16, TPU: 4,
+                                             "pods": 110})
+             for i in range(3)]
+    # h0: 1 chip used (donor), h1: 2 chips used (receiver), h2: free
+    pg_a, pods_a = gang_job("packa", replicas=1, min_available=0,
+                            requests={"cpu": 1, TPU: 1},
+                            running_on=["h0"],
+                            pg_phase=PodGroupPhase.RUNNING)
+    pg_b, pods_b = gang_job("packb", replicas=1, min_available=0,
+                            requests={"cpu": 1, TPU: 2},
+                            running_on=["h1"],
+                            pg_phase=PodGroupPhase.RUNNING)
+    conf = conf_with(
+        {"name": "rescheduling",
+         "arguments": {"rescheduling.interval": 0,
+                       "rescheduling.strategies": "tpuFragmentation"}},
+        actions="shuffle")
+    ctx = TestContext(nodes=hosts, podgroups=[pg_a, pg_b],
+                      pods=pods_a + pods_b, conf=conf)
+    ctx.run(["shuffle"])
+    # the 1-chip pack on the emptier host is the victim; the 2-chip
+    # receiver pack stays put
+    ctx.expect_evict_num(1)
+    assert ctx.cluster.evictions[0] == "default/packa-0"
+
+
+def test_rescheduling_victim_cap_and_priority_threshold():
+    """maxVictims bounds a pass; tasks at/above thresholdPriority are
+    never victimized even on hot nodes."""
+    busy = [Node(name=f"b{i}", allocatable={"cpu": 8}) for i in range(3)]
+    idle = Node(name="idle", allocatable={"cpu": 64})
+    pgs, pods = [], []
+    for i, n in enumerate(busy):
+        pg, ps = gang_job(f"hot{i}", replicas=2, min_available=0,
+                          requests={"cpu": 4}, running_on=[n.name],
+                          pg_phase=PodGroupPhase.RUNNING)
+        if i == 0:
+            for p in ps:
+                p.priority = 5_000_000_000          # protected
+        pgs.append(pg)
+        pods.extend(ps)
+    conf = conf_with(
+        {"name": "rescheduling",
+         "arguments": {"rescheduling.interval": 0,
+                       "rescheduling.maxVictims": 1,
+                       "rescheduling.thresholdPriority": 1_000_000}},
+        actions="shuffle")
+    ctx = TestContext(nodes=busy + [idle], podgroups=pgs, pods=pods,
+                      conf=conf)
+    ctx.run(["shuffle"])
+    ctx.expect_evict_num(1)                        # capped at 1
+    assert not ctx.cluster.evictions[0].startswith("default/hot0"), \
+        "priority-protected task was victimized"

@@ -36,6 +36,9 @@ class FakeCluster(Cluster):
         self.priority_classes: Dict[str, PriorityClass] = {}
         self.vcjobs: Dict[str, object] = {}       # key: ns/name -> VCJob
         self.commands: List[dict] = []            # bus/v1alpha1 analogue
+        # namespace -> annotations (the podgroup mutate webhook reads
+        # the namespace's default-queue annotation from here)
+        self.namespaces: Dict[str, Dict[str, str]] = {}
         self.jobflows: Dict[str, object] = {}     # flow/v1alpha1 JobFlow
         self.jobtemplates: Dict[str, object] = {} # flow/v1alpha1 JobTemplate
         self.cronjobs: Dict[str, object] = {}     # batch/v1alpha1 CronJob

@@ -45,6 +45,9 @@ KINDS: Dict[str, KindSpec] = {
     "nodeshard": KindSpec("nodeshards", _name),
     "numatopology": KindSpec("numatopologies", _name),
     # plain-dict kinds (plugin/operator supplied payloads)
+    # namespace -> annotations dict (podgroup mutate webhook reads the
+    # per-namespace default-queue annotation)
+    "namespace": KindSpec("namespaces", None),
     "service": KindSpec("services", None),
     "config_map": KindSpec("config_maps", None),
     "secret": KindSpec("secrets", None),

@@ -663,5 +663,43 @@ def main(argv: Optional[List[str]] = None) -> int:
     return 0
 
 
+# global connection flags (defined on the root parser) and their
+# value arity — the alias mains hoist these in front of the verb so
+# `vjobs --server URL` works the way a standalone binary should
+_GLOBAL_FLAGS = {"--state": 1, "--server": 1, "--token": 1,
+                 "--token-file": 1, "--ca-cert": 1, "--insecure": 0}
+
+
+def _alias_main(verb: str):
+    """Standalone slurm-style binary (reference builds vsub/vcancel/
+    vsuspend/vresume/vjobs/vqueues as separate binaries, Makefile:281):
+    each console script is the vtpctl verb with argv passed through."""
+    def _main() -> int:
+        args = list(sys.argv[1:])
+        pre, post, i = [], [], 0
+        while i < len(args):
+            name = args[i].split("=", 1)[0]
+            if name in _GLOBAL_FLAGS:
+                pre.append(args[i])
+                if _GLOBAL_FLAGS[name] and "=" not in args[i] \
+                        and i + 1 < len(args):
+                    i += 1
+                    pre.append(args[i])
+            else:
+                post.append(args[i])
+            i += 1
+        return main([*pre, verb, *post])
+    _main.__name__ = verb
+    return _main
+
+
+vsub_main = _alias_main("vsub")
+vcancel_main = _alias_main("vcancel")
+vsuspend_main = _alias_main("vsuspend")
+vresume_main = _alias_main("vresume")
+vjobs_main = _alias_main("vjobs")
+vqueues_main = _alias_main("vqueues")
+
+
 if __name__ == "__main__":
     sys.exit(main())

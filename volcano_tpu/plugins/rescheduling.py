@@ -1,22 +1,163 @@
-"""Rescheduling plugin — periodic low-utilization rebalancing.
+"""Rescheduling plugin — strategy-driven periodic rebalancing.
 
 Reference parity: plugins/rescheduling/rescheduling.go:110 (strategy
-lowNodeUtilization feeds VictimTasks; shuffle executes).  Arguments:
-  rescheduling.interval: seconds between passes (default 300)
-  rescheduling.lowThreshold:  fraction below which a node is "low"
-  rescheduling.highThreshold: fraction above which a node is "high"
-Victims are preemptable pods on HIGH nodes, movable only while LOW
-nodes exist to absorb them.
+registry feeding VictimTasks; the shuffle action executes evictions)
++ low_node_utilization.go (per-resource thresholds, nodeFit, priority
+threshold).  Two strategies ship:
+
+  lowNodeUtilization — victims from nodes above the per-resource
+    target thresholds while nodes below the low thresholds exist to
+    absorb them (the reference's LNU strategy, thresholds as
+    fractions per dimension instead of descheduler percentages).
+
+  tpuFragmentation — TPU-native defragmentation: sub-host packs
+    strand partially-used hosts, and a multi-host slice gang needs
+    WHOLE hosts (api/devices/tpu/device_info.py:71-105).  Donor hosts
+    (fewest used chips) hand their sub-host pods to receiver hosts
+    (most used chips, enough idle), freeing whole hosts for gangs.
+
+Arguments (all under the plugin's `arguments` map):
+  rescheduling.interval: seconds between passes         (default 300)
+  rescheduling.strategies: comma list                   (default
+      "lowNodeUtilization")
+  rescheduling.maxVictims: victim cap per pass          (default 8)
+  rescheduling.thresholdPriority: never victimize tasks at or above
+      this priority                                     (default 2e9)
+  lowNodeUtilization.thresholds: {dim: frac} below which a node is
+      LOW on every dim                                  (default
+      {"cpu": .2, "memory": .2})
+  lowNodeUtilization.targetThresholds: {dim: frac} above which a node
+      is HIGH on any dim                                (default
+      {"cpu": .8, "memory": .8})
+  lowNodeUtilization.nodeFit: victims must fit a low node (default
+      True)
+  legacy flat keys rescheduling.lowThreshold/highThreshold are still
+  honored as uniform thresholds.
 """
 
 from __future__ import annotations
 
 import time
-from typing import List
+from typing import Callable, Dict, List
 
 from volcano_tpu.api.job_info import TaskInfo
-from volcano_tpu.api.resource import MIN_RESOURCE
+from volcano_tpu.api.resource import MIN_RESOURCE, TPU
 from volcano_tpu.framework.plugins import Plugin, register_plugin
+
+# strategy name -> fn(plugin, ssn) -> victims (reference VictimFn map,
+# rescheduling.go:62); registration is module-level so operators can
+# add strategies the way they add plugins
+STRATEGIES: Dict[str, Callable] = {}
+
+
+def register_strategy(name: str):
+    def deco(fn):
+        STRATEGIES[name] = fn
+        return fn
+    return deco
+
+
+def _utilization(node, dim: str) -> float:
+    cap = node.allocatable.get(dim)
+    return node.used.get(dim) / cap if cap > MIN_RESOURCE else 0.0
+
+
+def _movable(plugin, task_on_node, node) -> "TaskInfo | None":
+    """The session-task for a node-task, if it may be victimized."""
+    if not task_on_node.occupies_resources() \
+            or not task_on_node.preemptable:
+        return None
+    if task_on_node.priority >= plugin.threshold_priority:
+        return None
+    job = plugin.ssn.jobs.get(task_on_node.job)
+    victim = job.tasks.get(task_on_node.uid) if job else None
+    return victim or task_on_node
+
+
+@register_strategy("lowNodeUtilization")
+def _lnu_victims(plugin, ssn) -> List[TaskInfo]:
+    nodes = [n for n in ssn.nodes.values() if n.ready]
+    lows = [n for n in nodes
+            if all(_utilization(n, d) < t
+                   for d, t in plugin.low_thresholds.items())]
+    highs = [n for n in nodes
+             if any(_utilization(n, d) > t
+                    for d, t in plugin.target_thresholds.items())]
+    if not lows or not highs:
+        return []
+    victims: List[TaskInfo] = []
+    # drain the hottest nodes first (reference sorts by usage)
+    highs.sort(key=lambda n: -max(_utilization(n, d)
+                                  for d in plugin.target_thresholds))
+    absorb = [n.future_idle() for n in lows]
+    for node in highs:
+        for t in sorted(node.tasks.values(),
+                        key=lambda t: t.resreq.milli_cpu):
+            victim = _movable(plugin, t, node)
+            if victim is None:
+                continue
+            if plugin.node_fit:
+                # a victim nothing can absorb just churns: charge the
+                # move against a low node's projected headroom
+                slot = next((i for i, idle in enumerate(absorb)
+                             if victim.resreq.less_equal(idle)), None)
+                if slot is None:
+                    continue
+                absorb[slot] = absorb[slot].clone().sub_unchecked(
+                    victim.resreq)
+            victims.append(victim)
+            if len(victims) >= plugin.max_victims:
+                return victims
+            break                       # one per high node per pass
+    return victims
+
+
+@register_strategy("tpuFragmentation")
+def _tpu_defrag_victims(plugin, ssn) -> List[TaskInfo]:
+    """Consolidate sub-host TPU packs to free whole hosts.
+
+    A host is FRAGMENTED when some but not all of its chips are used.
+    Moving the least-loaded fragmented hosts' packs onto the most-
+    loaded fragmented hosts (that can absorb them chip-for-chip)
+    converts fragmented pairs into one packed host + one free host —
+    and free whole hosts are the currency multi-host slice gangs
+    spend (device_info.py: atomic whole-host on multi-host slices)."""
+    frag = []
+    for n in ssn.nodes.values():
+        if not n.ready:
+            continue
+        cap, used = n.allocatable.get(TPU), n.used.get(TPU)
+        if cap > MIN_RESOURCE and MIN_RESOURCE < used < cap - MIN_RESOURCE:
+            frag.append(n)
+    if len(frag) < 2:
+        return []
+    # donors drain from the emptiest end, receivers fill the fullest
+    frag.sort(key=lambda n: n.used.get(TPU))
+    victims: List[TaskInfo] = []
+    receiver_idle = {n.name: n.future_idle() for n in frag}
+    donors, receivers = frag[:len(frag) // 2], frag[len(frag) // 2:]
+    for donor in donors:
+        for t in sorted(donor.tasks.values(),
+                        key=lambda t: t.resreq.get(TPU)):
+            if t.resreq.get(TPU) <= MIN_RESOURCE:
+                continue                # cpu-only pod: not fragmenting
+            victim = _movable(plugin, t, donor)
+            if victim is None:
+                continue
+            # the FULL resreq (cpu/memory too, not just chips) must
+            # fit the receiver, or the evicted pack just re-lands on
+            # the donor and the next pass evicts it again
+            home = next((r for r in reversed(receivers)
+                         if victim.resreq.less_equal(
+                             receiver_idle[r.name])), None)
+            if home is None:
+                continue                # nothing can absorb this pack
+            receiver_idle[home.name] = receiver_idle[home.name] \
+                .clone().sub_unchecked(victim.resreq)
+            victims.append(victim)
+            if len(victims) >= plugin.max_victims:
+                return victims
+    return victims
 
 
 @register_plugin("rescheduling")
@@ -25,21 +166,39 @@ class ReschedulingPlugin(Plugin):
 
     def __init__(self, arguments=None):
         super().__init__(arguments)
-        self.interval = float(self.arguments.get("rescheduling.interval", 300))
-        self.low = float(self.arguments.get("rescheduling.lowThreshold", 0.2))
-        self.high = float(self.arguments.get("rescheduling.highThreshold", 0.8))
+        args = self.arguments
+        self.interval = float(args.get("rescheduling.interval", 300))
+        self.max_victims = int(args.get("rescheduling.maxVictims", 8))
+        self.threshold_priority = float(args.get(
+            "rescheduling.thresholdPriority", 2_000_000_000))
+        names = args.get("rescheduling.strategies",
+                         "lowNodeUtilization")
+        if isinstance(names, str):
+            names = [s.strip() for s in names.split(",") if s.strip()]
+        unknown = [n for n in names if n not in STRATEGIES]
+        if unknown:
+            # a typo must not silently disable rebalancing
+            import logging
+            logging.getLogger(__name__).warning(
+                "rescheduling: unknown strategies %s (registered: %s)",
+                unknown, sorted(STRATEGIES))
+        self.strategies = [STRATEGIES[n] for n in names
+                           if n in STRATEGIES]
+        # legacy flat thresholds double as uniform per-dim defaults
+        low = float(args.get("rescheduling.lowThreshold", 0.2))
+        high = float(args.get("rescheduling.highThreshold", 0.8))
+        self.low_thresholds = dict(args.get(
+            "lowNodeUtilization.thresholds",
+            {"cpu": low, "memory": low}))
+        self.target_thresholds = dict(args.get(
+            "lowNodeUtilization.targetThresholds",
+            {"cpu": high, "memory": high}))
+        self.node_fit = bool(args.get("lowNodeUtilization.nodeFit",
+                                      True))
 
     def on_session_open(self, ssn):
         self.ssn = ssn
         ssn.add_victim_tasks_fn(self.name, self._victims)
-
-    @staticmethod
-    def _utilization(node) -> float:
-        frac = 0.0
-        for dim, cap in node.allocatable.res.items():
-            if cap > MIN_RESOURCE:
-                frac = max(frac, node.used.get(dim) / cap)
-        return frac
 
     def _victims(self) -> List[TaskInfo]:
         # interval limiter survives sessions on the cache's per-
@@ -50,18 +209,17 @@ class ReschedulingPlugin(Plugin):
         now = time.time()
         if now - state["ts"] < self.interval:
             return []
-        nodes = [n for n in self.ssn.nodes.values() if n.ready]
-        low = [n for n in nodes if self._utilization(n) < self.low]
-        high = [n for n in nodes if self._utilization(n) > self.high]
-        if not low or not high:
-            return []
-        state["ts"] = now
-        victims = []
-        for node in high:
-            for t in node.tasks.values():
-                if t.occupies_resources() and t.preemptable:
-                    job = self.ssn.jobs.get(t.job)
-                    victim = job.tasks.get(t.uid) if job else None
-                    victims.append(victim or t)
-                    break  # one per high node per pass
+        state["ts"] = now      # the PASS is rate-limited, not the hit:
+        # a zero-victim scan still costs O(nodes x tasks) and must not
+        # re-run every session on a persistently imbalanced cluster
+        victims: List[TaskInfo] = []
+        seen = set()
+        for strategy in self.strategies:
+            for v in strategy(self, self.ssn):
+                if v.uid not in seen:
+                    seen.add(v.uid)
+                    victims.append(v)
+            if len(victims) >= self.max_victims:
+                victims = victims[:self.max_victims]
+                break
         return victims

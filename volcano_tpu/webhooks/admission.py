@@ -146,6 +146,30 @@ def validate_job(job: VCJob, cluster=None) -> None:
 
 # -- queues -----------------------------------------------------------
 
+# reference-style hierarchy annotations (KubeHierarchyAnnotationKey):
+# consumed by hdrf when annotation-driven hierarchy is used in place
+# of the parent field
+HIERARCHY_ANNOTATION = "volcano-tpu.io/hierarchy"
+HIERARCHY_WEIGHTS_ANNOTATION = "volcano-tpu.io/hierarchy-weights"
+
+
+def mutate_queue(queue):
+    """Create-path defaulting (reference admission/queues/mutate/
+    mutate_queue.go:40): weight 0 -> 1, and hierarchy annotations are
+    rooted (`a/b` -> `root/a/b`, weights `2/1` -> `1/2/1`) so every
+    hierarchy walk shares one root.  reclaimable/state defaulting is
+    the dataclass's (a wire create without those fields lands on
+    True/OPEN already)."""
+    if queue.weight <= 0:
+        queue.weight = 1
+    h = queue.annotations.get(HIERARCHY_ANNOTATION, "")
+    hw = queue.annotations.get(HIERARCHY_WEIGHTS_ANNOTATION, "")
+    if h and hw and h.split("/", 1)[0] != "root":
+        queue.annotations[HIERARCHY_ANNOTATION] = f"root/{h}"
+        queue.annotations[HIERARCHY_WEIGHTS_ANNOTATION] = f"1/{hw}"
+    return queue
+
+
 def validate_queue(queue, cluster=None) -> None:
     if not DNS1123.match(queue.name):
         raise AdmissionError(f"queue name {queue.name!r} invalid")
@@ -168,6 +192,27 @@ def validate_queue(queue, cluster=None) -> None:
 
 
 # -- podgroups / hypernodes -------------------------------------------
+
+# namespace annotation naming that namespace's default queue
+# (reference QueueNameAnnotationKey, podgroups/mutate)
+QUEUE_NAME_NAMESPACE_ANNOTATION = "volcano-tpu.io/queue-name"
+
+
+def mutate_podgroup(pg, cluster=None):
+    """Create-path defaulting (reference admission/podgroups/mutate):
+    a podgroup left on the default queue adopts its NAMESPACE's
+    queue-name annotation, so teams get per-namespace queues without
+    every submitter naming one."""
+    if pg.queue in ("", DEFAULT_QUEUE) and cluster is not None:
+        ns_ann = getattr(cluster, "namespaces", {}).get(
+            pg.namespace) or {}
+        ns_queue = ns_ann.get(QUEUE_NAME_NAMESPACE_ANNOTATION)
+        if ns_queue:
+            pg.queue = ns_queue
+    if not pg.queue:
+        pg.queue = DEFAULT_QUEUE
+    return pg
+
 
 def validate_podgroup(pg) -> None:
     if pg.min_member < 0:
@@ -350,10 +395,12 @@ class AdmissionChain:
         return job
 
     def admit_queue(self, queue, cluster=None):
+        queue = mutate_queue(queue)
         validate_queue(queue, cluster)
         return queue
 
     def admit_podgroup(self, pg, cluster=None):
+        pg = mutate_podgroup(pg, cluster)
         validate_podgroup(pg)
         return pg
 
