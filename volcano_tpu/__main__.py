@@ -91,6 +91,12 @@ def main(argv=None) -> int:
                              "ledger), or a comma list of "
                              "'cgroup:ROOT' and 'tc:IFACE' "
                              "(agent/enforcer.py)")
+    parser.add_argument("--member-cluster", action="append", default=[],
+                        metavar="NAME=URL",
+                        help="HyperJob multi-cluster forwarding: a "
+                             "member control plane's state-server URL "
+                             "(repeatable); split members whose domain "
+                             "is NAME are created THERE")
     parser.add_argument("--hypernode-discovery", default="label",
                         help="topology provider: 'label' (node labels) "
                              "or 'fabric:ENDPOINT[#TOKEN]' (fabric-"
@@ -165,6 +171,29 @@ def main(argv=None) -> int:
                 parser.error(str(e))
             ctrl_overrides["hypernode"] = \
                 lambda: hn_mod.HyperNodeController(discoverer=disc)
+        if args.member_cluster:
+            from volcano_tpu.cache.remote_cluster import RemoteCluster
+            from volcano_tpu.controllers import hyperjob as hj_mod
+            from volcano_tpu.server.tlsutil import load_token
+            remotes = {}
+            for item in args.member_cluster:
+                name, sep, url = item.partition("=")
+                if not sep or not name or not url:
+                    parser.error(f"--member-cluster {item!r} "
+                                 "(want NAME=URL)")
+                # member planes share the hub's credential flags (one
+                # fleet CA/token); per-member credentials would go in
+                # a kubeconfig-style file if ever needed
+                # a member down at hub start must not crash-loop the
+                # hub: the client self-heals and the hyperjob
+                # controller retries forwarding from its stored plan
+                remotes[name] = RemoteCluster(
+                    url, token=load_token(args.token, args.token_file),
+                    ca_cert=args.ca_cert, insecure=args.insecure,
+                    tolerate_unreachable=True)
+            ctrl_overrides["hyperjob"] = \
+                lambda: hj_mod.HyperJobController(
+                    binder=hj_mod.MultiClusterBinder(cluster, remotes))
         mgr = ControllerManager(
             cluster, enabled=[c for c in args.controllers.split(",") if c],
             overrides=ctrl_overrides)

@@ -40,7 +40,12 @@ class RemoteError(RuntimeError):
 class RemoteCluster(Cluster):
     def __init__(self, base_url: str, start_watch: bool = True,
                  timeout: float = 10.0, token: str = "",
-                 ca_cert: str = "", insecure: bool = False):
+                 ca_cert: str = "", insecure: bool = False,
+                 tolerate_unreachable: bool = False):
+        """tolerate_unreachable: a dead server at construction time
+        leaves the mirror empty instead of raising — the watch loop's
+        resync-on-reconnect self-heals once the server returns (the
+        hub's member-cluster clients must survive a member outage)."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token
@@ -56,7 +61,14 @@ class RemoteCluster(Cluster):
             setattr(self, spec.attr, {})
         self.commands: List[dict] = []
         self.events: List[tuple] = []          # local record only
-        self.resync()
+        try:
+            self.resync()
+        except Exception:  # noqa: BLE001 — URLError, ConnectionError
+            if not tolerate_unreachable:
+                raise
+            log.warning("state server %s unreachable at startup; "
+                        "mirror starts empty and the watch loop will "
+                        "resync when it returns", self.base_url)
         self._watch_thread = None
         if start_watch:
             self._watch_thread = threading.Thread(

@@ -75,13 +75,42 @@ class DRFPlugin(Plugin):
             deallocate_fn=lambda e: self._on_event(e, -1, ssn)))
 
     def _queue_chain(self, queue_name: str):
-        """leaf -> root path of queue names (cycle-safe)."""
-        chain, seen = [], set()
-        cur = queue_name
-        while cur and cur not in seen and cur in self._queues:
-            chain.append(cur)
-            seen.add(cur)
-            cur = self._queues[cur].parent
+        """leaf -> root path of queue names (cycle-safe).
+
+        Two hierarchy sources, matching the reference's dual model:
+        the queue `parent` field (capacity-style tree), or the
+        reference-style hierarchy ANNOTATION (`root/eng/ml`, rooted by
+        the queue mutate webhook — drf.go hierarchicalQueue).  The
+        annotation wins when present; its intermediate segments need
+        not exist as Queue objects."""
+        queue = self._queues.get(queue_name)
+        chain = None
+        if queue is not None:
+            from volcano_tpu.webhooks.admission import (
+                HIERARCHY_ANNOTATION)
+            # _queues holds session QueueInfo (raw Queue underneath)
+            # in-session, raw Queue in unit seams
+            raw = getattr(queue, "queue", queue)
+            path = getattr(raw, "annotations", {}).get(
+                HIERARCHY_ANNOTATION, "")
+            if path:
+                segs = [s for s in path.split("/") if s]
+                if segs and segs[-1] != queue_name:
+                    segs.append(queue_name)
+                chain = list(reversed(segs))
+        if chain is None:
+            chain, seen = [], set()
+            cur = queue_name
+            while cur and cur not in seen and cur in self._queues:
+                chain.append(cur)
+                seen.add(cur)
+                cur = self._queues[cur].parent
+        # EVERY chain ends at the shared synthetic root, whichever
+        # hierarchy source produced it — _path_shares compares these
+        # vectors element-wise from the root end, so an unrooted chain
+        # would misalign every comparison against a rooted one
+        if chain and chain[-1] != "root":
+            chain.append("root")
         return chain
 
     def _path_shares(self, queue_name: str):
