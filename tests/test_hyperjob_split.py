@@ -281,3 +281,36 @@ def test_hierarchy_annotation_feeds_hdrf_queue_chain():
     plugin._queues = cluster.queues
     assert plugin._queue_chain("ml") == ["ml", "eng", "root"]
     assert plugin._queue_chain("web") == ["web", "eng", "root"]
+
+
+def test_auto_split_defers_until_capacity_visible():
+    """A hub whose member mirrors are still blind (zero visible
+    capacity) must NOT persist a degenerate one-domain plan — the
+    HyperJob stays Pending and replans once capacity appears."""
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    from volcano_tpu.controllers.hyperjob import (HyperJobPhase,
+                                                  MultiClusterBinder)
+
+    hub = make_tpu_cluster([("sa", "v5e-16")], dcn_pods={"sa": "pod-a"})
+    b = FakeCluster()                       # EMPTY: mirror not synced
+    hj = HyperJob(name="blind", min_available=1, replicated_jobs=[
+        ReplicatedJob(name="train", replicas=1,
+                      template=training_template(pods=4, chips=4),
+                      split_policy=SplitPolicy(mode="auto"))])
+    hub.put_object("hyperjob", hj)
+    ctrl = HyperJobController(
+        binder=MultiClusterBinder(hub, {"cluster-b": b}))
+    ctrl.initialize(hub)
+    ctrl.sync()
+    live = hub.hyperjobs[hj.key]
+    assert live.split_plans == {}, "blind plan must not persist"
+    assert live.phase is HyperJobPhase.PENDING
+    assert not b.vcjobs
+
+    # capacity appears (mirror synced) -> plan lands normally
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.simulator import slice_nodes
+    for node in slice_nodes(slice_for("sb", "v5e-16")):
+        b.add_node(node)
+    ctrl.sync()
+    assert [k for k in b.vcjobs if "blind-train-0-s" in k]

@@ -175,6 +175,7 @@ def main(argv=None) -> int:
             from volcano_tpu.cache.remote_cluster import RemoteCluster
             from volcano_tpu.controllers import hyperjob as hj_mod
             from volcano_tpu.server.tlsutil import load_token
+            fleet_token = load_token(args.token, args.token_file)
             remotes = {}
             for item in args.member_cluster:
                 name, sep, url = item.partition("=")
@@ -188,7 +189,7 @@ def main(argv=None) -> int:
                 # hub: the client self-heals and the hyperjob
                 # controller retries forwarding from its stored plan
                 remotes[name] = RemoteCluster(
-                    url, token=load_token(args.token, args.token_file),
+                    url, token=fleet_token,
                     ca_cert=args.ca_cert, insecure=args.insecure,
                     tolerate_unreachable=True)
             ctrl_overrides["hyperjob"] = \

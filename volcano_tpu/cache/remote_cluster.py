@@ -63,12 +63,16 @@ class RemoteCluster(Cluster):
         self.events: List[tuple] = []          # local record only
         try:
             self.resync()
-        except Exception:  # noqa: BLE001 — URLError, ConnectionError
+        except OSError as e:
+            # connection-level only (URLError is an OSError): auth and
+            # protocol failures (401 RemoteError, malformed payloads)
+            # are permanent config errors the watch loop can never
+            # heal — those must fail fast even in tolerant mode
             if not tolerate_unreachable:
                 raise
-            log.warning("state server %s unreachable at startup; "
+            log.warning("state server %s unreachable at startup (%s); "
                         "mirror starts empty and the watch loop will "
-                        "resync when it returns", self.base_url)
+                        "resync when it returns", self.base_url, e)
         self._watch_thread = None
         if start_watch:
             self._watch_thread = threading.Thread(

@@ -144,6 +144,11 @@ class ForwardingBinder:
              and job.name.startswith(prefix)),
             key=lambda j: j.name)
 
+    def get_member(self, namespace: str, name: str):
+        """Exact-name member lookup (prefix matching would confuse
+        hj-train-1 with hj-train-10), wherever it lives."""
+        return self.cluster.vcjobs.get(f"{namespace}/{name}")
+
     def submit(self, job: VCJob, domain: str) -> None:
         """Create the member job in whatever cluster owns *domain*."""
         if domain:
@@ -206,12 +211,35 @@ class MultiClusterBinder(ForwardingBinder):
                         and job.name.startswith(prefix))
         return sorted(jobs, key=lambda j: j.name)
 
+    def get_member(self, namespace: str, name: str):
+        key = f"{namespace}/{name}"
+        job = self.cluster.vcjobs.get(key)
+        if job is not None:
+            return job
+        for cluster in self.remotes.values():
+            job = cluster.vcjobs.get(key)
+            if job is not None:
+                return job
+        return None
+
     def submit(self, job: VCJob, domain: str) -> None:
         target = self.remotes.get(domain)
         if target is None:
             super().submit(job, domain)
             return
         job.annotations[FORWARD_DOMAIN_ANNOTATION] = domain
+        # LIVE existence check before creating: a stale (e.g. just-
+        # reconnected) mirror that misses a running member must not
+        # let a retry upsert-overwrite it with a fresh Pending job.
+        # If the resync fails the submit fails — the stored split
+        # plan retries next sync.
+        refresh = getattr(target, "resync", None)
+        if refresh is not None:
+            refresh()
+        if job.key in target.vcjobs:
+            log.info("member %s already exists in cluster %s",
+                     job.key, domain)
+            return
         target.add_vcjob(job)
         log.info("forwarded member %s to cluster %s", job.key, domain)
 
@@ -266,8 +294,8 @@ class HyperJobController(Controller):
                     split_total += planned
                     member_index += 1
                     continue
-                key = f"{hj.namespace}/{hj.member_name(rj, i)}"
-                member = self.cluster.vcjobs.get(key)
+                member = self.binder.get_member(
+                    hj.namespace, hj.member_name(rj, i))
                 if member is None and rj.template is not None:
                     member = self._deploy(hj, rj, i, member_index,
                                           allowed_domains)
@@ -323,6 +351,11 @@ class HyperJobController(Controller):
             if existing:
                 return existing, len(existing)  # pre-persistence: as-is
             plan = self._plan_splits(hj, rj, allowed_domains)
+            if plan is None:
+                # capacity view not ready (auto mode, blind mirrors):
+                # count one pending member so the HyperJob stays
+                # Pending, and replan next sync
+                return [], 1
             hj.split_plans[prefix] = [[d, list(pt)] for d, pt in plan]
             stored = hj.split_plans[prefix]
         have = {job.name for job in existing}
@@ -390,6 +423,13 @@ class HyperJobController(Controller):
                 free = self._domain_free_chips(acc)
             if allowed_domains:
                 free = {d: free.get(d, 0.0) for d in allowed_domains}
+            if not any(v > 0 for v in free.values()):
+                # zero VISIBLE capacity usually means the capacity
+                # view isn't there yet (member mirrors still syncing
+                # after a hub restart) — planning now would pin the
+                # whole replica on one arbitrary domain and persist
+                # that forever.  Defer; next sync replans.
+                return None
             ordered = sorted(free.items(), key=lambda kv: (-kv[1], kv[0]))
             budgets: List[tuple] = []
             remaining = total_chips
@@ -447,28 +487,33 @@ class HyperJobController(Controller):
             acc, lambda node: node.labels.get(DCN_POD_LABEL))
 
     def _deploy(self, hj: HyperJob, rj: ReplicatedJob, index: int,
-                member_index: int, allowed_domains: List[str]) -> VCJob:
+                member_index: int,
+                allowed_domains: List[str]) -> Optional[VCJob]:
         job = copy.deepcopy(rj.template)
         job.name = hj.member_name(rj, index)
         job.namespace = hj.namespace
         job.uid = new_uid()
-        if hj.max_domains > 0:
-            if job.network_topology is None:
-                # each member stays slice-local (ICI-coherent)
-                from volcano_tpu.api.podgroup import NetworkTopologySpec
-                from volcano_tpu.api.types import NetworkTopologyMode
-                job.network_topology = NetworkTopologySpec(
-                    NetworkTopologyMode.HARD, 1)
-            if allowed_domains:
-                # the SPREAD cap: pin member round-robin onto one of the
-                # allowed DCN pods via node affinity on the pod label
-                from volcano_tpu.controllers.hypernode import DCN_POD_LABEL
-                domain = allowed_domains[member_index % len(allowed_domains)]
-                for spec in job.tasks:
-                    template = spec.template_pod()
-                    template.affinity_node_terms = [
-                        {DCN_POD_LABEL: [domain]}]
-                    spec.template = template
-        self.cluster.add_vcjob(job)
-        log.info("hyperjob %s deployed member %s", hj.key, job.key)
+        domain = ""
+        if allowed_domains and (hj.max_domains > 0
+                                or self.binder.domains() is not None):
+            # the SPREAD cap: members round-robin over the allowed
+            # domains — DCN pods (affinity-pinned by the forwarding
+            # binder) or member clusters (created there outright)
+            domain = allowed_domains[member_index % len(allowed_domains)]
+        if hj.max_domains > 0 and job.network_topology is None:
+            # each member stays slice-local (ICI-coherent)
+            from volcano_tpu.api.podgroup import NetworkTopologySpec
+            from volcano_tpu.api.types import NetworkTopologyMode
+            job.network_topology = NetworkTopologySpec(
+                NetworkTopologyMode.HARD, 1)
+        try:
+            self.binder.submit(job, domain)
+        except Exception:  # noqa: BLE001 — a down member cluster:
+            # retried next sync (get_member still misses it)
+            log.warning("hyperjob %s member %s -> %s failed; will "
+                        "retry", hj.key, job.key, domain or "-",
+                        exc_info=True)
+            return None
+        log.info("hyperjob %s deployed member %s -> %s", hj.key,
+                 job.key, domain or "-")
         return job

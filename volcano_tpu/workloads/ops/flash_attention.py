@@ -274,15 +274,17 @@ def _from_bh(x, b, h):
     return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, block_q, block_k, block_q_bwd, block_k_bwd,
+           interpret):
     b, _, h, _ = q.shape
     out, _ = _flash_bh(_to_bh(q), _to_bh(k), _to_bh(v), block_q=block_q,
                        block_k=block_k, causal=causal, interpret=interpret)
     return _from_bh(out, b, h)
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, block_q, block_k, block_q_bwd,
+               block_k_bwd, interpret):
     b, _, h, _ = q.shape
     qb, kb, vb = _to_bh(q), _to_bh(k), _to_bh(v)
     out, lse = _flash_bh(qb, kb, vb, block_q=block_q, block_k=block_k,
@@ -290,12 +292,16 @@ def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
     return _from_bh(out, b, h), (qb, kb, vb, out, lse, b, h)
 
 
-def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
+def _flash_bwd(causal, block_q, block_k, block_q_bwd, block_k_bwd,
+               interpret, residuals, g):
     # flash backward kernels: dq over q-blocks, dk/dv over k-blocks,
-    # both skipping fully-masked blocks past the causal diagonal
+    # both skipping fully-masked blocks past the causal diagonal.
+    # Blocks are tuned separately from the forward pass: the bwd
+    # kernels hold more live tiles (p, dp, ds + accumulators), so the
+    # VMEM-optimal block is usually smaller than the fwd one.
     qb, kb, vb, out, lse, b, h = residuals
     dq, dk, dv = _flash_bh_bwd(qb, kb, vb, out, lse, _to_bh(g),
-                               block_q=block_q, block_k=block_k,
+                               block_q=block_q_bwd, block_k=block_k_bwd,
                                causal=causal, interpret=interpret)
     return (_from_bh(dq, b, h), _from_bh(dk, b, h), _from_bh(dv, b, h))
 
@@ -303,19 +309,44 @@ def _flash_bwd(causal, block_q, block_k, interpret, residuals, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _env_block(name: str, t: int, fallback: int) -> int:
+    """Block-size override from the environment (read at TRACE time:
+    the bench sweeps fwd/bwd block shapes without API churn)."""
+    import os
+    raw = os.environ.get(name, "")
+    if raw:
+        try:
+            b = int(raw)
+            if t % b == 0:
+                return b
+        except ValueError:
+            pass
+    return fallback
+
+
 def flash_attention(q, k, v, causal: bool = True,
                     block_q: Optional[int] = None,
                     block_k: Optional[int] = None,
+                    block_q_bwd: Optional[int] = None,
+                    block_k_bwd: Optional[int] = None,
                     interpret: bool = False):
     """Causal flash attention; q/k/v: [b, t, h, d] -> [b, t, h, d].
     Differentiable (custom VJP).  Block sizes default to the largest
-    power-of-two divisor of t up to 512 (see default_block)."""
+    power-of-two divisor of t up to 512 (see default_block); the
+    backward kernels take their own pair (env overrides FLASH_BLOCK /
+    FLASH_BLOCK_BWD for sweeps)."""
     b, t, h, d = q.shape
     if block_q is None:
-        block_q = default_block(t)
+        block_q = _env_block("FLASH_BLOCK", t, default_block(t))
     if block_k is None:
-        block_k = default_block(t)
-    if not supported(t, d, block_q, block_k):
+        block_k = _env_block("FLASH_BLOCK", t, default_block(t))
+    if block_q_bwd is None:
+        block_q_bwd = _env_block("FLASH_BLOCK_BWD", t, block_q)
+    if block_k_bwd is None:
+        block_k_bwd = _env_block("FLASH_BLOCK_BWD", t, block_k)
+    if not supported(t, d, block_q, block_k) or \
+            not supported(t, d, block_q_bwd, block_k_bwd):
         # fallback honors the causal flag (the jnp reference expression)
         return _reference(q, k, v, causal)
-    return _flash(q, k, v, causal, block_q, block_k, interpret)
+    return _flash(q, k, v, causal, block_q, block_k,
+                  block_q_bwd, block_k_bwd, interpret)
