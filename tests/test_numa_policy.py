@@ -1,0 +1,104 @@
+"""TopologyManager policy framework (reference plugins/numaaware/
+policy/policy_{best_effort,restricted,single_numa_node}_test.go
+translated to the cell-vector hint model in plugins/numa_policy.py).
+"""
+
+from volcano_tpu.api.numatopology import (
+    POLICY_BEST_EFFORT,
+    POLICY_NONE,
+    POLICY_RESTRICTED,
+    POLICY_SINGLE_NUMA,
+)
+from volcano_tpu.plugins.numa_policy import (
+    TopologyHint,
+    admit,
+    merge_hints,
+    merged_hint_for,
+    resource_hints,
+)
+
+
+def hint(cells, preferred):
+    return TopologyHint(None if cells is None else frozenset(cells),
+                        preferred)
+
+
+def test_resource_hints_prefer_minimal_width():
+    # one cell satisfies -> width-1 hints preferred, wider unpreferred
+    hints = resource_hints([4.0, 4.0], 3.0)
+    assert hint([0], True) in hints and hint([1], True) in hints
+    assert hint([0, 1], False) in hints
+    # nothing fits a single cell -> the PAIR is the minimal width and
+    # therefore preferred (kubelet cpumanager semantics)
+    hints = resource_hints([4.0, 4.0], 6.0)
+    assert hints == [hint([0, 1], True)]
+    # unsatisfiable -> no hints
+    assert resource_hints([4.0, 4.0], 100.0) == []
+    # zero need -> any-cell preference
+    assert resource_hints([4.0, 4.0], 0.0) == [hint(None, True)]
+
+
+def test_merge_intersects_and_narrowest_preferred_wins():
+    # cpu fits either cell, tpu only cell 1 -> merged {1} preferred
+    merged = merge_hints(2, [
+        [hint([0], True), hint([1], True), hint([0, 1], False)],
+        [hint([1], True)],
+    ])
+    assert merged == hint([1], True)
+    # disjoint single-cell prefs, RAW kubelet AND semantics (no
+    # validator): the narrowest non-empty intersection wins even
+    # though it under-covers one provider — merged_hint_for adds the
+    # satisfiability validator on top for admission decisions
+    merged = merge_hints(2, [
+        [hint([0], True), hint([0, 1], False)],
+        [hint([1], True), hint([0, 1], False)],
+    ])
+    assert merged.preferred is False and len(merged.mask) == 1
+    # with a validator the under-covering masks are dropped
+    merged = merge_hints(2, [
+        [hint([0], True), hint([0, 1], False)],
+        [hint([1], True), hint([0, 1], False)],
+    ], validate=lambda m: len(m) == 2)
+    assert merged == hint([0, 1], False)
+    # an unsatisfiable provider poisons preference but not viability
+    merged = merge_hints(2, [[hint([0], True)], []])
+    assert merged.preferred is False
+
+
+def test_policy_admission_matrix():
+    one_preferred = hint([0], True)
+    pair_preferred = hint([0, 1], True)
+    pair_unpreferred = hint([0, 1], False)
+    for policy in (POLICY_NONE, POLICY_BEST_EFFORT):
+        assert admit(policy, one_preferred)
+        assert admit(policy, pair_unpreferred)
+    # restricted: preferred at ANY width admits; unpreferred never
+    assert admit(POLICY_RESTRICTED, one_preferred)
+    assert admit(POLICY_RESTRICTED, pair_preferred)
+    assert not admit(POLICY_RESTRICTED, pair_unpreferred)
+    # single-numa: exactly one preferred cell
+    assert admit(POLICY_SINGLE_NUMA, one_preferred)
+    assert not admit(POLICY_SINGLE_NUMA, pair_preferred)
+    assert not admit(POLICY_SINGLE_NUMA, pair_unpreferred)
+
+
+def test_restricted_distinct_from_single_numa():
+    """The case the old ladder model got wrong: a request that MUST
+    span two NUMA nodes at minimal width is restricted-admissible but
+    single-numa-rejected."""
+    cells = [[4000.0, 2.0], [4000.0, 2.0]]      # (cpu_millis, chips)
+    merged, viable = merged_hint_for(cells, (6000.0, 3.0))
+    assert viable and merged == hint([0, 1], True)
+    assert admit(POLICY_RESTRICTED, merged)
+    assert not admit(POLICY_SINGLE_NUMA, merged)
+
+
+def test_cross_resource_intersection_denies_restricted():
+    """cpu's minimal home is cell 0, tpu's is cell 1 -> merged pair is
+    NOT preferred: restricted rejects even though each resource fits
+    some single cell."""
+    cells = [[4000.0, 0.0], [1000.0, 4.0]]
+    merged, viable = merged_hint_for(cells, (3000.0, 2.0))
+    assert viable and merged == hint([0, 1], False)
+    assert not admit(POLICY_RESTRICTED, merged)
+    assert admit(POLICY_BEST_EFFORT, merged)

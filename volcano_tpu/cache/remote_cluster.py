@@ -21,6 +21,7 @@ import logging
 import threading
 import urllib.error
 import urllib.request
+from http.client import HTTPException
 from typing import Callable, Dict, List, Optional
 
 from volcano_tpu.api import codec
@@ -63,12 +64,16 @@ class RemoteCluster(Cluster):
         self.events: List[tuple] = []          # local record only
         try:
             self.resync()
-        except OSError as e:
-            # connection-level only (URLError is an OSError): auth and
-            # protocol failures (401 RemoteError, malformed payloads)
-            # are permanent config errors the watch loop can never
-            # heal — those must fail fast even in tolerant mode
-            if not tolerate_unreachable:
+        except Exception as e:  # noqa: BLE001 — classified below
+            # Tolerable: anything the watch loop could heal once the
+            # server is back — connection failures (URLError IS an
+            # OSError), truncated/garbled responses (HTTPException),
+            # and server-side 5xx (a restarting proxy).  NOT
+            # tolerable: 4xx auth/config errors — every retry would
+            # 401 forever, so fail fast even in tolerant mode.
+            transient = isinstance(e, (OSError, HTTPException)) or \
+                (isinstance(e, RemoteError) and e.code >= 500)
+            if not tolerate_unreachable or not transient:
                 raise
             log.warning("state server %s unreachable at startup (%s); "
                         "mirror starts empty and the watch loop will "

@@ -186,6 +186,14 @@ class MultiClusterBinder(ForwardingBinder):
     def __init__(self, cluster, remotes: dict):
         super().__init__(cluster)
         self.remotes = dict(remotes)
+        self._fresh: set = set()     # targets resynced this cycle
+
+    def begin_cycle(self) -> None:
+        """Controller sync start: forget which targets were resynced —
+        submit() refreshes each target at most ONCE per cycle instead
+        of once per member (an N-way split is N full-snapshot fetches
+        otherwise)."""
+        self._fresh.clear()
 
     def domains(self) -> List[str]:
         return sorted(self.remotes)
@@ -231,11 +239,14 @@ class MultiClusterBinder(ForwardingBinder):
         # LIVE existence check before creating: a stale (e.g. just-
         # reconnected) mirror that misses a running member must not
         # let a retry upsert-overwrite it with a fresh Pending job.
-        # If the resync fails the submit fails — the stored split
-        # plan retries next sync.
+        # At most one resync per target per controller cycle (the
+        # local echo of our own creates keeps the mirror current for
+        # the rest of the cycle).  If the resync fails the submit
+        # fails — the stored split plan retries next sync.
         refresh = getattr(target, "resync", None)
-        if refresh is not None:
+        if refresh is not None and domain not in self._fresh:
             refresh()
+            self._fresh.add(domain)
         if job.key in target.vcjobs:
             log.info("member %s already exists in cluster %s",
                      job.key, domain)
@@ -259,6 +270,9 @@ class HyperJobController(Controller):
             self.binder = ForwardingBinder(cluster)
 
     def sync(self) -> None:
+        begin = getattr(self.binder, "begin_cycle", None)
+        if begin is not None:
+            begin()
         for hj in list(self.cluster.hyperjobs.values()):
             try:
                 self.sync_hyperjob(hj)
@@ -280,12 +294,21 @@ class HyperJobController(Controller):
         phases: List[Optional[JobPhase]] = []
         member_index = 0
         split_total = 0
+        deferred = False
         for rj in hj.replicated_jobs:
             for i in range(rj.replicas):
                 if rj.split_policy is not None and rj.template is not None:
                     members, planned = self._sync_split_replica(
                         hj, rj, i, allowed_domains)
                     phases.extend(m.phase for m in members)
+                    if planned is None:
+                        # plan deferred (blind capacity view): totals
+                        # are unknowable, so phase math must not run
+                        # this cycle — min_available vs a guessed
+                        # total could flip the job terminal FAILED
+                        deferred = True
+                        member_index += 1
+                        continue
                     # planned-but-undeployed members (a domain was
                     # down) count toward total as not-yet-running —
                     # a partial deploy must stay Pending, never flip
@@ -303,6 +326,8 @@ class HyperJobController(Controller):
                 split_total += 1
                 phases.append(member.phase if member else None)
         hj.split_count = split_total
+        if deferred:
+            return      # totals unknown this cycle: stay as-is
 
         running = sum(1 for p in phases if p is JobPhase.RUNNING)
         completed = sum(1 for p in phases if p is JobPhase.COMPLETED)
@@ -353,9 +378,10 @@ class HyperJobController(Controller):
             plan = self._plan_splits(hj, rj, allowed_domains)
             if plan is None:
                 # capacity view not ready (auto mode, blind mirrors):
-                # count one pending member so the HyperJob stays
-                # Pending, and replan next sync
-                return [], 1
+                # planned count UNKNOWN — phase reconciliation must
+                # not run failure math against a guess (None planned
+                # defers the phase decision); replan next sync
+                return [], None
             hj.split_plans[prefix] = [[d, list(pt)] for d, pt in plan]
             stored = hj.split_plans[prefix]
         have = {job.name for job in existing}

@@ -88,6 +88,9 @@ class NumaAwarePlugin(Plugin):
         self._cell_caps: Dict[str, List[List[float]]] = {}
         # task uid -> [(node, cell index, cpu, tpu)] for exact reversal
         self._deducted: Dict[str, List[Tuple[str, int, float, float]]] = {}
+        # node -> {needs: merged hint} (see _merged_hint; dropped on
+        # any cell mutation for that node)
+        self._hint_cache: Dict[str, dict] = {}
         # running victims evicted this session: their consumption was
         # never in our cells (exporter free excludes running pods), so
         # eviction CREDITS their request to the least-free cell (in the
@@ -147,6 +150,7 @@ class NumaAwarePlugin(Plugin):
                 if cells and i < len(cells):
                     cells[i][0] -= cpu
                     cells[i][1] -= tpu
+                self._hint_cache.pop(node_name, None)
             return
         node = self._ssn.nodes.get(task.node_name)
         if node is None:
@@ -162,6 +166,7 @@ class NumaAwarePlugin(Plugin):
         # running-victim credit path and fabricating free space
         self._deducted.setdefault(task.uid, []).extend(
             (node.name, i, cpu, tpu) for i, cpu, tpu in taken)
+        self._hint_cache.pop(node.name, None)
 
     def _on_deallocate(self, event) -> None:
         taken = self._deducted.pop(event.task.uid, None)
@@ -194,6 +199,7 @@ class NumaAwarePlugin(Plugin):
                 tpu = min(tpu, max(0.0, caps[i][1] - cells[i][1]))
             cells[i][0] += cpu
             cells[i][1] += tpu
+            self._hint_cache.pop(node.name, None)
             self._credited.setdefault(task.uid, []).append(
                 (node.name, i, cpu, tpu))
             return
@@ -202,6 +208,7 @@ class NumaAwarePlugin(Plugin):
             if cells and i < len(cells):
                 cells[i][0] += cpu
                 cells[i][1] += tpu
+            self._hint_cache.pop(node_name, None)
 
     # -- policy -------------------------------------------------------
 
@@ -222,41 +229,63 @@ class NumaAwarePlugin(Plugin):
         return order[max(pod_rank, node_rank)]
 
     @staticmethod
-    def _fits_single_numa(task: TaskInfo, cells) -> bool:
-        need_cpu = task.resreq.milli_cpu
-        need_tpu = task.resreq.get(TPU)
-        return any(need_cpu <= cpu_free and need_tpu <= tpu_free
-                   for cpu_free, tpu_free in cells)
+    def _needs(task: TaskInfo):
+        return (task.resreq.milli_cpu, task.resreq.get(TPU))
 
     # -- session hooks ------------------------------------------------
 
+    def _merged_hint(self, node: NodeInfo, cells, needs):
+        """Session-memoized merged hint: the subset enumeration is
+        combinatorial and _predicate + _score would otherwise compute
+        it twice per (task, node); cell mutations (allocate /
+        deallocate / credit) invalidate the node's entries."""
+        per_node = self._hint_cache.setdefault(node.name, {})
+        hint = per_node.get(needs)
+        if hint is None:
+            from volcano_tpu.plugins import numa_policy
+            hint = numa_policy.merged_hint_for(cells, needs)
+            per_node[needs] = hint
+        return hint
+
     def _predicate(self, task: TaskInfo, node: NodeInfo):
-        if self._effective_policy(task, node) not in _GATING:
+        policy = self._effective_policy(task, node)
+        if policy not in _GATING:
+            return None
+        needs = self._needs(task)
+        if all(n <= 0 for n in needs):
+            # no alignable resources -> no hint providers -> admit
+            # (kubelet admits hint-less pods under every policy)
             return None
         cells = self._live_cells(node)
         if cells is None:
             return None  # no topology published: don't block
-        if not self._fits_single_numa(task, cells):
-            # resolvable only if some cell's CAPACITY could hold the
-            # request — then eviction can free it (see _on_deallocate
-            # crediting).  A request bigger than every cell can never
-            # be cured by evicting victims; marking it resolvable
-            # would make preempt kill fresh victims every cycle.
-            return unschedulable(
-                "request cannot fit a single NUMA node", "numaaware",
-                resolvable=self._fits_capacity(task, node),
-                evict_curable=True)
-        return None
+        from volcano_tpu.plugins import numa_policy
+        hint, _ = self._merged_hint(node, cells, needs)
+        if numa_policy.admit(policy, hint):
+            return None
+        # resolvable only if the CAPACITY view could admit — then
+        # eviction can free it (see _on_deallocate crediting).  A
+        # request no cell layout can ever admit cannot be cured by
+        # evicting victims; marking it resolvable would make preempt
+        # kill fresh victims every cycle.
+        reason = ("request cannot fit a single NUMA node"
+                  if policy == POLICY_SINGLE_NUMA else
+                  "no preferred (minimal-width) NUMA assignment")
+        return unschedulable(
+            reason, "numaaware",
+            resolvable=self._capacity_admits(task, node, policy),
+            evict_curable=True)
 
-    def _fits_capacity(self, task: TaskInfo, node: NodeInfo) -> bool:
-        """Could ANY cell ever hold this request?  Only a published
-        capacity_res can prove 'never' — published free values exclude
-        running victims, so without capacity data we stay permissive
-        (resolvable) and rely on the eviction-cure re-check in
-        preempt/reclaim to roll back evictions that don't help.
-        Ceilings are reserved-adjusted, mirroring _build_cells, so
-        preemption can never place into kubelet-reserved headroom that
-        the normal allocate path refuses."""
+    def _capacity_admits(self, task: TaskInfo, node: NodeInfo,
+                         policy: str) -> bool:
+        """Could the policy EVER admit this request on this node?
+        Only a published capacity_res can prove 'never' — published
+        free values exclude running victims, so without capacity data
+        we stay permissive (resolvable) and rely on the eviction-cure
+        re-check in preempt/reclaim to roll back evictions that don't
+        help.  Ceilings are reserved-adjusted, mirroring _build_cells,
+        so preemption can never place into kubelet-reserved headroom
+        that the normal allocate path refuses."""
         caps = self._cell_caps.get(node.name)
         if caps is None:
             topo = self._topologies.get(node.name)
@@ -266,15 +295,26 @@ class NumaAwarePlugin(Plugin):
             caps = self._cell_caps.get(node.name)
             if caps is None:
                 return True
-        need_cpu = task.resreq.milli_cpu
-        need_tpu = task.resreq.get(TPU)
-        return any(need_cpu <= cap_cpu and need_tpu <= cap_tpu
-                   for cap_cpu, cap_tpu in caps)
+        from volcano_tpu.plugins import numa_policy
+        needs = self._needs(task)
+        if all(n <= 0 for n in needs):
+            return True
+        hint, _ = numa_policy.merged_hint_for(caps, needs)
+        return numa_policy.admit(policy, hint)
 
     def _score(self, task: TaskInfo, node: NodeInfo) -> float:
+        """Narrower merged affinity scores higher (best-effort places
+        by hint even though it never rejects); preferred assignments
+        beat unpreferred at any width."""
         if self._effective_policy(task, node) not in _KNOWN:
             return 0.0
-        cells = self._live_cells(node)
-        if cells is None:
+        needs = self._needs(task)
+        if all(n <= 0 for n in needs):
             return 0.0
-        return MAX_SCORE if self._fits_single_numa(task, cells) else 0.0
+        cells = self._live_cells(node)
+        if not cells:
+            return 0.0
+        hint, _ = self._merged_hint(node, cells, needs)
+        width = len(hint.mask) if hint.mask is not None else len(cells)
+        score = MAX_SCORE * (len(cells) - width + 1) / len(cells)
+        return score if hint.preferred else score / 2
