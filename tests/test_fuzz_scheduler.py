@@ -127,6 +127,17 @@ def test_fuzz_full_contention_pipeline():
                 for i in range(n):
                     cluster.delete_pod(f"default/{name}-{i}")
                 cluster.podgroups.pop(pg.key, None)
+            elif 0.75 <= op < 0.85:
+                # control-kind churn: a priority class vanishes and
+                # returns with a FLIPPED value mid-flight — the
+                # incremental snapshot must rebuild job priorities,
+                # never preempt/order on a stale one (r4 *_deleted
+                # invalidation path)
+                victim = rng.choice(("high", "low"))
+                old = cluster.priority_classes[victim].value
+                cluster.delete_object("priority_class", victim)
+                cluster.add_priority_class(PriorityClass(
+                    name=victim, value=1010 - old))
             sched.run_once()
             cluster.tick()
             check_invariants(cluster)
