@@ -299,9 +299,12 @@ def test_mutate_webhooks_run_server_side(wire):
         QUEUE_NAME_NAMESPACE_ANNOTATION)
     a = wire.client()
     b = wire.client()
-    a.put_object("queue", Queue(name="ml", weight=0, annotations={
+    created = a.put_object("queue", Queue(name="ml", weight=0,
+                           annotations={
         HIERARCHY_ANNOTATION: "eng/ml",
         HIERARCHY_WEIGHTS_ANNOTATION: "3/1"}))
+    # the CREATING client's echo carries the server-side mutation
+    assert created.weight == 1 and a.queues["ml"].weight == 1
     a.put_object("namespace",
                  {QUEUE_NAME_NAMESPACE_ANNOTATION: "ml"}, key="team")
     a.put_object("podgroup",
