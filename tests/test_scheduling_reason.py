@@ -1,0 +1,123 @@
+"""Per-pod scheduling reasons (reference docs/design/
+scheduling-reason.md): blockers get Unschedulable + WHY (fit-error
+histogram or queue-share), fitting tasks get Schedulable + the gang
+explanation — so users and autoscalers see which task breaks the
+cycle."""
+
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.types import PodGroupPhase, TaskStatus
+from volcano_tpu.framework.job_updater import (
+    REASON_SCHEDULABLE,
+    REASON_UNSCHEDULABLE,
+    SCHEDULING_REASON_ANNOTATION,
+)
+from volcano_tpu.uthelper import TestContext, gang_job
+
+
+def reasons_and_msgs(cluster, prefix):
+    reasons, msgs = {}, {}
+    for p in cluster.pods.values():
+        if p.name.startswith(prefix):
+            reasons[p.name] = p.annotations.get(
+                SCHEDULING_REASON_ANNOTATION)
+            msgs[p.name] = p.status_message
+    return reasons, msgs
+
+
+def test_queue_share_blocker_reason():
+    """Gang of 3x6cpu on a 16-cpu cluster: two tasks fit, the third
+    exceeds the queue's deserved share — and says so."""
+    nodes = [Node(name=f"n{i}", allocatable={"cpu": 8, "pods": 110})
+             for i in range(2)]
+    pg, pods = gang_job("gangy", replicas=3, min_available=3,
+                        requests={"cpu": 6})
+    ctx = TestContext(nodes=nodes, podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(0)          # gang can't fully place
+
+    reasons, msgs = reasons_and_msgs(ctx.cluster, "gangy")
+    assert sorted(reasons.values()) == [
+        REASON_SCHEDULABLE, REASON_SCHEDULABLE, REASON_UNSCHEDULABLE], \
+        reasons
+    blocker = next(n for n, r in reasons.items()
+                   if r == REASON_UNSCHEDULABLE)
+    fitting = next(n for n, r in reasons.items()
+                   if r == REASON_SCHEDULABLE)
+    assert "deserved share" in msgs[blocker], msgs[blocker]
+    assert "gang is not ready" in msgs[fitting], msgs[fitting]
+    assert "1 of 3" in msgs[fitting]
+
+    # steady state: a second cycle publishes NOTHING new (no churn)
+    calls = []
+    orig = ctx.cluster.put_object
+    ctx.cluster.put_object = lambda *a, **k: (calls.append(a),
+                                              orig(*a, **k))[1]
+    ctx.run()
+    assert not [c for c in calls if c and c[0] == "pod"], calls
+
+
+def test_insufficient_resources_blocker_histogram():
+    """Queue share is ample but no node has the idle cpu: the blocker
+    carries the per-node Insufficient-cpu histogram (the path that
+    previously recorded NOTHING — predicates passed, resources
+    didn't)."""
+    nodes = [Node(name=f"n{i}", allocatable={"cpu": 8, "pods": 110})
+             for i in range(3)]
+    # a running occupant pins n2 at 4 idle cpu
+    squatter_pg, squatters = gang_job(
+        "squat", replicas=1, min_available=0, requests={"cpu": 4},
+        running_on=["n2"], pg_phase=PodGroupPhase.RUNNING)
+    pg, pods = gang_job("gangy", replicas=3, min_available=3,
+                        requests={"cpu": 6})
+    ctx = TestContext(nodes=nodes, podgroups=[squatter_pg, pg],
+                      pods=squatters + pods)
+    ctx.run()
+    assert not any(p.node_name for p in ctx.cluster.pods.values()
+                   if p.name.startswith("gangy")
+                   and p.phase is TaskStatus.BOUND)
+
+    reasons, msgs = reasons_and_msgs(ctx.cluster, "gangy")
+    assert sorted(reasons.values()) == [
+        REASON_SCHEDULABLE, REASON_SCHEDULABLE, REASON_UNSCHEDULABLE], \
+        reasons
+    blocker = next(n for n, r in reasons.items()
+                   if r == REASON_UNSCHEDULABLE)
+    assert "Insufficient cpu" in msgs[blocker], msgs[blocker]
+    assert "node(s)" in msgs[blocker]
+
+
+def test_no_reason_noise_on_success():
+    """A gang that fully places gets no Unschedulable noise."""
+    nodes = [Node(name=f"n{i}", allocatable={"cpu": 8, "pods": 110})
+             for i in range(3)]
+    pg, pods = gang_job("fits", replicas=3, min_available=3,
+                        requests={"cpu": 6})
+    ctx = TestContext(nodes=nodes, podgroups=[pg], pods=pods)
+    ctx.run()
+    ctx.expect_bind_num(3)
+    for p in ctx.cluster.pods.values():
+        assert SCHEDULING_REASON_ANNOTATION not in p.annotations
+
+
+def test_stale_reasons_cleared_after_gang_places():
+    """A previously-blocked gang that later places must drop its
+    Unschedulable/Schedulable reasons — autoscalers key on the
+    Unschedulable reason and would scale for an already-running job."""
+    nodes = [Node(name=f"n{i}", allocatable={"cpu": 8, "pods": 110})
+             for i in range(2)]
+    pg, pods = gang_job("wavy", replicas=3, min_available=3,
+                        requests={"cpu": 6})
+    ctx = TestContext(nodes=nodes, podgroups=[pg], pods=pods)
+    ctx.run()
+    reasons, _ = reasons_and_msgs(ctx.cluster, "wavy")
+    assert REASON_UNSCHEDULABLE in reasons.values()
+
+    # capacity arrives; the gang binds on the next cycle
+    ctx.cluster.add_node(Node(name="n2",
+                              allocatable={"cpu": 8, "pods": 110}))
+    ctx.run()
+    ctx.expect_bind_num(3)
+    for p in ctx.cluster.pods.values():
+        assert SCHEDULING_REASON_ANNOTATION not in p.annotations, \
+            f"{p.name} kept a stale reason"
+        assert p.status_message == ""

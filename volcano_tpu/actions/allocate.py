@@ -16,7 +16,8 @@ import time
 from typing import Dict, List, Optional
 
 from volcano_tpu import metrics
-from volcano_tpu.api.fit_error import FitError, FitErrors
+from volcano_tpu.api.fit_error import (FitError, FitErrors,
+                                       unschedulable)
 from volcano_tpu.api.job_info import JobInfo, TaskInfo
 from volcano_tpu.api.types import PodGroupPhase, TaskStatus
 from volcano_tpu.framework.plugins import Action, register_action
@@ -30,6 +31,30 @@ from volcano_tpu.actions.util import (
 )
 
 log = logging.getLogger(__name__)
+
+
+def _record_insufficient(job, task, fit_nodes, spec_memo) -> None:
+    """Per-node insufficient-resource fit errors for a task whose
+    predicates passed but that fit NO node's idle or future-idle
+    (the `1 node(s) Insufficient cpu`-style histogram).  fit_nodes
+    may be the cached dict form ({name: node}) or a list.  The
+    histogram is identical across a gang's identical siblings, so it
+    is computed once per task_spec and replayed (the future_idle
+    clone per node is the expensive part)."""
+    entries = spec_memo.get(task.task_spec) if task.task_spec else None
+    if entries is None:
+        nodes = (fit_nodes.values() if isinstance(fit_nodes, dict)
+                 else fit_nodes)
+        entries = []
+        for node in nodes:
+            missing = node.future_idle().fit_delta(task.resreq)
+            dims = ", ".join(sorted(missing.res)) or "resources"
+            entries.append((node.name, f"Insufficient {dims}"))
+        if task.task_spec:
+            spec_memo[task.task_spec] = entries
+    for node_name, reason in entries:
+        job.record_fit_error(task, node_name, FitError(
+            task, node_name, statuses=[unschedulable(reason)]))
 
 
 class AllocateAction(Action):
@@ -183,6 +208,7 @@ class AllocateAction(Action):
         # is what takes a 1024-host gang over 5k hosts from ~9s to
         # well under a second.
         spec_cache: Dict[str, dict] = {}
+        insufficient_memo: Dict[str, list] = {}
         # Heap fast path is exact when every enabled BatchNodeOrder
         # plugin also provides the leaf-grouped form (scores constant
         # within a node group): the per-group heaps stay ordered by the
@@ -289,7 +315,14 @@ class AllocateAction(Action):
                 continue
             if not ssn.allocatable(queue, task):
                 # skip just this task: a smaller sibling may still fit the
-                # queue's share (allocate.go:744-747 uses continue)
+                # queue's share (allocate.go:744-747 uses continue).
+                # RECORD the reason: without it the pod shows nothing
+                # at all (scheduling-reason.md)
+                if record_errors:
+                    errs = job.fit_errors.setdefault(task.uid,
+                                                     FitErrors())
+                    errs.set_error(f"task would exceed queue "
+                                   f"{queue.name}'s deserved share")
                 log.debug("queue %s quota exhausted for task %s",
                           queue.name, task.key)
                 continue
@@ -348,8 +381,17 @@ class AllocateAction(Action):
                     invalidate(node)
                 continue
 
-            if record_errors and not fit_nodes:
-                failed_specs.add(task.task_spec)
+            if record_errors:
+                if not fit_nodes:
+                    failed_specs.add(task.task_spec)
+                else:
+                    # predicates passed somewhere but nothing had the
+                    # resources (now or releasing): without an explicit
+                    # record the task shows NO reason at all — the
+                    # reference surfaces per-node "Insufficient cpu"
+                    # entries here (node_info.go FutureIdle checks)
+                    _record_insufficient(job, task, fit_nodes,
+                                         insufficient_memo)
         return placed
 
 

@@ -95,18 +95,20 @@ class FitErrors:
         self.err = err
 
     def error(self) -> str:
-        if self.err:
-            return self.err
         # Compress to "N node(s) reason" histogram like the reference.
+        # A job/queue-level err is PREFIXED, not exclusive: per-node
+        # entries recorded later in the session (preempt/reclaim
+        # retries) must stay visible.
         reason_counts = Counter()
         for fe in self.nodes.values():
             for r in set(fe.reasons()) or {"node(s) didn't fit"}:
                 reason_counts[r] += 1
         if not reason_counts:
-            return "no fit errors recorded"
+            return self.err or "no fit errors recorded"
         parts = [f"{n} node(s) {r}" for r, n in
                  sorted(reason_counts.items(), key=lambda kv: (-kv[1], kv[0]))]
-        return f"all nodes are unavailable: {', '.join(parts)}."
+        histogram = f"all nodes are unavailable: {', '.join(parts)}."
+        return f"{self.err}; {histogram}" if self.err else histogram
 
     def __str__(self):
         return self.error()

@@ -26,6 +26,7 @@ from volcano_tpu.api.queue import Queue
 from volcano_tpu.api.resource import TPU
 from volcano_tpu.api.types import JobPhase
 from volcano_tpu.api.vcjob import TaskSpec, VCJob
+from volcano_tpu.framework.job_updater import SCHEDULING_REASON_ANNOTATION
 
 
 def _load(path: str):
@@ -129,7 +130,14 @@ def cmd_job_view(cluster, args):
                   for t in job.tasks],
         "message": job.state_message,
         "pods": [{"name": p.name, "phase": p.phase.value,
-                  "node": p.node_name}
+                  "node": p.node_name,
+                  # per-pod scheduling reason (scheduling-reason.md):
+                  # which task blocks the gang, and why
+                  **({"schedulingReason":
+                          p.annotations[SCHEDULING_REASON_ANNOTATION],
+                      "message": p.status_message}
+                     if SCHEDULING_REASON_ANNOTATION in p.annotations
+                     and not p.node_name else {})}
                  for p in cluster.pods.values() if p.owner == job.uid],
     }
     print(json.dumps(out, indent=2))

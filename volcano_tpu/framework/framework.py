@@ -45,6 +45,7 @@ def close_session(ssn: Session) -> None:
                         plugin=name, point="close")
     job_updater.update_job_statuses(ssn)
     job_updater.remove_admission_gates(ssn)
+    job_updater.publish_scheduling_reasons(ssn)
     # session mutations invalidate snapshot reuse for the objects they
     # touched, whether the ops committed or were discarded
     note = getattr(ssn.cache, "note_touched", None)

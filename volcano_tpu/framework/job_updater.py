@@ -34,6 +34,84 @@ def remove_admission_gates(ssn) -> int:
     return removed
 
 
+# per-pod scheduling reason (reference docs/design/scheduling-reason.md:
+# pod.status.conditions["PodScheduled"] reasons; autoscalers key on
+# "Unschedulable" specifically)
+SCHEDULING_REASON_ANNOTATION = "volcano-tpu.io/scheduling-reason"
+REASON_UNSCHEDULABLE = "Unschedulable"
+REASON_SCHEDULABLE = "Schedulable"
+
+
+def publish_scheduling_reasons(ssn) -> int:
+    """Per-POD scheduling reasons for gang-blocked jobs (reference
+    docs/design/scheduling-reason.md): users (and autoscalers) must
+    see WHICH task breaks the cycle and why — the tasks that fit get
+    `Schedulable` ("can be scheduled, waiting for the gang"), the
+    blockers get `Unschedulable` with the per-node fit-error
+    histogram.  Written only on change: the message stabilizes after
+    one session, so steady pending jobs cost no wire traffic."""
+    published = 0
+    for job in ssn.jobs.values():
+        pg = job.podgroup
+        gang_blocked = (pg is not None
+                        and pg.phase in (PodGroupPhase.PENDING,
+                                         PodGroupPhase.INQUEUE)
+                        and (job.fit_errors or job.job_fit_errors))
+        if not gang_blocked:
+            # CLEAR stale reasons: a bound/running pod still carrying
+            # Unschedulable would make autoscalers scale up for a job
+            # that already placed
+            for task in job.tasks.values():
+                pod = task.pod
+                if SCHEDULING_REASON_ANNOTATION in pod.annotations:
+                    del pod.annotations[SCHEDULING_REASON_ANNOTATION]
+                    pod.status_message = ""
+                    ssn.cache.cluster.put_object("pod", pod)
+                    published += 1
+            continue
+        pending = list(job.tasks_in_status(TaskStatus.PENDING))
+        blocked = sum(1 for t in pending
+                      if t.uid in job.fit_errors)
+        for task in pending:
+            errs = job.fit_errors.get(task.uid)
+            if errs is not None:
+                reason, message = REASON_UNSCHEDULABLE, errs.error()
+            elif not job.fit_errors and job.job_fit_errors is not None:
+                # a JOB-level failure only when no per-task detail
+                # exists (job_fit_errors is also set as a summary OF
+                # per-task errors — that must not paint the tasks
+                # that fit as Unschedulable)
+                reason = REASON_UNSCHEDULABLE
+                message = job.job_fit_errors.error()
+            else:
+                reason = REASON_SCHEDULABLE
+                message = (f"pod can be scheduled, but the gang is "
+                           f"not ready: {blocked} of {len(pending)} "
+                           f"pending task(s) unschedulable "
+                           f"(minAvailable={job.min_available})")
+            pod = task.pod
+            if pod.annotations.get(SCHEDULING_REASON_ANNOTATION) == \
+                    reason and pod.status_message == message:
+                continue
+            pod.annotations[SCHEDULING_REASON_ANNOTATION] = reason
+            pod.status_message = message
+            ssn.cache.cluster.put_object("pod", pod)
+            published += 1
+        # tasks of a still-blocked job that DID place (pipelined /
+        # partially bound) must not keep a stale pending-time reason
+        pending_uids = {t.uid for t in pending}
+        for task in job.tasks.values():
+            if task.uid in pending_uids:
+                continue
+            pod = task.pod
+            if SCHEDULING_REASON_ANNOTATION in pod.annotations:
+                del pod.annotations[SCHEDULING_REASON_ANNOTATION]
+                pod.status_message = ""
+                ssn.cache.cluster.put_object("pod", pod)
+                published += 1
+    return published
+
+
 def update_job_statuses(ssn) -> int:
     """Recompute + push PodGroup status for jobs dirtied this session."""
     updated = 0
