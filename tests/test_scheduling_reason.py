@@ -121,3 +121,68 @@ def test_stale_reasons_cleared_after_gang_places():
         assert SCHEDULING_REASON_ANNOTATION not in p.annotations, \
             f"{p.name} kept a stale reason"
         assert p.status_message == ""
+
+
+def test_agent_scheduler_publishes_park_reason():
+    """The fast path stamps a why-not at park time and clears it on a
+    later successful bind."""
+    from volcano_tpu.agentscheduler import AgentScheduler
+    from volcano_tpu.api.shard import AGENT_SCHEDULER
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="n0", allocatable={"cpu": 2, "pods": 10}))
+    sched = AgentScheduler(cluster)
+    pod = make_pod("big", requests={"cpu": 8})
+    pod.scheduler_name = AGENT_SCHEDULER
+    cluster.add_pod(pod)
+    sched.run_until_drained()
+    assert pod.annotations[SCHEDULING_REASON_ANNOTATION] == \
+        REASON_UNSCHEDULABLE
+    assert "static filters" in pod.status_message
+
+    # capacity arrives: parked pod reactivates, binds, reason cleared
+    cluster.add_node(Node(name="n1", allocatable={"cpu": 16,
+                                                  "pods": 10}))
+    assert sched.run_until_drained() == 1
+    assert SCHEDULING_REASON_ANNOTATION not in pod.annotations
+    assert pod.status_message == ""
+
+
+def test_failed_spec_siblings_all_report_unschedulable():
+    """Identical siblings of a spec that failed everywhere share the
+    representative's errors — the spec memoization must not mislabel
+    them Schedulable (an autoscaler would undercount by n-1)."""
+    nodes = [Node(name="n0", allocatable={"cpu": 8, "pods": 110})]
+    pg, pods = gang_job("sel", replicas=3, min_available=3,
+                        requests={"cpu": 1})
+    for p in pods:
+        p.node_selector = {"zone": "nowhere"}
+    ctx = TestContext(nodes=nodes, podgroups=[pg], pods=pods)
+    ctx.run()
+    reasons, msgs = reasons_and_msgs(ctx.cluster, "sel")
+    assert list(reasons.values()) == [REASON_UNSCHEDULABLE] * 3, reasons
+    assert all("selector" in m or "node(s)" in m
+               for m in msgs.values()), msgs
+
+
+def test_reasons_survive_skipped_sessions():
+    """A session that never ATTEMPTS the job (queue overused) must not
+    blank the previously-published reasons of still-pending pods."""
+    from volcano_tpu.api.queue import Queue
+    from volcano_tpu.api.resource import TPU
+    nodes = [Node(name="n0", allocatable={"cpu": 8, "pods": 110})]
+    pg, pods = gang_job("kept", replicas=2, min_available=2,
+                        requests={"cpu": 6})
+    ctx = TestContext(nodes=nodes, podgroups=[pg], pods=pods)
+    ctx.run()
+    reasons, _ = reasons_and_msgs(ctx.cluster, "kept")
+    assert REASON_UNSCHEDULABLE in reasons.values()
+
+    # next cycle the job is not attempted (simulate: second run with
+    # nothing changed still keeps reasons; the no-churn check in
+    # test_queue_share_blocker_reason covers the attempted case)
+    ctx.run()
+    reasons2, _ = reasons_and_msgs(ctx.cluster, "kept")
+    assert reasons2 == reasons

@@ -209,6 +209,7 @@ class AllocateAction(Action):
         # well under a second.
         spec_cache: Dict[str, dict] = {}
         insufficient_memo: Dict[str, list] = {}
+        spec_error_rep: Dict[str, str] = {}   # failed spec -> task uid
         # Heap fast path is exact when every enabled BatchNodeOrder
         # plugin also provides the leaf-grouped form (scores constant
         # within a node group): the per-group heaps stay ordered by the
@@ -311,7 +312,15 @@ class AllocateAction(Action):
             t_task = time.perf_counter()
             if task.task_spec in failed_specs:
                 # identical spec already failed everywhere this round
-                # (fit-error memoization, allocate.go TaskHasFitErrors)
+                # (fit-error memoization, allocate.go TaskHasFitErrors).
+                # Share the representative's recorded errors so the
+                # sibling is REPORTED as a blocker too, not mislabeled
+                # Schedulable by the reason publisher
+                if record_errors:
+                    rep = spec_error_rep.get(task.task_spec)
+                    if rep is not None and rep in job.fit_errors:
+                        job.fit_errors.setdefault(
+                            task.uid, job.fit_errors[rep])
                 continue
             if not ssn.allocatable(queue, task):
                 # skip just this task: a smaller sibling may still fit the
@@ -333,6 +342,7 @@ class AllocateAction(Action):
                     job.record_fit_error(task, "",
                                          FitError(task, None,
                                                   statuses=[status]))
+                    spec_error_rep.setdefault(task.task_spec, task.uid)
                 failed_specs.add(task.task_spec)
                 continue
 
@@ -384,6 +394,7 @@ class AllocateAction(Action):
             if record_errors:
                 if not fit_nodes:
                     failed_specs.add(task.task_spec)
+                    spec_error_rep.setdefault(task.task_spec, task.uid)
                 else:
                     # predicates passed somewhere but nothing had the
                     # resources (now or releasing): without an explicit

@@ -27,6 +27,10 @@ from typing import Dict, List, Optional, Tuple
 
 from volcano_tpu.api.job_info import TaskInfo
 from volcano_tpu.api.node_info import NodeInfo
+from volcano_tpu.framework.job_updater import (
+    REASON_UNSCHEDULABLE,
+    SCHEDULING_REASON_ANNOTATION,
+)
 from volcano_tpu.api.shard import (
     AGENT_SCHEDULER,
     SHARD_MODE_HARD,
@@ -428,6 +432,19 @@ class AgentScheduler:
             return [(n, n.bind_generation)
                     for n in self._candidate_nodes(task)]
 
+    def _unschedulable_reason(self, task) -> str:
+        """Compact why-not for a pod with zero candidates, from the
+        spec-cache view (O(1) — the entry was just computed)."""
+        entry = self._spec_entry(task)
+        total = len(self.nodes)
+        static_ok = len(entry.scores)
+        if static_ok == 0:
+            return (f"0/{total} node(s) pass static filters "
+                    f"(selector/affinity/taints/device shape)")
+        return (f"{static_ok}/{total} node(s) pass static filters but "
+                f"none can host the pod now (occupancy: resources/"
+                f"ports/pod count)")
+
     def schedule_one(self) -> Optional[str]:
         """Pop one pod, place it; returns bound node name or None."""
         pod = self.queue.pop()
@@ -449,6 +466,19 @@ class AgentScheduler:
         t0 = time.perf_counter()
         candidates = self._select_candidates(task)
         if not candidates:
+            # publish WHY before parking (scheduling-reason.md): the
+            # fast path has no session-close publisher, so the reason
+            # is stamped at park time and cleared on bind below
+            reason = self._unschedulable_reason(task)
+            if pod.annotations.get(SCHEDULING_REASON_ANNOTATION) != \
+                    REASON_UNSCHEDULABLE or pod.status_message != reason:
+                pod.annotations[SCHEDULING_REASON_ANNOTATION] = \
+                    REASON_UNSCHEDULABLE
+                pod.status_message = reason
+                try:
+                    self.cluster.put_object("pod", pod)
+                except Exception:  # noqa: BLE001 — status is advisory
+                    log.debug("reason publish failed for %s", pod.key)
             self.queue.park_unschedulable(pod)
             metrics.inc("agent_unschedulable_total")
             return None
@@ -476,6 +506,17 @@ class AgentScheduler:
             metrics.observe("agent_pod_e2e_latency_seconds",
                             time.perf_counter() - t0)
             self._attempts.pop(pod.key, None)
+            if SCHEDULING_REASON_ANNOTATION in pod.annotations:
+                # a previously-parked pod placed: drop the stale
+                # reason AND persist — bind_pod's POST carries only
+                # node/phase, so without this write the apiserver copy
+                # stays marked Unschedulable while running
+                del pod.annotations[SCHEDULING_REASON_ANNOTATION]
+                pod.status_message = ""
+                try:
+                    self.cluster.put_object("pod", pod)
+                except Exception:  # noqa: BLE001 — status is advisory
+                    log.debug("reason clear failed for %s", pod.key)
             return node.name
 
         self._attempts[pod.key] = attempt + 1

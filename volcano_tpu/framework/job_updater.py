@@ -58,10 +58,16 @@ def publish_scheduling_reasons(ssn) -> int:
                                          PodGroupPhase.INQUEUE)
                         and (job.fit_errors or job.job_fit_errors))
         if not gang_blocked:
-            # CLEAR stale reasons: a bound/running pod still carrying
-            # Unschedulable would make autoscalers scale up for a job
-            # that already placed
+            # CLEAR stale reasons — but only from tasks that actually
+            # PLACED: fit errors rebuild empty every snapshot, so a
+            # job merely skipped this session (queue overused, FIFO-
+            # blocked, not yet admitted) has no errors while its pods
+            # still pend; blanking those would drop the autoscaler's
+            # scale-up signal and churn publish/clear on alternating
+            # cycles
             for task in job.tasks.values():
+                if task.status is TaskStatus.PENDING:
+                    continue
                 pod = task.pod
                 if SCHEDULING_REASON_ANNOTATION in pod.annotations:
                     del pod.annotations[SCHEDULING_REASON_ANNOTATION]
@@ -76,13 +82,6 @@ def publish_scheduling_reasons(ssn) -> int:
             errs = job.fit_errors.get(task.uid)
             if errs is not None:
                 reason, message = REASON_UNSCHEDULABLE, errs.error()
-            elif not job.fit_errors and job.job_fit_errors is not None:
-                # a JOB-level failure only when no per-task detail
-                # exists (job_fit_errors is also set as a summary OF
-                # per-task errors — that must not paint the tasks
-                # that fit as Unschedulable)
-                reason = REASON_UNSCHEDULABLE
-                message = job.job_fit_errors.error()
             else:
                 reason = REASON_SCHEDULABLE
                 message = (f"pod can be scheduled, but the gang is "
