@@ -633,6 +633,18 @@ def _train_child():
         b = int(os.environ["BENCH_TRAIN_BATCH"])
         sweep = [(f"d2048-L8-b{b}", wide, b, opt, {})]
 
+    def emit(results):
+        """Cumulative line after EVERY config: a parent-side timeout
+        mid-sweep salvages the best-so-far instead of losing all."""
+        ok = [r for r in results if "error" not in r]
+        if not ok:
+            return
+        best = max(ok, key=lambda r: r["mfu"] or 0)
+        out = {"tpu_available": True, "device_kind": dev.device_kind,
+               "sweep": results}
+        out.update(best)
+        print(json.dumps(out), flush=True)
+
     results = []
     for tag, cfg, b, opt_i, env_over in sweep:
         saved = {k: os.environ.get(k) for k in env_over}
@@ -642,6 +654,7 @@ def _train_child():
                 cfg, b, t, opt_i)
         except Exception as e:  # noqa: BLE001 — e.g. OOM on one shape
             results.append({"config": tag, "error": str(e)[-200:]})
+            emit(results)
             continue
         finally:
             for k, old in saved.items():
@@ -658,14 +671,9 @@ def _train_child():
             "model_tflops": round(flops / step_s / 1e12, 1),
             "mfu": round(flops / step_s / peak, 3) if peak else None,
         })
-    ok = [r for r in results if "error" not in r]
-    if not ok:
+        emit(results)
+    if not [r for r in results if "error" not in r]:
         raise RuntimeError(f"every sweep point failed: {results}")
-    best = max(ok, key=lambda r: r["mfu"] or 0)
-    out = {"tpu_available": True, "device_kind": dev.device_kind,
-           "sweep": results}
-    out.update(best)
-    print(json.dumps(out))
 
 
 def _probe_child():
@@ -680,7 +688,7 @@ def _probe_child():
                       "device_kind": dev.device_kind}))
 
 
-def bench_train_step_tpu(timeout_s: float = 540.0) -> dict:
+def bench_train_step_tpu(timeout_s: float = 780.0) -> dict:
     """Real-chip train-step throughput in a subprocess with a hard
     timeout (the axon tunnel can hang at backend init)."""
     return _tpu_subprocess("--train-child", timeout_s)
@@ -770,25 +778,44 @@ def _tpu_subprocess(flag: str, timeout_s: float) -> dict:
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)      # let the TPU platform load
     env.pop("XLA_FLAGS", None)
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), flag],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=os.path.dirname(os.path.abspath(__file__)))
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), flag],
-            capture_output=True, text=True, timeout=timeout_s, env=env,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
+        out, err = child.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
+        # a long sweep may time out mid-run: kill, DRAIN the pipes
+        # (subprocess.run discards them on POSIX timeouts), and
+        # salvage the child's last COMPLETE cumulative JSON line —
+        # the kill can truncate the final line mid-write, so keep
+        # scanning upward past a fragment
+        child.kill()
+        try:
+            partial, _ = child.communicate(timeout=10)
+        except Exception:  # noqa: BLE001
+            partial = ""
+        for line in reversed((partial or "").strip().splitlines()
+                             or [""]):
+            if line.startswith("{"):
+                try:
+                    salvaged = json.loads(line)
+                    salvaged["timed_out_after_s"] = timeout_s
+                    return salvaged
+                except json.JSONDecodeError:
+                    continue
         return {"tpu_available": False, "attempted": True,
                 "tpu_unreachable": True,
                 "error": f"TPU backend init exceeded {timeout_s:g}s "
                          f"(axon tunnel dead/hung)"}
-    for line in reversed(proc.stdout.strip().splitlines() or [""]):
+    for line in reversed((out or "").strip().splitlines() or [""]):
         if line.startswith("{"):
             try:
                 return json.loads(line)
             except json.JSONDecodeError:
-                break
+                continue
     return {"tpu_available": False, "attempted": True,
-            "error": (proc.stderr or proc.stdout or "no output")
-            .strip()[-400:]}
+            "error": (err or out or "no output").strip()[-400:]}
 
 
 def main():

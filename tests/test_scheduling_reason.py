@@ -168,10 +168,11 @@ def test_failed_spec_siblings_all_report_unschedulable():
 
 
 def test_reasons_survive_skipped_sessions():
-    """A session that never ATTEMPTS the job (queue overused) must not
-    blank the previously-published reasons of still-pending pods."""
-    from volcano_tpu.api.queue import Queue
-    from volcano_tpu.api.resource import TPU
+    """A session that never ATTEMPTS the job (here: its queue closed)
+    records no fit errors — the publisher must NOT blank the
+    previously-published reasons of still-pending pods (losing the
+    autoscaler's scale-up signal and churning publish/clear)."""
+    from volcano_tpu.api.queue import QueueState
     nodes = [Node(name="n0", allocatable={"cpu": 8, "pods": 110})]
     pg, pods = gang_job("kept", replicas=2, min_available=2,
                         requests={"cpu": 6})
@@ -180,9 +181,10 @@ def test_reasons_survive_skipped_sessions():
     reasons, _ = reasons_and_msgs(ctx.cluster, "kept")
     assert REASON_UNSCHEDULABLE in reasons.values()
 
-    # next cycle the job is not attempted (simulate: second run with
-    # nothing changed still keeps reasons; the no-churn check in
-    # test_queue_share_blocker_reason covers the attempted case)
+    # close the queue: the next session skips the job entirely, so
+    # no fit errors rebuild — the pods still pend and their reasons
+    # must survive the gang_blocked=False clear branch
+    ctx.cluster.queues["default"].state = QueueState.CLOSED
     ctx.run()
     reasons2, _ = reasons_and_msgs(ctx.cluster, "kept")
     assert reasons2 == reasons

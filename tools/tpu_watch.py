@@ -52,14 +52,21 @@ def _child(flag: str, timeout_s: float) -> dict:
     env.pop("XLA_FLAGS", None)
     t0 = time.time()
     started = _utcnow()
+    proc = subprocess.Popen(
+        [sys.executable, BENCH, flag], stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=env, cwd=REPO)
     try:
-        proc = subprocess.run(
-            [sys.executable, BENCH, flag], capture_output=True,
-            text=True, timeout=timeout_s, env=env, cwd=REPO)
-        raw_out, raw_err, rc = proc.stdout, proc.stderr, proc.returncode
-    except subprocess.TimeoutExpired as e:
-        raw_out = (e.stdout or b"").decode() if isinstance(
-            e.stdout, bytes) else (e.stdout or "")
+        raw_out, raw_err = proc.communicate(timeout=timeout_s)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        # kill then DRAIN (subprocess.run loses the pipes on POSIX
+        # timeouts): a mid-sweep timeout still yields the cumulative
+        # lines printed so far
+        proc.kill()
+        try:
+            raw_out, _ = proc.communicate(timeout=10)
+        except Exception:  # noqa: BLE001
+            raw_out = ""
         raw_err = f"timeout after {timeout_s:g}s"
         rc = -1
     wall = round(time.time() - t0, 1)
@@ -68,9 +75,9 @@ def _child(flag: str, timeout_s: float) -> dict:
         if line.startswith("{"):
             try:
                 parsed = json.loads(line)
+                break
             except json.JSONDecodeError:
-                pass
-            break
+                continue    # the kill can truncate the final line
     return {"flag": flag, "started_utc": started, "wall_s": wall,
             "rc": rc, "parsed": parsed,
             "raw_stdout": (raw_out or "")[-4000:],

@@ -434,14 +434,24 @@ class AgentScheduler:
 
     def _unschedulable_reason(self, task) -> str:
         """Compact why-not for a pod with zero candidates, from the
-        spec-cache view (O(1) — the entry was just computed)."""
-        entry = self._spec_entry(task)
-        total = len(self.nodes)
-        static_ok = len(entry.scores)
+        spec-cache view (O(1) — the entry was just computed).  Locked:
+        _spec_entry mutates the shared cache and iterates self.nodes,
+        both of which concurrent workers / watch refreshes touch.  In
+        hard shard mode the denominator is the SHARD (the evaluated
+        universe), not the whole cluster."""
+        with self._lock:
+            entry = self._spec_entry(task)
+            static_ok = len(entry.scores)
+            if self._shard and self.shard_mode == SHARD_MODE_HARD:
+                total = len(self._shard & set(self.nodes))
+                scope = "in-shard node(s)"
+            else:
+                total = len(self.nodes)
+                scope = "node(s)"
         if static_ok == 0:
-            return (f"0/{total} node(s) pass static filters "
+            return (f"0/{total} {scope} pass static filters "
                     f"(selector/affinity/taints/device shape)")
-        return (f"{static_ok}/{total} node(s) pass static filters but "
+        return (f"{static_ok}/{total} {scope} pass static filters but "
                 f"none can host the pod now (occupancy: resources/"
                 f"ports/pod count)")
 
@@ -466,9 +476,12 @@ class AgentScheduler:
         t0 = time.perf_counter()
         candidates = self._select_candidates(task)
         if not candidates:
-            # publish WHY before parking (scheduling-reason.md): the
-            # fast path has no session-close publisher, so the reason
-            # is stamped at park time and cleared on bind below
+            # park FIRST, then publish: put_object's synchronous watch
+            # echo (RemoteCluster) pushes the echoed pod back into the
+            # queue, and the parked-key branch of _push_locked swaps in
+            # that freshest copy — publishing first would land the echo
+            # in the ACTIVE pool alongside the stale copy we then park
+            self.queue.park_unschedulable(pod)
             reason = self._unschedulable_reason(task)
             if pod.annotations.get(SCHEDULING_REASON_ANNOTATION) != \
                     REASON_UNSCHEDULABLE or pod.status_message != reason:
@@ -479,7 +492,6 @@ class AgentScheduler:
                     self.cluster.put_object("pod", pod)
                 except Exception:  # noqa: BLE001 — status is advisory
                     log.debug("reason publish failed for %s", pod.key)
-            self.queue.park_unschedulable(pod)
             metrics.inc("agent_unschedulable_total")
             return None
 
