@@ -63,14 +63,3 @@ class _Entry(Generic[T]):
         return self.seq < other.seq
 
 
-def chain_comparators(fns: List[Callable[[T, T], int]]) -> Callable[[T, T], bool]:
-    """Compose tiered compare fns (negative => a first) into a less()."""
-    def less(a: T, b: T) -> bool:
-        for fn in fns:
-            r = fn(a, b)
-            if r < 0:
-                return True
-            if r > 0:
-                return False
-        return False
-    return less
