@@ -1,0 +1,57 @@
+"""Ulysses-style sequence parallelism — all-to-all context exchange.
+
+The second long-context mechanism next to ring attention
+(ring_attention.py): instead of rotating K/V blocks around the ICI
+ring, TWO all-to-alls re-partition the problem so every device runs
+ordinary full-sequence attention on a HEAD subset (DeepSpeed-Ulysses;
+see PAPERS.md):
+
+  [b, t/sp, h, d]  --all_to_all(seq<-heads)-->  [b, t, h/sp, d]
+       full-sequence causal attention on h/sp heads
+  [b, t, h/sp, d]  --all_to_all(heads<-seq)-->  [b, t/sp, h, d]
+
+Trade-off vs the ring: two bulk all-to-alls (great on ICI's all-to-all
+bandwidth, one shot, overlappable) instead of sp p2p hops; the
+constraint is heads-per-device divisibility ((h_local % sp) == 0),
+where the ring constrains nothing but rotates sp times.  Because each
+device sees the WHOLE sequence for its heads, the inner attention can
+be the Pallas flash kernel — the ring's blockwise math can't use it
+across hops.
+
+Used inside shard_map with the same specs as ring attention:
+  q,k,v: P(("dp","fsdp"), "sp", "tp", None)     # [b, t, h, d]
+"""
+
+from __future__ import annotations
+
+from jax import lax
+
+from volcano_tpu.workloads.ring_attention import local_causal_attention
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sp",
+                      use_flash: bool = False):
+    """Causal attention inside shard_map; q/k/v: [b, t_local, h_local,
+    d] with h_local % sp == 0.  Returns [b, t_local, h_local, d]."""
+    sp = lax.psum(1, axis_name)
+    if sp == 1:
+        return local_causal_attention(q, k, v)
+    # exchange: split the HEAD axis across the ring, concatenate the
+    # received SEQUENCE chunks (tiled all-to-all keeps rank count) —
+    # chunk order along the sp axis is device order, so the global
+    # sequence comes back in token order
+    qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                        tiled=True)
+    if use_flash:
+        from volcano_tpu.workloads.ops.flash_attention import (
+            flash_attention)
+        og = flash_attention(qg, kg, vg)     # falls back when unaligned
+    else:
+        og = local_causal_attention(qg, kg, vg)
+    # inverse exchange: back to all heads, local sequence shard
+    return lax.all_to_all(og, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
