@@ -223,40 +223,41 @@ def bench_agent_scheduler_throughput() -> float:
     from volcano_tpu.api.shard import AGENT_SCHEDULER
     from volcano_tpu.cache.fake_cluster import FakeCluster
 
-    cluster = FakeCluster()
-    for i in range(20):
-        cluster.add_node(Node(name=f"n{i}",
-                              allocatable={"cpu": 64, "pods": 256}))
-    sched = AgentScheduler(cluster)
-    # throughput with the batch-parity predicate chain DISABLED is not
-    # a result (VERDICT r2 item 3): prove the full default chain is on
-    assert [p.name for p in sched.plugins] == \
-        ["predicates", "resources", "deviceshare", "leastalloc"], \
-        f"parity plugin chain not enabled: {[p.name for p in sched.plugins]}"
-    # warmup: first-touch imports and spec-cache build are startup
-    # costs, not steady-state throughput
-    for i in range(50):
-        pod = make_pod(f"warm{i}", requests={"cpu": "100m"})
-        pod.scheduler_name = AGENT_SCHEDULER
-        cluster.add_pod(pod)
-    assert sched.run_until_drained() == 50
-    # best of 3 bursts: a loaded driver machine's transient stalls
-    # must not read as a scheduler regression (throughput benches take
-    # best-of-N for exactly this reason)
-    best = 0.0
-    for burst in range(3):
+    def one_burst() -> float:
+        """One 500-pod burst on a FRESH cluster + scheduler (identical
+        conditions per trial; teardown-free — delete events would
+        trigger untimed full cache refreshes)."""
+        cluster = FakeCluster()
+        for i in range(20):
+            cluster.add_node(Node(name=f"n{i}",
+                                  allocatable={"cpu": 64, "pods": 256}))
+        sched = AgentScheduler(cluster)
+        # throughput with the batch-parity predicate chain DISABLED is
+        # not a result (VERDICT r2 item 3): prove the default chain
+        assert [p.name for p in sched.plugins] == \
+            ["predicates", "resources", "deviceshare", "leastalloc"], \
+            f"parity chain not enabled: {[p.name for p in sched.plugins]}"
+        # warmup: first-touch imports and spec-cache build are startup
+        # costs, not steady-state throughput
+        for i in range(50):
+            pod = make_pod(f"warm{i}", requests={"cpu": "100m"})
+            pod.scheduler_name = AGENT_SCHEDULER
+            cluster.add_pod(pod)
+        assert sched.run_until_drained() == 50
         for i in range(500):
-            pod = make_pod(f"a{burst}-{i}", requests={"cpu": "100m"})
+            pod = make_pod(f"a{i}", requests={"cpu": "100m"})
             pod.scheduler_name = AGENT_SCHEDULER
             cluster.add_pod(pod)
         t0 = time.perf_counter()
         bound = sched.run_until_drained()
         dt = time.perf_counter() - t0
         assert bound == 500, f"agent bound {bound}/500"
-        best = max(best, bound / dt)
-        for i in range(500):
-            cluster.delete_pod(f"default/a{burst}-{i}")
-    return best
+        return bound / dt
+
+    # median of 3 independent trials: robust to one driver-machine
+    # stall while staying comparable to earlier single-run rounds
+    # (each trial matches the old methodology exactly)
+    return statistics.median(one_burst() for _ in range(3))
 
 
 def bench_gangpreempt_latency() -> float:
