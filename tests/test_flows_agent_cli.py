@@ -592,3 +592,29 @@ def test_jobflow_delete_reaps_jobs_with_delete_retain_policy():
     assert "default/fl-step" not in reaped.podgroups
     retained = build("retain")
     assert "default/fl-step" in retained.vcjobs
+
+
+def test_pod_describe_and_reason_column(tmp_path, capsys):
+    """`pod describe` surfaces state + scheduling reason; `pod list`
+    grows a REASON column for pending pods (scheduling-reason.md
+    triage surface)."""
+    import json as _json
+    from volcano_tpu.cli import vtpctl
+    state = str(tmp_path / "c.pkl")
+    assert vtpctl.main(["--state", state, "init",
+                        "--slices", "sa=v5e-16"]) == 0
+    assert vtpctl.main(["--state", state, "job", "run", "-N", "big",
+                        "--replicas", "5", "--min-available", "5",
+                        "--cpu", "8", "--tpu", "4"]) == 0
+    assert vtpctl.main(["--state", state, "tick"]) == 0
+    capsys.readouterr()
+    assert vtpctl.main(["--state", state, "pod", "describe",
+                        "-N", "big-worker-4"]) == 0
+    out = _json.loads(capsys.readouterr().out)
+    assert out["phase"] == "Pending"
+    assert out.get("schedulingReason") in ("Unschedulable",
+                                           "Schedulable")
+    assert out.get("message")
+    assert vtpctl.main(["--state", state, "pod", "list"]) == 0
+    listing = capsys.readouterr().out
+    assert "REASON" in listing and "Unschedulable" in listing

@@ -371,9 +371,77 @@ def cmd_pod_list(cluster, args):
     for pod in cluster.pods.values():
         if args.namespace and pod.namespace != args.namespace:
             continue
+        reason = "-"
+        if not pod.node_name:
+            reason = pod.annotations.get(SCHEDULING_REASON_ANNOTATION,
+                                         "-")
         rows.append([pod.namespace, pod.name, pod.phase.value,
-                     pod.node_name or "-"])
-    print(_table(rows, ["NAMESPACE", "NAME", "PHASE", "NODE"]))
+                     pod.node_name or "-", reason])
+    print(_table(rows, ["NAMESPACE", "NAME", "PHASE", "NODE",
+                        "REASON"]))
+
+
+def cmd_pod_describe(cluster, args):
+    """kubectl-describe analogue: pod state + scheduling reason +
+    the server-side audit history of this pod (bind/evict/phase
+    transitions as the apiserver saw them)."""
+    key = f"{args.namespace}/{args.name}"
+    pod = cluster.pods.get(key)
+    if pod is None:
+        sys.exit(f"pod {key} not found")
+    out = {
+        "name": pod.name, "namespace": pod.namespace,
+        "uid": pod.uid, "phase": pod.phase.value,
+        "node": pod.node_name or None,
+        "owner": pod.owner or None, "task": pod.task_spec or None,
+        "requests": dict(pod.resource_requests().res),
+        "annotations": dict(pod.annotations),
+    }
+    if pod.status_message:
+        out["message"] = pod.status_message
+    reason = pod.annotations.get(SCHEDULING_REASON_ANNOTATION)
+    if reason:
+        out["schedulingReason"] = reason
+    history = _pod_audit_history(cluster, key)
+    if history is not None:
+        out["events"] = history
+    print(json.dumps(out, indent=2))
+
+
+def _pod_audit_history(cluster, key):
+    """This pod's slice of the server audit trail (wire mode only:
+    the standalone state file keeps no trail).  Goes through the
+    cluster client's _request so TLS context / bearer auth apply;
+    records are filtered SERVER-side via the key param."""
+    request = getattr(cluster, "_request", None)
+    if request is None:
+        return None
+    from urllib.parse import quote
+    try:
+        records, since, truncated = [], 0, False
+        while True:
+            payload = request(
+                "GET", f"/audit?since={since}&key={quote(key)}")
+            truncated = truncated or bool(payload.get("lost"))
+            batch = payload.get("records", [])
+            records.extend(batch)
+            if payload["idx"] <= since:
+                break
+            since = payload["idx"]
+        import datetime
+        out = [{"ts": datetime.datetime.fromtimestamp(
+                    rec["ts"]).isoformat(timespec="seconds"),
+                "kind": rec["kind"],
+                **({"node": rec["node"]} if rec.get("node") else {}),
+                **({"phase": rec["phase"]} if rec.get("phase") else {})}
+               for rec in records]
+        if truncated:
+            # ring eviction dropped early records: never present the
+            # surviving tail as the pod's complete history
+            return {"historyTruncated": True, "records": out}
+        return out
+    except Exception:  # noqa: BLE001 — audit is best-effort extra
+        return None
 
 
 def cmd_node_list(cluster, args):
@@ -603,6 +671,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = pod.add_parser("list")
     p.add_argument("-n", "--namespace", default=None)
     p.set_defaults(fn=cmd_pod_list)
+    p = pod.add_parser("describe", help="pod state + scheduling "
+                       "reason + server audit history")
+    p.add_argument("-N", "--name", required=True)
+    p.add_argument("-n", "--namespace", default="default")
+    p.set_defaults(fn=cmd_pod_describe)
 
     node = sub.add_parser("node", help="node operations").add_subparsers(
         dest="node_cmd", required=True)

@@ -115,8 +115,8 @@ class StateServer:
             rec["phase"] = getattr(phase, "value", str(phase))
         return rec
 
-    def audit_since(self, since: int,
-                    limit: int = 10_000) -> Tuple[int, List[dict], bool]:
+    def audit_since(self, since: int, limit: int = 10_000,
+                    key: str = "") -> Tuple[int, List[dict], bool]:
         """(idx, records with index > since, lost) — no long-poll, the
         exporter pages with `since` until a short batch comes back.
         The first call enables collection.  lost is True when the
@@ -134,6 +134,10 @@ class StateServer:
             records = list(itertools.islice(
                 self._audit, start, start + max(1, limit)))
             idx = records[-1]["i"] if records else self._audit_idx
+            if key:
+                # server-side object filter (pod describe): paging
+                # indices stay ring-global, only matching records ship
+                records = [r for r in records if r.get("key") == key]
             return idx, records, lost
 
     def events_since(self, since: int, timeout: float = 25.0):
@@ -250,7 +254,8 @@ class _Handler(BaseHTTPRequestHandler):
         if url.path == "/audit":
             q = parse_qs(url.query)
             since = int(q.get("since", ["0"])[0])
-            idx, records, lost = st.audit_since(since)
+            key = q.get("key", [""])[0]
+            idx, records, lost = st.audit_since(since, key=key)
             return self._json(200, {"idx": idx, "records": records,
                                     "lost": lost})
         return self._json(404, {"error": f"no route {url.path}"})
