@@ -84,3 +84,35 @@ def test_model_with_flash_attention_matches_jnp_path():
     l_plain = model_lib.forward(params, tokens, cfg_plain)
     np.testing.assert_allclose(np.asarray(l_flash), np.asarray(l_plain),
                                atol=5e-4, rtol=5e-4)
+
+
+def test_backward_blocks_decoupled_from_forward():
+    """The bwd kernels may run at DIFFERENT block shapes than the fwd
+    pass (the r4 tuning surface): gradients stay exact with
+    block_q_bwd/block_k_bwd != block_q/block_k, and with the
+    FLASH_BLOCK_BWD env override the bench sweeps through."""
+    import os
+    q, k, v = _rand_qkv(t=256)
+
+    def fr(q, k, v):
+        return jnp.sum(jnp.tanh(local_causal_attention(q, k, v)))
+
+    gr = jax.grad(fr, argnums=(0, 1, 2))(q, k, v)
+
+    def check(**kw):
+        def f(q, k, v):
+            return jnp.sum(jnp.tanh(
+                flash_attention(q, k, v, interpret=True, **kw)))
+        g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4)
+
+    # explicit kwargs: fwd 128 blocks, bwd 256 (full-seq) blocks
+    check(block_q=128, block_k=128, block_q_bwd=256, block_k_bwd=256)
+    # env override path (read at trace time)
+    os.environ["FLASH_BLOCK_BWD"] = "256"
+    try:
+        check()
+    finally:
+        del os.environ["FLASH_BLOCK_BWD"]
