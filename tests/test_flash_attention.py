@@ -86,13 +86,15 @@ def test_model_with_flash_attention_matches_jnp_path():
                                atol=5e-4, rtol=5e-4)
 
 
-def test_backward_blocks_decoupled_from_forward():
+def test_backward_blocks_decoupled_from_forward(monkeypatch):
     """The bwd kernels may run at DIFFERENT block shapes than the fwd
     pass (the r4 tuning surface): gradients stay exact with
-    block_q_bwd/block_k_bwd != block_q/block_k, and with the
-    FLASH_BLOCK_BWD env override the bench sweeps through."""
-    import os
-    q, k, v = _rand_qkv(t=256)
+    MISMATCHED multi-block bwd shapes (block_q_bwd != block_k_bwd !=
+    fwd blocks — exercising the start_q floor and causal iota offsets
+    across several grid programs), and the FLASH_BLOCK_BWD env
+    override must pick a NON-default value or it proves nothing
+    (default_block(512) = 512)."""
+    q, k, v = _rand_qkv(t=512)
 
     def fr(q, k, v):
         return jnp.sum(jnp.tanh(local_causal_attention(q, k, v)))
@@ -108,11 +110,16 @@ def test_backward_blocks_decoupled_from_forward():
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-4, rtol=2e-4)
 
-    # explicit kwargs: fwd 128 blocks, bwd 256 (full-seq) blocks
-    check(block_q=128, block_k=128, block_q_bwd=256, block_k_bwd=256)
-    # env override path (read at trace time)
-    os.environ["FLASH_BLOCK_BWD"] = "256"
-    try:
-        check()
-    finally:
-        del os.environ["FLASH_BLOCK_BWD"]
+    # fwd 512 (default), bwd q/k blocks mismatched AND multi-block:
+    # dq loops 4 k-blocks per q-block row, dkv crosses block_k >
+    # block_q rounding in start_q
+    check(block_q_bwd=128, block_k_bwd=256)
+    check(block_q_bwd=256, block_k_bwd=128)
+    # env override path (read at trace time): 128 != default 512, so
+    # a broken _env_block lookup fails the comparison against the
+    # kwargs run ONLY if the kernels are wrong — prove the override
+    # is actually consumed by inspecting the resolved blocks instead
+    from volcano_tpu.workloads.ops.flash_attention import _env_block
+    monkeypatch.setenv("FLASH_BLOCK_BWD", "128")
+    assert _env_block("FLASH_BLOCK_BWD", 512, 512) == 128
+    check()     # gradients stay exact under the overridden blocks
