@@ -96,6 +96,54 @@ def test_hdrf_orders_by_queue_path_share():
     close_session(ssn)
 
 
+def test_hdrf_hierarchy_weights_divide_level_shares():
+    """A weight-3 subtree tolerates 3x the share of a weight-1
+    sibling (drf.go:174,462-470): eng consumes MORE raw share than
+    sci but still orders first because 0.5/3 < 0.25/1."""
+    from volcano_tpu.cache.cache import SchedulerCache
+    from volcano_tpu.conf import load_conf
+    from volcano_tpu.framework.framework import close_session, open_session
+    from volcano_tpu.webhooks.admission import (
+        HIERARCHY_ANNOTATION, HIERARCHY_WEIGHTS_ANNOTATION)
+
+    cluster = FakeCluster()
+    for i in range(2):
+        cluster.add_node(Node(name=f"n{i}", allocatable={"cpu": 8}))
+    cluster.add_queue(Queue(name="eng", annotations={
+        HIERARCHY_ANNOTATION: "root/eng",
+        HIERARCHY_WEIGHTS_ANNOTATION: "1/3"}))
+    cluster.add_queue(Queue(name="sci", annotations={
+        HIERARCHY_ANNOTATION: "root/sci",
+        HIERARCHY_WEIGHTS_ANNOTATION: "1/1"}))
+    pg_e, pods_e = gang_job("eng-hog", queue="eng", replicas=2,
+                            requests={"cpu": 4}, running_on=["n0", "n0"],
+                            pg_phase=PodGroupPhase.RUNNING)
+    pg_s, pods_s = gang_job("sci-hog", queue="sci", replicas=1,
+                            requests={"cpu": 4}, running_on=["n1"],
+                            pg_phase=PodGroupPhase.RUNNING)
+    pg_a, pods_a = gang_job("next-eng", queue="eng", replicas=1,
+                            requests={"cpu": 2})
+    pg_b, pods_b = gang_job("next-sci", queue="sci", replicas=1,
+                            requests={"cpu": 2})
+    for pg, pods in [(pg_e, pods_e), (pg_s, pods_s),
+                     (pg_a, pods_a), (pg_b, pods_b)]:
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+    conf = load_conf({
+        "actions": "enqueue, allocate",
+        "tiers": [{"plugins": [
+            {"name": "gang"},
+            {"name": "drf", "arguments": {"drf.enable-hierarchy": True}},
+            {"name": "predicates"}, {"name": "nodeorder"}]}]})
+    ssn = open_session(SchedulerCache(cluster), conf)
+    job_a = next(j for j in ssn.jobs.values() if j.name == "next-eng")
+    job_b = next(j for j in ssn.jobs.values() if j.name == "next-sci")
+    assert ssn.job_order_fn(job_a, job_b)      # eng first despite 0.5 raw
+    assert not ssn.job_order_fn(job_b, job_a)
+    close_session(ssn)
+
+
 def test_datalocality_scores_and_hard_mode():
     nodes = [Node(name="data0", allocatable={"cpu": 8}),
              Node(name="far0", allocatable={"cpu": 8})]

@@ -246,3 +246,110 @@ def test_agent_restart_reconciles_stale_enforcement(tmp_path):
     tc = TcEnforcer("eth0", runner=runs.append)
     tc.apply_network(60_000, 40_000, {})
     assert runs[0] == ["qdisc", "del", "dev", "eth0", "root"]
+
+
+def test_reconcile_confined_to_owned_subtree(tmp_path):
+    """ADVICE r4 medium: the restart sweep must never touch foreign
+    cgroups on a shared hierarchy.  A shared-looking root is narrowed
+    to {root}/volcano, so pre-existing init.scope / kubelet dirs
+    beside the owned subtree are invisible to enforced_uids and
+    survive a reconciling first sync."""
+    import os
+
+    root = tmp_path / "sys-fs-cgroup"
+    for foreign in ["init.scope", "kubepods-burstable.slice",
+                    "some-kubelet-pod-uid"]:
+        os.makedirs(root / foreign)
+    # unprefixed dir INSIDE the owned subtree (e.g. an operator's
+    # own nesting under a shared 'volcano' dir): the vtp- prefix is
+    # the ownership mark, so it must be invisible to the sweep too
+    os.makedirs(root / "volcano" / "operator-dir")
+    cg = CgroupV2Enforcer(str(root))
+    assert cg.root == str(root / "volcano")
+    assert cg.enforced_uids() == set()          # foreign dirs invisible
+
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    provider = FakeUsageProvider()
+    provider.set("sa-w0", cpu_fraction=0.2, tpu_chips_detected=4,
+                 tpu_chips_healthy=4)
+    agent = NodeAgent(cluster, "sa-w0", provider, enforcer=cg)
+    agent.sync()                                # reconciling first sync
+    for foreign in ["init.scope", "kubepods-burstable.slice",
+                    "some-kubelet-pod-uid"]:
+        assert (root / foreign).is_dir()        # untouched
+    assert (root / "volcano" / "operator-dir").is_dir()
+
+    # a root already inside a volcano subtree is taken as-is
+    owned = tmp_path / "volcano" / "pods"
+    assert CgroupV2Enforcer(str(owned)).root == str(owned)
+
+
+def test_offline_class_allocator_recycles_minors():
+    """ADVICE r4 low: released HTB minors are reused lowest-first so
+    a long-lived agent never walks off the 16-bit minor space."""
+    from volcano_tpu.agent.enforcer import (
+        FIRST_POD_CLASS,
+        OfflineClassAllocator,
+    )
+
+    alloc = OfflineClassAllocator()
+    a, b, c = (alloc.classid(u) for u in ["a", "b", "c"])
+    assert (a, b, c) == (FIRST_POD_CLASS, FIRST_POD_CLASS + 1,
+                         FIRST_POD_CLASS + 2)
+    alloc.release("b")
+    alloc.release("a")
+    assert alloc.classid("d") == a              # lowest freed first
+    assert alloc.classid("e") == b
+    assert alloc.classid("f") == FIRST_POD_CLASS + 3
+    # idempotent per uid
+    assert alloc.classid("d") == a
+
+
+def test_tc_reprograms_when_recycled_minor_yields_identical_argv():
+    """A new pod that inherits a departed pod's recycled minor and
+    limit produces byte-identical tc argv right after that class was
+    deleted — the program cache must still reprogram (it keys on
+    uid->class, not argv alone)."""
+    runs = []
+    tc = TcEnforcer("eth0", runner=runs.append)
+    tc.apply_network(60_000, 40_000, {"pod-a": 100})
+    assert ["class", "del", "dev", "eth0", "classid", "1:21"] not in runs
+
+    # pod-a leaves, pod-b arrives with the SAME limit in one sync
+    tc.remove_pod("pod-a")
+    assert ["class", "del", "dev", "eth0", "classid", "1:21"] in runs
+    n = len(runs)
+    tc.apply_network(60_000, 40_000, {"pod-b": 100})
+    # pod-b recycled minor 21: the class MUST be recreated
+    recreated = [r for r in runs[n:]
+                 if r[:2] == ["class", "replace"] and "1:21" in r]
+    assert recreated, runs[n:]
+    assert tc.enforced_uids() == {"pod-b"}
+
+
+def test_tc_cache_invalidated_on_remove_even_after_failed_reprogram():
+    """Demote -> class deleted -> reprogram FAILS transiently ->
+    readmit with the recycled minor: the cache was invalidated by the
+    delete, so the class is recreated (an argv-identical key must not
+    mask the kernel mutation)."""
+    calls = []
+    fail = {"on": False}
+
+    def runner(argv):
+        calls.append(argv)
+        if fail["on"] and argv[0] == "qdisc":
+            raise RuntimeError("transient tc failure")
+
+    tc = TcEnforcer("eth0", runner=runner)
+    tc.apply_network(60_000, 40_000, {"pod-a": 100})
+    # promote pod-a out; the base reprogram fails transiently
+    fail["on"] = True
+    tc.apply_network(60_000, 40_000, {})
+    fail["on"] = False
+    assert ["class", "del", "dev", "eth0", "classid", "1:21"] in calls
+    n = len(calls)
+    # demote pod-a back: same uid, recycled minor, identical argv
+    tc.apply_network(60_000, 40_000, {"pod-a": 100})
+    recreated = [r for r in calls[n:]
+                 if r[:2] == ["class", "replace"] and "1:21" in r]
+    assert recreated, calls[n:]

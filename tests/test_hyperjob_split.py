@@ -314,3 +314,29 @@ def test_auto_split_defers_until_capacity_visible():
         b.add_node(node)
     ctrl.sync()
     assert [k for k in b.vcjobs if "blind-train-0-s" in k]
+
+
+def test_deferred_plan_keeps_previous_split_count():
+    """ADVICE r4 low: a cycle where any split plan defers (blind
+    member-mirror warmup) must not overwrite split_count with the
+    partial total — status keeps the last known member count."""
+    cluster = two_pod_cluster()
+    hj = HyperJob(name="big", min_available=2, replicated_jobs=[
+        ReplicatedJob(name="train", replicas=1,
+                      template=training_template(pods=8, chips=4),
+                      split_policy=SplitPolicy(mode="static",
+                                               accelerators=16))])
+    cluster.put_object("hyperjob", hj)
+    ctrl = HyperJobController()
+    ctrl.initialize(cluster)
+    ctrl.sync()
+    assert cluster.hyperjobs["default/big"].split_count == 2
+
+    # next cycle defers (capacity view not ready): count must hold
+    orig = ctrl._sync_split_replica
+    ctrl._sync_split_replica = lambda *a, **k: ([], None)
+    ctrl.sync()
+    assert cluster.hyperjobs["default/big"].split_count == 2
+    ctrl._sync_split_replica = orig
+    ctrl.sync()
+    assert cluster.hyperjobs["default/big"].split_count == 2

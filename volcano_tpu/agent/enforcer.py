@@ -13,7 +13,9 @@ Three implementations:
   * CgroupV2Enforcer  — real cgroup-v2 file writes (cpu.max,
     cpu.max.burst, memory.high) under a configurable root, so tests
     exercise the REAL write path against a tmpdir root and production
-    points it at /sys/fs/cgroup/kubepods.slice.
+    points it at a volcano-owned subtree (a root without a 'volcano'
+    path component is narrowed to {root}/volcano; pod dirs are
+    vtp-prefixed — see the class docstring).
   * TcEnforcer        — `tc` HTB program for the online/offline DCN
     split (the portable stand-in for the reference's eBPF maps).
     Commands run through an injectable runner; only a CHANGED program
@@ -43,6 +45,7 @@ it was down.
 from __future__ import annotations
 
 import abc
+import heapq
 import logging
 import os
 import shutil
@@ -67,16 +70,30 @@ class OfflineClassAllocator:
     def __init__(self):
         self._uid_class: Dict[str, int] = {}
         self._next = FIRST_POD_CLASS
+        # released minors, reused lowest-first before bumping _next —
+        # a long-lived agent must not walk off the 16-bit minor space
+        self._free: List[int] = []
 
     def classid(self, uid: str) -> int:
         cls = self._uid_class.get(uid)
         if cls is None:
-            cls = self._uid_class[uid] = self._next
-            self._next += 1
+            if self._free:
+                cls = heapq.heappop(self._free)
+            else:
+                if self._next > 0xFFFF:
+                    raise RuntimeError(
+                        "HTB minor space exhausted: >65k concurrent "
+                        "offline pods on one interface")
+                cls = self._next
+                self._next += 1
+            self._uid_class[uid] = cls
         return cls
 
     def release(self, uid: str) -> Optional[int]:
-        return self._uid_class.pop(uid, None)
+        cls = self._uid_class.pop(uid, None)
+        if cls is not None:
+            heapq.heappush(self._free, cls)
+        return cls
 
     def peek(self, uid: str) -> Optional[int]:
         return self._uid_class.get(uid)
@@ -184,16 +201,30 @@ class CgroupV2Enforcer(Enforcer):
     Layout: {root}/{uid}/cpu.max, cpu.max.burst, memory.high, and —
     for offline pods — net_cls.classid (the classification half of
     the DCN split: packets from the pod's cgroup carry 1:<class> and
-    TcEnforcer's cgroup filter delivers them to that HTB class).  On
-    a real node root is a DEDICATED volcano-managed subtree (a co-
-    mounted v1 net_cls hierarchy for the tag; kubelet-owned *.slice
-    entries under a shared root are never claimed); tests point it at
-    a tmpdir and assert the actual file contents (the write path has
-    no fake).  A failed kernel write degrades that one knob with a
-    warning — enforcement must never kill the agent's sync loop."""
+    TcEnforcer's cgroup filter delivers them to that HTB class).
+    Ownership is explicit, never inferred: pod dirs are named
+    'vtp-{uid}' (cgroupfs forbids regular marker files, so the name
+    prefix IS the claim-time ownership mark), and a root without a
+    'volcano' path component (e.g. a shared /sys/fs/cgroup) is
+    additionally narrowed to {root}/volcano.  Restart reconciliation
+    sweeps ONLY vtp-prefixed dirs, so foreign entries (init.scope,
+    kubelet pod dirs) survive even if an operator points the
+    enforcer at a shared hierarchy.  Dirs written by a pre-prefix
+    agent (unprefixed {root}/{uid}) are deliberately NOT swept — an
+    upgrade across the prefix change needs a one-time manual cleanup
+    of the old layout.  Tests point root at a tmpdir and assert the
+    actual file contents (the write path has no fake).  A failed
+    kernel write degrades that one knob with a warning — enforcement
+    must never kill the agent's sync loop."""
+
+    OWNED_COMPONENT = "volcano"
+    POD_DIR_PREFIX = "vtp-"
 
     def __init__(self, root: str,
                  classids: Optional[OfflineClassAllocator] = None):
+        if self.OWNED_COMPONENT not in \
+                os.path.normpath(root).split(os.sep):
+            root = os.path.join(root, self.OWNED_COMPONENT)
         self.root = root
         self.classids = classids if classids is not None \
             else OfflineClassAllocator()
@@ -204,7 +235,7 @@ class CgroupV2Enforcer(Enforcer):
         os.makedirs(root, exist_ok=True)
 
     def _dir(self, uid: str) -> str:
-        return os.path.join(self.root, uid)
+        return os.path.join(self.root, self.POD_DIR_PREFIX + uid)
 
     @staticmethod
     def _write(path: str, value: str) -> None:
@@ -263,13 +294,14 @@ class CgroupV2Enforcer(Enforcer):
             self.classids.release(uid)
 
     def enforced_uids(self) -> set:
-        """Dirs under the root that are plausibly ours: kubelet-owned
-        systemd slices (*.slice) under a shared root are excluded —
-        reconciling those away would wipe live pods' enforcement."""
+        """Only vtp-prefixed dirs — the claim-time ownership mark —
+        are reported, so the restart sweep can never touch a foreign
+        cgroup even under a shared root."""
+        p = self.POD_DIR_PREFIX
         try:
-            return {e for e in os.listdir(self.root)
-                    if os.path.isdir(os.path.join(self.root, e))
-                    and not e.endswith(".slice")}
+            return {e[len(p):] for e in os.listdir(self.root)
+                    if e.startswith(p)
+                    and os.path.isdir(os.path.join(self.root, e))}
         except OSError:
             return set()
 
@@ -306,7 +338,7 @@ class TcEnforcer(Enforcer):
         self.runner = runner if runner is not None else self._run_tc
         self.classids = classids if classids is not None \
             else OfflineClassAllocator()
-        self._program: Optional[list] = None
+        self._program: Optional[tuple] = None   # (argv prog, uid->class)
         # uid -> class minor actually programmed into the kernel; OUR
         # removal ledger, independent of the shared allocator (the
         # cgroup half may release an allocation first — the kernel
@@ -326,6 +358,11 @@ class TcEnforcer(Enforcer):
         self.classids.release(uid)
         cls = self._programmed.pop(uid, None)
         if cls is not None:
+            # the kernel no longer matches the cached program — and a
+            # later sync could rebuild a byte-identical key if the
+            # freed minor is recycled to the same uid, so the cache
+            # must not survive the delete
+            self._program = None
             try:
                 self.runner(["class", "del", "dev", self.iface,
                              "classid", f"1:{cls}"])
@@ -371,7 +408,13 @@ class TcEnforcer(Enforcer):
                  "1:20", "classid", f"1:{classes[uid]}", "htb",
                  "rate", f"{max(1, pod_limits[uid])}mbit",
                  "ceil", f"{max(1, pod_limits[uid])}mbit"])
-        if prog == self._program:
+        # the cache key carries uid->class, not just argv: minor
+        # RECYCLING can hand a new pod the class a departed pod just
+        # freed, yielding byte-identical argv right after that class
+        # was `del`ed above — an argv-only compare would skip the
+        # reprogram and leave the new pod unshaped forever
+        key = (prog, sorted(classes.items()))
+        if key == self._program:
             return                      # unchanged: no kernel churn
         for argv in prog:
             try:
@@ -379,7 +422,7 @@ class TcEnforcer(Enforcer):
             except Exception:  # noqa: BLE001
                 log.warning("tc %s failed", " ".join(argv))
                 return                  # keep old program marker
-        self._program = prog
+        self._program = key
         self._programmed.update(classes)
 
     def enforced_uids(self) -> set:
@@ -413,7 +456,8 @@ class CompositeEnforcer(Enforcer):
 
 def build_enforcer(spec: str) -> Enforcer:
     """CLI factory: 'none', 'record', or a comma list of
-    'cgroup:/sys/fs/cgroup/kubepods.slice' and 'tc:eth0'.  When both
+    'cgroup:/sys/fs/cgroup' (narrowed to the volcano-owned subtree
+    inside it) and 'tc:eth0'.  When both
     halves are present they share one OfflineClassAllocator so the
     classid written into net_cls.classid is the HTB class tc built —
     that pairing IS the packet classification."""

@@ -325,9 +325,12 @@ class HyperJobController(Controller):
                 member_index += 1
                 split_total += 1
                 phases.append(member.phase if member else None)
-        hj.split_count = split_total
         if deferred:
-            return      # totals unknown this cycle: stay as-is
+            # totals unknown this cycle: keep the previous
+            # split_count too — a partial total (deferred replicas
+            # contribute 0) would transiently under-report members
+            return
+        hj.split_count = split_total
 
         running = sum(1 for p in phases if p is JobPhase.RUNNING)
         completed = sum(1 for p in phases if p is JobPhase.COMPLETED)

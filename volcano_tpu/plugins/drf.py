@@ -7,12 +7,27 @@ from higher-share jobs).
 
 from __future__ import annotations
 
+import logging
+from itertools import zip_longest
 from typing import Dict, List
 
 from volcano_tpu.api.job_info import JobInfo, TaskInfo
 from volcano_tpu.api.resource import MIN_RESOURCE, Resource
 from volcano_tpu.framework.plugins import Plugin, register_plugin
 from volcano_tpu.framework.session import EventHandler
+
+log = logging.getLogger(__name__)
+
+
+def _hierarchy_annotations(queue):
+    """(path string, weights string) from a session QueueInfo (raw
+    Queue underneath) or a raw Queue in unit seams."""
+    raw = getattr(queue, "queue", queue)
+    anns = getattr(raw, "annotations", {})
+    from volcano_tpu.webhooks.admission import (
+        HIERARCHY_ANNOTATION, HIERARCHY_WEIGHTS_ANNOTATION)
+    return (anns.get(HIERARCHY_ANNOTATION, ""),
+            anns.get(HIERARCHY_WEIGHTS_ANNOTATION, ""))
 
 
 class _JobAttr:
@@ -38,10 +53,24 @@ class DRFPlugin(Plugin):
         self.hierarchy = bool(self.arguments.get("drf.enable-hierarchy",
                                                  False))
         self._queues = {}
+        # per-level hierarchy weights (drf.go:462-470: annotation
+        # segments align with the path; node order compares
+        # share/weight, not raw share)
+        self._qweights: Dict[str, float] = {}
 
     def on_session_open(self, ssn):
         self.total = ssn.total_resource
         self._queues = ssn.queues
+        if self.hierarchy:
+            # parse EVERY queue's weight annotation once, before any
+            # comparison: lazy per-chain parsing would let sibling
+            # queues that disagree about an ancestor's weight rewrite
+            # _qweights mid-sort, making the comparator inconsistent
+            # (result depends on argument order).  First declaration
+            # wins for the session; conflicts are logged once.
+            self._qweights = {}
+            for q in self._queues.values():
+                self._parse_weights(q)
         for job in ssn.jobs.values():
             attr = _JobAttr()
             attr.allocated = job.allocated()
@@ -86,16 +115,10 @@ class DRFPlugin(Plugin):
         queue = self._queues.get(queue_name)
         chain = None
         if queue is not None:
-            from volcano_tpu.webhooks.admission import (
-                HIERARCHY_ANNOTATION)
-            # _queues holds session QueueInfo (raw Queue underneath)
-            # in-session, raw Queue in unit seams
-            raw = getattr(queue, "queue", queue)
-            path = getattr(raw, "annotations", {}).get(
-                HIERARCHY_ANNOTATION, "")
-            if path:
-                segs = [s for s in path.split("/") if s]
-                if segs and segs[-1] != queue_name:
+            path, _ = _hierarchy_annotations(queue)
+            segs = [s for s in path.split("/") if s]
+            if segs:
+                if segs[-1] != queue_name:
                     segs.append(queue_name)
                 chain = list(reversed(segs))
         if chain is None:
@@ -113,9 +136,58 @@ class DRFPlugin(Plugin):
             chain.append("root")
         return chain
 
+    def _parse_weights(self, queue):
+        """Session-open weight harvest for one queue: the weights
+        annotation aligns root->leaf with the hierarchy path
+        (drf.go:462-470); clamp to >=1.  Alignment is done on the
+        RAW splits (an empty path segment drops its weight in
+        tandem); an unrooted weight list beside a rooted path gets
+        root weight 1 prepended — the same recovery mutate_queue
+        applies — and any residual count mismatch is logged, not
+        silently zip-truncated."""
+        path, wstr = _hierarchy_annotations(queue)
+        if not path or not wstr:
+            return
+        bad = False
+        pairs = []
+        for s, w in zip_longest(path.split("/"), wstr.split("/"),
+                                fillvalue=""):
+            if s:
+                pairs.append((s, w))
+            elif w:
+                bad = True      # surplus weight with no path level
+        if len(pairs) > 1 and pairs[0][0] == "root" and \
+                not pairs[-1][1] and pairs[0][1] != "1":
+            # rooted path + unrooted weights ('root/eng' + '3'):
+            # shift weights down one level, root defaults to 1
+            ws = [w for _, w in pairs]
+            pairs = [(pairs[0][0], "1")] + \
+                [(s, w) for (s, _), w in zip(pairs[1:], ws)]
+        for name, w in pairs:
+            if not w:
+                bad = True
+                continue
+            try:
+                val = max(1.0, float(w))
+            except ValueError:
+                bad = True
+                continue
+            prev = self._qweights.setdefault(name, val)
+            if prev != val:
+                log.warning(
+                    "hdrf: conflicting weight for %r (%s vs %s); "
+                    "keeping %s", name, prev, val, prev)
+        if bad:
+            log.warning(
+                "hdrf: weights %r do not align with path %r on "
+                "queue %s; unmatched levels default to weight 1",
+                wstr, path, getattr(queue, "name", "?"))
+
     def _path_shares(self, queue_name: str):
-        """Root-to-leaf share vector for hierarchical comparison."""
-        return [self.queue_attrs[q].share
+        """Root-to-leaf share/weight vector for hierarchical
+        comparison — a weight-3 sibling tolerates 3x the share of a
+        weight-1 one before losing priority (drf.go:174)."""
+        return [self.queue_attrs[q].share / self._qweights.get(q, 1.0)
                 for q in reversed(self._queue_chain(queue_name))
                 if q in self.queue_attrs]
 
