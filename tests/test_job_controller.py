@@ -317,3 +317,74 @@ def test_depends_on_any_across_target_list():
     mgr.sync_all()
     names = {p.name for p in cluster.pods.values() if p.owner == job.uid}
     assert "anyjob-dep-0" in names   # a satisfied; b irrelevant
+
+
+def test_jax_plugin_multislice_env_contract():
+    """Subgrouped worker tasks = one jax.distributed job spanning
+    slices: global worker ids, hostnames across every slice, plus
+    TPU_SLICE_ID / TPU_NUM_SLICES feeding make_hybrid_mesh."""
+    cluster = mk_cluster()
+    mgr = ControllerManager(cluster, enabled=["job"])
+    tasks = [
+        TaskSpec(name="slice-a", replicas=2, subgroup="slice-a",
+                 template=Pod(name="t", containers=[
+                     Container(requests={"cpu": 4, TPU: 4})])),
+        TaskSpec(name="slice-b", replicas=2, subgroup="slice-b",
+                 template=Pod(name="t", containers=[
+                     Container(requests={"cpu": 4, TPU: 4})])),
+    ]
+    job = cluster.add_vcjob(mk_job(tasks=tasks,
+                                   plugins={"jax": [], "svc": []}))
+    mgr.sync_all()
+
+    workers = sorted((p for p in cluster.pods.values()
+                      if p.owner == job.uid),
+                     key=lambda p: (p.task_spec, p.task_index))
+    assert len(workers) == 4
+    seen_ids = set()
+    for pod in workers:
+        env = pod.containers[0].env
+        assert env["NUM_PROCESSES"] == "4"
+        assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 4
+        assert env["TPU_NUM_SLICES"] == "2"
+        expected_slice = 0 if pod.task_spec == "slice-a" else 1
+        assert env["TPU_SLICE_ID"] == str(expected_slice)
+        # global process id = slice offset + index within slice
+        assert env["TPU_WORKER_ID"] == \
+            str(expected_slice * 2 + pod.task_index)
+        seen_ids.add(env["TPU_WORKER_ID"])
+    assert seen_ids == {"0", "1", "2", "3"}
+
+    from volcano_tpu.workloads.bootstrap import from_env
+    info = from_env(workers[3].containers[0].env)
+    assert info.is_multislice and info.num_slices == 2
+    assert info.slice_id == 1 and info.process_id == 3
+
+
+def test_jax_plugin_shared_subgroup_tasks_one_slice():
+    """Multiple tasks sharing a subgroup are ONE slice (controller
+    dedups subgroups into one SubGroupPolicy each): slice ids key on
+    distinct subgroup names and same-slice ranks stay contiguous."""
+    cluster = mk_cluster()
+    mgr = ControllerManager(cluster, enabled=["job"])
+    tmpl = lambda: Pod(name="t", containers=[
+        Container(requests={"cpu": 4, TPU: 4})])
+    tasks = [TaskSpec(name="w0", replicas=1, subgroup="s1",
+                      template=tmpl()),
+             TaskSpec(name="w1", replicas=1, subgroup="s1",
+                      template=tmpl()),
+             TaskSpec(name="w2", replicas=1, subgroup="s2",
+                      template=tmpl()),
+             TaskSpec(name="w3", replicas=1, subgroup="s2",
+                      template=tmpl())]
+    job = cluster.add_vcjob(mk_job(tasks=tasks,
+                                   plugins={"jax": [], "svc": []}))
+    mgr.sync_all()
+    workers = sorted((p for p in cluster.pods.values()
+                      if p.owner == job.uid), key=lambda p: p.task_spec)
+    envs = {p.task_spec: p.containers[0].env for p in workers}
+    assert all(e["TPU_NUM_SLICES"] == "2" for e in envs.values())
+    assert [envs[w]["TPU_SLICE_ID"] for w in ["w0", "w1", "w2", "w3"]] \
+        == ["0", "0", "1", "1"]
+    ids = [envs[w]["TPU_WORKER_ID"] for w in ["w0", "w1", "w2", "w3"]]
+    assert ids == ["0", "1", "2", "3"]     # same-slice ranks contiguous

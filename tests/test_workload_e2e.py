@@ -97,3 +97,71 @@ def test_scheduled_pods_launch_real_jax_workers():
         assert res["loss"] == res["loss"] and res["loss"] > 0
     assert results[0]["loss"] == results[1]["loss"], \
         "ranks disagree on the globally-reduced loss"
+
+
+def test_multislice_job_trains_across_dcn_axis():
+    """Multi-slice e2e (VERDICT r4 #3): two subgrouped worker tasks
+    land on two DCN-separated slices; each bound pod's injected env
+    launches a REAL jax.distributed process; the workers build the
+    hybrid DCN x ICI mesh from TPU_SLICE_ID/TPU_NUM_SLICES and run
+    train steps whose gradient psum crosses the dcn axis (process
+    boundary = slice boundary here)."""
+    # v5e-4 slices: each subgroup's 4-chip worker FILLS its slice, so
+    # gang placement must spread the two subgroups across DCN pods
+    cluster = make_tpu_cluster([("sa", "v5e-4"), ("sb", "v5e-4")],
+                               dcn_pods={"sa": "pod-a", "sb": "pod-b"})
+    cluster.admission = default_admission()
+    mgr = ControllerManager(cluster, enabled=["job", "queue"])
+    sched = Scheduler(cluster, schedule_period=0)
+    job = cluster.add_vcjob(VCJob(
+        name="multislice", min_available=2,
+        tasks=[TaskSpec(name="slice-a", replicas=1, subgroup="slice-a",
+                        template=Pod(name="t", containers=[
+                            Container(requests={"cpu": 4, TPU: 4})])),
+               TaskSpec(name="slice-b", replicas=1, subgroup="slice-b",
+                        template=Pod(name="t", containers=[
+                            Container(requests={"cpu": 4, TPU: 4})]))],
+        plugins={"jax": [], "svc": []},
+    ))
+    for _ in range(3):
+        mgr.sync_all()
+        sched.run_once()
+        cluster.tick()
+    job = cluster.vcjobs[job.key]
+    assert job.phase is JobPhase.RUNNING
+    workers = sorted((p for p in cluster.pods.values()
+                      if p.owner == job.uid),
+                     key=lambda p: p.task_spec)
+    assert len(workers) == 2 and all(p.node_name for p in workers)
+    # the gang landed one subgroup per slice
+    assert {p.node_name.split("-w")[0] for p in workers} == {"sa", "sb"}
+
+    port = free_port()
+    procs = []
+    for pod in workers:
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)          # 1 CPU device per process
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO
+        env.update(pod.containers[0].env)   # the controller's contract
+        env["COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"  # DNS stand-in
+        env["WORKER_STEPS"] = "2"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "volcano_tpu.workloads.worker"],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    results = []
+    for proc in procs:
+        out, err = proc.communicate(timeout=180)
+        assert proc.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        results.append(json.loads(out.strip().splitlines()[-1]))
+
+    for rank, res in enumerate(results):
+        assert res["process_id"] == rank
+        assert res["num_processes"] == 2
+        assert res["num_slices"] == 2
+        assert res["slice_id"] == rank          # one slice per process
+        assert res["collective_sum"] == 2.0     # crossed the dcn axis
+        assert res["loss"] == res["loss"] and res["loss"] > 0
+    assert results[0]["loss"] == results[1]["loss"], \
+        "slices disagree on the dcn-reduced loss"

@@ -159,3 +159,74 @@ def test_ulysses_train_step_descends():
         params, opt_state, m = step(params, opt_state, batch)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0], losses
+
+
+def test_hybrid_mesh_builds_and_trains_across_dcn():
+    """Hybrid DCN x ICI mesh (VERDICT r4 #3): two slices of four
+    devices, dp over the dcn tier, fsdp/tp inside the slice.  Params
+    never name dcn so they replicate per-slice; the batch shards
+    over dcn, making the gradient mean insert the one cross-slice
+    psum per step.  Loss must match the equivalent flat mesh."""
+    from volcano_tpu.workloads.mesh import (
+        HYBRID_AXES, group_by_slice, make_hybrid_mesh,
+    )
+
+    axes = {"dcn": 2, "dp": 1, "fsdp": 2, "tp": 2, "sp": 1}
+    mesh = make_hybrid_mesh(axes)
+    assert mesh.axis_names == HYBRID_AXES
+    assert mesh.devices.shape == (2, 1, 2, 2, 1)
+
+    cfg = model_lib.tiny_config(dtype=jnp.float32)
+    optimizer = train.make_optimizer()
+    params, opt_state, shardings = train.init_sharded(
+        jax.random.key(0), cfg, mesh, optimizer)
+    # params replicated across slices: no spec names 'dcn'
+    flat = jax.tree.leaves(shardings)
+    assert all("dcn" not in (ax for p in s.spec if p
+               for ax in (p if isinstance(p, tuple) else (p,)))
+               for s in flat)
+    batch = train.synthetic_batch(jax.random.key(1), cfg,
+                                  batch_size=4, seq_len=64, mesh=mesh)
+    assert "dcn" in str(train.batch_sharding(mesh).spec)
+    step = train.make_train_step(cfg, mesh, optimizer)
+    losses = []
+    for _ in range(3):
+        params, opt_state, m = step(params, opt_state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses      # descends
+
+    # flat-mesh equivalence: same factorization without the dcn tier
+    flat_mesh = make_mesh({"dp": 2, "fsdp": 2, "tp": 2, "sp": 1})
+    p2, o2, _ = train.init_sharded(jax.random.key(0), cfg, flat_mesh,
+                                   optimizer)
+    b2 = train.synthetic_batch(jax.random.key(1), cfg, batch_size=4,
+                               seq_len=64, mesh=flat_mesh)
+    s2 = train.make_train_step(cfg, flat_mesh, optimizer)
+    _, _, m2 = s2(p2, o2, b2)
+    np.testing.assert_allclose(losses[0], float(m2["loss"]),
+                               rtol=1e-5)
+
+    # slice grouping fallback: single-process devices partition
+    # sequentially into equal chunks
+    groups = group_by_slice(jax.devices(), 2)
+    assert [len(g) for g in groups] == [4, 4]
+
+
+def test_hybrid_mesh_rejects_bad_factorization():
+    from volcano_tpu.workloads.mesh import make_hybrid_mesh
+
+    with pytest.raises(ValueError):
+        make_hybrid_mesh({"dcn": 3, "fsdp": 2})   # 6 != 8 devices
+
+
+def test_bootstrap_parses_slice_env():
+    from volcano_tpu.workloads.bootstrap import from_env
+
+    info = from_env({"TPU_WORKER_ID": "3", "NUM_PROCESSES": "4",
+                     "COORDINATOR_ADDRESS": "h0:8476",
+                     "TPU_SLICE_ID": "1", "TPU_NUM_SLICES": "2"})
+    assert info.is_multislice and info.slice_id == 1
+    assert info.num_slices == 2 and info.process_id == 3
+    # absent slice env -> single-slice defaults
+    info = from_env({"TPU_WORKER_ID": "0"})
+    assert not info.is_multislice and info.num_slices == 1

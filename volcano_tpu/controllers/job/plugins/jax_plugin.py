@@ -4,15 +4,29 @@ The TPU-native replacement for the reference's pytorch plugin
 (plugins/distributed-framework/pytorch/pytorch.go:46-52 emits
 MASTER_ADDR/RANK/WORLD_SIZE): every worker pod gets
 
-    TPU_WORKER_ID        - its index within the worker task group
+    TPU_WORKER_ID        - its GLOBAL process index across all slices
     TPU_WORKER_HOSTNAMES - all worker hostnames, comma separated
     COORDINATOR_ADDRESS  - worker 0 host:port for jax.distributed
-    NUM_PROCESSES        - worker replica count
+    NUM_PROCESSES        - total worker replica count
 
 plus the `google.com/tpu` toleration GKE puts on TPU node pools, so no
 ssh, no hostfile and no NCCL vars are needed — jax.distributed and the
 TPU runtime self-assemble the mesh (consumed by
 volcano_tpu.workloads.bootstrap).
+
+Multi-slice: a job whose worker tasks carry subGroupPolicy
+memberships (TaskSpec.subgroup — each subgroup is gang-placed into
+its own ICI domain by the scheduler's topology_alloc) is ONE
+jax.distributed job spanning every slice; each pod additionally gets
+
+    TPU_SLICE_ID         - index of its subgroup (spec order)
+    TPU_NUM_SLICES       - number of subgrouped worker tasks
+
+so the workload builds a hybrid DCN x ICI mesh (mesh.make_hybrid_mesh)
+with dp over DCN and fsdp/tp/sp inside the slice.  This closes the
+loop on the scheduler's multi-slice placement: the domains
+subGroupPolicy buys are the slices the dcn axis spans
+(scheduling/v1beta1 types.go:173-223 subGroupPolicy analogue).
 """
 
 from __future__ import annotations
@@ -47,17 +61,50 @@ class JaxPlugin(JobPlugin):
                 return spec.name
         return job.tasks[0].name if job.tasks else ""
 
+    def _worker_tasks(self, job):
+        """The task groups that form the process grid, ordered so
+        same-slice processes are CONTIGUOUS in the global rank space
+        (group_by_slice's sequential fallback depends on that).  A
+        slice is one scheduler-placed subgroup domain — multiple
+        tasks may share a subgroup (controller.py dedups subgroups by
+        name into one SubGroupPolicy each), so slice ids key on
+        DISTINCT subgroup names in spec order, not on tasks.  Returns
+        [(task, slice_id)]; single-slice jobs get the lone worker
+        task with slice_id 0."""
+        subgroups = []              # distinct, spec order
+        for t in job.tasks:
+            if t.subgroup and t.subgroup not in subgroups:
+                subgroups.append(t.subgroup)
+        if len(subgroups) > 1:
+            order = {sg: i for i, sg in enumerate(subgroups)}
+            sliced = [t for t in job.tasks if t.subgroup]
+            sliced.sort(key=lambda t: order[t.subgroup])  # stable
+            return [(t, order[t.subgroup]) for t in sliced]
+        name = self._worker_task_name(job)
+        return [(t, 0) for t in job.tasks if t.name == name]
+
     def on_pod_create(self, pod, job):
-        worker_task = self._worker_task_name(job)
-        hostnames = task_hostnames(job, worker_task)
+        tasks = self._worker_tasks(job)
+        num_slices = len({sid for _, sid in tasks})
+        hostnames = []
+        for t, _ in tasks:
+            hostnames.extend(task_hostnames(job, t.name))
         if not hostnames:
             return
         set_env(pod, "TPU_WORKER_HOSTNAMES", ",".join(hostnames))
         set_env(pod, "COORDINATOR_ADDRESS",
                 f"{hostnames[0]}:{self.port}")
         set_env(pod, "NUM_PROCESSES", str(len(hostnames)))
-        if pod.task_spec == worker_task:
-            set_env(pod, "TPU_WORKER_ID", str(pod.task_index))
+        offset = 0
+        for t, slice_id in tasks:
+            if pod.task_spec == t.name:
+                set_env(pod, "TPU_WORKER_ID",
+                        str(offset + pod.task_index))
+                if num_slices > 1:
+                    set_env(pod, "TPU_SLICE_ID", str(slice_id))
+                    set_env(pod, "TPU_NUM_SLICES", str(num_slices))
+                break
+            offset += t.replicas
 
         # ride GKE TPU node-pool taints without user boilerplate
         requests_tpu = any(

@@ -3,13 +3,17 @@
 The jax job plugin (volcano_tpu.controllers.job.plugins.jax_plugin)
 injects into every worker pod:
 
-    TPU_WORKER_ID        - this worker's index within the slice
-    TPU_WORKER_HOSTNAMES - comma-separated worker hostnames
+    TPU_WORKER_ID        - this worker's GLOBAL process index
+    TPU_WORKER_HOSTNAMES - comma-separated worker hostnames (all slices)
     COORDINATOR_ADDRESS  - host:port of process 0 for jax.distributed
     NUM_PROCESSES        - total process count
+    TPU_SLICE_ID         - this worker's ICI slice (multi-slice only)
+    TPU_NUM_SLICES       - slice count (multi-slice only; default 1)
 
 This module is the consumer side (reference contract analogue:
 pytorch plugin's MASTER_ADDR/RANK/WORLD_SIZE, pytorch.go:46-52).
+The slice fields feed mesh.make_hybrid_mesh: tier 0 = ICI within the
+slice, tier 1 = DCN across slices (SURVEY §5).
 """
 
 from __future__ import annotations
@@ -22,6 +26,8 @@ ENV_WORKER_ID = "TPU_WORKER_ID"
 ENV_HOSTNAMES = "TPU_WORKER_HOSTNAMES"
 ENV_COORDINATOR = "COORDINATOR_ADDRESS"
 ENV_NUM_PROCESSES = "NUM_PROCESSES"
+ENV_SLICE_ID = "TPU_SLICE_ID"
+ENV_NUM_SLICES = "TPU_NUM_SLICES"
 DEFAULT_COORDINATOR_PORT = 8476
 
 
@@ -31,10 +37,16 @@ class BootstrapInfo:
     num_processes: int = 1
     coordinator_address: str = ""
     hostnames: Optional[List[str]] = None
+    slice_id: int = 0
+    num_slices: int = 1
 
     @property
     def is_distributed(self) -> bool:
         return self.num_processes > 1
+
+    @property
+    def is_multislice(self) -> bool:
+        return self.num_slices > 1
 
 
 def from_env(environ=None) -> BootstrapInfo:
@@ -49,6 +61,8 @@ def from_env(environ=None) -> BootstrapInfo:
         num_processes=num,
         coordinator_address=coordinator,
         hostnames=hostnames or None,
+        slice_id=int(env.get(ENV_SLICE_ID, 0)),
+        num_slices=int(env.get(ENV_NUM_SLICES, 1)),
     )
 
 

@@ -45,9 +45,18 @@ def train_step(params, opt_state, batch, cfg: ModelConfig, optimizer,
     return params, opt_state, metrics
 
 
+def data_axes(mesh: Mesh) -> tuple:
+    """Mesh axes the batch dim shards over: dp+fsdp, plus the
+    inter-slice dcn axis on a hybrid mesh."""
+    return ("dcn", "dp", "fsdp") if "dcn" in mesh.axis_names \
+        else ("dp", "fsdp")
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Tokens [b, t]: batch over dp+fsdp, sequence over sp."""
-    return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+    """Tokens [b, t]: batch over data_axes (params never name dcn,
+    so on a hybrid mesh the gradient mean inserts the one
+    cross-slice psum per step), sequence over sp."""
+    return NamedSharding(mesh, P(data_axes(mesh), "sp"))
 
 
 def init_sharded(rng, cfg: ModelConfig, mesh: Mesh, optimizer):

@@ -37,14 +37,25 @@ def run(environ=None) -> dict:
     from volcano_tpu.workloads import train
 
     n_dev = jax.device_count()
-    dp = int(os.environ.get("WORKER_DP", n_dev))
-    mesh = mesh_lib.make_mesh({"dp": dp, "fsdp": n_dev // dp})
+    if info.is_multislice:
+        # hybrid DCN x ICI: dp rides the dcn axis across slices so
+        # the gradient psum is the ONLY per-step cross-slice traffic;
+        # within the slice params shard over fsdp
+        per_slice = n_dev // info.num_slices
+        mesh = mesh_lib.make_hybrid_mesh(
+            {"dcn": info.num_slices,
+             "dp": int(os.environ.get("WORKER_DP", 1)),
+             "fsdp": per_slice // int(os.environ.get("WORKER_DP", 1))})
+    else:
+        dp = int(os.environ.get("WORKER_DP", n_dev))
+        mesh = mesh_lib.make_mesh({"dp": dp, "fsdp": n_dev // dp})
 
     # collective sanity: every device contributes 1; the global sum
-    # crossing process boundaries proves the mesh spans the job
+    # crossing process (and slice) boundaries proves the mesh spans
+    # the job
     ones = jax.jit(
         lambda: jnp.ones((n_dev,)),
-        out_shardings=NamedSharding(mesh, P(("dp", "fsdp"))))()
+        out_shardings=NamedSharding(mesh, P(train.data_axes(mesh))))()
     collective_sum = float(jax.jit(
         jnp.sum, out_shardings=NamedSharding(mesh, P()))(ones))
 
@@ -72,6 +83,8 @@ def run(environ=None) -> dict:
         "device_count": n_dev,
         "collective_sum": collective_sum,
         "loss": round(loss, 4),
+        "slice_id": info.slice_id,
+        "num_slices": info.num_slices,
     }
 
 
