@@ -388,3 +388,32 @@ def test_jax_plugin_shared_subgroup_tasks_one_slice():
         == ["0", "0", "1", "1"]
     ids = [envs[w]["TPU_WORKER_ID"] for w in ["w0", "w1", "w2", "w3"]]
     assert ids == ["0", "1", "2", "3"]     # same-slice ranks contiguous
+
+
+def test_jax_plugin_one_shared_subgroup_spans_all_its_tasks():
+    """All worker tasks ganged into ONE subgroup still form one
+    process grid across every task (no slice env — it's a single
+    slice — but global ids and full hostname list)."""
+    cluster = mk_cluster()
+    mgr = ControllerManager(cluster, enabled=["job"])
+    tmpl = lambda: Pod(name="t", containers=[
+        Container(requests={"cpu": 4, TPU: 4})])
+    tasks = [TaskSpec(name="w0", replicas=2, subgroup="s1",
+                      template=tmpl()),
+             TaskSpec(name="w1", replicas=2, subgroup="s1",
+                      template=tmpl())]
+    job = cluster.add_vcjob(mk_job(tasks=tasks,
+                                   plugins={"jax": [], "svc": []}))
+    mgr.sync_all()
+    workers = sorted((p for p in cluster.pods.values()
+                      if p.owner == job.uid),
+                     key=lambda p: (p.task_spec, p.task_index))
+    assert len(workers) == 4
+    ids = []
+    for pod in workers:
+        env = pod.containers[0].env
+        assert env["NUM_PROCESSES"] == "4"
+        assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 4
+        assert "TPU_NUM_SLICES" not in env      # one slice: no dcn tier
+        ids.append(env["TPU_WORKER_ID"])
+    assert sorted(ids) == ["0", "1", "2", "3"]

@@ -83,8 +83,10 @@ def main(argv=None) -> int:
                         help="run per-node QoS agents: 'all' or a "
                              "comma-separated list of node names")
     parser.add_argument("--usage-source", default="",
-                        help="agent usage backend: prometheus:URL or "
-                             "es:URL (default: static zeros)")
+                        help="agent usage backend: prometheus:URL, "
+                             "es:URL, or collectors:NAME[,NAME...] "
+                             "(registered collectors, e.g. local,tpu;"
+                             " default: static zeros)")
     parser.add_argument("--enforcer", default="none",
                         help="node-agent OS enforcement: 'none' "
                              "(publish only), 'record' (in-memory "
@@ -234,9 +236,18 @@ def main(argv=None) -> int:
                 usage_source = metrics_source.PrometheusUsageSource(url)
             elif kind == "es" and url:
                 usage_source = metrics_source.ElasticsearchUsageSource(url)
+            elif kind == "collectors" and url:
+                # pluggable metric collection (agent/collect.py):
+                # e.g. collectors:local,tpu
+                from volcano_tpu.agent.collect import build_provider
+                try:
+                    usage_source = build_provider(url)
+                except ValueError as e:
+                    parser.error(str(e))
             else:
                 parser.error(f"unknown --usage-source {args.usage_source!r}"
-                             " (want prometheus:URL or es:URL)")
+                             " (want prometheus:URL, es:URL, or "
+                             "collectors:NAME[,NAME...])")
         if usage_source is not None:
             provider = usage_source
             agent_kwargs = {}

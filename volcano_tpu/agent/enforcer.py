@@ -108,20 +108,38 @@ def net_cls_value(minor: int) -> str:
 
 
 class PodQoSDecision:
-    """One pod's computed QoS knobs (agent._apply_cpu_qos outputs)."""
+    """One pod's computed QoS knobs, filled incrementally by the
+    agent's handler pipeline (cpu knobs by cpuqos, memory knobs by
+    memoryqosv2) and applied once by the enforcement handler.
+
+    Memory knob semantics (cgroup-v2; reference memoryqosv2 handler):
+      memory.min  — kernel-guaranteed, never reclaimed (online pods)
+      memory.low  — reclaim-protected while the node has slack
+      memory.high — allocation-throttled soft cap (BE pods)"""
 
     __slots__ = ("pod_key", "uid", "burst_millis", "throttled",
-                 "request_millis", "memory_high_bytes")
+                 "request_millis", "memory_high_bytes",
+                 "memory_min_bytes", "memory_low_bytes")
 
-    def __init__(self, pod_key: str, uid: str, burst_millis: int,
-                 throttled: bool, request_millis: int,
-                 memory_high_bytes: Optional[int] = None):
+    def __init__(self, pod_key: str, uid: str, burst_millis: int = 0,
+                 throttled: bool = False, request_millis: int = 0,
+                 memory_high_bytes: Optional[int] = None,
+                 memory_min_bytes: Optional[int] = None,
+                 memory_low_bytes: Optional[int] = None):
         self.pod_key = pod_key
         self.uid = uid
         self.burst_millis = burst_millis
         self.throttled = throttled
         self.request_millis = request_millis
         self.memory_high_bytes = memory_high_bytes
+        self.memory_min_bytes = memory_min_bytes
+        self.memory_low_bytes = memory_low_bytes
+
+    def knobs(self) -> tuple:
+        """Value tuple for change detection (RecordingEnforcer)."""
+        return (self.burst_millis, self.throttled, self.request_millis,
+                self.memory_high_bytes, self.memory_min_bytes,
+                self.memory_low_bytes)
 
 
 class Enforcer(abc.ABC):
@@ -169,11 +187,7 @@ class RecordingEnforcer(Enforcer):
 
     def apply_pod_qos(self, decision):
         prev = self.pods.get(decision.uid)
-        if prev is not None and \
-                (prev.burst_millis, prev.throttled, prev.request_millis,
-                 prev.memory_high_bytes) == \
-                (decision.burst_millis, decision.throttled,
-                 decision.request_millis, decision.memory_high_bytes):
+        if prev is not None and prev.knobs() == decision.knobs():
             return                      # unchanged: no ledger noise
         self.pods[decision.uid] = decision
         self.log.append(("pod_qos", decision.uid, decision.burst_millis,
@@ -265,6 +279,13 @@ class CgroupV2Enforcer(Enforcer):
         self._write(os.path.join(d, "memory.high"),
                     str(decision.memory_high_bytes)
                     if decision.memory_high_bytes else "max")
+        # memoryqosv2 guarantee knobs (kernel defaults are 0: writing
+        # them explicitly keeps re-application idempotent after a
+        # pod's QoS class changes)
+        self._write(os.path.join(d, "memory.min"),
+                    str(decision.memory_min_bytes or 0))
+        self._write(os.path.join(d, "memory.low"),
+                    str(decision.memory_low_bytes or 0))
 
     def remove_pod(self, uid: str) -> None:
         d = self._dir(uid)

@@ -75,7 +75,11 @@ class JaxPlugin(JobPlugin):
         for t in job.tasks:
             if t.subgroup and t.subgroup not in subgroups:
                 subgroups.append(t.subgroup)
-        if len(subgroups) > 1:
+        if subgroups:
+            # even ONE subgroup spanning several tasks is a process
+            # grid over all of them (a single-slice gang): falling
+            # back to the first task would strand the others with no
+            # worker id and a too-small NUM_PROCESSES
             order = {sg: i for i, sg in enumerate(subgroups)}
             sliced = [t for t in job.tasks if t.subgroup]
             sliced.sort(key=lambda t: order[t.subgroup])  # stable
