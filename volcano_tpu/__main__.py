@@ -47,7 +47,9 @@ def main(argv=None) -> int:
                              "components against the wire instead of an "
                              "in-memory cluster")
     parser.add_argument("--token", default="",
-                        help="bearer token for state-server writes")
+                        help="cluster bearer token (required on all "
+                             "state-server routes except /healthz "
+                             "and /metrics)")
     parser.add_argument("--token-file", default="")
     parser.add_argument("--ca-cert", default="",
                         help="CA bundle to verify an https state "
@@ -353,15 +355,37 @@ def main(argv=None) -> int:
         while not stop.is_set():
             is_leader = elector.is_leader if elector is not None else True
             if is_leader:
-                sync_node_agents()
-                if mgr is not None:
-                    mgr.sync_all()
-                if sched is not None:
-                    sched.run_once()
-                if agent_sched is not None:
-                    agent_sched.run_until_drained()
-                if not remote:
-                    cluster.tick()
+                try:
+                    sync_node_agents()
+                    if mgr is not None:
+                        mgr.sync_all()
+                    if sched is not None:
+                        sched.run_once()
+                    if agent_sched is not None:
+                        agent_sched.run_until_drained()
+                    if not remote:
+                        cluster.tick()
+                except Exception:  # noqa: BLE001
+                    # REMOTE mode only: a wire blip (server restart,
+                    # partition healing mid-request) must not kill the
+                    # process — the reference scheduler rides out
+                    # apiserver disconnects the same way.  The elector
+                    # steps us down if the server stays unreachable;
+                    # the next cycle resyncs.  (Found by
+                    # tools/chaos_partition.py: a severed in-flight
+                    # POST crashed the whole scheduler.)  In LOCAL
+                    # mode the crash must propagate: the state is the
+                    # in-process cluster, possibly half-mutated, and
+                    # the snapshot guard below relies on clean_exit
+                    # staying False to not overwrite the last good
+                    # pickle.
+                    if not remote:
+                        raise
+                    log.exception("scheduling cycle failed; retrying "
+                                  "next period")
+                # a failed cycle still counts toward --cycles: a
+                # bounded run against a dead server must terminate,
+                # not spin forever
                 cycles += 1
             if args.cycles and cycles >= args.cycles:
                 break

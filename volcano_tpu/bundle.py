@@ -1,0 +1,366 @@
+"""Deploy bundle generator — config, units, scrape config, dashboards.
+
+Reference parity: installer/helm/ (chart with values + CRDs) and
+benchmark/manifests/monitoring/ (Grafana + Prometheus manifests for
+the exported metric families).  volcano-tpu is a standalone control
+plane, so its "chart" is a rendered directory an operator can run
+as-is: systemd units OR a docker-compose file (both rendered from the
+same values), the scheduler conf, a generated cluster bearer token,
+a Prometheus scrape config that carries that token, and Grafana
+dashboard JSON over the families volcano_tpu.metrics actually
+exports.
+
+    python -m volcano_tpu.bundle --out ./bundle \
+        --topology sa:v5e-256,sb:v5e-256 --port 8700
+
+renders:
+    bundle/
+      values.json            the resolved values (re-render input)
+      token                  cluster bearer token (0600)
+      scheduler.conf.yaml    actions/tiers the scheduler loads
+      topology.json          slice layout consumed by cluster-init
+      cluster-init.sh        registers the nodes via vtpctl
+      systemd/*.service      one unit per role
+      docker-compose.yaml    same roles as containers
+      prometheus.yml         scrape config (bearer token wired)
+      grafana/*.json         dashboards over the exported families
+      README.md              bring-up order
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import secrets
+from typing import Dict, List
+
+# Every metric family the control plane exports, by type — dashboards
+# are generated from (and tests validated against) THIS table, so a
+# renamed family breaks the build, not the operator's dashboard.
+# Histogram-typed families export <name>_count / <name>_sum.
+FAMILIES: Dict[str, str] = {
+    # scheduler core (metrics.py call sites)
+    "e2e_scheduling_latency_seconds": "histogram",
+    "pod_scheduling_latency_seconds": "histogram",
+    "task_scheduling_latency_seconds": "histogram",
+    "action_latency_seconds": "histogram",
+    "plugin_latency_seconds": "histogram",
+    "open_session_duration_seconds": "histogram",
+    "schedule_attempts_total": "counter",
+    "unschedule_job_count": "gauge",
+    "unschedule_task_count": "gauge",
+    "job_retry_counts": "counter",
+    # preemption / reclaim
+    "pod_preemption_total": "counter",
+    "preemption_victims_total": "counter",
+    "gang_preemption_total": "counter",
+    "pod_reclaim_total": "counter",
+    "reclaim_commits_total": "counter",
+    "shuffle_victims_total": "counter",
+    # fair share
+    "job_share": "gauge",
+    "queue_share": "gauge",
+    "queue_weight": "gauge",
+    "queue_allocated_milli_cpu": "gauge",
+    "queue_allocated_memory_bytes": "gauge",
+    "queue_allocated_scalar_resources": "gauge",
+    "queue_deserved_milli_cpu": "gauge",
+    "queue_request_milli_cpu": "gauge",
+    # agent scheduler (fast path)
+    "agent_pod_e2e_latency_seconds": "histogram",
+    "agent_bind_conflicts_total": "counter",
+    "agent_unschedulable_total": "counter",
+}
+
+
+def _mean_expr(family: str) -> str:
+    return (f"rate({family}_sum[5m]) / "
+            f"clamp_min(rate({family}_count[5m]), 1e-9)")
+
+
+def _panel(panel_id: int, title: str, exprs: List[str], x: int, y: int,
+           unit: str = "short") -> dict:
+    return {
+        "id": panel_id, "type": "timeseries", "title": title,
+        "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+        "fieldConfig": {"defaults": {"unit": unit}, "overrides": []},
+        "targets": [{"expr": e, "refId": chr(ord("A") + i)}
+                    for i, e in enumerate(exprs)],
+        "datasource": {"type": "prometheus", "uid": "${datasource}"},
+    }
+
+
+def scheduler_dashboard() -> dict:
+    """Latency + throughput + fairness over the scheduler families."""
+    panels = [
+        _panel(1, "End-to-end scheduling latency (mean)",
+               [_mean_expr("e2e_scheduling_latency_seconds"),
+                _mean_expr("pod_scheduling_latency_seconds")],
+               0, 0, unit="s"),
+        _panel(2, "Action latency by action (mean)",
+               [f"sum by (action) (rate(action_latency_seconds_sum[5m]))"
+                f" / sum by (action) "
+                f"(clamp_min(rate(action_latency_seconds_count[5m]),"
+                f" 1e-9))"], 12, 0, unit="s"),
+        _panel(3, "Plugin latency by plugin (mean)",
+               [f"sum by (plugin) (rate(plugin_latency_seconds_sum[5m]))"
+                f" / sum by (plugin) "
+                f"(clamp_min(rate(plugin_latency_seconds_count[5m]),"
+                f" 1e-9))"], 0, 8, unit="s"),
+        _panel(4, "Schedule attempts / retries",
+               ["rate(schedule_attempts_total[5m])",
+                "rate(job_retry_counts[5m])"], 12, 8),
+        _panel(5, "Unschedulable jobs / tasks",
+               ["unschedule_job_count", "unschedule_task_count"],
+               0, 16),
+        _panel(6, "Preemption + reclaim activity",
+               ["rate(pod_preemption_total[5m])",
+                "rate(preemption_victims_total[5m])",
+                "rate(gang_preemption_total[5m])",
+                "rate(pod_reclaim_total[5m])",
+                "rate(shuffle_victims_total[5m])"], 12, 16),
+        _panel(7, "Queue dominant share vs weight",
+               ["queue_share", "queue_weight"], 0, 24),
+        _panel(8, "Queue allocated mCPU / chips",
+               ["queue_allocated_milli_cpu",
+                "queue_allocated_scalar_resources"], 12, 24),
+    ]
+    return {
+        "title": "volcano-tpu / scheduler", "uid": "vtp-scheduler",
+        "timezone": "browser", "schemaVersion": 39, "version": 1,
+        "refresh": "10s", "panels": panels,
+        "templating": {"list": [{
+            "name": "datasource", "type": "datasource",
+            "query": "prometheus"}]},
+    }
+
+
+def agent_dashboard() -> dict:
+    """Fast-path + session health over the agent families."""
+    panels = [
+        _panel(1, "Agent-scheduler pod e2e latency (mean)",
+               [_mean_expr("agent_pod_e2e_latency_seconds")], 0, 0,
+               unit="s"),
+        _panel(2, "Bind conflicts / unschedulable (fast path)",
+               ["rate(agent_bind_conflicts_total[5m])",
+                "rate(agent_unschedulable_total[5m])"], 12, 0),
+        _panel(3, "Session open duration (mean)",
+               [_mean_expr("open_session_duration_seconds")], 0, 8,
+               unit="s"),
+        _panel(4, "Per-job dominant share",
+               ["topk(20, job_share)"], 12, 8),
+    ]
+    return {
+        "title": "volcano-tpu / agents", "uid": "vtp-agents",
+        "timezone": "browser", "schemaVersion": 39, "version": 1,
+        "refresh": "10s", "panels": panels,
+        "templating": {"list": [{
+            "name": "datasource", "type": "datasource",
+            "query": "prometheus"}]},
+    }
+
+
+def dashboard_metric_names(dash: dict) -> set:
+    """Metric families referenced by a dashboard's exprs (validation
+    seam: tests cross-check these against FAMILIES and a live
+    exposition)."""
+    import re
+    names = set()
+    for panel in dash.get("panels", []):
+        for tgt in panel.get("targets", []):
+            for m in re.finditer(r"[a-z_][a-z0-9_]*", tgt["expr"]):
+                tok = m.group(0)
+                if tok in FAMILIES:
+                    # exact family first: gauge names may themselves
+                    # end in _count (unschedule_job_count)
+                    names.add(tok)
+                    continue
+                base = re.sub(r"_(count|sum)$", "", tok)
+                if base in FAMILIES:
+                    names.add(base)
+    return names
+
+
+DEFAULT_CONF = {
+    "actions": "enqueue, allocate, backfill, preempt, reclaim",
+    "tiers": [
+        {"plugins": [
+            {"name": "priority"}, {"name": "gang"},
+            {"name": "conformance"}]},
+        {"plugins": [
+            {"name": "overcommit"}, {"name": "drf"},
+            {"name": "predicates"}, {"name": "proportion"},
+            {"name": "nodeorder"}, {"name": "binpack"},
+            {"name": "networktopologyaware"}]},
+    ],
+}
+
+# role -> (command template, metrics port offset from the server
+# port).  The scheduler/controllers/agents processes each carry their
+# own Prometheus registry (the families the dashboards query live
+# THERE, not on the state server), so every role gets a --metrics-port
+# and the scrape config targets all of them.
+ROLES = [
+    ("server", "volcano-tpu-server --port {port} --state "
+               "{data_dir}/state.pkl --token-file {bundle_dir}/token",
+     0),
+    ("scheduler", "volcano-tpu --cluster-url http://127.0.0.1:{port} "
+                  "--components scheduler --leader-elect --holder %H "
+                  "--conf {bundle_dir}/scheduler.conf.yaml "
+                  "--metrics-port {port1} "
+                  "--token-file {bundle_dir}/token", 1),
+    ("controllers", "volcano-tpu --cluster-url http://127.0.0.1:{port}"
+                    " --components controllers "
+                    "--metrics-port {port2} "
+                    "--token-file {bundle_dir}/token", 2),
+    ("agents", "volcano-tpu --cluster-url http://127.0.0.1:{port} "
+               "--components none --agent-scheduler --node-agents all "
+               "--usage-source collectors:local,tpu "
+               "--enforcer cgroup:/sys/fs/cgroup,tc:eth0 "
+               "--metrics-port {port3} "
+               "--token-file {bundle_dir}/token", 3),
+]
+
+UNIT_TEMPLATE = """[Unit]
+Description=volcano-tpu {role}
+After=network-online.target {after}
+[Service]
+ExecStart={cmd}
+Restart=always
+RestartSec=2
+[Install]
+WantedBy=multi-user.target
+"""
+
+
+# yaml is not a baked-in dependency everywhere; the conf loader
+# accepts JSON (a YAML subset), so the bundle writes JSON-formatted
+# .yaml files that both PyYAML and the loader parse.
+def render(out_dir: str, topology: str = "sa:v5e-256",
+           port: int = 8700, data_dir: str = "/var/lib/volcano-tpu",
+           token: str = "") -> Dict[str, str]:
+    """Render the bundle; returns {relative path: absolute path}."""
+    bundle_dir = os.path.abspath(out_dir)
+    os.makedirs(bundle_dir, exist_ok=True)
+    values = {"topology": topology, "port": port,
+              "port1": port + 1, "port2": port + 2, "port3": port + 3,
+              "data_dir": data_dir, "bundle_dir": bundle_dir}
+    written: Dict[str, str] = {}
+
+    def emit(rel: str, content: str, mode: int = 0o644):
+        path = os.path.join(bundle_dir, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(content)
+        os.chmod(path, mode)
+        written[rel] = path
+
+    emit("values.json", json.dumps(values, indent=2) + "\n")
+    emit("token", (token or secrets.token_urlsafe(32)) + "\n", 0o600)
+    emit("scheduler.conf.yaml",
+         json.dumps(DEFAULT_CONF, indent=2) + "\n")
+
+    slices = []
+    for item in (s for s in topology.split(",") if s):
+        name, _, kind = item.partition(":")
+        slices.append({"name": name, "kind": kind or "v5e-256"})
+    emit("topology.json", json.dumps({"slices": slices}, indent=2)
+         + "\n")
+    slice_args = " ".join(f"{s['name']}={s['kind']}" for s in slices)
+    emit("cluster-init.sh", "\n".join(
+        ["#!/bin/sh", "# registers the slice topology on the state "
+         "server (run once)", "set -e",
+         f"vtpctl --server http://127.0.0.1:{port} "
+         f"--token-file {bundle_dir}/token init --slices "
+         f"{slice_args}", ""]), 0o755)
+
+    after = {"server": "", "scheduler": "volcano-tpu-server.service",
+             "controllers": "volcano-tpu-server.service",
+             "agents": "volcano-tpu-server.service"}
+    for role, cmd_tmpl, _off in ROLES:
+        cmd = cmd_tmpl.format(**values)
+        emit(f"systemd/volcano-tpu-{role}.service",
+             UNIT_TEMPLATE.format(role=role, cmd=cmd,
+                                  after=after[role]))
+
+    compose_services = {}
+    for role, cmd_tmpl, _off in ROLES:
+        cmd = cmd_tmpl.format(**dict(
+            values, bundle_dir="/bundle", data_dir="/data"))
+        # %H is a systemd specifier; in compose the container's
+        # hostname is the unique holder identity — substitute via a
+        # shell so two scaled scheduler replicas never present the
+        # same lease holder (identical holders would BOTH hold it)
+        compose_services[role] = {
+            "image": "volcano-tpu:latest",
+            "command": ["sh", "-c", cmd.replace("%H", "$(hostname)")],
+            "network_mode": "host",
+            "volumes": [f"{bundle_dir}:/bundle:ro", "data:/data"],
+            **({} if role == "server"
+               else {"depends_on": ["server"]}),
+        }
+    emit("docker-compose.yaml", json.dumps(
+        {"services": compose_services, "volumes": {"data": {}}},
+        indent=2) + "\n")
+
+    emit("prometheus.yml", json.dumps({
+        "global": {"scrape_interval": "10s"},
+        "scrape_configs": [{
+            "job_name": "volcano-tpu",
+            "bearer_token_file": f"{bundle_dir}/token",
+            "static_configs": [{
+                # the state server's own registry AND every role
+                # process's --metrics-port (scheduler latency/fair-
+                # share families live in those registries)
+                "targets": [f"127.0.0.1:{port + off}"
+                            for _, _, off in ROLES],
+                "labels": {"control_plane": "volcano-tpu"}}],
+        }]}, indent=2) + "\n")
+
+    for fname, dash in (("scheduler.json", scheduler_dashboard()),
+                        ("agents.json", agent_dashboard())):
+        emit(f"grafana/{fname}", json.dumps(dash, indent=2) + "\n")
+
+    emit("README.md", BUNDLE_README.format(**values))
+    return written
+
+
+BUNDLE_README = """# volcano-tpu deploy bundle
+
+Rendered by `python -m volcano_tpu.bundle` — edit values.json and
+re-render rather than hand-editing outputs.
+
+Bring-up order:
+1. `systemd/`: `systemctl enable --now volcano-tpu-server`, then the
+   other units (they After= the server).  Or: `docker compose up`.
+2. `./cluster-init.sh` once to register the topology
+   ({topology}).
+3. Point Prometheus at `prometheus.yml` (the scrape carries the
+   bearer token — ALL state-server routes except /healthz and
+   /metrics require it) and import `grafana/*.json`.
+
+The token in `token` (mode 0600) guards every read and write on the
+state server at port {port}.
+"""
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="render the volcano-tpu deploy bundle")
+    parser.add_argument("--out", required=True)
+    parser.add_argument("--topology", default="sa:v5e-256")
+    parser.add_argument("--port", type=int, default=8700)
+    parser.add_argument("--data-dir", default="/var/lib/volcano-tpu")
+    parser.add_argument("--token", default="",
+                        help="cluster token (default: generate)")
+    args = parser.parse_args(argv)
+    written = render(args.out, args.topology, args.port,
+                     args.data_dir, args.token)
+    for rel in sorted(written):
+        print(rel)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -561,7 +561,8 @@ def build_parser() -> argparse.ArgumentParser:
                              "the live control plane instead of a "
                              "state file)")
     parser.add_argument("--token", default="",
-                        help="bearer token for state-server writes")
+                        help="cluster bearer token (required for ALL "
+                             "state-server routes when configured)")
     parser.add_argument("--token-file", default="")
     parser.add_argument("--ca-cert", default="",
                         help="CA bundle to verify an https server")

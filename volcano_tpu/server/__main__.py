@@ -37,8 +37,9 @@ def main(argv=None) -> int:
                         help="generate a self-signed cert/key at the "
                              "--tls-cert/--tls-key paths first")
     parser.add_argument("--token", default="",
-                        help="bearer token required on mutating routes"
-                             " (also presented on webhook callouts)")
+                        help="cluster bearer token: required on every "
+                             "route except /healthz and /metrics "
+                             "(also presented on webhook callouts)")
     parser.add_argument("--token-file", default="")
     parser.add_argument("--webhook-ca-cert", default="",
                         help="CA bundle for --webhook-url callouts")

@@ -38,9 +38,11 @@ MAX_TRACKED = 100_000
 
 class AuditExporter:
     def __init__(self, base_url: str, timeout: float = 5.0,
-                 ca_cert: str = "", insecure: bool = False):
+                 ca_cert: str = "", insecure: bool = False,
+                 token: str = ""):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self.token = token
         from volcano_tpu.server.tlsutil import client_ssl_context
         self._ssl_ctx = client_ssl_context(ca_cert, insecure)
         self._since = 0
@@ -64,8 +66,11 @@ class AuditExporter:
         total = 0
         while True:
             url = f"{self.base_url}/audit?since={self._since}"
+            req = urllib.request.Request(url, headers={
+                "Authorization": f"Bearer {self.token}"}
+                if self.token else {})
             try:
-                with urllib.request.urlopen(url, timeout=self.timeout,
+                with urllib.request.urlopen(req, timeout=self.timeout,
                                             context=self._ssl_ctx
                                             ) as resp:
                     payload = json.load(resp)
