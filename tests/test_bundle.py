@@ -59,7 +59,23 @@ def test_render_produces_runnable_bundle(tmp_path):
     # compose schedulers must not share a literal lease holder
     compose_cmd = " ".join(json.load(open(
         written["docker-compose.yaml"]))["services"]["scheduler"]["command"])
-    assert "%H" not in compose_cmd and "$(hostname)" in compose_cmd
+    assert "%H" not in compose_cmd
+    assert "/proc/sys/kernel/random/uuid" in compose_cmd
+
+    # every conf plugin name resolves in the registry — a typo'd name
+    # would be silently skipped at session open
+    import volcano_tpu.plugins  # noqa: F401 — registers builders
+    from volcano_tpu.framework.plugins import PLUGIN_BUILDERS
+    conf_names = [p["name"] for tier in
+                  json.load(open(written["scheduler.conf.yaml"]))["tiers"]
+                  for p in tier["plugins"]]
+    missing = [n for n in conf_names if n not in PLUGIN_BUILDERS]
+    assert not missing, missing
+
+    # re-render without --token keeps the live credential
+    rewritten = render(str(written["token"])[:-len("/token")],
+                       topology="sa:v5e-16,sb:v5e-4", port=8701)
+    assert open(rewritten["token"]).read().strip() == "tok123"
 
     # the conf the scheduler unit points at actually loads
     from volcano_tpu.conf import load_conf

@@ -228,24 +228,27 @@ class AllocateAction(Action):
                 "proto": task,
                 "fits": {},     # name -> node (predicate-passing)
                 "scores": {},   # name -> cached NodeOrder score
-                "class": {},    # name -> "idle" | "future" | None
-                "gen": {},      # name -> generation for lazy heaps
+                # name -> (gen, cls, score): heap validity in ONE
+                # lookup — heap_peek runs ~60x per task on a 10k-host
+                # gang, and three separate dict.gets per peek were a
+                # measurable slice of the cycle
+                "meta": {},
                 "group": {},    # name -> node group (leaf hypernode)
                 # cls -> group -> heap of (-score, name, gen)
                 "heaps": {"idle": {}, "future": {}},
             }
             for n in fit_nodes:
                 entry["fits"][n.name] = n
-                entry["scores"][n.name] = ssn.node_order(task, n)
+                score = ssn.node_order(task, n)
+                entry["scores"][n.name] = score
                 if use_heap:
-                    entry["gen"][n.name] = 0
                     group = ssn.node_group(n.name) if has_grouped else None
                     entry["group"][n.name] = group
                     cls = fit_class(task, n)
-                    entry["class"][n.name] = cls
+                    entry["meta"][n.name] = (0, cls, score)
                     if cls is not None:
                         entry["heaps"][cls].setdefault(group, []).append(
-                            (-entry["scores"][n.name], n.name, 0))
+                            (-score, n.name, 0))
             if use_heap:
                 for groups in entry["heaps"].values():
                     for heap in groups.values():
@@ -256,15 +259,15 @@ class AllocateAction(Action):
         def invalidate(node):
             for entry in spec_cache.values():
                 proto = entry["proto"]
+                old = entry["meta"].get(node.name) if use_heap else None
+                gen = (old[0] + 1) if old else 1
                 if ssn.predicate(proto, node) is None:
                     entry["fits"][node.name] = node
                     score = ssn.node_order(proto, node)
                     entry["scores"][node.name] = score
                     if use_heap:
-                        gen = entry["gen"].get(node.name, 0) + 1
-                        entry["gen"][node.name] = gen
                         cls = fit_class(proto, node)
-                        entry["class"][node.name] = cls
+                        entry["meta"][node.name] = (gen, cls, score)
                         if cls is not None:
                             group = entry["group"].get(node.name)
                             heapq.heappush(
@@ -274,20 +277,19 @@ class AllocateAction(Action):
                     entry["fits"].pop(node.name, None)
                     entry["scores"].pop(node.name, None)
                     if use_heap:
-                        entry["gen"][node.name] = \
-                            entry["gen"].get(node.name, 0) + 1
-                        entry["class"][node.name] = None
+                        entry["meta"][node.name] = (gen, None, None)
 
         def heap_peek(entry, cls, group):
             """Valid top of one group heap (lazy-discarding stale)."""
             heap = entry["heaps"][cls].get(group)
             if not heap:
                 return None
+            meta = entry["meta"]
             while heap:
                 neg_score, name, gen = heap[0]
-                if entry["gen"].get(name) == gen and \
-                        entry["class"].get(name) == cls and \
-                        entry["scores"].get(name) == -neg_score:
+                m = meta.get(name)
+                if m is not None and m[0] == gen and m[1] == cls \
+                        and m[2] == -neg_score:
                     return -neg_score, name
                 heapq.heappop(heap)
             return None

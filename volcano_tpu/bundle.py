@@ -192,7 +192,7 @@ DEFAULT_CONF = {
             {"name": "overcommit"}, {"name": "drf"},
             {"name": "predicates"}, {"name": "proportion"},
             {"name": "nodeorder"}, {"name": "binpack"},
-            {"name": "networktopologyaware"}]},
+            {"name": "network-topology-aware"}]},
     ],
 }
 
@@ -251,12 +251,21 @@ def render(out_dir: str, topology: str = "sa:v5e-256",
     def emit(rel: str, content: str, mode: int = 0o644):
         path = os.path.join(bundle_dir, rel)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        with open(path, "w", encoding="utf-8") as f:
+        # secret-permissioned BEFORE any secret byte lands: a default-
+        # umask create would leave a world-readable window
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, mode)
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
             f.write(content)
-        os.chmod(path, mode)
+        os.chmod(path, mode)    # pre-existing files keep umask bits
         written[rel] = path
 
     emit("values.json", json.dumps(values, indent=2) + "\n")
+    token_path = os.path.join(bundle_dir, "token")
+    if not token and os.path.exists(token_path):
+        # re-render of a live bundle: rotating the credential would
+        # 401 every running daemon until restart — keep it (pass
+        # --token to rotate deliberately)
+        token = open(token_path, encoding="utf-8").read().strip()
     emit("token", (token or secrets.token_urlsafe(32)) + "\n", 0o600)
     emit("scheduler.conf.yaml",
          json.dumps(DEFAULT_CONF, indent=2) + "\n")
@@ -288,13 +297,18 @@ def render(out_dir: str, topology: str = "sa:v5e-256",
     for role, cmd_tmpl, _off in ROLES:
         cmd = cmd_tmpl.format(**dict(
             values, bundle_dir="/bundle", data_dir="/data"))
-        # %H is a systemd specifier; in compose the container's
-        # hostname is the unique holder identity — substitute via a
-        # shell so two scaled scheduler replicas never present the
-        # same lease holder (identical holders would BOTH hold it)
+        # %H is a systemd specifier (hostname: unique per host, one
+        # scheduler unit per host).  Compose runs with host networking
+        # where every scaled replica reports the SAME hostname — and
+        # identical lease holders would BOTH hold the lease — so each
+        # container derives a per-boot unique holder from the kernel
+        # instead.  (A restarted replica gets a fresh identity and
+        # simply re-contends once the old lease expires.)
         compose_services[role] = {
             "image": "volcano-tpu:latest",
-            "command": ["sh", "-c", cmd.replace("%H", "$(hostname)")],
+            "command": ["sh", "-c", cmd.replace(
+                "%H",
+                "$(cat /proc/sys/kernel/random/uuid)")],
             "network_mode": "host",
             "volumes": [f"{bundle_dir}:/bundle:ro", "data:/data"],
             **({} if role == "server"
