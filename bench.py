@@ -35,7 +35,11 @@ TARGET_P50_S = 2.0
 TRIALS = 12
 
 # bf16 peak FLOP/s per chip, for MFU (shared by both TPU children)
-TPU_PEAK_FLOPS = {"TPU v5e": 394e12, "TPU v5 lite": 394e12,
+# bf16 MXU peak per chip — the MFU denominator.  v5e's bf16 peak is
+# 197 TFLOP/s (394 is its INT8 TOPS figure; rounds 1-4 used 394 here,
+# halving every reported v5e MFU — the r3 builder-observed "MFU 0.31"
+# is 0.62 against the correct bf16 peak; see docs/MFU_PLAN.md).
+TPU_PEAK_FLOPS = {"TPU v5e": 197e12, "TPU v5 lite": 197e12,
                   "TPU v5p": 459e12, "TPU v4": 275e12,
                   "TPU v6e": 918e12}
 
@@ -885,7 +889,7 @@ def main():
             "scale_10k_hosts": scale10k,
             "scale_20k_hosts": scale20k,
             # where the cost curve bends: per-gang-member cycle cost
-            # at each scale point (s/member), from this run
+            # at each scale point (ms/member), from this run
             "scale_knee": _scale_knee(scale, scale10k, scale20k),
             "tpu_probe": probe,
             "flash_attention_tpu": flash,

@@ -10,31 +10,17 @@ precise figures are bench.py's job.
 import time
 
 from volcano_tpu.api.pod import make_pod
-from volcano_tpu.api.podgroup import PodGroup
 from volcano_tpu.api.resource import TPU
-from volcano_tpu.api.types import (GROUP_NAME_ANNOTATION, PodGroupPhase,
-                                   TaskStatus)
+from volcano_tpu.api.types import TaskStatus
 from volcano_tpu.scheduler import Scheduler
-from volcano_tpu.simulator import make_tpu_cluster
 from volcano_tpu.uthelper import gang_job
 
 
 def build_5k_cluster(busy_fraction=0.6):
-    slices = [(f"s{i:03d}", "v5e-256") for i in range(78)]  # 4992 hosts
-    cluster = make_tpu_cluster(slices)
-    names = sorted(cluster.nodes)
-    busy = names[: int(len(names) * busy_fraction)]
-    for j, start in enumerate(range(0, len(busy), 64)):
-        hosts = busy[start:start + 64]
-        pg = PodGroup(name=f"pg{j}", min_member=len(hosts),
-                      phase=PodGroupPhase.RUNNING)
-        cluster.add_podgroup(pg)
-        for i, node in enumerate(hosts):
-            cluster.add_pod(make_pod(
-                f"j{j}-{i}", requests={"cpu": 8, TPU: 4},
-                annotations={GROUP_NAME_ANNOTATION: pg.key},
-                node_name=node, phase=TaskStatus.RUNNING))
-    return cluster
+    # ONE occupancy-shape definition shared with the 5k/10k/20k
+    # benchmarks — the test and bench must measure the same cluster
+    from bench import _build_scale_cluster
+    return _build_scale_cluster(78, busy_fraction)   # 4992 hosts
 
 
 def test_5k_hosts_cycle_under_schedule_period():
