@@ -212,3 +212,31 @@ def test_local_collector_keeps_per_node_delta_windows(tmp_path):
     stat.write_text("cpu  200 0 100 900 0 0 0 0 0 0\n")
     assert c.collect("n0")["cpu_fraction"] == pytest.approx(0.5)
     assert c.collect("n1")["cpu_fraction"] == pytest.approx(0.5)
+
+
+def test_cpu_qos_level_class_knobs(tmp_path):
+    """cpuqos qos-level analogue (reference cpuqos_linux.go writes a
+    kernel cpu.qos_level int): the class ladder LC/HLS > LS > BE maps
+    to cgroup-v2 cpu.weight 400/100/1, with BE additionally parked in
+    SCHED_IDLE via cpu.idle — and a promotion rewrites the knobs."""
+    lc = make_pod("critical", node_name="sa-w0",
+                  phase=TaskStatus.RUNNING, requests={"cpu": "1"},
+                  annotations={"volcano-tpu.io/qos-level": "LC"})
+    ls = make_pod("serve", node_name="sa-w0", phase=TaskStatus.RUNNING,
+                  requests={"cpu": "1"})      # unannotated -> LS
+    be = make_pod("batch", node_name="sa-w0", phase=TaskStatus.RUNNING,
+                  requests={"cpu": "500m"}, annotations=dict(BE))
+    _, agent, cg = mk_agent(tmp_path, pods=[lc, ls, be])
+    agent.sync()
+
+    assert cg.read(lc.uid, "cpu.weight") == "400"
+    assert cg.read(lc.uid, "cpu.idle") == "0"
+    assert cg.read(ls.uid, "cpu.weight") == "100"
+    assert cg.read(be.uid, "cpu.weight") == "1"
+    assert cg.read(be.uid, "cpu.idle") == "1"
+
+    # promotion BE -> LS flips the class knobs on the same cgroup
+    del be.annotations["volcano-tpu.io/qos-level"]
+    agent.sync()
+    assert cg.read(be.uid, "cpu.weight") == "100"
+    assert cg.read(be.uid, "cpu.idle") == "0"

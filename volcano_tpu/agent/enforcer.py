@@ -119,13 +119,16 @@ class PodQoSDecision:
 
     __slots__ = ("pod_key", "uid", "burst_millis", "throttled",
                  "request_millis", "memory_high_bytes",
-                 "memory_min_bytes", "memory_low_bytes")
+                 "memory_min_bytes", "memory_low_bytes",
+                 "cpu_weight", "cpu_idle")
 
     def __init__(self, pod_key: str, uid: str, burst_millis: int = 0,
                  throttled: bool = False, request_millis: int = 0,
                  memory_high_bytes: Optional[int] = None,
                  memory_min_bytes: Optional[int] = None,
-                 memory_low_bytes: Optional[int] = None):
+                 memory_low_bytes: Optional[int] = None,
+                 cpu_weight: Optional[int] = None,
+                 cpu_idle: bool = False):
         self.pod_key = pod_key
         self.uid = uid
         self.burst_millis = burst_millis
@@ -134,12 +137,17 @@ class PodQoSDecision:
         self.memory_high_bytes = memory_high_bytes
         self.memory_min_bytes = memory_min_bytes
         self.memory_low_bytes = memory_low_bytes
+        # qos-level scheduling class (reference cpuqos handler's
+        # cpu.qos_level, mapped to the portable cgroup-v2 knobs:
+        # cpu.weight proportional share + cpu.idle SCHED_IDLE)
+        self.cpu_weight = cpu_weight
+        self.cpu_idle = cpu_idle
 
     def knobs(self) -> tuple:
         """Value tuple for change detection (RecordingEnforcer)."""
         return (self.burst_millis, self.throttled, self.request_millis,
                 self.memory_high_bytes, self.memory_min_bytes,
-                self.memory_low_bytes)
+                self.memory_low_bytes, self.cpu_weight, self.cpu_idle)
 
 
 class Enforcer(abc.ABC):
@@ -286,6 +294,13 @@ class CgroupV2Enforcer(Enforcer):
                     str(decision.memory_min_bytes or 0))
         self._write(os.path.join(d, "memory.low"),
                     str(decision.memory_low_bytes or 0))
+        # qos-level class knobs (cpuqos handler analogue): explicit
+        # defaults for the same idempotency reason
+        self._write(os.path.join(d, "cpu.weight"),
+                    str(decision.cpu_weight
+                        if decision.cpu_weight is not None else 100))
+        self._write(os.path.join(d, "cpu.idle"),
+                    "1" if decision.cpu_idle else "0")
 
     def remove_pod(self, uid: str) -> None:
         d = self._dir(uid)

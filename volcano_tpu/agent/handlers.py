@@ -126,10 +126,15 @@ class OversubscriptionHandler(Handler):
 
 @register_handler
 class CpuQoSHandler(Handler):
-    """cpuburst + cputhrottle (reference handlers of the same names):
-    BE pods burst into measured idle, throttle to request under
-    pressure; guaranteed pods keep fixed headroom.  Publishes the
-    annotations and fills the cpu half of the decision set."""
+    """cpuburst + cputhrottle + cpuqos (reference handlers of the
+    same names): BE pods burst into measured idle, throttle to
+    request under pressure; guaranteed pods keep fixed headroom; and
+    every pod gets its qos-LEVEL scheduling class — the reference
+    writes a kernel cpu.qos_level int (LC/HLS=2, LS=1, BE=-1,
+    extension/qos.go), mapped here to the portable cgroup-v2 pair:
+    cpu.weight (LC/HLS 400, LS 100, BE 1) and cpu.idle (SCHED_IDLE
+    for BE — offline work yields the CPU entirely under contention
+    instead of merely weighing less)."""
 
     name = "cpuqos"
     events = (EVENT_PODS,)
@@ -138,6 +143,12 @@ class CpuQoSHandler(Handler):
         from volcano_tpu.agent.agent import (
             CPU_BURST_ANNOTATION, CPU_THROTTLE_ANNOTATION,
             PREEMPTABLE_QOS_ANNOTATION, QOS_BEST_EFFORT)
+        from volcano_tpu.api.types import (
+            QOS_HIGHLY_LATENCY_SENSITIVE, QOS_LATENCY_CRITICAL,
+            QOS_LATENCY_SENSITIVE)
+        class_weight = {QOS_LATENCY_CRITICAL: 400,
+                        QOS_HIGHLY_LATENCY_SENSITIVE: 400,
+                        QOS_LATENCY_SENSITIVE: 100}
         agent = self.agent
         usage = event.usage
         idle_frac = max(0.0, 1.0 - usage.cpu_fraction)
@@ -157,11 +168,21 @@ class CpuQoSHandler(Handler):
                 pod.annotations[CPU_THROTTLE_ANNOTATION] = (
                     "true" if throttled else "false")
                 d.burst_millis, d.throttled = burst, throttled
+                d.cpu_weight, d.cpu_idle = 1, True
             else:
                 burst = int(request_m * 0.2)
                 pod.annotations[CPU_BURST_ANNOTATION] = str(burst)
                 pod.annotations.pop(CPU_THROTTLE_ANNOTATION, None)
                 d.burst_millis, d.throttled = burst, False
+                # unannotated pods are LS (extension/qos.go default);
+                # an UNRECOGNIZED level also lands on LS weight but
+                # loudly — a typo'd "lc" silently demoting a
+                # latency-critical pod 400 -> 100 would be invisible
+                if qos and qos not in class_weight:
+                    log.warning("pod %s: unknown qos-level %r; "
+                                "treating as LS", pod.key, qos)
+                d.cpu_weight = class_weight.get(qos, 100)
+                d.cpu_idle = False
             d.request_millis = int(request_m)
 
 

@@ -163,6 +163,11 @@ TPU_COORDS_LABEL = "volcano-tpu.io/ici-coords"                    # "x,y,z" of h
 # agent's BE eviction path; value "BE" marks best-effort colocation pods.
 QOS_LEVEL_ANNOTATION = "volcano-tpu.io/qos-level"
 QOS_BEST_EFFORT = "BE"
+# the reference's full class ladder (pkg/agent/apis/extension/qos.go:
+# LC/HLS=2, LS=1, BE=-1); unannotated pods are treated as LS
+QOS_LATENCY_CRITICAL = "LC"
+QOS_HIGHLY_LATENCY_SENSITIVE = "HLS"
+QOS_LATENCY_SENSITIVE = "LS"
 
 # Node annotation: reclaimable millicores published by the node agent,
 # consumed by the scheduler's BE fit path.
