@@ -232,8 +232,10 @@ def test_cpu_qos_level_class_knobs(tmp_path):
     assert cg.read(lc.uid, "cpu.weight") == "400"
     assert cg.read(lc.uid, "cpu.idle") == "0"
     assert cg.read(ls.uid, "cpu.weight") == "100"
-    assert cg.read(be.uid, "cpu.weight") == "1"
     assert cg.read(be.uid, "cpu.idle") == "1"
+    # the real kernel rejects weight writes on idle groups (EINVAL),
+    # so the enforcer must NOT touch cpu.weight while idle is set
+    assert cg.read(be.uid, "cpu.weight") is None
 
     # promotion BE -> LS flips the class knobs on the same cgroup
     del be.annotations["volcano-tpu.io/qos-level"]

@@ -295,12 +295,20 @@ class CgroupV2Enforcer(Enforcer):
         self._write(os.path.join(d, "memory.low"),
                     str(decision.memory_low_bytes or 0))
         # qos-level class knobs (cpuqos handler analogue): explicit
-        # defaults for the same idempotency reason
-        self._write(os.path.join(d, "cpu.weight"),
-                    str(decision.cpu_weight
-                        if decision.cpu_weight is not None else 100))
+        # defaults for the same idempotency reason.  ORDER MATTERS on
+        # a real kernel: cpu.idle must be written first, and
+        # cpu.weight must NOT be written while the group is idle —
+        # sched_group_set_shares returns EINVAL for idle groups, so a
+        # weight write against an idle BE cgroup fails every sync
+        # (and a promotion's weight write would fail in its own
+        # cycle if idle were cleared only afterwards)
         self._write(os.path.join(d, "cpu.idle"),
                     "1" if decision.cpu_idle else "0")
+        if not decision.cpu_idle:
+            self._write(os.path.join(d, "cpu.weight"),
+                        str(decision.cpu_weight
+                            if decision.cpu_weight is not None
+                            else 100))
 
     def remove_pod(self, uid: str) -> None:
         d = self._dir(uid)

@@ -34,8 +34,19 @@ from volcano_tpu.agent.framework import (
     register_handler,
 )
 from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.types import (
+    QOS_HIGHLY_LATENCY_SENSITIVE,
+    QOS_LATENCY_CRITICAL,
+    QOS_LATENCY_SENSITIVE,
+)
 
 log = logging.getLogger(__name__)
+
+# cpu qos-level ladder -> cgroup-v2 cpu.weight (extension/qos.go:
+# LC/HLS=2, LS=1; BE takes weight 1 + cpu.idle instead)
+CLASS_WEIGHT = {QOS_LATENCY_CRITICAL: 400,
+                QOS_HIGHLY_LATENCY_SENSITIVE: 400,
+                QOS_LATENCY_SENSITIVE: 100}
 
 # agent.py owns the annotation-name constants (they are its public
 # API); handlers import them inside handle() to avoid an import cycle
@@ -143,12 +154,6 @@ class CpuQoSHandler(Handler):
         from volcano_tpu.agent.agent import (
             CPU_BURST_ANNOTATION, CPU_THROTTLE_ANNOTATION,
             PREEMPTABLE_QOS_ANNOTATION, QOS_BEST_EFFORT)
-        from volcano_tpu.api.types import (
-            QOS_HIGHLY_LATENCY_SENSITIVE, QOS_LATENCY_CRITICAL,
-            QOS_LATENCY_SENSITIVE)
-        class_weight = {QOS_LATENCY_CRITICAL: 400,
-                        QOS_HIGHLY_LATENCY_SENSITIVE: 400,
-                        QOS_LATENCY_SENSITIVE: 100}
         agent = self.agent
         usage = event.usage
         idle_frac = max(0.0, 1.0 - usage.cpu_fraction)
@@ -178,10 +183,10 @@ class CpuQoSHandler(Handler):
                 # an UNRECOGNIZED level also lands on LS weight but
                 # loudly — a typo'd "lc" silently demoting a
                 # latency-critical pod 400 -> 100 would be invisible
-                if qos and qos not in class_weight:
+                if qos and qos not in CLASS_WEIGHT:
                     log.warning("pod %s: unknown qos-level %r; "
                                 "treating as LS", pod.key, qos)
-                d.cpu_weight = class_weight.get(qos, 100)
+                d.cpu_weight = CLASS_WEIGHT.get(qos, 100)
                 d.cpu_idle = False
             d.request_millis = int(request_m)
 
