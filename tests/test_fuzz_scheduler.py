@@ -89,58 +89,80 @@ def check_invariants(cluster):
             f"{members}/{pg.min_member}"
 
 
+def churn_episode(seed, steps=60, gang_sizes=(1, 2, 4, 4, 8),
+                  p_new=0.55, p_del=0.75, p_prio=0.85,
+                  p_weight=None):
+    """One randomized contention episode with per-cycle invariants —
+    shared by the CI fuzz (fixed seeds below) and the extended soak
+    sweep (tools/fuzz_sweep.py), so new ops/invariants reach both.
+    p_weight, when set, adds a queue-weight flip op driven through
+    the real add_queue update path (upsert + notify — an in-place
+    mutation would bypass the event-driven invalidation the op
+    exists to stress)."""
+    from volcano_tpu.api.podgroup import PodGroup
+
+    rng = random.Random(seed)
+    cluster = make_tpu_cluster(
+        [("sa", "v5e-16"), ("sb", "v5e-16"), ("sc", "v5e-64")])
+    cluster.add_queue(Queue(name="gold", weight=3))
+    cluster.add_queue(Queue(name="dirt", weight=1))
+    cluster.add_priority_class(PriorityClass(name="high", value=1000))
+    cluster.add_priority_class(PriorityClass(name="low", value=10))
+    sched = Scheduler(cluster, conf=FULL_CONF, schedule_period=0)
+
+    live = []
+    for step in range(steps):
+        op = rng.random()
+        if op < p_new:
+            # new gang job: random size/queue/priority
+            n = rng.choice(gang_sizes)
+            name = f"j{seed}-{step}"
+            pg = PodGroup(name=f"pg-{name}", min_member=n,
+                          queue=rng.choice(("gold", "dirt")),
+                          priority_class=rng.choice(("", "high",
+                                                     "low")))
+            cluster.add_podgroup(pg)
+            for i in range(n):
+                cluster.add_pod(make_pod(
+                    f"{name}-{i}",
+                    requests={"cpu": rng.choice((1, 4)),
+                              TPU: rng.choice((0, 4, 4))},
+                    annotations={GROUP_NAME_ANNOTATION: pg.key},
+                    priority_class=pg.priority_class))
+            live.append((pg, name, n))
+        elif op < p_del and live:
+            # delete a random live job (releases its resources) —
+            # through delete_podgroup so the podgroup_deleted
+            # invalidation path fires, not a silent dict pop
+            pg, name, n = live.pop(rng.randrange(len(live)))
+            for i in range(n):
+                cluster.delete_pod(f"default/{name}-{i}")
+            cluster.delete_podgroup(pg.key)
+        elif op < p_prio:
+            # control-kind churn: a priority class vanishes and
+            # returns with a FLIPPED value mid-flight — the
+            # incremental snapshot must rebuild job priorities,
+            # never preempt/order on a stale one (r4 *_deleted
+            # invalidation path)
+            victim = rng.choice(("high", "low"))
+            old = cluster.priority_classes[victim].value
+            cluster.delete_object("priority_class", victim)
+            cluster.add_priority_class(PriorityClass(
+                name=victim, value=1010 - old))
+        elif p_weight is not None and op < p_weight:
+            # queue-weight flip mid-flight, through the notify path:
+            # fair-share state must follow, never a stale weight
+            name = rng.choice(("gold", "dirt"))
+            cluster.add_queue(Queue(name=name,
+                                    weight=rng.choice((1, 2, 3, 5))))
+        sched.run_once()
+        cluster.tick()
+        check_invariants(cluster)
+
+
 def test_fuzz_full_contention_pipeline():
     for seed in (7, 23, 404, 1719):
-        rng = random.Random(seed)
-        cluster = make_tpu_cluster(
-            [("sa", "v5e-16"), ("sb", "v5e-16"), ("sc", "v5e-64")])
-        cluster.add_queue(Queue(name="gold", weight=3))
-        cluster.add_queue(Queue(name="dirt", weight=1))
-        cluster.add_priority_class(PriorityClass(name="high", value=1000))
-        cluster.add_priority_class(PriorityClass(name="low", value=10))
-        sched = Scheduler(cluster, conf=FULL_CONF, schedule_period=0)
-
-        live = []
-        for step in range(60):
-            op = rng.random()
-            if op < 0.55:
-                # new gang job: random size/queue/priority
-                n = rng.choice((1, 2, 4, 4, 8))
-                name = f"j{seed}-{step}"
-                from volcano_tpu.api.podgroup import PodGroup
-                pg = PodGroup(name=f"pg-{name}", min_member=n,
-                              queue=rng.choice(("gold", "dirt")),
-                              priority_class=rng.choice(("", "high",
-                                                         "low")))
-                cluster.add_podgroup(pg)
-                for i in range(n):
-                    cluster.add_pod(make_pod(
-                        f"{name}-{i}",
-                        requests={"cpu": rng.choice((1, 4)),
-                                  TPU: rng.choice((0, 4, 4))},
-                        annotations={GROUP_NAME_ANNOTATION: pg.key},
-                        priority_class=pg.priority_class))
-                live.append((pg, name, n))
-            elif op < 0.75 and live:
-                # delete a random live job (releases its resources)
-                pg, name, n = live.pop(rng.randrange(len(live)))
-                for i in range(n):
-                    cluster.delete_pod(f"default/{name}-{i}")
-                cluster.podgroups.pop(pg.key, None)
-            elif 0.75 <= op < 0.85:
-                # control-kind churn: a priority class vanishes and
-                # returns with a FLIPPED value mid-flight — the
-                # incremental snapshot must rebuild job priorities,
-                # never preempt/order on a stale one (r4 *_deleted
-                # invalidation path)
-                victim = rng.choice(("high", "low"))
-                old = cluster.priority_classes[victim].value
-                cluster.delete_object("priority_class", victim)
-                cluster.add_priority_class(PriorityClass(
-                    name=victim, value=1010 - old))
-            sched.run_once()
-            cluster.tick()
-            check_invariants(cluster)
+        churn_episode(seed)
 
 
 def test_fuzz_gang_floor_protects_victims_from_plain_preempt():
