@@ -253,6 +253,22 @@ phases = {}
 for j in c.vcjobs.values():
     ph = getattr(j.phase, "value", str(j.phase))
     phases[ph] = phases.get(ph, 0) + 1
+    if ph not in ("Completed",):
+        # forensic dump for any straggler: what does the control
+        # plane think is blocking it?
+        pg = c.podgroups.get(j.key)
+        pods = {p.name: (getattr(p.phase, "value", str(p.phase)),
+                         p.node_name)
+                for p in c.pods.values() if p.owner == j.uid}
+        print(json.dumps({
+            "straggler": j.key, "phase": ph,
+            "pg_phase": getattr(getattr(pg, "phase", None), "value",
+                                None),
+            "pg_conditions": [
+                {"type": cond.type, "reason": cond.reason,
+                 "message": cond.message[:300]}
+                for cond in getattr(pg, "conditions", [])],
+            "pods": pods}), flush=True)
 overcommit = []
 node_chips = {}
 for p in c.pods.values():
