@@ -236,6 +236,12 @@ class AllocateAction(Action):
                 "group": {},    # name -> node group (leaf hypernode)
                 # cls -> group -> heap of (-score, name, gen)
                 "heaps": {"idle": {}, "future": {}},
+                # (cls, group) -> valid heap top (score, name) | None.
+                # Only a placement/invalidate can change a group's
+                # top, so heap_best reads this cache instead of
+                # re-peeking every group for every task (at 20k hosts
+                # that was ~126 peeks x 4096 tasks per gang cycle)
+                "top": {},
             }
             for n in fit_nodes:
                 entry["fits"][n.name] = n
@@ -250,9 +256,11 @@ class AllocateAction(Action):
                         entry["heaps"][cls].setdefault(group, []).append(
                             (-score, n.name, 0))
             if use_heap:
-                for groups in entry["heaps"].values():
-                    for heap in groups.values():
+                for cls, groups in entry["heaps"].items():
+                    for group, heap in groups.items():
                         heapq.heapify(heap)
+                        entry["top"][(cls, group)] = heap_peek(
+                            entry, cls, group)
             spec_cache[task.task_spec] = entry
             return entry
 
@@ -278,6 +286,16 @@ class AllocateAction(Action):
                     entry["scores"].pop(node.name, None)
                     if use_heap:
                         entry["meta"][node.name] = (gen, None, None)
+                if use_heap:
+                    # this node's group is the only one whose top can
+                    # have changed (either class: a node may have
+                    # moved idle <-> future) — refresh just those two
+                    # cache slots
+                    group = entry["group"].get(node.name)
+                    for cls in ("idle", "future"):
+                        if group in entry["heaps"][cls]:
+                            entry["top"][(cls, group)] = heap_peek(
+                                entry, cls, group)
 
         def heap_peek(entry, cls, group):
             """Valid top of one group heap (lazy-discarding stale)."""
@@ -296,18 +314,21 @@ class AllocateAction(Action):
 
         def heap_best(entry, cls, group_scores):
             """Highest (cached score + group offset) node of *cls*;
-            ties broken by smallest name, exactly like the linear scan."""
+            ties broken by smallest name, exactly like the linear
+            scan.  Group tops come from the entry's top cache
+            (maintained by build/invalidate), so scoring a task is
+            one arithmetic pass over groups, not a heap walk."""
             best = None          # (total, name)
+            tops = entry["top"]
             for group in entry["heaps"][cls]:
-                top = heap_peek(entry, cls, group)
+                top = tops.get((cls, group))
                 if top is None:
                     continue
                 total = top[0] + (group_scores.get(group, 0.0)
                                   if group_scores else 0.0)
-                cand = (total, top[1])
-                if best is None or cand[0] > best[0] or \
-                        (cand[0] == best[0] and cand[1] < best[1]):
-                    best = cand
+                if best is None or total > best[0] or \
+                        (total == best[0] and top[1] < best[1]):
+                    best = (total, top[1])
             return entry["fits"][best[1]] if best else None
 
         for task in tasks:
