@@ -236,12 +236,14 @@ class AllocateAction(Action):
                 "group": {},    # name -> node group (leaf hypernode)
                 # cls -> group -> heap of (-score, name, gen)
                 "heaps": {"idle": {}, "future": {}},
-                # (cls, group) -> valid heap top (score, name) | None.
+                # cls -> {group: valid heap top (score, name)|None}.
                 # Only a placement/invalidate can change a group's
                 # top, so heap_best reads this cache instead of
                 # re-peeking every group for every task (at 20k hosts
-                # that was ~126 peeks x 4096 tasks per gang cycle)
-                "top": {},
+                # that was ~126 peeks x 4096 tasks per gang cycle);
+                # per-class dicts let it iterate items() instead of
+                # hashing a (cls, group) tuple per group per task
+                "top": {"idle": {}, "future": {}},
             }
             for n in fit_nodes:
                 entry["fits"][n.name] = n
@@ -257,10 +259,10 @@ class AllocateAction(Action):
                             (-score, n.name, 0))
             if use_heap:
                 for cls, groups in entry["heaps"].items():
+                    tops = entry["top"][cls]
                     for group, heap in groups.items():
                         heapq.heapify(heap)
-                        entry["top"][(cls, group)] = heap_peek(
-                            entry, cls, group)
+                        tops[group] = heap_peek(entry, cls, group)
             spec_cache[task.task_spec] = entry
             return entry
 
@@ -294,7 +296,7 @@ class AllocateAction(Action):
                     group = entry["group"].get(node.name)
                     for cls in ("idle", "future"):
                         if group in entry["heaps"][cls]:
-                            entry["top"][(cls, group)] = heap_peek(
+                            entry["top"][cls][group] = heap_peek(
                                 entry, cls, group)
 
         def heap_peek(entry, cls, group):
@@ -319,16 +321,22 @@ class AllocateAction(Action):
             (maintained by build/invalidate), so scoring a task is
             one arithmetic pass over groups, not a heap walk."""
             best = None          # (total, name)
-            tops = entry["top"]
-            for group in entry["heaps"][cls]:
-                top = tops.get((cls, group))
-                if top is None:
-                    continue
-                total = top[0] + (group_scores.get(group, 0.0)
-                                  if group_scores else 0.0)
-                if best is None or total > best[0] or \
-                        (total == best[0] and top[1] < best[1]):
-                    best = (total, top[1])
+            if group_scores:
+                get_offset = group_scores.get
+                for group, top in entry["top"][cls].items():
+                    if top is None:
+                        continue
+                    total = top[0] + get_offset(group, 0.0)
+                    if best is None or total > best[0] or \
+                            (total == best[0] and top[1] < best[1]):
+                        best = (total, top[1])
+            else:
+                for top in entry["top"][cls].values():
+                    if top is None:
+                        continue
+                    if best is None or top[0] > best[0] or \
+                            (top[0] == best[0] and top[1] < best[1]):
+                        best = top
             return entry["fits"][best[1]] if best else None
 
         for task in tasks:
