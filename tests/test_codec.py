@@ -155,3 +155,54 @@ def test_decode_tolerates_unknown_fields():
     data["f"]["some_future_field"] = 42
     q = codec.decode(data)
     assert q.name == "q"
+
+
+def test_default_fields_elided_from_wire_body():
+    """The wire fast lane: fields still equal to their dataclass
+    default are omitted (decode restores them from the default — the
+    compat contract the codec already promises), so a default-shaped
+    pod ships a handful of keys, not ~30."""
+    pod = make_pod("w-0", requests={"cpu": 1})
+    enc = codec.encode(pod)
+    total = len(dataclasses.fields(pod))
+    assert len(enc["f"]) < total / 2, sorted(enc["f"])
+    # non-defaults always present; empty-container defaults elided
+    assert "name" in enc["f"] and "containers" in enc["f"]
+    assert "labels" not in enc["f"] and "annotations" not in enc["f"]
+    got = roundtrip(pod)
+    for f in dataclasses.fields(pod):
+        va, vb = getattr(pod, f.name), getattr(got, f.name)
+        assert va == vb or type(va) is type(vb), (f.name, va, vb)
+    # setting a field away from its default puts it back on the wire
+    pod.labels["team"] = "ml"
+    assert "labels" in codec.encode(pod)["f"]
+    assert roundtrip(pod).labels == {"team": "ml"}
+
+
+def test_default_elision_is_type_exact():
+    """bool-vs-int (True == 1) and other equal-but-differently-typed
+    values must still encode: elision compares type first."""
+    @codec.register_class
+    @dataclasses.dataclass
+    class Flaggy:
+        flag: bool = False
+        n: int = 0
+
+    assert codec.encode(Flaggy())["f"] == {}
+    sneaky = Flaggy(flag=0, n=False)        # == defaults, wrong types
+    assert set(codec.encode(sneaky)["f"]) == {"flag", "n"}
+    got = roundtrip(sneaky)
+    assert got.flag == 0 and type(got.flag) is int
+    assert got.n is False
+
+
+def test_enum_and_scalar_default_elision():
+    pod = make_pod("w-0", requests={"cpu": 1})
+    # phase default (PENDING enum) elided; non-default enum encodes
+    assert "phase" not in codec.encode(pod)["f"]
+    pod.phase = TaskStatus.RUNNING
+    assert "phase" in codec.encode(pod)["f"]
+    assert roundtrip(pod).phase is TaskStatus.RUNNING
+    # a pod left default decodes back with the default phase
+    fresh = make_pod("w-1", requests={"cpu": 1})
+    assert roundtrip(fresh).phase is fresh.phase

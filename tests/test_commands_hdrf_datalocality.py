@@ -144,6 +144,62 @@ def test_hdrf_hierarchy_weights_divide_level_shares():
     close_session(ssn)
 
 
+def test_hdrf_weights_key_by_path_not_segment_name():
+    """Two subtrees reusing a child segment NAME with different
+    weights ('root/a/team' 1/1/5 vs 'root/b/team' 1/1/1) must not
+    collide: weights key on the full path prefix (reference drf.go
+    buildHierarchy keys per hierarchy node).  With the old bare-name
+    map, first declaration won and both 'team' nodes shared one
+    weight, making this ordering a tie."""
+    from volcano_tpu.cache.cache import SchedulerCache
+    from volcano_tpu.conf import load_conf
+    from volcano_tpu.framework.framework import close_session, open_session
+    from volcano_tpu.webhooks.admission import (
+        HIERARCHY_ANNOTATION, HIERARCHY_WEIGHTS_ANNOTATION)
+
+    cluster = FakeCluster()
+    for i in range(2):
+        cluster.add_node(Node(name=f"n{i}", allocatable={"cpu": 8}))
+    # equal raw consumption in both subtrees: every level of the two
+    # path-share vectors ties EXCEPT the reused 'team' segment, whose
+    # weight (5 vs 1) is the only discriminator left
+    cluster.add_queue(Queue(name="qa", annotations={
+        HIERARCHY_ANNOTATION: "root/a/team",
+        HIERARCHY_WEIGHTS_ANNOTATION: "1/1/5"}))
+    cluster.add_queue(Queue(name="qb", annotations={
+        HIERARCHY_ANNOTATION: "root/b/team",
+        HIERARCHY_WEIGHTS_ANNOTATION: "1/1/1"}))
+    pg_a, pods_a = gang_job("hog-a", queue="qa", replicas=1,
+                            requests={"cpu": 4}, running_on=["n0"],
+                            pg_phase=PodGroupPhase.RUNNING)
+    pg_b, pods_b = gang_job("hog-b", queue="qb", replicas=1,
+                            requests={"cpu": 4}, running_on=["n1"],
+                            pg_phase=PodGroupPhase.RUNNING)
+    pg_na, pods_na = gang_job("next-a", queue="qa", replicas=1,
+                              requests={"cpu": 2})
+    pg_nb, pods_nb = gang_job("next-b", queue="qb", replicas=1,
+                              requests={"cpu": 2})
+    for pg, pods in [(pg_a, pods_a), (pg_b, pods_b),
+                     (pg_na, pods_na), (pg_nb, pods_nb)]:
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+    conf = load_conf({
+        "actions": "enqueue, allocate",
+        "tiers": [{"plugins": [
+            {"name": "gang"},
+            {"name": "drf", "arguments": {"drf.enable-hierarchy": True}},
+            {"name": "predicates"}, {"name": "nodeorder"}]}]})
+    ssn = open_session(SchedulerCache(cluster), conf)
+    job_a = next(j for j in ssn.jobs.values() if j.name == "next-a")
+    job_b = next(j for j in ssn.jobs.values() if j.name == "next-b")
+    # a's team node tolerates 5x the share: next-a orders strictly
+    # first despite equal raw consumption everywhere
+    assert ssn.job_order_fn(job_a, job_b)
+    assert not ssn.job_order_fn(job_b, job_a)
+    close_session(ssn)
+
+
 def test_datalocality_scores_and_hard_mode():
     nodes = [Node(name="data0", allocatable={"cpu": 8}),
              Node(name="far0", allocatable={"cpu": 8})]

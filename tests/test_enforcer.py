@@ -353,3 +353,58 @@ def test_tc_cache_invalidated_on_remove_even_after_failed_reprogram():
     recreated = [r for r in calls[n:]
                  if r[:2] == ["class", "replace"] and "1:21" in r]
     assert recreated, calls[n:]
+
+
+def test_legacy_unprefixed_dirs_warn_at_startup(tmp_path, caplog):
+    """ADVICE r5 #3: a pre-prefix agent wrote pod dirs as {root}/{uid}
+    (no 'vtp-'), which the prefixed sweep deliberately never touches —
+    an in-place upgrade must WARN about the orphaned state instead of
+    silently letting stale cpu/memory/net_cls limits persist."""
+    import logging
+    import os
+
+    root = tmp_path / "kubepods" / "volcano"
+    old = root / "old-uid-1"
+    old.mkdir(parents=True)
+    (old / "cpu.max").write_text("5000 100000\n")
+    # a dir with no enforcer knob files is NOT ours (foreign entry on
+    # a shared hierarchy): must not be flagged
+    (root / "init.scope").mkdir()
+
+    with caplog.at_level(logging.WARNING, "volcano_tpu.agent.enforcer"):
+        cg = CgroupV2Enforcer(str(root))
+    msgs = [r.message for r in caplog.records
+            if "legacy unprefixed" in r.message]
+    assert len(msgs) == 1 and "old-uid-1" in msgs[0]
+    assert "init.scope" not in msgs[0]
+    # the legacy dir is detected, never swept
+    assert (old / "cpu.max").exists()
+    # current-layout pods are unaffected
+    assert cg.enforced_uids() == set()
+
+    # narrowed-root upgrade shape: the configured root lacked a
+    # 'volcano' component, so the pre-upgrade agent wrote pod dirs
+    # DIRECTLY under it while the upgraded enforcer owns
+    # {root}/volcano — the scan must cover the pre-narrowing root
+    caplog.clear()
+    shared = tmp_path / "shared-kubepods"
+    legacy2 = shared / "old-uid-2"
+    legacy2.mkdir(parents=True)
+    (legacy2 / "memory.high").write_text("1073741824\n")
+    with caplog.at_level(logging.WARNING, "volcano_tpu.agent.enforcer"):
+        cg2 = CgroupV2Enforcer(str(shared))
+    assert cg2.root.endswith("volcano")
+    msgs2 = [r.message for r in caplog.records
+             if "legacy unprefixed" in r.message]
+    assert len(msgs2) == 1 and "old-uid-2" in msgs2[0]
+    # the owned subtree itself is never reported as legacy
+    assert "volcano" not in msgs2[0].split("(")[1].split(")")[0]
+
+    # a clean root (only vtp- dirs) stays silent
+    caplog.clear()
+    clean = tmp_path / "clean" / "volcano"
+    (clean / "vtp-abc").mkdir(parents=True)
+    with caplog.at_level(logging.WARNING, "volcano_tpu.agent.enforcer"):
+        CgroupV2Enforcer(str(clean))
+    assert not [r for r in caplog.records
+                if "legacy unprefixed" in r.message]

@@ -353,3 +353,177 @@ def test_reads_require_token_when_configured():
             c.close()
     finally:
         httpd.shutdown()
+
+
+def test_bind_batch_matches_per_pod_semantics(wire):
+    """The batched bind endpoint: one request carries a gang's binds;
+    per-item verdicts (bound / conflict / missing) are EXACTLY what
+    the per-pod route would have returned, and a failure on one item
+    never vetoes the rest."""
+    a = wire.client()
+    b = wire.client()
+    a.add_node(Node(name="n0", allocatable={"cpu": "32", "pods": 110}))
+    for i in range(4):
+        a.add_pod(make_pod(f"g-{i}", requests={"cpu": 1}))
+    # pre-bind g-2 elsewhere so the batch hits a conflict mid-list
+    a.add_node(Node(name="n1", allocatable={"cpu": "8", "pods": 110}))
+    a.bind_pod("default", "g-2", "n1")
+
+    errors = a.bind_pods(
+        [("default", "g-0", "n0"),
+         ("default", "g-2", "n0"),          # conflict: already on n1
+         ("default", "missing", "n0"),      # 404: no such pod
+         ("default", "g-3", "n0")])
+    assert errors[0] is None and errors[3] is None
+    assert "conflict" in errors[1]
+    assert "not found" in errors[2]
+    # server state: successes applied, failures skipped
+    server_pods = wire.state.cluster.pods
+    assert server_pods["default/g-0"].node_name == "n0"
+    assert server_pods["default/g-2"].node_name == "n1"
+    assert server_pods["default/g-3"].node_name == "n0"
+    assert server_pods["default/g-1"].node_name == ""
+    # local echo on the issuing client, watch propagation to another
+    assert a.pods["default/g-0"].phase is TaskStatus.BOUND
+    wait_for(lambda: b.pods.get("default/g-3") is not None
+             and b.pods["default/g-3"].node_name == "n0",
+             msg="batch bind propagation")
+
+
+def test_flush_binds_goes_through_bind_batch(wire):
+    """The scheduler cache's bind flush crosses the wire as ONE
+    /bind_batch request per cycle, not one POST per pod."""
+    from volcano_tpu.api.job_info import TaskInfo
+    from volcano_tpu.cache.cache import SchedulerCache
+
+    a = wire.client()
+    a.add_node(Node(name="n0", allocatable={"cpu": "32", "pods": 110}))
+    pods = [make_pod(f"fb-{i}", requests={"cpu": 1}) for i in range(3)]
+    for p in pods:
+        a.add_pod(p)
+    calls = []
+    orig = a._request
+    a._request = lambda m, p, *args, **kw: (
+        calls.append(p), orig(m, p, *args, **kw))[1]
+    cache = SchedulerCache(a)
+    for p in pods:
+        t = TaskInfo(p)
+        t.node_name = "n0"
+        cache.add_bind_task(t)
+    assert cache.flush_binds() == 3
+    assert calls.count("/bind_batch") == 1
+    assert "/bind" not in calls
+    assert all(a.pods[p.key].node_name == "n0" for p in pods)
+
+
+def test_delta_resync_equals_full_refetch(wire):
+    """A stale mirror catches up through the delta lane (the watch
+    endpoint at timeout=0: events since its revision) and lands on
+    EXACTLY the state a full /snapshot refetch produces — binds,
+    deletes, phase flips and all."""
+    a = wire.client()
+    stale = wire.client(start_watch=False)   # mirror frozen at rv_0
+    a.add_node(Node(name="n0", allocatable={"cpu": "32", "pods": 110}))
+    for i in range(5):
+        a.add_pod(make_pod(f"d-{i}", requests={"cpu": 1}))
+    a.bind_pod("default", "d-0", "n0")
+    a.evict_pod("default", "d-1", "test")
+    a.tick()                                 # releasing -> deleted
+    a.delete_pod("default/d-2")
+    a.add_podgroup(PodGroup(name="pg-x", min_member=1))
+
+    calls = []
+    orig = stale._request
+    stale._request = lambda m, p, *args, **kw: (
+        calls.append(p), orig(m, p, *args, **kw))[1]
+    stale.resync()
+    assert any(p.startswith("/watch?") and "timeout=0" in p
+               for p in calls), calls
+    assert "/snapshot" not in calls, calls
+
+    fresh = wire.client(start_watch=False)   # full LIST ground truth
+    for attr in ("pods", "nodes", "podgroups", "queues", "vcjobs"):
+        sa, sf = getattr(stale, attr), getattr(fresh, attr)
+        assert set(sa) == set(sf), (attr, set(sa) ^ set(sf))
+    for k, p in fresh.pods.items():
+        assert stale.pods[k].node_name == p.node_name, k
+        assert stale.pods[k].phase is p.phase, k
+
+
+def test_delta_resync_falls_back_on_compaction(wire):
+    """A revision that fell off the event ring can only recover by a
+    full LIST: the delta probe says resync, the client re-lists."""
+    a = wire.client()
+    stale = wire.client(start_watch=False)
+    for i in range(6):
+        a.add_node(Node(name=f"c{i}", allocatable={"cpu": "8"}))
+    # evict the ring past the stale client's revision
+    st = wire.state
+    with st._event_cv:
+        while st._events and st._events[0][0] <= stale._rv + 2:
+            st._events.popleft()
+    calls = []
+    orig = stale._request
+    stale._request = lambda m, p, *args, **kw: (
+        calls.append(p), orig(m, p, *args, **kw))[1]
+    stale.resync()
+    assert any(p.startswith("/watch?") and "timeout=0" in p
+               for p in calls), calls
+    assert "/snapshot" in calls, calls
+    assert len(stale.nodes) == 6
+
+
+def test_gzip_on_large_bodies():
+    """Snapshot/watch bodies gzip when the client asks; small control
+    responses and non-accepting clients stay plain."""
+    import gzip as _gzip
+    import json as _json
+    import urllib.request
+
+    httpd, state = serve(port=0)
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        c = RemoteCluster(url)
+        try:
+            for i in range(30):
+                c.add_node(Node(name=f"gz{i}", allocatable={"cpu": 8}))
+            req = urllib.request.Request(
+                url + "/snapshot", headers={"Accept-Encoding": "gzip"})
+            with urllib.request.urlopen(req, timeout=5) as r:
+                assert r.headers.get("Content-Encoding") == "gzip"
+                payload = _json.loads(_gzip.decompress(r.read()))
+            assert len(payload["stores"]["node"]) == 30
+            # no Accept-Encoding -> plain body
+            with urllib.request.urlopen(url + "/snapshot",
+                                        timeout=5) as r:
+                assert r.headers.get("Content-Encoding") is None
+                assert _json.loads(r.read())["stores"]["node"]
+            # small response stays plain even when gzip is accepted
+            req = urllib.request.Request(
+                url + "/healthz", headers={"Accept-Encoding": "gzip"})
+            with urllib.request.urlopen(req, timeout=5) as r:
+                assert r.headers.get("Content-Encoding") is None
+            # ERROR bodies stay plain regardless of size or accepted
+            # encodings: every client parses HTTPError.read() raw for
+            # the diagnostic message (409/422 contract)
+            req = urllib.request.Request(
+                url + "/bind",
+                data=_json.dumps({"namespace": "default",
+                                  "name": "x" * 600,
+                                  "node_name": "n0"}).encode(),
+                headers={"Content-Type": "application/json",
+                         "Accept-Encoding": "gzip"}, method="POST")
+            try:
+                urllib.request.urlopen(req, timeout=5)
+                raise AssertionError("bind of missing pod succeeded")
+            except urllib.error.HTTPError as e:
+                assert e.headers.get("Content-Encoding") is None
+                assert "not found" in _json.loads(e.read())["error"]
+            # and the RemoteCluster client (which opts in) round-trips
+            c2 = RemoteCluster(url, start_watch=False)
+            assert len(c2.nodes) == 30
+            c2.close()
+        finally:
+            c.close()
+    finally:
+        httpd.shutdown()

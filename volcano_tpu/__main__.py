@@ -362,7 +362,11 @@ def main(argv=None) -> int:
                     if sched is not None:
                         sched.run_once()
                     if agent_sched is not None:
-                        agent_sched.run_until_drained()
+                        # over the wire, commit binds in batches (one
+                        # /bind_batch per ~64 pods); in-process the
+                        # per-pod lane is already a function call
+                        agent_sched.run_until_drained(
+                            bind_batch=64 if remote else 0)
                     if not remote:
                         cluster.tick()
                 except Exception:  # noqa: BLE001

@@ -244,10 +244,16 @@ class CgroupV2Enforcer(Enforcer):
 
     def __init__(self, root: str,
                  classids: Optional[OfflineClassAllocator] = None):
-        if self.OWNED_COMPONENT not in \
-                os.path.normpath(root).split(os.sep):
+        configured_root = os.path.normpath(root)
+        if self.OWNED_COMPONENT not in configured_root.split(os.sep):
             root = os.path.join(root, self.OWNED_COMPONENT)
         self.root = root
+        # pre-upgrade agents wrote pod dirs directly under the
+        # CONFIGURED root (the {root}/volcano narrowing came with the
+        # vtp- prefix), so legacy detection must look there too
+        self._legacy_roots = [self.root]
+        if os.path.normpath(self.root) != configured_root:
+            self._legacy_roots.append(configured_root)
         self.classids = classids if classids is not None \
             else OfflineClassAllocator()
         # uids whose net_cls.classid WE tagged non-zero: the
@@ -255,6 +261,53 @@ class CgroupV2Enforcer(Enforcer):
         # never sweep every dir under a possibly-shared root
         self._tagged: set = set()
         os.makedirs(root, exist_ok=True)
+        self._warn_legacy_dirs()
+
+    # knob files only this enforcer family writes: their presence in an
+    # unprefixed dir marks pre-upgrade enforcement state, not a foreign
+    # cgroup that merely exists under a shared root
+    _KNOB_FILES = ("cpu.max", "cpu.max.burst", "memory.high",
+                   "net_cls.classid")
+
+    def _warn_legacy_dirs(self) -> None:
+        """Startup detection of pre-prefix enforcement state.
+
+        The vtp- prefix (and the {root}/volcano narrowing) changed
+        both the pod dir name and the effective root, so dirs written
+        by a pre-upgrade agent (unprefixed {root}/{uid}) are never
+        reconciled: their cpu/memory caps and net_cls tags outlive the
+        pods they enforced.  That cleanup stays deliberately manual
+        (sweeping unowned-looking dirs under a possibly-shared
+        hierarchy is how an agent kills a kubelet's cgroups) — but it
+        must not stay SILENT.  Both candidate roots are scanned — the
+        owned subtree AND, when __init__ narrowed the configured root,
+        the pre-narrowing root the old agent actually wrote under —
+        but only dirs carrying a knob file this enforcer writes are
+        flagged, so foreign entries (init.scope, kubelet dirs) on a
+        shared hierarchy are reported only if they look like our
+        writes; the warning never sweeps either way."""
+        for base in self._legacy_roots:
+            try:
+                entries = os.listdir(base)
+            except OSError:
+                continue
+            legacy = sorted(
+                e for e in entries
+                if not e.startswith(self.POD_DIR_PREFIX)
+                and e != self.OWNED_COMPONENT
+                and os.path.isdir(os.path.join(base, e))
+                and any(os.path.isfile(os.path.join(base, e, k))
+                        for k in self._KNOB_FILES))
+            if legacy:
+                shown = ", ".join(legacy[:5]) + \
+                    (", ..." if len(legacy) > 5 else "")
+                log.warning(
+                    "cgroup root %s holds %d legacy unprefixed pod "
+                    "dir(s) (%s) from a pre-upgrade agent; their cpu/"
+                    "memory/net_cls limits are NOT reconciled and "
+                    "will persist until removed — clean up the old "
+                    "layout manually (e.g. rmdir after verifying the "
+                    "pods are gone)", base, len(legacy), shown)
 
     def _dir(self, uid: str) -> str:
         return os.path.join(self.root, self.POD_DIR_PREFIX + uid)

@@ -457,6 +457,25 @@ class AgentScheduler:
 
     def schedule_one(self) -> Optional[str]:
         """Pop one pod, place it; returns bound node name or None."""
+        placed = self._place_one()
+        if placed is None:
+            return None
+        pod, task, node, attempt, t0 = placed
+        try:
+            self.cluster.bind_pod(pod.namespace, pod.name, node.name)
+            err = None
+        except Exception as e:  # noqa: BLE001 - conflict path
+            err = str(e) or type(e).__name__
+        return self._commit_bind(pod, task, node, attempt, t0, err)
+
+    def _place_one(self):
+        """Pop one pod and RESERVE a node for it in the local cache —
+        the optimistic half of the bind (add_task + generation bump) —
+        without committing to the cluster.  Returns
+        (pod, task, node, attempt, t0) or None (empty queue, gated,
+        parked unschedulable, or sent to backoff).  schedule_one
+        commits immediately; run_until_drained's batched lane commits
+        many reservations as one bind_pods call."""
         pod = self.queue.pop()
         if pod is None:
             return None
@@ -504,43 +523,78 @@ class AgentScheduler:
                 except (KeyError, ValueError):
                     continue
                 node.bind_generation += 1
-            try:
-                self.cluster.bind_pod(pod.namespace, pod.name, node.name)
-            except Exception as e:  # noqa: BLE001 - conflict path
-                with self._lock:
-                    node.remove_task(task)
-                log.debug("agent bind conflict for %s on %s: %s",
-                          pod.key, node.name, e)
-                self._attempts[pod.key] = attempt + 1
-                self.queue.push(pod, urgent=True)
-                metrics.inc("agent_bind_conflicts_total")
-                return None
-            metrics.observe("agent_pod_e2e_latency_seconds",
-                            time.perf_counter() - t0)
-            self._attempts.pop(pod.key, None)
-            if SCHEDULING_REASON_ANNOTATION in pod.annotations:
-                # a previously-parked pod placed: drop the stale
-                # reason AND persist — bind_pod's POST carries only
-                # node/phase, so without this write the apiserver copy
-                # stays marked Unschedulable while running
-                del pod.annotations[SCHEDULING_REASON_ANNOTATION]
-                pod.status_message = ""
-                try:
-                    self.cluster.put_object("pod", pod)
-                except Exception:  # noqa: BLE001 — status is advisory
-                    log.debug("reason clear failed for %s", pod.key)
-            return node.name
+            return pod, task, node, attempt, t0
 
         self._attempts[pod.key] = attempt + 1
         self.queue.requeue_backoff(pod, attempt)
         return None
 
-    def run_until_drained(self, max_iters: int = 100000) -> int:
-        """Drain the active queue (tests/benchmarks); returns bound count."""
+    def _commit_bind(self, pod, task, node, attempt, t0,
+                     err) -> Optional[str]:
+        """Finish one reservation given the cluster's bind verdict —
+        IDENTICAL bookkeeping for the per-pod and batched lanes.
+        Success clears attempts and any stale unschedulable reason;
+        failure rolls the reservation back and requeues urgent."""
+        if err is not None:
+            with self._lock:
+                node.remove_task(task)
+            log.debug("agent bind conflict for %s on %s: %s",
+                      pod.key, node.name, err)
+            self._attempts[pod.key] = attempt + 1
+            self.queue.push(pod, urgent=True)
+            metrics.inc("agent_bind_conflicts_total")
+            return None
+        metrics.observe("agent_pod_e2e_latency_seconds",
+                        time.perf_counter() - t0)
+        self._attempts.pop(pod.key, None)
+        if SCHEDULING_REASON_ANNOTATION in pod.annotations:
+            # a previously-parked pod placed: drop the stale
+            # reason AND persist — bind_pod's POST carries only
+            # node/phase, so without this write the apiserver copy
+            # stays marked Unschedulable while running
+            del pod.annotations[SCHEDULING_REASON_ANNOTATION]
+            pod.status_message = ""
+            try:
+                self.cluster.put_object("pod", pod)
+            except Exception:  # noqa: BLE001 — status is advisory
+                log.debug("reason clear failed for %s", pod.key)
+        return node.name
+
+    def run_until_drained(self, max_iters: int = 100000,
+                          bind_batch: int = 0) -> int:
+        """Drain the active queue (tests/benchmarks/the wire agent
+        process); returns bound count.
+
+        bind_batch > 1 switches to the wire fast lane: up to that many
+        pods are RESERVED against the local cache (the same optimistic
+        add_task discipline), then their binds commit as ONE
+        cluster.bind_pods call — a 500-pod burst costs ~8 round-trips
+        at batch 64 instead of 500.  Per-item verdicts feed the exact
+        same rollback/requeue bookkeeping as the per-pod lane, so a
+        conflict on one pod still only requeues that pod."""
         bound = 0
-        for _ in range(max_iters):
-            if not self.queue.active:
+        if bind_batch <= 1:
+            for _ in range(max_iters):
+                if not self.queue.active:
+                    break
+                if self.schedule_one() is not None:
+                    bound += 1
+            return bound
+        iters = 0
+        while iters < max_iters:
+            placements = []
+            while len(placements) < bind_batch and iters < max_iters \
+                    and self.queue.active:
+                iters += 1
+                placed = self._place_one()
+                if placed is not None:
+                    placements.append(placed)
+            if not placements:
                 break
-            if self.schedule_one() is not None:
-                bound += 1
+            errors = self.cluster.bind_pods(
+                [(p.namespace, p.name, node.name)
+                 for p, _, node, _, _ in placements])
+            for placed, err in zip(placements, errors):
+                if self._commit_bind(*placed, err) is not None:
+                    bound += 1
         return bound

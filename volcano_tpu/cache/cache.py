@@ -380,23 +380,30 @@ class SchedulerCache:
     def flush_binds(self) -> int:
         """Execute queued binds against the cluster; returns bound count.
         Failures are recorded and the pod left Pending for resync
-        (reference: resyncTask queue)."""
+        (reference: resyncTask queue).  The whole queue goes through
+        ONE bind_pods call: in-process that is the same loop as before,
+        over the wire it is one /bind_batch request per cycle instead
+        of one POST per pod — the per-item error contract keeps the
+        failure bookkeeping identical either way."""
         with self._lock:
             queue, self._bind_queue = self._bind_queue, []
+        if not queue:
+            return 0
         from volcano_tpu import metrics
+        errors = self.cluster.bind_pods(
+            [(ctx.task.namespace, ctx.task.name, ctx.node_name)
+             for ctx in queue])
         bound = 0
-        for ctx in queue:
-            try:
-                self.cluster.bind_pod(ctx.task.namespace, ctx.task.name,
-                                      ctx.node_name)
+        for ctx, err in zip(queue, errors):
+            if err is None:
                 bound += 1
                 metrics.inc("schedule_attempts_total", result="scheduled")
-            except Exception as e:  # noqa: BLE001 - record any bind failure
+            else:
                 log.warning("bind failed for %s on %s: %s",
-                            ctx.task.key, ctx.node_name, e)
-                self.bind_failures.append((ctx.task.key, str(e)))
+                            ctx.task.key, ctx.node_name, err)
+                self.bind_failures.append((ctx.task.key, err))
                 self.cluster.record_event(
-                    ctx.task.key, "FailedBinding", str(e))
+                    ctx.task.key, "FailedBinding", err)
                 metrics.inc("schedule_attempts_total", result="error")
         return bound
 

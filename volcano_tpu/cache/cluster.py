@@ -56,6 +56,23 @@ class Cluster(abc.ABC):
     def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
         """POST pods/binding analogue.  Raises on conflict/missing."""
 
+    def bind_pods(self, binds) -> List[Optional[str]]:
+        """Batch bind: `binds` is [(namespace, name, node_name), ...];
+        returns a per-item list of None (bound) or an error string,
+        NEVER raising — per-item failure semantics match the per-pod
+        path (a conflict on one pod must not veto its gang-mates, the
+        discipline flush_binds already had).  The default loops
+        bind_pod; wire backends override with ONE request so a 256-pod
+        gang's binds don't cost 256 HTTP round-trips."""
+        errors: List[Optional[str]] = []
+        for namespace, name, node_name in binds:
+            try:
+                self.bind_pod(namespace, name, node_name)
+                errors.append(None)
+            except Exception as e:  # noqa: BLE001 — per-item verdicts
+                errors.append(str(e) or type(e).__name__)
+        return errors
+
     @abc.abstractmethod
     def evict_pod(self, namespace: str, name: str, reason: str = "") -> None:
         """Graceful eviction: mark pod terminating; the 'kubelet' side

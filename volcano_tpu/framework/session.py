@@ -142,9 +142,18 @@ class Session:
     def add_node_order_fn(self, p, fn):       self.add_fn("nodeOrder", p, fn)
     def add_batch_node_order_fn(self, p, fn): self.add_fn("batchNodeOrder", p, fn)
     def add_grouped_batch_node_order_fn(self, p, fn):
-        # optional leaf-grouped twin of a BatchNodeOrder fn: scores are
-        # per node-group (session.node_group), letting allocate keep its
-        # heap fast path when every batch scorer provides this form
+        """Optional leaf-grouped twin of a BatchNodeOrder fn: fn(task)
+        returns {group: score} per node-group (session.node_group),
+        letting allocate keep its heap fast path when every batch
+        scorer provides this form.
+
+        CONTRACT: fn must return a FRESH dict per call, never a
+        memoized or otherwise shared mapping.  Callers treat the
+        mapping as their own (grouped_batch_node_order hands it out,
+        allocate's heap_best reads it across placements); a plugin
+        that caches its score dict would be aliased to every caller.
+        The single-plugin fast path defensively copies, but the copy
+        is shallow — shared VALUES are still the plugin's problem."""
         self.add_fn("groupedBatchNodeOrder", p, fn)
     def add_hyper_node_order_fn(self, p, fn): self.add_fn("hyperNodeOrder", p, fn)
     def add_allocatable_fn(self, p, fn):      self.add_fn("allocatable", p, fn)
@@ -379,10 +388,12 @@ class Session:
         if len(fns) == 1:
             # the common case (one topology plugin): skip the merge —
             # at 20k hosts the per-task dict merge over ~300 leaves
-            # was a measurable slice of the gang cycle.  Callers only
-            # read the mapping (allocate's heap_best), and the plugin
-            # returns a fresh dict per call.
-            return fns[0](task)
+            # was a measurable slice of the gang cycle.  dict() still
+            # copies: the fresh-dict contract lives in the plugin
+            # (see add_grouped_batch_node_order_fn), but a future
+            # memoizing scorer must degrade to a cheap shallow copy
+            # here, not to silent aliasing of its cache to callers.
+            return dict(fns[0](task))
         totals: Dict[object, float] = defaultdict(float)
         for fn in fns:
             for group, s in fn(task).items():

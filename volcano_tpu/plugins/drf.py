@@ -163,7 +163,16 @@ class DRFPlugin(Plugin):
             ws = [w for _, w in pairs]
             pairs = [(pairs[0][0], "1")] + \
                 [(s, w) for (s, _), w in zip(pairs[1:], ws)]
+        # weights key on the FULL path prefix, not the bare segment
+        # name (reference drf.go buildHierarchy keys per hierarchy
+        # NODE): 'root/a/team' and 'root/b/team' are different nodes
+        # that may legitimately carry different weights — a bare-name
+        # map collided them, first declaration silently winning.
+        # Unrooted unit-seam annotations are aligned under the same
+        # synthetic root _queue_chain appends.
+        prefix = [] if pairs and pairs[0][0] == "root" else ["root"]
         for name, w in pairs:
+            prefix.append(name)
             if not w:
                 bad = True
                 continue
@@ -172,11 +181,12 @@ class DRFPlugin(Plugin):
             except ValueError:
                 bad = True
                 continue
-            prev = self._qweights.setdefault(name, val)
+            path_key = "/".join(prefix)
+            prev = self._qweights.setdefault(path_key, val)
             if prev != val:
                 log.warning(
                     "hdrf: conflicting weight for %r (%s vs %s); "
-                    "keeping %s", name, prev, val, prev)
+                    "keeping %s", path_key, prev, val, prev)
         if bad:
             log.warning(
                 "hdrf: weights %r do not align with path %r on "
@@ -186,10 +196,19 @@ class DRFPlugin(Plugin):
     def _path_shares(self, queue_name: str):
         """Root-to-leaf share/weight vector for hierarchical
         comparison — a weight-3 sibling tolerates 3x the share of a
-        weight-1 one before losing priority (drf.go:174)."""
-        return [self.queue_attrs[q].share / self._qweights.get(q, 1.0)
-                for q in reversed(self._queue_chain(queue_name))
-                if q in self.queue_attrs]
+        weight-1 one before losing priority (drf.go:174).  Weight
+        lookup is by path PREFIX ('root/a/team'), matching
+        _parse_weights' keying, so a segment name reused in two
+        subtrees resolves to its own node's weight."""
+        shares = []
+        prefix = []
+        for q in reversed(self._queue_chain(queue_name)):
+            prefix.append(q)
+            if q in self.queue_attrs:
+                shares.append(
+                    self.queue_attrs[q].share
+                    / self._qweights.get("/".join(prefix), 1.0))
+        return shares
 
     def _queue_order(self, a, b) -> int:
         sa, sb = self._path_shares(a.name), self._path_shares(b.name)

@@ -66,14 +66,16 @@ class AuditExporter:
         total = 0
         while True:
             url = f"{self.base_url}/audit?since={self._since}"
-            req = urllib.request.Request(url, headers={
-                "Authorization": f"Bearer {self.token}"}
-                if self.token else {})
+            headers = {"Accept-Encoding": "gzip"}   # 10k-record pages
+            if self.token:
+                headers["Authorization"] = f"Bearer {self.token}"
+            req = urllib.request.Request(url, headers=headers)
             try:
+                from volcano_tpu.server.httputil import read_json_body
                 with urllib.request.urlopen(req, timeout=self.timeout,
                                             context=self._ssl_ctx
                                             ) as resp:
-                    payload = json.load(resp)
+                    payload = read_json_body(resp)
             except Exception as e:  # noqa: BLE001 - exporter must not die
                 log.warning("audit poll of %s failed: %s", url, e)
                 break

@@ -4,18 +4,53 @@ bound-handler + threaded-server bootstrap."""
 
 from __future__ import annotations
 
+import gzip
 import json
 import threading
 from http.server import ThreadingHTTPServer
 
+# bodies below this stay plain: gzip's ~20-byte header + the deflate
+# call cost more than the wire bytes they save on small control
+# responses ({"ok":true} and friends)
+GZIP_MIN_BYTES = 512
+
+
+def read_json_body(resp):
+    """Client half of the encoding negotiation: read an http.client
+    response and parse JSON, inflating a gzip'd body.  Lives beside
+    the compression half (json_response) so the two can never drift —
+    every wire client (RemoteCluster, AuditExporter) reads through
+    here."""
+    body = resp.read()
+    if resp.headers.get("Content-Encoding") == "gzip":
+        body = gzip.decompress(body)
+    return json.loads(body)
+
 
 def json_response(handler, code: int, payload) -> None:
     """Write a JSON response; a client that went away mid-response
-    (killed scheduler, cancelled watch) is routine, not an error."""
+    (killed scheduler, cancelled watch) is routine, not an error.
+
+    Large SUCCESS bodies are gzip-compressed when the client
+    advertised `Accept-Encoding: gzip` — snapshot/watch payloads are
+    the wire fast lane's dominant byte cost and JSON object dumps
+    deflate 5-10x.  Level 1: the hot bodies are codec output
+    (repetitive tag strings), where higher levels buy little but cost
+    CPU.  Error bodies stay plain regardless: urllib surfaces them
+    through HTTPError.read(), which every client parses raw for the
+    diagnostic message — a gzip'd 422 would turn an admission veto's
+    reason into mojibake exactly when the operator needs it."""
     body = json.dumps(payload, separators=(",", ":")).encode()
+    encoding = ""
+    if code < 400 and len(body) >= GZIP_MIN_BYTES and "gzip" in (
+            handler.headers.get("Accept-Encoding") or ""):
+        body = gzip.compress(body, compresslevel=1)
+        encoding = "gzip"
     try:
         handler.send_response(code)
         handler.send_header("Content-Type", "application/json")
+        if encoding:
+            handler.send_header("Content-Encoding", encoding)
         handler.send_header("Content-Length", str(len(body)))
         handler.end_headers()
         handler.wfile.write(body)

@@ -44,6 +44,18 @@ EVENT_RING = 100_000     # events kept for watchers before forcing resync
 AUDIT_RING = 200_000     # audit records kept for the latency exporter
 
 
+def _error_code(e: Exception) -> int:
+    """Exception -> wire status, the same mapping do_POST applies to
+    whole-request failures (reused for per-item /bind_batch verdicts):
+    missing object 404, admission veto 422, conflict 409, else 500."""
+    if isinstance(e, KeyError):
+        return 404
+    if isinstance(e, ValueError):
+        from volcano_tpu.webhooks.admission import AdmissionError
+        return 422 if isinstance(e, AdmissionError) else 409
+    return 500
+
+
 class Lease:
     __slots__ = ("holder", "expires")
 
@@ -249,6 +261,12 @@ class _Handler(BaseHTTPRequestHandler):
                            "expires_in": round(l.expires - now, 3)}
                     for name, l in st._leases.items()})
         if url.path == "/watch":
+            # timeout=0 doubles as the DELTA RESYNC lane: the events
+            # since a revision, returned immediately — a mirror whose
+            # rv is still inside the event ring catches up in O(churn)
+            # instead of re-LISTing; resync=true means the revision
+            # fell off the compaction horizon (the ring) and only a
+            # full /snapshot recovers
             q = parse_qs(url.query)
             since = int(q.get("since", ["0"])[0])
             timeout = min(float(q.get("timeout", ["25"])[0]), 55.0)
@@ -291,6 +309,26 @@ class _Handler(BaseHTTPRequestHandler):
                 cl.bind_pod(body["namespace"], body["name"],
                             body["node_name"])
                 return self._json(200, {"ok": True})
+            if url.path == "/bind_batch":
+                # a gang's binds as ONE request (the wire fast lane's
+                # biggest round-trip saving: 256 POSTs -> 1).  Failure
+                # stays per-item — same verdict the per-pod route
+                # would have returned, so a conflict on one pod never
+                # vetoes its gang-mates
+                results = []
+                bound = 0
+                for b in body.get("binds", []):
+                    try:
+                        cl.bind_pod(b["namespace"], b["name"],
+                                    b["node_name"])
+                        results.append({"ok": True})
+                        bound += 1
+                    except Exception as e:  # noqa: BLE001 — per-item
+                        results.append({
+                            "ok": False, "code": _error_code(e),
+                            "error": str(e) or type(e).__name__})
+                return self._json(200, {"bound": bound,
+                                        "results": results})
             if url.path == "/evict":
                 cl.evict_pod(body["namespace"], body["name"],
                              body.get("reason", ""))
@@ -329,12 +367,9 @@ class _Handler(BaseHTTPRequestHandler):
         except KeyError as e:
             return self._json(404, {"error": str(e)})
         except ValueError as e:
-            # discriminate by TYPE, never message wording: webhook
-            # rejection (AdmissionError) -> 422, anything else
-            # (bind conflicts etc.) -> 409
-            from volcano_tpu.webhooks.admission import AdmissionError
-            code = 422 if isinstance(e, AdmissionError) else 409
-            return self._json(code, {"error": str(e)})
+            # discriminate by TYPE, never message wording (see
+            # _error_code): admission veto 422, conflict 409
+            return self._json(_error_code(e), {"error": str(e)})
         except Exception as e:  # noqa: BLE001 — surface, don't kill thread
             log.exception("POST %s failed", url.path)
             return self._json(500, {"error": str(e)})
