@@ -1,0 +1,643 @@
+"""Per-pod DCN bandwidth accounting — measure what the enforcer shapes.
+
+The subsystem under test closes the enforce→measure→react loop
+(VERDICT r5 missing #5 / next-round #7; reference: pinned eBPF
+watermark maps, pkg/networkqos/utils/ebpf/map.go):
+
+  collector  (agent/collect.py NetAccountingCollector): per-cgroup
+      tx/rx counters keyed by the enforcer's net_cls classids, EWMA
+      rates, counter-reset handling — tested against a fake cgroup fs;
+  handler    (agent/handlers.py netaccounting): watermark comparison
+      with hysteresis, BandwidthViolation events, BandwidthReport
+      posting, store-side fold into node annotations;
+  scheduler  (plugins/rescheduling.py bandwidthPressure + nodeorder
+      bandwidth scorer): chronic violators evicted, saturated hosts
+      penalized for new online pods;
+  wire e2e   : the full lifecycle through a real HTTP state server —
+      agent measures over its wire mirror, the violation reaches the
+      server and a wire-mirrored scheduler evicts the violator.
+"""
+
+import os
+import time
+
+import pytest
+
+from volcano_tpu.agent.agent import (
+    DCN_BANDWIDTH_ANNOTATION,
+    DCN_POD_LIMIT_ANNOTATION,
+    NodeAgent,
+    FakeUsageProvider,
+)
+from volcano_tpu.agent.collect import NetAccountingCollector
+from volcano_tpu.agent.enforcer import CgroupV2Enforcer
+from volcano_tpu.api.netusage import (
+    NODE_MEASURED_OFFLINE_ANNOTATION,
+    NODE_MEASURED_ONLINE_ANNOTATION,
+    NODE_SATURATED_ANNOTATION,
+    POD_TX_ANNOTATION,
+    POD_VIOLATING_ANNOTATION,
+    POD_VIOLATIONS_ANNOTATION,
+)
+from volcano_tpu.api.pod import make_pod
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.simulator import make_tpu_cluster
+
+BE = {"volcano-tpu.io/qos-level": "BE"}
+
+
+class Clock:
+    """Injectable monotonic time for deterministic EWMA windows."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float = 1.0) -> None:
+        self.t += dt
+
+
+def write_counters(root: str, uid: str, tx: int, rx: int = 0) -> None:
+    d = os.path.join(root, "vtp-" + uid)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "net_stat.tx_bytes"), "w") as f:
+        f.write(f"{tx}\n")
+    with open(os.path.join(d, "net_stat.rx_bytes"), "w") as f:
+        f.write(f"{rx}\n")
+
+
+# -- collector unit tests (fake cgroup filesystem) ---------------------
+
+def test_collector_classid_mapping_and_ewma(tmp_path):
+    """tx-byte counters advancing across windows yield mbps rates;
+    the classid the ENFORCER wrote is what keys the measurement."""
+    root = str(tmp_path)
+    clock = Clock()
+    col = NetAccountingCollector(root, now=clock)
+    d = os.path.join(root, "vtp-u1")
+    os.makedirs(d)
+    with open(os.path.join(d, "net_cls.classid"), "w") as f:
+        f.write("0x00010015\n")                  # 1:21
+    write_counters(root, "u1", 0)
+    col.collect("n0")                            # baseline reading
+    clock.tick(1.0)
+    write_counters(root, "u1", 125_000_000)      # 1e9 bits over 1s
+    totals = col.collect("n0")
+    r = col.rates()["u1"]
+    assert r.classid == 0x15 == 21
+    assert r.tx_mbps == pytest.approx(1000.0)    # 1 Gbps
+    assert totals["dcn_tx_mbps"] == pytest.approx(1000.0)
+    # EWMA: a second window at zero traffic halves (alpha 0.5)
+    clock.tick(1.0)
+    write_counters(root, "u1", 125_000_000)
+    assert col.collect("n0")["dcn_tx_mbps"] == pytest.approx(500.0)
+
+
+def test_collector_counter_reset_handling(tmp_path):
+    """A reading BELOW the last one (exporter/kernel restart) is a
+    reset: the new absolute value counts as the delta — never a
+    negative rate, never a skipped window."""
+    root = str(tmp_path)
+    clock = Clock()
+    col = NetAccountingCollector(root, now=clock)
+    write_counters(root, "u1", 1_000_000)
+    col.collect("n0")
+    clock.tick(1.0)
+    write_counters(root, "u1", 2_000_000)
+    col.collect("n0")
+    before = col.rates()["u1"].tx_mbps
+    assert before > 0
+    clock.tick(1.0)
+    write_counters(root, "u1", 250_000)          # reset: 250k since
+    col.collect("n0")
+    r = col.rates()["u1"]
+    assert r.tx_mbps >= 0
+    # 250_000 bytes/1s = 2 mbps folded into the EWMA, not negative
+    assert r.tx_mbps == pytest.approx(0.5 * 2.0 + 0.5 * before)
+
+
+def test_collector_drops_departed_pods_and_double_sample(tmp_path):
+    root = str(tmp_path)
+    clock = Clock()
+    col = NetAccountingCollector(root, now=clock)
+    write_counters(root, "gone", 1_000)
+    col.collect("n0")
+    assert "gone" in col.rates()
+    # a second collect inside MIN_INTERVAL_S is a cached no-op (the
+    # handler and the composite provider may both sample one sync)
+    write_counters(root, "gone", 9_999_999)
+    col.collect("n0")
+    assert col.rates()["gone"].tx_bytes == 1_000
+    # dir removed -> state dropped (classids recycle)
+    import shutil
+    shutil.rmtree(os.path.join(root, "vtp-gone"))
+    clock.tick(1.0)
+    col.collect("n0")
+    assert "gone" not in col.rates()
+
+
+def test_collector_one_sided_read_failure_keeps_rates_honest(tmp_path):
+    """An exporter mid-rewrite can fail ONE direction's read; the
+    other direction's window must not be torn — the returning counter
+    averages its delta over its own (longer) window instead of
+    reading ~2x hot over a single window's dt."""
+    root = str(tmp_path)
+    clock = Clock()
+    col = NetAccountingCollector(root, now=clock)
+    write_counters(root, "u1", 0, rx=0)
+    col.collect("n0")                    # baseline both directions
+    # window 1: rx file unreadable, tx advances at 1000 mbps
+    rx_path = os.path.join(root, "vtp-u1", "net_stat.rx_bytes")
+    os.unlink(rx_path)
+    clock.tick(1.0)
+    write_counters(root, "u1", 125_000_000)
+    os.unlink(rx_path)                   # write_counters recreated it
+    col.collect("n0")
+    assert col.rates()["u1"].tx_mbps == pytest.approx(1000.0)
+    # window 2: rx returns having accumulated 2 windows of 500 mbps
+    clock.tick(1.0)
+    write_counters(root, "u1", 250_000_000, rx=125_000_000)
+    col.collect("n0")
+    r = col.rates()["u1"]
+    assert r.tx_mbps == pytest.approx(1000.0)
+    # 125e6 bytes over the 2s window it actually spans = 500 mbps,
+    # not 1000 (the inflation a shared timestamp would produce)
+    assert r.rx_mbps == pytest.approx(500.0)
+
+
+def test_node_put_cannot_erase_folded_annotations():
+    """The store-side fold must be STICKY: a whole-node write from a
+    mirror that predates the fold (the agent's own persist) re-applies
+    the stored report's summary instead of erasing it."""
+    from volcano_tpu.api.netusage import BandwidthReport
+    from volcano_tpu.api.node_info import Node
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="n0", allocatable={"cpu": "8"}))
+    cluster.put_object("bandwidthreport", BandwidthReport(
+        node="n0", offline_tx_mbps=700.0, online_tx_mbps=200.0,
+        total_mbps=1000.0, violations=2, saturated=True))
+    assert cluster.nodes["n0"].annotations[
+        NODE_SATURATED_ANNOTATION] == "true"
+    # a stale mirror's whole-node persist (no folded keys on it)
+    stale = Node(name="n0", allocatable={"cpu": "8"},
+                 annotations={"somebody": "else"})
+    cluster.put_object("node", stale)
+    ann = cluster.nodes["n0"].annotations
+    assert ann["somebody"] == "else"
+    assert ann[NODE_SATURATED_ANNOTATION] == "true"
+    assert float(ann[NODE_MEASURED_OFFLINE_ANNOTATION]) == 700.0
+
+
+def test_node_delete_drops_report_no_stale_resurrection():
+    """A node's report dies with the node: a REPLACEMENT host
+    registering under the same name must not be born saturated from
+    the dead host's last report."""
+    from volcano_tpu.api.netusage import BandwidthReport
+    from volcano_tpu.api.node_info import Node
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="n0", allocatable={"cpu": "8"}))
+    cluster.put_object("bandwidthreport", BandwidthReport(
+        node="n0", offline_tx_mbps=700.0, total_mbps=1000.0,
+        violations=2, saturated=True))
+    cluster.delete_object("node", "n0")
+    assert "n0" not in cluster.bandwidthreports
+    cluster.put_object("node", Node(name="n0",
+                                    allocatable={"cpu": "8"}))
+    assert NODE_SATURATED_ANNOTATION not in \
+        cluster.nodes["n0"].annotations
+
+
+# -- handler: watermarks, hysteresis, report fold ----------------------
+
+def mk_accounting_agent(tmp_path, pods, total_mbps=1000,
+                        cpu_fraction=0.2):
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    cluster.nodes["sa-w0"].annotations[DCN_BANDWIDTH_ANNOTATION] = \
+        str(total_mbps)
+    for p in pods:
+        cluster.add_pod(p)
+    provider = FakeUsageProvider()
+    provider.set("sa-w0", cpu_fraction=cpu_fraction,
+                 tpu_chips_detected=4, tpu_chips_healthy=4)
+    cg = CgroupV2Enforcer(str(tmp_path / "cg"))
+    clock = Clock()
+    col = NetAccountingCollector(cg.root, now=clock)
+    agent = NodeAgent(cluster, "sa-w0", provider, enforcer=cg,
+                      net_collector=col)
+    return cluster, agent, cg, col, clock
+
+
+def test_violation_fires_with_hysteresis_and_clears(tmp_path):
+    """Over-watermark EWMA rates must persist FIRE_SYNCS windows to
+    raise the violation (a single burst never flaps) and stay under
+    CLEAR_MARGIN x watermark for CLEAR_SYNCS windows to clear it."""
+    hog = make_pod("hog", node_name="sa-w0", phase=TaskStatus.RUNNING,
+                   requests={"cpu": "1"}, annotations=dict(BE))
+    cluster, agent, cg, col, clock = mk_accounting_agent(
+        tmp_path, [hog])
+    agent.sync()                       # enforcer tags the cgroup
+    # offline share of 1000 mbps at low cpu = 400; one BE pod -> 400
+    assert hog.annotations[DCN_POD_LIMIT_ANNOTATION] == "400"
+    assert cg.read(hog.uid, "net_cls.classid") not in (None, "0x00000000")
+
+    tx = 0
+    write_counters(cg.root, hog.uid, tx)
+    clock.tick()
+    agent.sync()                       # baseline counter reading
+
+    def run_sync(bytes_per_s):
+        nonlocal tx
+        tx += bytes_per_s
+        write_counters(cg.root, hog.uid, tx)
+        clock.tick()
+        agent.sync()
+
+    # 900 mbps against a 400 mbps watermark: 2 windows is NOT enough
+    run_sync(112_500_000)
+    run_sync(112_500_000)
+    assert POD_VIOLATING_ANNOTATION not in hog.annotations
+    assert not any(r == "BandwidthViolation" for _, r, _ in
+                   cluster.events)
+    # third consecutive window fires exactly once
+    run_sync(112_500_000)
+    assert hog.annotations[POD_VIOLATING_ANNOTATION] == "true"
+    assert [r for _, r, _ in cluster.events].count(
+        "BandwidthViolation") == 1
+    assert float(hog.annotations[POD_TX_ANNOTATION]) > 400
+    # cumulative violating-sync count grows while the state holds
+    run_sync(112_500_000)
+    assert int(hog.annotations[POD_VIOLATIONS_ANNOTATION]) >= 2
+
+    # report reached the store and the STORE folded node annotations
+    rep = cluster.bandwidthreports["sa-w0"]
+    assert rep.violations == 1 and rep.saturated   # 900 >= 0.85*1000
+    node = cluster.nodes["sa-w0"]
+    assert node.annotations[NODE_SATURATED_ANNOTATION] == "true"
+    assert float(node.annotations[
+        NODE_MEASURED_OFFLINE_ANNOTATION]) > 400
+
+    # traffic stops: EWMA decays under 0.9*400=360, and after
+    # CLEAR_SYNCS windows the violation clears (with an event)
+    for _ in range(8):
+        run_sync(0)
+    assert POD_VIOLATING_ANNOTATION not in hog.annotations
+    assert any(r == "BandwidthViolationCleared"
+               for _, r, _ in cluster.events)
+    assert not cluster.bandwidthreports["sa-w0"].saturated
+    assert NODE_SATURATED_ANNOTATION not in node.annotations
+
+
+def test_online_pod_declared_watermark(tmp_path):
+    """Online pods have no enforced cap; a DECLARED watermark
+    annotation is what their measured rate verifies against."""
+    from volcano_tpu.api.netusage import POD_WATERMARK_ANNOTATION
+    srv = make_pod("srv", node_name="sa-w0", phase=TaskStatus.RUNNING,
+                   requests={"cpu": "1"},
+                   annotations={POD_WATERMARK_ANNOTATION: "100"})
+    cluster, agent, cg, col, clock = mk_accounting_agent(
+        tmp_path, [srv])
+    agent.sync()
+    # online pod: no net_cls tag, but the collector still accounts the
+    # cgroup dir the cpu/memory knobs created
+    write_counters(cg.root, srv.uid, 0)
+    clock.tick()
+    agent.sync()
+    tx = 0
+    for _ in range(3):
+        tx += 25_000_000               # 200 mbps > declared 100
+        write_counters(cg.root, srv.uid, tx)
+        clock.tick()
+        agent.sync()
+    assert srv.annotations[POD_VIOLATING_ANNOTATION] == "true"
+    rep = cluster.bandwidthreports["sa-w0"]
+    assert rep.usages[0].tier == "online"
+    assert rep.online_tx_mbps > 100 and rep.offline_tx_mbps == 0
+
+
+def test_steady_rates_generate_no_churn(tmp_path):
+    """EWMA jitter inside the publish dead-band must not defeat the
+    change-elision: with steady traffic, repeated syncs produce no new
+    pod writes and no new report posts (O(pods x mirrors) watch
+    traffic otherwise)."""
+    hog = make_pod("hog", node_name="sa-w0", phase=TaskStatus.RUNNING,
+                   requests={"cpu": "1"}, annotations=dict(BE))
+    cluster, agent, cg, col, clock = mk_accounting_agent(
+        tmp_path, [hog])
+    agent.sync()
+    tx = 0
+    def run_sync(bytes_per_s):
+        nonlocal tx
+        tx += bytes_per_s
+        write_counters(cg.root, hog.uid, tx)
+        clock.tick()
+        agent.sync()
+    run_sync(0)
+    for _ in range(6):                  # ~40 mbps, well under the cap
+        run_sync(5_000_000)
+    events = []
+    cluster.watch(lambda kind, obj: events.append(kind))
+    for _ in range(4):                  # jitter-free steady state
+        run_sync(5_000_000)
+    assert "pod" not in events, events
+    assert "bandwidthreport" not in events, events
+
+
+def test_crowded_host_floor_keeps_watermark_live(tmp_path):
+    """When BE pods outnumber offline mbps the per-pod cap floors at
+    1 (matching the tc clamp) instead of publishing a literal 0 that
+    the verifier would read as 'no watermark' — violations must stay
+    detectable exactly where the host is most crowded."""
+    pods = [make_pod(f"be{i}", node_name="sa-w0",
+                     phase=TaskStatus.RUNNING, requests={"cpu": "100m"},
+                     annotations=dict(BE)) for i in range(5)]
+    cluster, agent, cg, col, clock = mk_accounting_agent(
+        tmp_path, pods, total_mbps=10)   # offline share: 4 mbps / 5 BE
+    agent.sync()
+    assert all(p.annotations[DCN_POD_LIMIT_ANNOTATION] == "1"
+               for p in pods)
+    tx = 0
+    write_counters(cg.root, pods[0].uid, tx)
+    clock.tick(); agent.sync()
+    for _ in range(3):
+        tx += 1_000_000                  # 8 mbps >> 1 mbps watermark
+        write_counters(cg.root, pods[0].uid, tx)
+        clock.tick(); agent.sync()
+    assert pods[0].annotations[POD_VIOLATING_ANNOTATION] == "true"
+
+
+def test_no_collector_is_a_noop(tmp_path):
+    """Deployments without accounting (no collector wired) keep the
+    exact pre-subsystem behavior: no annotations, no reports."""
+    pod = make_pod("w", node_name="sa-w0", phase=TaskStatus.RUNNING,
+                   requests={"cpu": "1"}, annotations=dict(BE))
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    cluster.add_pod(pod)
+    agent = NodeAgent(cluster, "sa-w0", FakeUsageProvider())
+    agent.sync()
+    assert POD_TX_ANNOTATION not in pod.annotations
+    assert not cluster.bandwidthreports
+
+
+# -- scheduler: bandwidthPressure + nodeorder --------------------------
+
+def _saturated_annotations(offline="700", online="200"):
+    return {DCN_BANDWIDTH_ANNOTATION: "1000",
+            NODE_SATURATED_ANNOTATION: "true",
+            NODE_MEASURED_OFFLINE_ANNOTATION: offline,
+            NODE_MEASURED_ONLINE_ANNOTATION: online}
+
+
+def test_bandwidth_pressure_evicts_chronic_violator():
+    """On a saturated host the chronic offline violator is the victim;
+    the compliant BE pod and the online pod stay."""
+    from volcano_tpu.api.node_info import Node
+    from volcano_tpu.api.types import PodGroupPhase
+    from volcano_tpu.uthelper import TestContext, gang_job
+
+    node = Node(name="hot", allocatable={"cpu": 64, "pods": 110},
+                annotations=_saturated_annotations())
+    pgs, pods = [], []
+    for name, ann in (
+            ("hog", dict(BE, **{POD_VIOLATING_ANNOTATION: "true",
+                                POD_VIOLATIONS_ANNOTATION: "7"})),
+            ("meek", dict(BE)),                       # compliant BE
+            ("serve", {})):                           # online tier
+        pg, ps = gang_job(name, replicas=1, min_available=0,
+                          requests={"cpu": 4}, running_on=["hot"],
+                          pg_phase=PodGroupPhase.RUNNING)
+        for p in ps:
+            p.annotations.update(ann)
+        pgs.append(pg)
+        pods.extend(ps)
+    conf = {"actions": "shuffle", "tiers": [{"plugins": [
+        {"name": "gang"},
+        {"name": "rescheduling", "arguments": {
+            "rescheduling.interval": 0,
+            "rescheduling.strategies": "bandwidthPressure"}}]}]}
+    ctx = TestContext(nodes=[node], podgroups=pgs, pods=pods,
+                      conf=conf)
+    ctx.run(["shuffle"])
+    ctx.expect_evict_num(1)
+    assert ctx.cluster.evictions == ["default/hog-0"]
+
+
+def test_bandwidth_pressure_respects_chronic_floor_and_saturation():
+    """A still-young violator (count below the chronic floor) and any
+    violator on an UNsaturated host are left to the enforcer's caps."""
+    from volcano_tpu.api.node_info import Node
+    from volcano_tpu.api.types import PodGroupPhase
+    from volcano_tpu.uthelper import TestContext, gang_job
+
+    hot = Node(name="hot", allocatable={"cpu": 64, "pods": 110},
+               annotations=_saturated_annotations())
+    cool = Node(name="cool", allocatable={"cpu": 64, "pods": 110},
+                annotations={DCN_BANDWIDTH_ANNOTATION: "1000"})
+    pgs, pods = [], []
+    for name, where, count in (("young", "hot", "2"),
+                               ("chronic", "cool", "9")):
+        pg, ps = gang_job(name, replicas=1, min_available=0,
+                          requests={"cpu": 4}, running_on=[where],
+                          pg_phase=PodGroupPhase.RUNNING)
+        for p in ps:
+            p.annotations.update(dict(
+                BE, **{POD_VIOLATING_ANNOTATION: "true",
+                       POD_VIOLATIONS_ANNOTATION: count}))
+        pgs.append(pg)
+        pods.extend(ps)
+    conf = {"actions": "shuffle", "tiers": [{"plugins": [
+        {"name": "gang"},
+        {"name": "rescheduling", "arguments": {
+            "rescheduling.interval": 0,
+            "rescheduling.strategies": "bandwidthPressure",
+            "bandwidthPressure.chronicViolations": 3}}]}]}
+    ctx = TestContext(nodes=[hot, cool], podgroups=pgs, pods=pods,
+                      conf=conf)
+    ctx.run(["shuffle"])
+    ctx.expect_evict_num(0)
+
+
+def test_nodeorder_steers_online_pods_off_saturated_hosts():
+    """Two otherwise-identical hosts: the online pod lands on the
+    unsaturated one; a BE pod is indifferent (caps shape it anywhere),
+    proving the penalty is tier-scoped."""
+    from volcano_tpu.api.node_info import Node
+    from volcano_tpu.uthelper import TestContext, gang_job
+
+    sat = Node(name="sat", allocatable={"cpu": 8, "pods": 110},
+               annotations=_saturated_annotations())
+    ok = Node(name="ok", allocatable={"cpu": 8, "pods": 110})
+    pg, pods = gang_job("serve", replicas=1, requests={"cpu": 1})
+    conf = {"actions": "enqueue, allocate", "tiers": [{"plugins": [
+        {"name": "gang"}, {"name": "predicates"},
+        {"name": "nodeorder"}]}]}
+    ctx = TestContext(nodes=[sat, ok], podgroups=[pg], pods=pods,
+                      conf=conf)
+    ctx.run()
+    ctx.expect_bind("default/serve-0", "ok")
+
+
+# -- wire e2e: the acceptance-criterion lifecycle ----------------------
+
+def wait_for(cond, timeout=10.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_violation_event_lifecycle_over_wire(tmp_path):
+    """End-to-end proof through the REAL wire control plane: an
+    over-watermark offline pod's traffic is measured by the agent
+    collector (agent on a wire mirror), the BandwidthViolation +
+    usage report reach the state server (folded node annotations,
+    /bandwidth GET route), a second wire mirror (the scheduler's)
+    converges on them, and bandwidthPressure selects the pod for
+    eviction — executed through the wire."""
+    import json
+    import urllib.request
+
+    from volcano_tpu.api.node_info import Node
+    from volcano_tpu.api.types import PodGroupPhase
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.server.state_server import serve
+    from volcano_tpu.uthelper import gang_job
+
+    httpd, state = serve(port=0)
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    mirrors = []
+
+    def client(**kw):
+        c = RemoteCluster(url, **kw)
+        mirrors.append(c)
+        return c
+
+    try:
+        kubectl = client()
+        kubectl.add_node(Node(
+            name="n0", allocatable={"cpu": "64", "pods": 110},
+            annotations={DCN_BANDWIDTH_ANNOTATION: "1000"}))
+        pg, pods = gang_job("hog", replicas=1, min_available=0,
+                            requests={"cpu": 4}, running_on=["n0"],
+                            pg_phase=PodGroupPhase.RUNNING)
+        hog = pods[0]
+        hog.annotations.update(BE)
+        kubectl.add_podgroup(pg)
+        kubectl.add_pod(hog)
+
+        # the agent lives on ITS OWN wire mirror, like a real node
+        agent_view = client()
+        wait_for(lambda: hog.key in agent_view.pods,
+                 msg="agent mirror sees the pod")
+        provider = FakeUsageProvider()
+        provider.set("n0", cpu_fraction=0.2, tpu_chips_detected=0)
+        cg = CgroupV2Enforcer(str(tmp_path / "cg"))
+        clock = Clock()
+        col = NetAccountingCollector(cg.root, now=clock)
+        agent = NodeAgent(agent_view, "n0", provider, enforcer=cg,
+                          net_collector=col)
+
+        uid = agent_view.pods[hog.key].uid
+        agent.sync()                   # tag cgroup, publish the split
+        tx = 0
+        write_counters(cg.root, uid, tx)
+        clock.tick()
+        agent.sync()                   # baseline counter reading
+        for _ in range(7):             # 900 mbps vs 400 mbps watermark
+            tx += 112_500_000
+            write_counters(cg.root, uid, tx)
+            clock.tick()
+            agent.sync()
+
+        # the violation reached the SERVER: report stored, node
+        # annotations folded, pod annotations persisted
+        server = state.cluster
+        wait_for(lambda: server.bandwidthreports.get("n0") is not None
+                 and server.bandwidthreports["n0"].violations == 1,
+                 msg="report on server")
+        assert server.nodes["n0"].annotations[
+            NODE_SATURATED_ANNOTATION] == "true"
+        assert server.pods[hog.key].annotations[
+            POD_VIOLATING_ANNOTATION] == "true"
+        assert int(server.pods[hog.key].annotations[
+            POD_VIOLATIONS_ANNOTATION]) >= 3
+        assert any(r == "BandwidthViolation"
+                   for _, r, _ in server.events)
+        # ... and over the GET route
+        with urllib.request.urlopen(url + "/bandwidth?node=n0",
+                                    timeout=5) as resp:
+            body = json.load(resp)
+        assert body["reports"]["n0"]["f"]["violations"] == 1
+
+        # the scheduler's own wire mirror converges and evicts
+        sched_view = client()
+        wait_for(lambda: sched_view.pods.get(hog.key) is not None
+                 and sched_view.pods[hog.key].annotations.get(
+                     POD_VIOLATING_ANNOTATION) == "true"
+                 and sched_view.nodes["n0"].annotations.get(
+                     NODE_SATURATED_ANNOTATION) == "true",
+                 msg="scheduler mirror convergence")
+        conf = {"actions": "shuffle", "tiers": [{"plugins": [
+            {"name": "gang"},
+            {"name": "rescheduling", "arguments": {
+                "rescheduling.interval": 0,
+                "rescheduling.strategies": "bandwidthPressure"}}]}]}
+        Scheduler(sched_view, conf=conf, schedule_period=0).run_once()
+        wait_for(lambda: hog.key in server.evictions,
+                 msg="bandwidthPressure eviction on server")
+        assert server.pods[hog.key].phase is TaskStatus.RELEASING
+    finally:
+        for m in mirrors:
+            m.close()
+        httpd.shutdown()
+
+
+# -- codec / CLI surfaces ----------------------------------------------
+
+def test_bandwidth_report_codec_roundtrip():
+    from volcano_tpu.api import codec
+    from volcano_tpu.api.netusage import (BandwidthReport,
+                                          PodBandwidthUsage)
+    rep = BandwidthReport(
+        node="n0", total_mbps=1000.0, offline_tx_mbps=700.0,
+        online_tx_mbps=100.0, violations=1, saturated=True,
+        usages=[PodBandwidthUsage(
+            pod_key="default/hog", uid="u1", classid=21,
+            tier="offline", tx_mbps=700.0, watermark_mbps=400.0,
+            violating=True, violations=5)])
+    back = codec.decode(codec.encode(rep))
+    assert back.node == "n0" and back.saturated
+    assert back.usages[0].classid == 21
+    assert back.usages[0].violating and back.usages[0].violations == 5
+
+
+def test_vtpctl_bandwidth_view(tmp_path, capsys):
+    from volcano_tpu.api.netusage import (BandwidthReport,
+                                          PodBandwidthUsage)
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    from volcano_tpu.cli.vtpctl import main as vtpctl
+    import pickle
+
+    cluster = FakeCluster()
+    cluster.bandwidthreports["n0"] = BandwidthReport(
+        node="n0", total_mbps=1000.0, offline_tx_mbps=700.0,
+        online_tx_mbps=100.0, violations=1, saturated=True,
+        usages=[PodBandwidthUsage(
+            pod_key="default/hog", uid="u1", classid=21,
+            tier="offline", tx_mbps=700.0, watermark_mbps=400.0,
+            violating=True, violations=5)])
+    path = str(tmp_path / "c.pkl")
+    with open(path, "wb") as f:
+        pickle.dump(cluster, f)
+    assert vtpctl(["--state", path, "bandwidth"]) == 0
+    out = capsys.readouterr().out
+    assert "default/hog" in out and "VIOLATING" in out
+    assert "1:21" in out and "yes" in out       # classid + saturated

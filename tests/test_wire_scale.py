@@ -184,3 +184,7 @@ def test_bench_wire_smoke_mode():
     assert out["wire_gang_p50_s"] > 0
     assert out["scale"]["delta_resync_s"] > 0
     assert out["scale"]["audit_lost_records"] is False
+    # accounting traffic rides the wire too: one usage report +
+    # violation event round-tripped through the state server
+    assert out["usage_roundtrip"]["usage_report_roundtrip_ok"] is True
+    assert out["usage_roundtrip"]["violation_roundtrip_ok"] is True

@@ -59,7 +59,9 @@ def test_ring_attention_matches_local():
     v = jax.random.normal(k3, (b, t, h, d))
 
     from jax.sharding import PartitionSpec as P
-    ring = jax.jit(jax.shard_map(
+
+    from volcano_tpu.workloads.mesh import shard_map as _shard_map
+    ring = jax.jit(_shard_map(
         lambda q, k, v: ring_attention(q, k, v, axis_name="sp"),
         mesh=mesh,
         in_specs=(P(("dp", "fsdp"), "sp", "tp", None),) * 3,
@@ -129,7 +131,9 @@ def test_ulysses_attention_matches_local():
     v = jax.random.normal(k3, (b, t, h, d))
 
     from jax.sharding import PartitionSpec as P
-    uly = jax.jit(jax.shard_map(
+
+    from volcano_tpu.workloads.mesh import shard_map as _shard_map
+    uly = jax.jit(_shard_map(
         lambda q, k, v: ulysses_attention(q, k, v, axis_name="sp"),
         mesh=mesh,
         in_specs=(P(("dp", "fsdp"), "sp", "tp", None),) * 3,

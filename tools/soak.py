@@ -11,7 +11,7 @@ server RSS 31->122MB — linear in RETAINED completed jobs (~115KB/job:
 ttlSecondsAfterFinished unset keeps finished jobs, matching k8s/
 reference semantics), not a leak.
 
-Usage:  python tools/soak.py          # logs to /tmp/soak/
+Usage:  python tools/soak.py [seconds]   # default 600; logs /tmp/soak/
 """
 import json, os, random, socket, subprocess, sys, time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -51,7 +51,7 @@ for sname in ("sa", "sb", "sc"):
 
 rng = random.Random(42)
 submitted = completed_seen = 0
-t_end = time.time() + 600
+t_end = time.time() + (float(sys.argv[1]) if len(sys.argv) > 1 else 600)
 i = 0
 rss_samples = []
 def server_rss():

@@ -95,7 +95,8 @@ class NodeAgent:
                  provider: Optional[UsageProvider] = None,
                  oversub_factor: float = 0.6,
                  eviction_threshold: float = 0.95,
-                 enforcer=None, handlers=None, probes=None):
+                 enforcer=None, handlers=None, probes=None,
+                 net_collector=None):
         from volcano_tpu.agent import handlers as _default  # registers
         from volcano_tpu.agent.enforcer import NullEnforcer
         from volcano_tpu.agent.framework import (
@@ -109,6 +110,11 @@ class NodeAgent:
         # handlers' decisions (enforcer.py; default publishes only)
         self.enforcer = enforcer if enforcer is not None \
             else NullEnforcer()
+        # explicit NetAccountingCollector handle for the netaccounting
+        # handler; when None the handler discovers one inside a
+        # CompositeUsageProvider's collector list (so 'collectors:
+        # local,netaccounting:ROOT' needs no extra wiring)
+        self.net_collector = net_collector
         # probe -> queue -> handler pipeline; handlers come from the
         # registry unless injected (tests can run a subset)
         self.probes = list(probes) if probes is not None \

@@ -24,6 +24,7 @@ import os
 from typing import Callable, Dict, List, Optional
 
 from volcano_tpu.agent.agent import NodeUsage, UsageProvider
+from volcano_tpu.agent.enforcer import CgroupV2Enforcer
 
 log = logging.getLogger(__name__)
 
@@ -155,6 +156,161 @@ class LocalProcCollector(Collector):
         except (OSError, ValueError):
             pass
         return out
+
+
+class PodNetRate:
+    """One pod's accounting state kept by NetAccountingCollector.
+    Timestamps are per direction: a one-sided failed read (exporter
+    mid-rewrite) must not advance the other counter's window, or the
+    returning counter's next delta would span two windows over one
+    window's dt and read ~2x hot."""
+
+    __slots__ = ("uid", "classid", "tx_mbps", "rx_mbps",
+                 "tx_bytes", "rx_bytes", "_last_tx", "_last_rx",
+                 "_last_ts_tx", "_last_ts_rx")
+
+    def __init__(self, uid: str):
+        self.uid = uid
+        self.classid = 0
+        self.tx_mbps = 0.0       # windowed EWMA egress rate
+        self.rx_mbps = 0.0
+        self.tx_bytes = 0        # last raw counter reading
+        self.rx_bytes = 0
+        self._last_tx: Optional[int] = None
+        self._last_rx: Optional[int] = None
+        self._last_ts_tx: Optional[float] = None
+        self._last_ts_rx: Optional[float] = None
+
+
+@register_collector("netaccounting")
+class NetAccountingCollector(Collector):
+    """Per-pod DCN byte accounting keyed by the enforcer's net_cls
+    classids — the measurement half of the online/offline split
+    (reference: pinned eBPF watermark maps, utils/ebpf/map.go:64-79;
+    divergence note in docs/design/network-accounting.md).
+
+    Reads, for every vtp-prefixed pod dir under the enforcer's cgroup
+    root, the net_cls.classid tag the CgroupV2Enforcer wrote plus the
+    per-cgroup byte counters an eBPF/conntrack exporter pins next to
+    it (net_stat.tx_bytes / net_stat.rx_bytes — same file convention
+    the tests' fake cgroup fs writes), and maintains a windowed EWMA
+    mbps rate per pod.  Counter semantics:
+
+      * monotonically increasing within one exporter lifetime;
+      * a reading BELOW the last one is a counter reset (exporter or
+        kernel restart): the new absolute value is taken as the delta
+        (the bytes since the reset — the only defensible reading);
+      * a vanished pod dir drops its state (classids recycle).
+
+    collect() runs once per agent sync (the agent samples its provider
+    exactly once), so the EWMA window is sync-period-spaced; rates()
+    hands the per-pod table to the netaccounting handler.
+    """
+
+    # the enforcer's ownership mark IS the accounting key (shared
+    # constant, so the measure half can never drift from the shape
+    # half)
+    POD_DIR_PREFIX = CgroupV2Enforcer.POD_DIR_PREFIX
+    TX_FILE = "net_stat.tx_bytes"
+    RX_FILE = "net_stat.rx_bytes"
+    ALPHA = 0.5                      # EWMA weight of the newest window
+
+    # a second collect() inside this window is a no-op returning the
+    # cached totals: the netaccounting handler calls collect() every
+    # sync so an explicitly-wired collector needs no provider, and
+    # when the collector ALSO sits in the composite provider (sampled
+    # at sync start) the handler's call microseconds later must not
+    # tear the EWMA windows with a near-zero dt
+    MIN_INTERVAL_S = 0.05
+
+    def __init__(self, root: str = "/sys/fs/cgroup/volcano",
+                 alpha: float = ALPHA, now=None):
+        import time
+        self.root = root
+        self.alpha = float(alpha)
+        self._now = now if now is not None else time.monotonic
+        self._rates: Dict[str, PodNetRate] = {}
+        self._last_walk: Optional[float] = None
+        self._totals: Dict[str, float] = {}
+
+    @staticmethod
+    def _read_int(path: str) -> Optional[int]:
+        try:
+            with open(path, encoding="ascii") as f:
+                return int(f.read().strip() or "0", 0)
+        except (OSError, ValueError):
+            return None
+
+    def _sample_one(self, rate: PodNetRate, d: str, ts: float) -> None:
+        tx = self._read_int(os.path.join(d, self.TX_FILE))
+        rx = self._read_int(os.path.join(d, self.RX_FILE))
+        cid = self._read_int(os.path.join(d, "net_cls.classid"))
+        if cid is not None:
+            rate.classid = cid & 0xFFFF
+
+        def fold(cur, last, last_ts, ewma):
+            """-> (last reading, window start ts, ewma); a failed
+            read leaves all three untouched so the direction's window
+            simply spans to the next successful read."""
+            if cur is None:
+                return last, last_ts, ewma
+            if last is None:         # first reading: no window yet
+                return cur, ts, ewma
+            delta = cur - last if cur >= last else cur   # reset: cur
+            dt = ts - last_ts if last_ts else 0.0
+            if dt > 0:
+                inst = delta * 8.0 / dt / 1e6            # bytes->mbps
+                ewma = inst if ewma == 0.0 else \
+                    self.alpha * inst + (1 - self.alpha) * ewma
+            return cur, ts, ewma
+
+        rate._last_tx, rate._last_ts_tx, rate.tx_mbps = fold(
+            tx, rate._last_tx, rate._last_ts_tx, rate.tx_mbps)
+        rate._last_rx, rate._last_ts_rx, rate.rx_mbps = fold(
+            rx, rate._last_rx, rate._last_ts_rx, rate.rx_mbps)
+        rate.tx_bytes = rate._last_tx or 0
+        rate.rx_bytes = rate._last_rx or 0
+
+    def collect(self, node_name: str) -> Dict[str, float]:
+        """Walk the pod cgroups once; returns node-level totals (the
+        per-pod table is served by rates()).  The totals are extra
+        keys NodeUsage ignores — harmless in the merged sample, and
+        visible to custom providers that want them."""
+        ts = self._now()
+        if self._last_walk is not None and \
+                ts - self._last_walk < self.MIN_INTERVAL_S:
+            return dict(self._totals)
+        self._last_walk = ts
+        seen = set()
+        try:
+            entries = os.listdir(self.root)
+        except OSError:
+            return {}
+        for e in entries:
+            if not e.startswith(self.POD_DIR_PREFIX):
+                continue
+            d = os.path.join(self.root, e)
+            if not os.path.isdir(d):
+                continue
+            uid = e[len(self.POD_DIR_PREFIX):]
+            seen.add(uid)
+            rate = self._rates.get(uid)
+            if rate is None:
+                rate = self._rates[uid] = PodNetRate(uid)
+            self._sample_one(rate, d, ts)
+        for uid in set(self._rates) - seen:   # departed: drop state
+            del self._rates[uid]
+        self._totals = {
+            "dcn_tx_mbps": sum(r.tx_mbps
+                               for r in self._rates.values()),
+            "dcn_rx_mbps": sum(r.rx_mbps
+                               for r in self._rates.values())}
+        return dict(self._totals)
+
+    def rates(self) -> Dict[str, PodNetRate]:
+        """uid -> PodNetRate as of the last collect() (the handler's
+        read surface; no re-walk)."""
+        return dict(self._rates)
 
 
 @register_collector("tpu")

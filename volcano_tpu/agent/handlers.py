@@ -1,4 +1,4 @@
-"""The default agent handler pipeline — nine registered handlers.
+"""The default agent handler pipeline — ten registered handlers.
 
 Reference parity: pkg/agent/events/handlers/* (one package per
 concern, self-registered via registry.go).  Each handler here carries
@@ -6,7 +6,11 @@ the logic the r4 agent kept inline in its sync loop; registration
 order is dispatch order, which matters only where stated:
 
     UsageReporter, TpuHealth, Oversubscription   (EVENT_USAGE)
-    CpuQoS, MemoryQoS, NetworkQoS, NumaExporter  (EVENT_PODS)
+    CpuQoS, MemoryQoS, NetworkQoS                (EVENT_PODS)
+    NetAccounting                                (EVENT_PODS, AFTER
+        NetworkQoS: this sync's per-pod caps are the offline
+        watermarks it verifies measured rates against)
+    NumaExporter                                 (EVENT_PODS)
     Enforcement                                  (EVENT_PODS, LAST:
         applies the decision set the QoS handlers built and
         reconciles enforcement for departed pods)
@@ -263,7 +267,11 @@ class NetworkQoSHandler(Handler):
             str(int(total_mbps - offline_mbps))
         pod_limits = {}
         if be_pods:
-            per_pod = offline_mbps // len(be_pods)
+            # floor at 1: TcEnforcer clamps the kernel class to 1mbit
+            # anyway, and a literal 0 would read as "no watermark" to
+            # the netaccounting verifier — exactly the crowded-host
+            # case where violations matter most
+            per_pod = max(1, offline_mbps // len(be_pods))
             for pod in be_pods:
                 pod.annotations[DCN_POD_LIMIT_ANNOTATION] = str(per_pod)
                 pod_limits[pod.uid] = per_pod
@@ -272,6 +280,222 @@ class NetworkQoSHandler(Handler):
             pod.annotations.pop(DCN_POD_LIMIT_ANNOTATION, None)
         agent.enforcer.apply_network(int(total_mbps - offline_mbps),
                                      offline_mbps, pod_limits)
+
+
+@register_handler
+class NetAccountingHandler(Handler):
+    """Verification half of the DCN split (reference: eBPF watermark
+    maps, utils/ebpf/map.go:64-79): the NetworkQoS handler SHAPES
+    traffic; this one MEASURES it and closes the loop.
+
+    Runs right after networkqos (same sync's per-pod caps are the
+    offline watermarks) off the NetAccountingCollector's per-classid
+    EWMA rates:
+
+      * publishes per-pod tx/rx mbps annotations + metrics;
+      * compares each pod's rate against its watermark — offline (BE)
+        pods' enforced cap, online pods' declared watermark-mbps
+        annotation — with HYSTERESIS: FIRE_SYNCS consecutive
+        over-watermark windows raise the violation (one burst never
+        flaps), CLEAR_SYNCS consecutive windows under CLEAR_MARGIN x
+        watermark lower it; the band between holds state;
+      * emits BandwidthViolation / BandwidthViolationCleared events on
+        the transitions and keeps a cumulative violating-sync count on
+        the pod (the chronic signal bandwidthPressure reschedules on);
+      * posts a BandwidthReport to the store when it materially
+        changes — the server folds the node summary into node
+        annotations for every watch mirror.
+    """
+
+    name = "netaccounting"
+    events = (EVENT_PODS,)
+
+    FIRE_SYNCS = 3
+    CLEAR_SYNCS = 3
+    CLEAR_MARGIN = 0.9
+    # published rates move only when the EWMA leaves a dead-band
+    # around the last published value (max of 1 mbps / 5%): raw EWMAs
+    # jitter every window, and publishing the jitter would defeat the
+    # agent's pod-annotation change-elision AND the report signature —
+    # O(pods) PUTs per sync fanning out to every watch mirror.
+    # Violation detection always uses the RAW rate.
+    PUBLISH_DEADBAND_MBPS = 1.0
+    PUBLISH_DEADBAND_FRAC = 0.05
+
+    def __init__(self, agent):
+        super().__init__(agent)
+        # uid -> {"over", "under", "violating", "violations"}
+        self._state = {}
+        self._published = {}           # uid -> (tx, rx) as published
+        self._last_report = None       # change-elision signature
+
+    def _publish_rates(self, uid, tx, rx):
+        pub = self._published.get(uid)
+        if pub is not None:
+            def inside(new, old):
+                return abs(new - old) <= max(self.PUBLISH_DEADBAND_MBPS,
+                                             self.PUBLISH_DEADBAND_FRAC
+                                             * old)
+            if inside(tx, pub[0]) and inside(rx, pub[1]):
+                return pub             # steady: keep published values
+        pub = (round(tx, 1), round(rx, 1))
+        self._published[uid] = pub
+        return pub
+
+    def _collector(self):
+        col = getattr(self.agent, "net_collector", None)
+        if col is not None:
+            return col
+        from volcano_tpu.agent.collect import NetAccountingCollector
+        for c in getattr(self.agent.provider, "collectors", ()):
+            if isinstance(c, NetAccountingCollector):
+                return c
+        return None
+
+    def _watermark(self, pod, offline: bool) -> float:
+        from volcano_tpu.agent.agent import DCN_POD_LIMIT_ANNOTATION
+        from volcano_tpu.api.netusage import POD_WATERMARK_ANNOTATION
+        key = DCN_POD_LIMIT_ANNOTATION if offline \
+            else POD_WATERMARK_ANNOTATION
+        try:
+            return float(pod.annotations.get(key, 0) or 0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    def handle(self, event: Event) -> None:
+        from volcano_tpu import metrics
+        from volcano_tpu.agent.agent import (
+            DCN_BANDWIDTH_ANNOTATION, DEFAULT_DCN_MBPS,
+            PREEMPTABLE_QOS_ANNOTATION, QOS_BEST_EFFORT)
+        from volcano_tpu.api.netusage import (
+            SATURATION_FRACTION, BandwidthReport, PodBandwidthUsage,
+            POD_RX_ANNOTATION, POD_TX_ANNOTATION,
+            POD_VIOLATING_ANNOTATION, POD_VIOLATIONS_ANNOTATION)
+        collector = self._collector()
+        if collector is None:
+            return                    # accounting not deployed: no-op
+        agent, node = self.agent, event.node
+        # drive the sample ourselves: an explicitly-wired collector
+        # needs no provider, and one that also sits in the composite
+        # provider already walked this sync (MIN_INTERVAL_S no-op)
+        try:
+            collector.collect(agent.node_name)
+        except Exception as e:  # noqa: BLE001 — degrade, keep sync
+            log.warning("net accounting sample failed: %s", e)
+        rates = collector.rates()
+        try:
+            total_mbps = float(node.annotations.get(
+                DCN_BANDWIDTH_ANNOTATION, DEFAULT_DCN_MBPS))
+        except (TypeError, ValueError):
+            total_mbps = float(DEFAULT_DCN_MBPS)
+
+        usages, rows = [], []
+        offline_tx = online_tx = 0.0
+        violating_pods = 0
+        current_uids = set()
+        for pod in event.pods:
+            rate = rates.get(pod.uid)
+            if rate is None:
+                continue              # no cgroup counters for this pod
+            current_uids.add(pod.uid)
+            offline = pod.annotations.get(
+                PREEMPTABLE_QOS_ANNOTATION) == QOS_BEST_EFFORT
+            tier = "offline" if offline else "online"
+            tx_pub, rx_pub = self._publish_rates(
+                pod.uid, rate.tx_mbps, rate.rx_mbps)
+            if offline:
+                offline_tx += tx_pub
+            else:
+                online_tx += tx_pub
+            watermark = self._watermark(pod, offline)
+            st = self._state.setdefault(pod.uid, {
+                "over": 0, "under": 0, "violating": False,
+                "violations": 0})
+            if watermark > 0 and rate.tx_mbps > watermark:
+                st["over"] += 1
+                st["under"] = 0
+                if not st["violating"] and st["over"] >= self.FIRE_SYNCS:
+                    st["violating"] = True
+                    agent.cluster.record_event(
+                        pod.key, "BandwidthViolation",
+                        f"{tier} pod at {rate.tx_mbps:.1f} mbps > "
+                        f"watermark {watermark:g} mbps for "
+                        f"{st['over']} syncs")
+                    metrics.inc("bandwidth_violations_total",
+                                pod=pod.key, node=agent.node_name)
+            elif watermark <= 0 or \
+                    rate.tx_mbps <= watermark * self.CLEAR_MARGIN:
+                st["under"] += 1
+                st["over"] = 0
+                if st["violating"] and st["under"] >= self.CLEAR_SYNCS:
+                    st["violating"] = False
+                    agent.cluster.record_event(
+                        pod.key, "BandwidthViolationCleared",
+                        f"{tier} pod back under watermark "
+                        f"{watermark:g} mbps")
+            else:
+                # hysteresis band (CLEAR_MARGIN..1.0 of watermark):
+                # neither direction makes progress
+                st["over"] = st["under"] = 0
+            if st["violating"]:
+                st["violations"] += 1     # chronic = large cumulative
+                violating_pods += 1
+                pod.annotations[POD_VIOLATING_ANNOTATION] = "true"
+            else:
+                pod.annotations.pop(POD_VIOLATING_ANNOTATION, None)
+            if st["violations"]:
+                pod.annotations[POD_VIOLATIONS_ANNOTATION] = \
+                    str(st["violations"])
+            pod.annotations[POD_TX_ANNOTATION] = f"{tx_pub:.1f}"
+            pod.annotations[POD_RX_ANNOTATION] = f"{rx_pub:.1f}"
+            usages.append(PodBandwidthUsage(
+                pod_key=pod.key, uid=pod.uid, classid=rate.classid,
+                tier=tier, tx_mbps=tx_pub, rx_mbps=rx_pub,
+                watermark_mbps=watermark,
+                violating=st["violating"],
+                violations=st["violations"]))
+            rows.append(("pod_dcn_tx_mbps",
+                         {"pod": pod.key, "node": agent.node_name,
+                          "tier": tier}, tx_pub))
+            rows.append(("pod_dcn_rx_mbps",
+                         {"pod": pod.key, "node": agent.node_name,
+                          "tier": tier}, rx_pub))
+        for uid in set(self._state) - current_uids:
+            del self._state[uid]      # departed pods drop hysteresis
+            self._published.pop(uid, None)
+
+        saturated = (offline_tx + online_tx) >= \
+            SATURATION_FRACTION * total_mbps
+        rows.append(("node_dcn_measured_mbps",
+                     {"node": agent.node_name, "tier": "offline"},
+                     round(offline_tx, 3)))
+        rows.append(("node_dcn_measured_mbps",
+                     {"node": agent.node_name, "tier": "online"},
+                     round(online_tx, 3)))
+        rows.append(("bandwidth_violating_pods",
+                     {"node": agent.node_name}, violating_pods))
+        metrics.swap_gauge_families(
+            {"pod_dcn_tx_mbps", "pod_dcn_rx_mbps",
+             "node_dcn_measured_mbps", "bandwidth_violating_pods"},
+            rows, node=agent.node_name)
+
+        report = BandwidthReport(
+            node=agent.node_name, usages=usages,
+            offline_tx_mbps=round(offline_tx, 1),
+            online_tx_mbps=round(online_tx, 1),
+            total_mbps=total_mbps, violations=violating_pods,
+            saturated=saturated)
+        sig = (report.offline_tx_mbps, report.online_tx_mbps,
+               report.violations, report.saturated,
+               tuple((u.pod_key, u.tx_mbps, u.violating)
+                     for u in report.usages))
+        if sig == self._last_report:
+            return                    # unchanged: no wire churn
+        try:
+            agent.cluster.put_object("bandwidthreport", report)
+            self._last_report = sig
+        except Exception as e:  # noqa: BLE001 — reporting must never
+            log.warning("bandwidth report post failed: %s", e)  # kill sync
 
 
 @register_handler

@@ -87,14 +87,14 @@ def _build_registry() -> None:
     global _built
     if _built:
         return
-    from volcano_tpu.api import (hypernode, jobflow, node_info,
-                                 numatopology, pod, podgroup, queue,
-                                 shard, types, vcjob)
+    from volcano_tpu.api import (hypernode, jobflow, netusage,
+                                 node_info, numatopology, pod, podgroup,
+                                 queue, shard, types, vcjob)
     from volcano_tpu.cache import cluster as cluster_mod
     from volcano_tpu.controllers import cronjob, hyperjob
     for mod in (types, pod, node_info, podgroup, queue, hypernode,
-                vcjob, jobflow, numatopology, shard, cluster_mod,
-                cronjob, hyperjob):
+                vcjob, jobflow, netusage, numatopology, shard,
+                cluster_mod, cronjob, hyperjob):
         _scan(mod)
     _built = True
 

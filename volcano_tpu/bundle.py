@@ -71,6 +71,13 @@ FAMILIES: Dict[str, str] = {
     "agent_pod_e2e_latency_seconds": "histogram",
     "agent_bind_conflicts_total": "counter",
     "agent_unschedulable_total": "counter",
+    # node-agent DCN bandwidth accounting (agent/handlers.py
+    # netaccounting: measured per-pod rates + watermark violations)
+    "pod_dcn_tx_mbps": "gauge",
+    "pod_dcn_rx_mbps": "gauge",
+    "node_dcn_measured_mbps": "gauge",
+    "bandwidth_violating_pods": "gauge",
+    "bandwidth_violations_total": "counter",
 }
 
 
@@ -150,6 +157,12 @@ def agent_dashboard() -> dict:
                unit="s"),
         _panel(4, "Per-job dominant share",
                ["topk(20, job_share)"], 12, 8),
+        _panel(5, "DCN measured bandwidth by node/tier (mbps)",
+               ["sum by (node, tier) (node_dcn_measured_mbps)",
+                "topk(20, pod_dcn_tx_mbps)"], 0, 16),
+        _panel(6, "Bandwidth watermark violations",
+               ["sum by (node) (bandwidth_violating_pods)",
+                "rate(bandwidth_violations_total[5m])"], 12, 16),
     ]
     return {
         "title": "volcano-tpu / agents", "uid": "vtp-agents",
@@ -214,9 +227,12 @@ ROLES = [
                     " --components controllers "
                     "--metrics-port {port2} "
                     "--token-file {bundle_dir}/token", 2),
+    # netaccounting reads the same volcano-owned cgroup subtree the
+    # cgroup enforcer narrows to (its default root), closing the
+    # shape->measure loop in the deployed agent
     ("agents", "volcano-tpu --cluster-url http://127.0.0.1:{port} "
                "--components none --agent-scheduler --node-agents all "
-               "--usage-source collectors:local,tpu "
+               "--usage-source collectors:local,tpu,netaccounting "
                "--enforcer cgroup:/sys/fs/cgroup,tc:eth0 "
                "--metrics-port {port3} "
                "--token-file {bundle_dir}/token", 3),

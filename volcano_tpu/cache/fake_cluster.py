@@ -45,6 +45,7 @@ class FakeCluster(Cluster):
         self.hyperjobs: Dict[str, object] = {}    # training/v1alpha1 HyperJob
         self.nodeshards: Dict[str, object] = {}   # shard/v1alpha1 NodeShard
         self.numatopologies: Dict[str, object] = {}  # nodeinfo/v1alpha1
+        self.bandwidthreports: Dict[str, object] = {}  # api/netusage.py
         self.services: Dict[str, dict] = {}       # svc plugin artifacts
         self.config_maps: Dict[str, dict] = {}
         self.secrets: Dict[str, dict] = {}
@@ -91,6 +92,10 @@ class FakeCluster(Cluster):
             node = self.nodes.pop(name, None)
         if node:
             self._notify("node_deleted", node)
+            with self._lock:
+                had = name in self.bandwidthreports
+            if had:    # same lifetime rule as delete_object("node")
+                self.delete_object("bandwidthreport", name)
 
     def add_pod(self, pod: Pod):
         if self.admission is not None and pod.key not in self.pods:
@@ -215,10 +220,66 @@ class FakeCluster(Cluster):
                     obj = getattr(self.admission, method)(obj, self)
             elif kind == "vcjob":
                 obj = self.admission.admit_job_update(obj, self)
+        if kind == "node":
+            # keep the accounting fold sticky: a node write from a
+            # mirror that predates the fold (the agent's whole-node
+            # persist) must not erase the measured-bandwidth summary —
+            # re-apply the stored report before the write lands
+            with self._lock:
+                rep = self.bandwidthreports.get(k)
+            if rep is not None:
+                self._apply_bandwidth_fold(obj, rep)
         with self._lock:
             getattr(self, spec.attr)[k] = obj
         self._notify(kind, obj if spec.key_of else {"key": k, "obj": obj})
+        if kind == "bandwidthreport":
+            self._fold_bandwidth_report(obj)
         return obj
+
+    @staticmethod
+    def _apply_bandwidth_fold(node, report) -> bool:
+        """Merge a BandwidthReport's node-level summary into *node*'s
+        annotations; returns True when anything changed."""
+        from volcano_tpu.api.netusage import (
+            NODE_MEASURED_OFFLINE_ANNOTATION,
+            NODE_MEASURED_ONLINE_ANNOTATION, NODE_SATURATED_ANNOTATION,
+            NODE_VIOLATING_PODS_ANNOTATION)
+        ann = node.annotations
+        before = (ann.get(NODE_MEASURED_OFFLINE_ANNOTATION),
+                  ann.get(NODE_MEASURED_ONLINE_ANNOTATION),
+                  ann.get(NODE_SATURATED_ANNOTATION),
+                  ann.get(NODE_VIOLATING_PODS_ANNOTATION))
+        ann[NODE_MEASURED_OFFLINE_ANNOTATION] = \
+            f"{report.offline_tx_mbps:.1f}"
+        ann[NODE_MEASURED_ONLINE_ANNOTATION] = \
+            f"{report.online_tx_mbps:.1f}"
+        if report.saturated:
+            ann[NODE_SATURATED_ANNOTATION] = "true"
+        else:
+            ann.pop(NODE_SATURATED_ANNOTATION, None)
+        ann[NODE_VIOLATING_PODS_ANNOTATION] = str(report.violations)
+        return before != (
+            ann.get(NODE_MEASURED_OFFLINE_ANNOTATION),
+            ann.get(NODE_MEASURED_ONLINE_ANNOTATION),
+            ann.get(NODE_SATURATED_ANNOTATION),
+            ann.get(NODE_VIOLATING_PODS_ANNOTATION))
+
+    def _fold_bandwidth_report(self, report) -> None:
+        """Fold a node agent's BandwidthReport summary into the node's
+        annotations AT THE STORE — the server-side half of the
+        accounting loop.  Doing it here (not in the agent) means every
+        watch mirror, the scheduler's included, learns saturation from
+        ordinary node events without decoding reports.  The fold is
+        also re-applied on every node PUT (put_object above), so a
+        whole-node persist from a mirror that hasn't seen the folded
+        keys yet cannot erase them between reports."""
+        with self._lock:
+            node = self.nodes.get(getattr(report, "node", ""))
+            if node is None:
+                return
+            changed = self._apply_bandwidth_fold(node, report)
+        if changed:     # unchanged summary: no watch traffic
+            self._notify("node", node)
 
     def delete_object(self, kind: str, key: str) -> None:
         from volcano_tpu.cache.kinds import KINDS
@@ -228,6 +289,15 @@ class FakeCluster(Cluster):
         if obj is not None:
             self._notify(f"{kind}_deleted",
                          obj if spec.key_of else {"key": key, "obj": obj})
+        if kind == "node" and obj is not None:
+            # the node's accounting report dies with it: the sticky
+            # re-fold (put_object) would otherwise resurrect stale
+            # saturation onto a REPLACEMENT host registering under
+            # the same name
+            with self._lock:
+                had = key in self.bandwidthreports
+            if had:
+                self.delete_object("bandwidthreport", key)
 
     def watch(self, fn: Callable[[str, object], None]):
         self._watchers.append(fn)

@@ -44,6 +44,10 @@ KINDS: Dict[str, KindSpec] = {
     "hyperjob": KindSpec("hyperjobs", _key),
     "nodeshard": KindSpec("nodeshards", _name),
     "numatopology": KindSpec("numatopologies", _name),
+    # per-node DCN bandwidth accounting report (api/netusage.py):
+    # posted by the node agent, folded into node annotations by the
+    # store so scheduler mirrors see saturation without decoding it
+    "bandwidthreport": KindSpec("bandwidthreports", _name),
     # plain-dict kinds (plugin/operator supplied payloads)
     # namespace -> annotations dict (podgroup mutate webhook reads the
     # per-namespace default-queue annotation)

@@ -507,6 +507,38 @@ def cmd_node_view(cluster, args):
             print(f"  {p.key} ({p.phase.value})")
 
 
+def cmd_bandwidth(cluster, args):
+    """Per-pod DCN usage as the agents measured it (BandwidthReport
+    store, api/netusage.py): node summary line + per-pod rates,
+    watermarks and violation tallies.  Works against a state file or
+    a live server (the mirror carries the bandwidthreport kind)."""
+    reports = getattr(cluster, "bandwidthreports", {})
+    rows, summary = [], []
+    for name in sorted(reports):
+        rep = reports[name]
+        if args.node and name != args.node:
+            continue
+        for u in rep.usages:
+            rows.append([
+                rep.node, u.pod_key, u.tier,
+                f"1:{u.classid}" if u.classid else "-",
+                f"{u.tx_mbps:g}", f"{u.rx_mbps:g}",
+                f"{u.watermark_mbps:g}" if u.watermark_mbps else "-",
+                ("VIOLATING" if u.violating else
+                 (str(u.violations) if u.violations else "-")),
+            ])
+        summary.append([
+            rep.node, f"{rep.online_tx_mbps:g}",
+            f"{rep.offline_tx_mbps:g}", f"{rep.total_mbps:g}",
+            rep.violations, "yes" if rep.saturated else "no"])
+    print(_table(rows, ["NODE", "POD", "TIER", "CLASS", "TX-MBPS",
+                        "RX-MBPS", "WATERMARK", "VIOLATIONS"]))
+    if summary:
+        print()
+        print(_table(summary, ["NODE", "ONLINE-MBPS", "OFFLINE-MBPS",
+                               "BUDGET", "VIOLATING", "SATURATED"]))
+
+
 def cmd_tick(cluster, args):
     """Run controllers + one scheduling cycle + kubelet tick.
 
@@ -685,6 +717,13 @@ def build_parser() -> argparse.ArgumentParser:
     p = node.add_parser("view")
     p.add_argument("-N", "--name", required=True)
     p.set_defaults(fn=cmd_node_view)
+
+    p = sub.add_parser("bandwidth", help="per-pod DCN usage as the "
+                       "agents measured it (rates, watermarks, "
+                       "violations)")
+    p.add_argument("--node", default="",
+                   help="limit to one node's report")
+    p.set_defaults(fn=cmd_bandwidth)
 
     p = sub.add_parser("tick",
                        help="advance the standalone control plane")

@@ -82,15 +82,22 @@ def delete_labeled(**labels):
                 del store[key]
 
 
-def swap_gauge_families(families, rows):
+def swap_gauge_families(families, rows, **scope):
     """Atomically replace whole gauge families: under ONE lock, drop
     every existing series whose metric name is in *families* (one scan
     of the registry), then install *rows* ([(name, labels-dict, value)]).
     A concurrent /metrics scrape sees either the old or the new export,
-    never a half-cleared family."""
+    never a half-cleared family.
+
+    *scope* labels narrow the drop to series carrying ALL of them —
+    how per-node exporters (several node agents in one process, e.g.
+    the bandwidth families) replace only THEIR slice of a family
+    instead of clobbering each other's every sync."""
     families = set(families)
+    match = set(scope.items())
     with _lock:
-        for key in [k for k in _gauges if k[0] in families]:
+        for key in [k for k in _gauges
+                    if k[0] in families and match <= set(k[1])]:
             del _gauges[key]
         for name, labels, value in rows:
             _gauges[_key(name, labels)] = value

@@ -37,6 +37,10 @@ class NodeOrderPlugin(Plugin):
             "tainttoleration.weight", 1))
         self.image_locality_weight = float(self.arguments.get(
             "imagelocality.weight", 1))
+        # measured DCN pressure (agent BandwidthReports folded into
+        # node annotations): keep NEW online pods off saturated hosts
+        self.bandwidth_weight = float(self.arguments.get(
+            "bandwidth.weight", 1))
 
     def on_session_open(self, ssn):
         ssn.add_node_order_fn(self.name, self._score)
@@ -52,6 +56,9 @@ class NodeOrderPlugin(Plugin):
         if self.image_locality_weight:
             score += self.image_locality_weight * \
                 self._image_locality_score(task, node)
+        if self.bandwidth_weight:
+            score += self.bandwidth_weight * \
+                self._bandwidth_score(task, node)
         return score
 
     def _resource_score(self, task: TaskInfo, node: NodeInfo) -> float:
@@ -98,6 +105,29 @@ class NodeOrderPlugin(Plugin):
             1 for taint in prefer
             if not any(tol.tolerates(taint) for tol in tols))
         return MAX_SCORE * (1.0 - intolerable / len(prefer))
+
+    def _bandwidth_score(self, task: TaskInfo, node: NodeInfo) -> float:
+        """Penalize placing ONLINE pods onto DCN-saturated hosts.
+
+        The agents measure per-pod rates and the store folds each
+        node's summary into annotations (api/netusage.py); a node
+        with no accounting deployed scores full marks (a uniform
+        shift that cannot reorder nodes).  A saturated host scores 0
+        for online (non-BE) pods — their bandwidth guarantee is
+        already not holding there; offline pods keep full score (the
+        per-pod HTB caps shape them wherever they land, and pushing
+        BE work away from saturated hosts is bandwidthPressure's
+        job, with hysteresis, not the scorer's)."""
+        from volcano_tpu.api.netusage import NODE_SATURATED_ANNOTATION
+        from volcano_tpu.api.types import (QOS_BEST_EFFORT,
+                                           QOS_LEVEL_ANNOTATION)
+        if node.node is None or node.node.annotations.get(
+                NODE_SATURATED_ANNOTATION) != "true":
+            return MAX_SCORE
+        if task.pod.annotations.get(QOS_LEVEL_ANNOTATION) == \
+                QOS_BEST_EFFORT:
+            return MAX_SCORE
+        return 0.0
 
     def _image_locality_score(self, task: TaskInfo, node: NodeInfo) -> float:
         """Fraction of the pod's container images already present on the

@@ -3,7 +3,7 @@
 Reference parity: plugins/rescheduling/rescheduling.go:110 (strategy
 registry feeding VictimTasks; the shuffle action executes evictions)
 + low_node_utilization.go (per-resource thresholds, nodeFit, priority
-threshold).  Two strategies ship:
+threshold).  Three strategies ship:
 
   lowNodeUtilization — victims from nodes above the per-resource
     target thresholds while nodes below the low thresholds exist to
@@ -16,7 +16,14 @@ threshold).  Two strategies ship:
     (fewest used chips) hand their sub-host pods to receiver hosts
     (most used chips, enough idle), freeing whole hosts for gangs.
 
+  bandwidthPressure — chronic offline-tier bandwidth violators on
+    DCN-saturated hosts (node/pod annotations folded from the agents'
+    BandwidthReports, api/netusage.py) are victimized so the enforced
+    online guarantee holds where shaping alone did not.
+
 Arguments (all under the plugin's `arguments` map):
+  bandwidthPressure.chronicViolations: violating-sync floor for a
+      bandwidth victim                                (default 3)
   rescheduling.interval: seconds between passes         (default 300)
   rescheduling.strategies: comma list                   (default
       "lowNodeUtilization")
@@ -160,6 +167,69 @@ def _tpu_defrag_victims(plugin, ssn) -> List[TaskInfo]:
     return victims
 
 
+@register_strategy("bandwidthPressure")
+def _bandwidth_victims(plugin, ssn) -> List[TaskInfo]:
+    """Chronic offline-tier bandwidth violators on saturated hosts
+    become migration victims — the react step of the agent's
+    enforce→measure→react loop (api/netusage.py).
+
+    A host is saturated when the agent's measured DCN total crossed
+    the pressure line (node annotation folded from its
+    BandwidthReport); a victim is an offline (BE) pod whose
+    cumulative violating-sync count reached the chronic threshold
+    AND is still violating — a pod that already backed under its
+    watermark gets to stay.  Hottest hosts drain first; per-pod caps
+    (the enforcer) keep shaping everyone else meanwhile."""
+    from volcano_tpu.api.netusage import (
+        NODE_MEASURED_OFFLINE_ANNOTATION, NODE_SATURATED_ANNOTATION,
+        POD_VIOLATING_ANNOTATION, POD_VIOLATIONS_ANNOTATION)
+    from volcano_tpu.api.types import QOS_BEST_EFFORT, QOS_LEVEL_ANNOTATION
+
+    def measured_offline(n) -> float:
+        node = getattr(n, "node", None)
+        try:
+            return float(node.annotations.get(
+                NODE_MEASURED_OFFLINE_ANNOTATION, 0)) if node else 0.0
+        except (TypeError, ValueError):
+            return 0.0
+
+    saturated = [n for n in ssn.nodes.values()
+                 if n.ready and n.node is not None
+                 and n.node.annotations.get(
+                     NODE_SATURATED_ANNOTATION) == "true"]
+    if not saturated:
+        return []
+    saturated.sort(key=measured_offline, reverse=True)
+    victims: List[TaskInfo] = []
+    for node in saturated:
+        def violation_count(t) -> int:
+            try:
+                return int(t.pod.annotations.get(
+                    POD_VIOLATIONS_ANNOTATION, 0))
+            except (TypeError, ValueError):
+                return 0
+        # worst offenders first: the biggest cumulative violator buys
+        # the most relief per eviction
+        for t in sorted(node.tasks.values(), key=violation_count,
+                        reverse=True):
+            pod = t.pod
+            if pod.annotations.get(QOS_LEVEL_ANNOTATION) != \
+                    QOS_BEST_EFFORT:
+                continue        # only the offline tier is migratable
+            if pod.annotations.get(POD_VIOLATING_ANNOTATION) != "true":
+                continue
+            if violation_count(t) < plugin.bw_chronic_violations:
+                continue
+            victim = _movable(plugin, t, node)
+            if victim is None:
+                continue
+            victims.append(victim)
+            if len(victims) >= plugin.max_victims:
+                return victims
+            break               # one victim per saturated host per pass
+    return victims
+
+
 @register_plugin("rescheduling")
 class ReschedulingPlugin(Plugin):
     name = "rescheduling"
@@ -195,6 +265,12 @@ class ReschedulingPlugin(Plugin):
             {"cpu": high, "memory": high}))
         self.node_fit = bool(args.get("lowNodeUtilization.nodeFit",
                                       True))
+        # bandwidthPressure: violating-sync count past which an
+        # offline pod counts as a CHRONIC violator (one hysteresis
+        # episode is FIRE_SYNCS syncs; default demands a sustained
+        # offender, not a pod that tripped the watermark once)
+        self.bw_chronic_violations = int(args.get(
+            "bandwidthPressure.chronicViolations", 3))
 
     def on_session_open(self, ssn):
         self.ssn = ssn

@@ -275,6 +275,18 @@ class _Handler(BaseHTTPRequestHandler):
                 "rv": rv, "resync": resync, "epoch": st.epoch,
                 "events": [{"rv": r, "kind": k, "obj": o}
                            for r, k, o in events]})
+        if url.path == "/bandwidth":
+            # per-node DCN accounting reports (api/netusage.py), the
+            # GET-route view of what the agents measured; ?node=
+            # narrows to one host
+            q = parse_qs(url.query)
+            want = q.get("node", [""])[0]
+            with st.cluster._lock:
+                reports = {
+                    name: codec.encode(rep) for name, rep in
+                    getattr(st.cluster, "bandwidthreports", {}).items()
+                    if not want or name == want}
+            return self._json(200, {"reports": reports})
         if url.path == "/audit":
             q = parse_qs(url.query)
             since = int(q.get("since", ["0"])[0])

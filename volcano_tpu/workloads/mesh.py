@@ -28,6 +28,21 @@ AXES = ("dp", "fsdp", "tp", "sp")
 HYBRID_AXES = ("dcn",) + AXES
 
 
+def shard_map(f, mesh, in_specs, out_specs, check_vma=None):
+    """jax.shard_map across jax versions: new jax exposes it at the
+    top level with `check_vma`; 0.4.x only has
+    jax.experimental.shard_map.shard_map with the same knob named
+    `check_rep`.  One call site, both vintages."""
+    if hasattr(jax, "shard_map"):
+        kwargs = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as _sm
+    kwargs = {} if check_vma is None else {"check_rep": check_vma}
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               **kwargs)
+
+
 def choose_axis_sizes(n_devices: int,
                       tp: Optional[int] = None,
                       sp: Optional[int] = None,

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from volcano_tpu.workloads.mesh import shard_map as _shard_map
 from volcano_tpu.workloads.ring_attention import (
     local_causal_attention,
     ring_attention,
@@ -121,6 +122,7 @@ _PARAM_SPECS = {
 
 # expert-parallel specs (expert dim rides fsdp; see workloads/moe.py)
 from volcano_tpu.workloads.moe import (  # noqa: E402
+    EXPERT_DIM_PARAMS as _EXPERT_DIM_PARAMS,
     MOE_PARAM_SPECS as _MOE_SPECS,
     init_moe_params,
     moe_mlp,
@@ -129,17 +131,32 @@ from volcano_tpu.workloads.moe import (  # noqa: E402
 _PARAM_SPECS.update({name: P(*axes) for name, axes in _MOE_SPECS.items()})
 
 
-def param_specs(params) -> Any:
-    """PartitionSpec pytree matching init_params' structure."""
-    def spec_of(path, _leaf):
+def param_specs(params, mesh: Optional[Mesh] = None) -> Any:
+    """PartitionSpec pytree matching init_params' structure.
+
+    On a hybrid mesh (a 'dcn' axis > 1) the MoE expert-dim leaves are
+    promoted from fsdp to ("dcn", "fsdp") when the expert count
+    divides — experts-over-slices: each ICI slice holds E/(dcn*fsdp)
+    experts and the token regroup's all_to_all is the only expert
+    traffic crossing DCN.  Dense params never name dcn (they replicate
+    per slice; the gradient mean inserts the one cross-slice psum)."""
+    dcn = mesh.shape.get("dcn", 1) if mesh is not None else 1
+    fsdp = mesh.shape.get("fsdp", 1) if mesh is not None else 1
+
+    def spec_of(path, leaf):
         name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-        return _PARAM_SPECS.get(name, P(None))
+        spec = _PARAM_SPECS.get(name, P(None))
+        if dcn > 1 and name in _EXPERT_DIM_PARAMS:
+            shape = getattr(leaf, "shape", ())
+            if shape and shape[0] % (dcn * fsdp) == 0:
+                spec = P(("dcn", "fsdp"), *list(spec)[1:])
+        return spec
     return jax.tree_util.tree_map_with_path(spec_of, params)
 
 
 def param_shardings(params, mesh: Mesh):
     return jax.tree.map(lambda s: NamedSharding(mesh, s),
-                        param_specs(params))
+                        param_specs(params, mesh))
 
 
 # -- forward ----------------------------------------------------------
@@ -179,7 +196,7 @@ def _attention(x, blk, cfg: ModelConfig, positions, mesh: Optional[Mesh]):
     if cfg.use_ulysses_attention and sp > 1 and \
             (cfg.n_heads // tp) % sp == 0:
         from volcano_tpu.workloads.ulysses import ulysses_attention
-        attn = jax.shard_map(
+        attn = _shard_map(
             functools.partial(ulysses_attention, axis_name="sp",
                               use_flash=cfg.use_flash_attention),
             mesh=mesh,
@@ -201,7 +218,7 @@ def _attention(x, blk, cfg: ModelConfig, positions, mesh: Optional[Mesh]):
                 f"use_ulysses_attention needs (n_heads/tp) % sp == 0 "
                 f"(heads={cfg.n_heads}, tp={tp}, sp={sp}); falling "
                 f"back to ring attention", stacklevel=2)
-        attn = jax.shard_map(
+        attn = _shard_map(
             functools.partial(ring_attention, axis_name="sp"),
             mesh=mesh,
             in_specs=(P(("dp", "fsdp"), "sp", "tp", None),) * 3,

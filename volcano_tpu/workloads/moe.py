@@ -47,6 +47,13 @@ MOE_PARAM_SPECS = {
     "moe_down": ("fsdp", "tp", None),
 }
 
+# Leaves whose LEADING dim is the expert dim: on a hybrid (dcn) mesh
+# these are promoted to shard over ("dcn", "fsdp") when the expert
+# count divides — experts-over-slices, the standard MoE scale-out
+# (model.param_specs does the promotion; the router's leading dim is
+# d_model, so it stays per-slice).
+EXPERT_DIM_PARAMS = frozenset({"moe_gate", "moe_up", "moe_down"})
+
 
 def _route(x, blk, n_experts: int, top_k: int):
     """Shared router: (probs, top_vals, top_idx, aux_loss)."""

@@ -31,6 +31,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from volcano_tpu.workloads import model as model_lib
+from volcano_tpu.workloads.mesh import shard_map as _shard_map
 from volcano_tpu.workloads.model import ModelConfig
 
 
@@ -39,6 +40,25 @@ def make_pp_mesh(n_stages: int, devices=None) -> Mesh:
     if len(devices) != n_stages:
         raise ValueError(f"need {n_stages} devices, have {len(devices)}")
     return Mesh(np.asarray(devices), ("pp",))
+
+
+def make_pp_mesh_over_slices(n_stages: int, devices=None) -> Mesh:
+    """Stage-per-slice mesh: pp OUTERMOST over the DCN tier.
+
+    Each pipeline stage owns ONE ICI slice (its devices replicate the
+    stage's params and computation over the inner 'pp_rep' axis), so
+    the ppermute activation hop between stages is the only traffic
+    crossing DCN — precisely the deployment shape multi-slice
+    scheduling buys (docs/design/hybrid-mesh.md).  Devices group by
+    physical slice the same way make_hybrid_mesh does
+    (slice_index -> process_index -> sequential chunks).  The GPipe
+    schedule runs unchanged: every spec in this module names only
+    'pp', so the inner axis replicates."""
+    from volcano_tpu.workloads.mesh import group_by_slice
+    devices = list(devices if devices is not None else jax.devices())
+    groups = group_by_slice(devices, n_stages)
+    arr = np.stack([np.asarray(g) for g in groups])   # [S, per_slice]
+    return Mesh(arr, ("pp", "pp_rep"))
 
 
 def stack_stage_params(params: Dict[str, Any], n_stages: int):
@@ -148,7 +168,7 @@ def pipelined_apply_blocks(x, stage_blocks, cfg: ModelConfig, positions,
                      mesh, n_microbatches, x_mb.shape[1:],
                      pos_mb.shape[1:], x_mb.dtype)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         pipeline, mesh=mesh,
         in_specs=(P(), P(), jax.tree.map(lambda _: P("pp"), stage_blocks)),
         out_specs=P(),
@@ -180,7 +200,7 @@ def pipelined_loss(outer, stage_blocks, tokens, cfg: ModelConfig,
         return _pipe(inject, stage_blocks, cfg, mesh, n_microbatches,
                      mb_shape, (mb, t), cfg.dtype)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         pipeline, mesh=mesh,
         in_specs=(P(), P(), jax.tree.map(lambda _: P("pp"), stage_blocks)),
         out_specs=P(),
