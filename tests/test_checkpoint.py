@@ -39,6 +39,66 @@ def test_latest_step_empty_dir(tmp_path):
     assert checkpoint.latest_step(str(tmp_path / "missing")) is None
 
 
+def test_restore_onto_remeshed_slice_changed_sharding(tmp_path):
+    """Failover onto a SAME-SHAPE but re-meshed slice: the replacement
+    slice assembles the mesh with a different device order (worker ids
+    permute after re-scheduling), so every leaf's target sharding maps
+    shards to different devices.  Restore must land on the NEW
+    shardings and continue bit-identically."""
+    cfg = model_lib.tiny_config()
+    opt = train.make_optimizer(lr=1e-2, warmup_steps=1)
+    axes = {"dp": 1, "fsdp": 2, "tp": 2, "sp": 2}
+    mesh = make_mesh(axes)
+    params, state, _ = train.init_sharded(jax.random.key(0), cfg,
+                                          mesh, opt)
+    step_fn = train.make_train_step(cfg, mesh, opt)
+    batch = train.synthetic_batch(jax.random.key(1), cfg, 4, 64, mesh)
+    params, state, _ = step_fn(params, state, batch)
+    ckpt = str(tmp_path / "ckpt")
+    checkpoint.save(ckpt, step=1, params=params, opt_state=state)
+
+    # the re-meshed slice: same topology, REVERSED device assignment
+    devices = list(jax.devices())[::-1]
+    remesh = make_mesh(axes, devices=devices)
+    p2, s2, _ = train.init_sharded(jax.random.key(7), cfg, remesh, opt)
+    p2, s2, step = checkpoint.restore(ckpt, p2, s2)
+    assert step == 1
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # restored leaves carry the re-meshed device order, not the old
+    leaf = jax.tree.leaves(p2)[0]
+    assert list(leaf.sharding.mesh.devices.flat) == \
+        list(remesh.devices.flat)
+    # the continued trajectory agrees across the re-mesh
+    batch2 = train.synthetic_batch(jax.random.key(1), cfg, 4, 64,
+                                   remesh)
+    _, _, m_old = step_fn(params, state, batch)
+    step2 = train.make_train_step(cfg, remesh, opt)
+    _, _, m_new = step2(p2, s2, batch2)
+    np.testing.assert_allclose(float(m_old["loss"]),
+                               float(m_new["loss"]), rtol=1e-6)
+
+
+def test_close_all_idempotent_under_double_shutdown(tmp_path):
+    """close_all twice (atexit + explicit failover teardown) must not
+    raise, and a save after shutdown gets a FRESH manager rather than
+    a closed one."""
+    mesh = make_mesh({"dp": 1, "fsdp": 2, "tp": 2, "sp": 2})
+    cfg = model_lib.tiny_config()
+    opt = train.make_optimizer(lr=1e-2, warmup_steps=1)
+    params, state, _ = train.init_sharded(jax.random.key(0), cfg,
+                                          mesh, opt)
+    ckpt = str(tmp_path / "ckpt")
+    checkpoint.save(ckpt, step=1, params=params, opt_state=state)
+    checkpoint.close_all()
+    checkpoint.close_all()             # double shutdown: no raise
+    # post-shutdown use re-opens cleanly (new manager, old data)
+    assert checkpoint.latest_step(ckpt) == 1
+    checkpoint.save(ckpt, step=2, params=params, opt_state=state)
+    assert checkpoint.latest_step(ckpt) == 2
+    checkpoint.close_all()
+
+
 def test_restore_across_mesh_topologies_flat_to_hybrid(tmp_path):
     """A job checkpointed on a FLAT single-slice mesh resumes on a
     HYBRID two-slice DCN x ICI mesh (and the training trajectory is

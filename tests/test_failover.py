@@ -1,0 +1,734 @@
+"""Slice-failure failover: detect → drain → reschedule → resume.
+
+The subsystem under test (ISSUE 3) connects pieces that previously
+existed in isolation:
+
+  agent      (agent/handlers.py TpuHealthHandler): chip health with
+      K-consecutive-ticks hysteresis BOTH directions + SliceHealthReport
+      wire objects (api/slicehealth.py), folded into node annotations
+      by the store;
+  controller (controllers/failover.py): declares the SLICE failed,
+      drains the resident gang with ONE job-level restart, stamps
+      resume metadata, quarantines behind a flap-damping TTL, and
+      times every phase into the failover_* metric families;
+  scheduler  (plugins/failover.py): quarantined hosts filtered,
+      requeued gangs get allocation priority, optional warm spares;
+  workload   (jax plugin → bootstrap → checkpoint.resume_state):
+      VTP_RESUME_STEP / VTP_CHECKPOINT_DIR carry the resume contract
+      into the worker, which restores from orbax instead of
+      recomputing from step 0;
+  wire e2e   : the full loop through a real HTTP state server.
+"""
+
+import time
+
+import pytest
+
+from volcano_tpu.agent.agent import FakeUsageProvider, NodeAgent
+from volcano_tpu.agent.handlers import TpuHealthHandler
+from volcano_tpu.api.pod import make_pod
+from volcano_tpu.api.podgroup import NetworkTopologySpec
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.slicehealth import (
+    CHECKPOINT_DIR_ANNOTATION,
+    FAILOVER_GENERATION_ANNOTATION,
+    LAST_STEP_ANNOTATION,
+    NODE_HEALTH_ANNOTATION,
+    NODE_QUARANTINED_UNTIL_ANNOTATION,
+    REQUEUED_ANNOTATION,
+    RESUME_STEP_ANNOTATION,
+    SliceHealthReport,
+    VERDICT_FAILED,
+    VERDICT_HEALTHY,
+    VERDICT_SUSPECT,
+)
+from volcano_tpu.api.types import (
+    JobPhase,
+    NetworkTopologyMode,
+    TPU_SLICE_LABEL,
+    TaskStatus,
+)
+from volcano_tpu.api.vcjob import TaskSpec, VCJob
+from volcano_tpu.controllers import ControllerManager
+from volcano_tpu.controllers.failover import FailoverController
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.simulator import fail_host, heal_host, make_tpu_cluster
+
+FAILOVER_CONF = {
+    "actions": "enqueue, allocate, backfill",
+    "tiers": [
+        {"plugins": [{"name": "priority"}, {"name": "gang"},
+                     {"name": "failover"}, {"name": "conformance"}]},
+        {"plugins": [{"name": "overcommit"}, {"name": "drf"},
+                     {"name": "predicates"}, {"name": "proportion"},
+                     {"name": "nodeorder"}, {"name": "binpack"},
+                     {"name": "deviceshare"},
+                     {"name": "network-topology-aware"}]},
+    ],
+}
+
+
+def tpu_gang_job(name="train", replicas=4, annotations=None,
+                 run_ticks=None):
+    from volcano_tpu.api.types import RUN_TICKS_ANNOTATION
+    pod_ann = {}
+    if run_ticks is not None:
+        pod_ann[RUN_TICKS_ANNOTATION] = str(run_ticks)
+    return VCJob(
+        name=name, min_available=replicas,
+        annotations=dict(annotations or {}),
+        network_topology=NetworkTopologySpec(
+            NetworkTopologyMode.HARD, 1),
+        plugins={"jax": []},
+        tasks=[TaskSpec(name="worker", replicas=replicas,
+                        template=make_pod(
+                            "t", requests={"cpu": 8, TPU: 4},
+                            annotations=pod_ann))])
+
+
+# -- agent: K-consecutive-ticks verdict + SliceHealthReport ------------
+
+def test_health_hysteresis_verdict_ladder_and_report():
+    """One bad sample -> Suspect (report posted, NOT cordoned); K bad
+    -> Failed (cordon + event, exactly once); one good sample resets
+    nothing visible; K good -> Healthy (uncordon + event)."""
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    provider = FakeUsageProvider()
+    agent = NodeAgent(cluster, "sa-w0", provider)
+    node = cluster.nodes["sa-w0"]
+
+    fail_host(cluster, "sa-w0", provider=provider, chips_healthy=3)
+    agent.sync()
+    rep = cluster.slicehealthreports["sa-w0"]
+    assert rep.verdict == VERDICT_SUSPECT
+    assert rep.slice == "sa" and rep.chips_healthy == 3
+    assert rep.first_bad_ts > 0
+    assert node.unschedulable is False
+    # store folded the verdict into node annotations for every mirror
+    assert node.annotations[NODE_HEALTH_ANNOTATION] == VERDICT_SUSPECT
+
+    for _ in range(TpuHealthHandler.FAIL_SYNCS - 1):
+        agent.sync()
+    rep = cluster.slicehealthreports["sa-w0"]
+    assert rep.verdict == VERDICT_FAILED
+    assert node.unschedulable is True
+    assert node.annotations[NODE_HEALTH_ANNOTATION] == VERDICT_FAILED
+    assert [r for _, r, _ in cluster.events].count("TPUUnhealthy") == 1
+
+    heal_host(cluster, "sa-w0", provider=provider)
+    agent.sync()
+    assert node.unschedulable is True          # one good tick: hold
+    assert cluster.slicehealthreports["sa-w0"].verdict == VERDICT_FAILED
+    for _ in range(TpuHealthHandler.RECOVER_SYNCS - 1):
+        agent.sync()
+    assert node.unschedulable is False
+    assert cluster.slicehealthreports["sa-w0"].verdict == VERDICT_HEALTHY
+    assert NODE_HEALTH_ANNOTATION not in node.annotations
+    assert any(r == "TPURecovered" for _, r, _ in cluster.events)
+
+
+def test_health_flap_never_reaches_failed():
+    """Alternating bad/good samples (the flappiness the old handler
+    cordoned on) never escalate past Suspect and never cordon."""
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    provider = FakeUsageProvider()
+    agent = NodeAgent(cluster, "sa-w0", provider)
+    for _ in range(4):
+        fail_host(cluster, "sa-w0", provider=provider, chips_healthy=3)
+        agent.sync()
+        heal_host(cluster, "sa-w0", provider=provider)
+        agent.sync()
+    node = cluster.nodes["sa-w0"]
+    assert node.unschedulable is False
+    assert not any(r == "TPUUnhealthy" for _, r, _ in cluster.events)
+
+
+def test_slicehealth_codec_roundtrip():
+    from volcano_tpu.api import codec
+    rep = SliceHealthReport(node="sa-w0", slice="sa",
+                            verdict=VERDICT_FAILED, chips_detected=4,
+                            chips_healthy=1, consecutive_bad=3,
+                            first_bad_ts=123.5)
+    back = codec.decode(codec.encode(rep))
+    assert back.node == "sa-w0" and back.slice == "sa"
+    assert back.verdict == VERDICT_FAILED
+    assert back.consecutive_bad == 3 and back.first_bad_ts == 123.5
+
+
+def test_health_fold_sticky_and_dies_with_node():
+    """A whole-node write from a stale mirror cannot erase the folded
+    verdict; a node delete drops the report so a replacement host is
+    not born Failed."""
+    from volcano_tpu.api.node_info import Node
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+
+    cluster = FakeCluster()
+    cluster.add_node(Node(name="n0", allocatable={"cpu": "8"}))
+    cluster.put_object("slicehealthreport", SliceHealthReport(
+        node="n0", slice="sa", verdict=VERDICT_FAILED))
+    assert cluster.nodes["n0"].annotations[
+        NODE_HEALTH_ANNOTATION] == VERDICT_FAILED
+    stale = Node(name="n0", allocatable={"cpu": "8"},
+                 annotations={"somebody": "else"})
+    cluster.put_object("node", stale)
+    ann = cluster.nodes["n0"].annotations
+    assert ann["somebody"] == "else"
+    assert ann[NODE_HEALTH_ANNOTATION] == VERDICT_FAILED
+    cluster.delete_object("node", "n0")
+    assert "n0" not in cluster.slicehealthreports
+    cluster.put_object("node", Node(name="n0",
+                                    allocatable={"cpu": "8"}))
+    assert NODE_HEALTH_ANNOTATION not in \
+        cluster.nodes["n0"].annotations
+
+
+# -- controller: declare -> drain -> quarantine ------------------------
+
+def drive(cluster, mgr, sched, n=1, agent=None):
+    for _ in range(n):
+        if agent is not None:
+            agent.sync()
+        mgr.sync_all()
+        sched.run_once()
+        cluster.tick()
+
+
+def start_running_gang(annotations=None):
+    cluster = make_tpu_cluster([("sa", "v5e-16"), ("sb", "v5e-16")])
+    mgr = ControllerManager(cluster, enabled=["job", "podgroup",
+                                              "queue", "failover"])
+    sched = Scheduler(cluster, conf=FAILOVER_CONF, schedule_period=0)
+    job = tpu_gang_job(annotations=annotations or {
+        CHECKPOINT_DIR_ANNOTATION: "/ckpt/train",
+        LAST_STEP_ANNOTATION: "42"})
+    cluster.add_vcjob(job)
+    drive(cluster, mgr, sched, 4)
+    job = cluster.vcjobs["default/train"]
+    assert job.phase is JobPhase.RUNNING
+    victim = sorted(p.node_name for p in cluster.pods.values()
+                    if p.owner == job.uid)[0]
+    return cluster, mgr, sched, job, victim
+
+
+def gang_slices(cluster, job):
+    return {cluster.nodes[p.node_name].labels[TPU_SLICE_LABEL]
+            for p in cluster.pods.values()
+            if p.owner == job.uid and p.node_name}
+
+
+def test_failover_drains_with_one_job_restart_and_stamps_resume():
+    """Slice failure -> ONE RestartJob (no per-pod policy cascade, no
+    maxRetry burn), podgroup + job stamped with generation/resume
+    metadata, every slice host quarantined, gang re-placed off the
+    failed slice, MTTR metrics observed, requeued marker cleared."""
+    from volcano_tpu import metrics
+
+    cluster, mgr, sched, job, victim = start_running_gang()
+    victim_slice = cluster.nodes[victim].labels[TPU_SLICE_LABEL]
+    retries_before = job.retry_count
+
+    fail_host(cluster, victim)         # direct mode: agent-equivalent
+    drive(cluster, mgr, sched, 12)
+
+    job = cluster.vcjobs["default/train"]
+    assert job.phase is JobPhase.RUNNING
+    assert job.annotations[FAILOVER_GENERATION_ANNOTATION] == "1"
+    assert job.annotations[RESUME_STEP_ANNOTATION] == "42"
+    assert job.retry_count == retries_before   # not a policy retry
+    assert gang_slices(cluster, job) == {"sb" if victim_slice == "sa"
+                                         else "sa"}
+    pg = cluster.podgroups["default/train"]
+    assert pg.annotations[FAILOVER_GENERATION_ANNOTATION] == "1"
+    assert pg.annotations[RESUME_STEP_ANNOTATION] == "42"
+    assert pg.annotations[CHECKPOINT_DIR_ANNOTATION] == "/ckpt/train"
+    assert REQUEUED_ANNOTATION not in pg.annotations  # episode done
+    for node in cluster.nodes.values():
+        quarantined = NODE_QUARANTINED_UNTIL_ANNOTATION in \
+            node.annotations
+        assert quarantined == (
+            node.labels[TPU_SLICE_LABEL] == victim_slice)
+    # the whole loop was timed
+    assert metrics.get_observations("failover_mttr_seconds",
+                                    slice=victim_slice)
+    assert metrics.get_observations("failover_detect_seconds",
+                                    slice=victim_slice)
+    reasons = [r for _, r, _ in cluster.events]
+    assert "SliceFailed" in reasons and "FailoverDrain" in reasons
+    assert "FailoverComplete" in reasons
+    # new workers carry the resume contract (jax plugin injection)
+    pod = next(p for p in cluster.pods.values() if p.owner == job.uid)
+    assert pod.containers[0].env["VTP_RESUME_STEP"] == "42"
+    assert pod.containers[0].env["VTP_CHECKPOINT_DIR"] == "/ckpt/train"
+
+
+def test_quarantine_ttl_lifts_only_after_healthy():
+    """Quarantined -> Healthy requires BOTH the TTL served and the
+    host verdicts back to Healthy (a sick slice stays out past its
+    TTL; a healed one re-enters only after the TTL)."""
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    clock = {"t": 1000.0}
+    ctrl = FailoverController(quarantine_ttl=60.0,
+                              now=lambda: clock["t"])
+    ctrl.initialize(cluster)
+    fail_host(cluster, "sa-w0")
+    ctrl.sync()
+    n0 = cluster.nodes["sa-w0"]
+    assert float(n0.annotations[
+        NODE_QUARANTINED_UNTIL_ANNOTATION]) == pytest.approx(1060.0)
+    # TTL served but the host is still Failed: quarantine re-arms
+    # WITHOUT re-declaring (one hardware death = one SliceFailed, not
+    # one per TTL expiry)
+    clock["t"] = 1070.0
+    ctrl.sync()
+    assert float(n0.annotations[NODE_QUARANTINED_UNTIL_ANNOTATION]) \
+        == pytest.approx(1130.0)
+    assert [r for _, r, _ in cluster.events].count("SliceFailed") == 1
+    # host heals: quarantine holds until the NEW TTL is served...
+    heal_host(cluster, "sa-w0")
+    clock["t"] = 1100.0
+    ctrl.sync()
+    assert NODE_QUARANTINED_UNTIL_ANNOTATION in n0.annotations
+    # ...then lifts, with an event
+    clock["t"] = 1131.0
+    ctrl.sync()
+    for node in cluster.nodes.values():
+        assert NODE_QUARANTINED_UNTIL_ANNOTATION not in node.annotations
+    assert any(r == "SliceRecovered" for _, r, _ in cluster.events)
+
+
+def test_bare_podgroup_gang_is_evicted_whole():
+    """A podgroup with no vcjob owner still gets a gang-level drain
+    (evictions) + resume stamp — not silently skipped."""
+    from volcano_tpu.uthelper import gang_job
+    from volcano_tpu.api.types import PodGroupPhase
+
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    pg, pods = gang_job("bare", replicas=2,
+                        requests={"cpu": 4, TPU: 4},
+                        running_on=["sa-w0", "sa-w1"],
+                        pg_phase=PodGroupPhase.RUNNING)
+    pg.annotations[LAST_STEP_ANNOTATION] = "7"
+    cluster.add_podgroup(pg)
+    for p in pods:
+        cluster.add_pod(p)
+    ctrl = FailoverController()
+    ctrl.initialize(cluster)
+    fail_host(cluster, "sa-w0")
+    ctrl.sync()
+    assert sorted(cluster.evictions) == ["default/bare-0",
+                                         "default/bare-1"]
+    pg = cluster.podgroups["default/bare"]
+    assert pg.annotations[FAILOVER_GENERATION_ANNOTATION] == "1"
+    assert pg.annotations[RESUME_STEP_ANNOTATION] == "7"
+    assert pg.annotations[REQUEUED_ANNOTATION] == "true"
+
+
+def test_active_quarantine_sticky_across_stale_node_write():
+    """A whole-node persist from a mirror that predates the stamp (the
+    victim's own agent) must not erase an ACTIVE quarantine; an
+    expired one is removable — that is how the controller lifts it."""
+    from volcano_tpu.api.node_info import Node
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+
+    cluster = FakeCluster()
+    active = time.time() + 300
+    cluster.add_node(Node(name="n0", allocatable={"cpu": "8"},
+                          annotations={
+                              NODE_QUARANTINED_UNTIL_ANNOTATION:
+                              f"{active:.3f}"}))
+    stale = Node(name="n0", allocatable={"cpu": "8"},
+                 annotations={"agent": "write"})
+    cluster.put_object("node", stale)
+    ann = cluster.nodes["n0"].annotations
+    assert ann["agent"] == "write"
+    assert float(ann[NODE_QUARANTINED_UNTIL_ANNOTATION]) == \
+        pytest.approx(active, abs=1e-3)
+    # expired: the removal (controller lift) lands
+    ann[NODE_QUARANTINED_UNTIL_ANNOTATION] = f"{time.time() - 5:.3f}"
+    cluster.put_object("node", cluster.nodes["n0"])
+    lifted = Node(name="n0", allocatable={"cpu": "8"})
+    cluster.put_object("node", lifted)
+    assert NODE_QUARANTINED_UNTIL_ANNOTATION not in \
+        cluster.nodes["n0"].annotations
+
+
+def test_episode_abandoned_when_drained_job_terminates():
+    """A drained gang that never resumes (user abort post-drain) must
+    retire its episode — no MTTR observation, no forever-scan."""
+    from volcano_tpu import metrics
+    from volcano_tpu.api.types import JobAction
+
+    cluster, mgr, sched, job, victim = start_running_gang()
+    victim_slice = cluster.nodes[victim].labels[TPU_SLICE_LABEL]
+    before = len(metrics.get_observations("failover_mttr_seconds",
+                                          slice=victim_slice))
+    fail_host(cluster, victim)
+    drive(cluster, mgr, sched, 2)      # declared + drain issued
+    cluster.add_command("default/train", JobAction.ABORT_JOB.value)
+    drive(cluster, mgr, sched, 8)
+    ctrl = next(c for c in mgr.controllers if c.name == "failover")
+    assert not ctrl._episodes
+    assert len(metrics.get_observations("failover_mttr_seconds",
+                                        slice=victim_slice)) == before
+    assert any(r == "FailoverAbandoned" for _, r, _ in cluster.events)
+
+
+# -- scheduler plugin --------------------------------------------------
+
+def test_quarantined_slice_filtered_for_all_tasks():
+    from volcano_tpu.uthelper import TestContext, gang_job
+
+    cluster = make_tpu_cluster([("sa", "v5e-16"), ("sb", "v5e-16")])
+    until = time.time() + 300
+    for name, node in cluster.nodes.items():
+        if node.labels[TPU_SLICE_LABEL] == "sa":
+            node.annotations[NODE_QUARANTINED_UNTIL_ANNOTATION] = \
+                f"{until:.3f}"
+    pg, pods = gang_job("j", replicas=4,
+                        requests={"cpu": 8, TPU: 4})
+    cluster.add_podgroup(pg)
+    for p in pods:
+        cluster.add_pod(p)
+    sched = Scheduler(cluster, conf=FAILOVER_CONF, schedule_period=0)
+    sched.run_once()
+    homes = {cluster.pods[k].node_name for k in cluster.pods
+             if cluster.pods[k].node_name}
+    assert homes and all(
+        cluster.nodes[h].labels[TPU_SLICE_LABEL] == "sb"
+        for h in homes)
+    # an EXPIRED quarantine is no filter
+    past = time.time() - 5
+    for node in cluster.nodes.values():
+        if NODE_QUARANTINED_UNTIL_ANNOTATION in node.annotations:
+            node.annotations[NODE_QUARANTINED_UNTIL_ANNOTATION] = \
+                f"{past:.3f}"
+    pg2, pods2 = gang_job("j2", replicas=4,
+                          requests={"cpu": 8, TPU: 4})
+    cluster.add_podgroup(pg2)
+    for p in pods2:
+        cluster.add_pod(p)
+    sched.run_once()
+    assert all(p.node_name for p in cluster.pods.values()
+               if p.name.startswith("j2-"))
+
+
+def test_requeued_gang_gets_allocation_priority():
+    """Two gangs contend for the one free slice; the requeued
+    (failover) gang wins although it is YOUNGER than the other."""
+    from volcano_tpu.uthelper import gang_job
+
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    pg_old, pods_old = gang_job("older", replicas=4,
+                                requests={"cpu": 8, TPU: 4})
+    pg_new, pods_new = gang_job("requeued", replicas=4,
+                                requests={"cpu": 8, TPU: 4})
+    pg_old.creation_time = 100.0
+    pg_new.creation_time = 200.0       # younger: FIFO would lose
+    pg_new.annotations[REQUEUED_ANNOTATION] = "true"
+    for pg, pods in ((pg_old, pods_old), (pg_new, pods_new)):
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+    sched = Scheduler(cluster, conf=FAILOVER_CONF, schedule_period=0)
+    sched.run_once()
+    placed = {p.name.rsplit("-", 1)[0] for p in cluster.pods.values()
+              if p.node_name}
+    assert placed == {"requeued"}
+
+
+def test_warm_spares_reserved_for_failover_traffic():
+    """warmSpares=1 holds one idle slice per shape: an ordinary gang
+    is steered to the other slice; a requeued gang may take the
+    spare."""
+    from volcano_tpu.uthelper import gang_job
+
+    conf = {
+        "actions": "enqueue, allocate, backfill",
+        "tiers": [
+            {"plugins": [{"name": "priority"}, {"name": "gang"},
+                         {"name": "failover", "arguments": {
+                             "failover.warmSpares": 1}},
+                         {"name": "conformance"}]},
+            FAILOVER_CONF["tiers"][1],
+        ],
+    }
+    cluster = make_tpu_cluster([("sa", "v5e-16"), ("sb", "v5e-16")])
+    pg, pods = gang_job("normal", replicas=4,
+                        requests={"cpu": 8, TPU: 4})
+    cluster.add_podgroup(pg)
+    for p in pods:
+        cluster.add_pod(p)
+    sched = Scheduler(cluster, conf=conf, schedule_period=0)
+    sched.run_once()
+    homes = {cluster.nodes[p.node_name].labels[TPU_SLICE_LABEL]
+             for p in cluster.pods.values() if p.node_name}
+    assert homes == {"sb"}             # sa (sorted first) is the spare
+
+    pg2, pods2 = gang_job("rq", replicas=4,
+                          requests={"cpu": 8, TPU: 4})
+    pg2.annotations[REQUEUED_ANNOTATION] = "true"
+    cluster.add_podgroup(pg2)
+    for p in pods2:
+        cluster.add_pod(p)
+    sched.run_once()
+    rq_homes = {cluster.nodes[p.node_name].labels[TPU_SLICE_LABEL]
+                for p in cluster.pods.values()
+                if p.node_name and p.name.startswith("rq-")}
+    assert rq_homes == {"sa"}          # the spare serves failover
+
+
+# -- workload resume contract ------------------------------------------
+
+def test_bootstrap_parses_resume_env():
+    from volcano_tpu.workloads import bootstrap
+    info = bootstrap.from_env({
+        "TPU_WORKER_ID": "0",
+        "VTP_CHECKPOINT_DIR": "/ckpt/j",
+        "VTP_RESUME_STEP": "42"})
+    assert info.checkpoint_dir == "/ckpt/j"
+    assert info.resume_step == 42
+    assert bootstrap.from_env({}).resume_step is None
+    assert bootstrap.from_env(
+        {"VTP_RESUME_STEP": "junk"}).resume_step is None
+
+
+def test_resume_state_guards(tmp_path):
+    """A stamped resume step with no checkpoint is an error (silent
+    step-0 recompute is the failure mode this subsystem exists to
+    kill); no stamp + no checkpoint = fresh start."""
+    from volcano_tpu.workloads import checkpoint
+    p, o, step = checkpoint.resume_state("params", "opt", environ={})
+    assert (p, o, step) == ("params", "opt", 0)
+    with pytest.raises(FileNotFoundError):
+        checkpoint.resume_state(
+            "params", "opt",
+            environ={"VTP_CHECKPOINT_DIR": str(tmp_path / "none"),
+                     "VTP_RESUME_STEP": "5"})
+
+
+def test_dryrun_kill_and_resume_loss_continuity(tmp_path):
+    """The acceptance dryrun: train to step 3 (checkpointing), kill
+    the 'gang', resume a fresh worker from the stamped env — the
+    post-resume losses are IDENTICAL to the uninterrupted run's steps
+    4..5 (no recompute from step 0, no trajectory change)."""
+    import jax
+
+    from volcano_tpu.workloads import checkpoint, model as model_lib, train
+    from volcano_tpu.workloads.mesh import make_mesh
+
+    mesh = make_mesh({"dp": 1, "fsdp": 2, "tp": 2, "sp": 2})
+    cfg = model_lib.tiny_config()
+    opt = train.make_optimizer(lr=1e-2, warmup_steps=1)
+    params, state, _ = train.init_sharded(jax.random.key(0), cfg,
+                                          mesh, opt)
+    step_fn = train.make_train_step(cfg, mesh, opt)
+    batch = train.synthetic_batch(jax.random.key(1), cfg, 4, 64, mesh)
+
+    ckpt = str(tmp_path / "ckpt")
+    losses = {}
+    for step in range(1, 6):
+        params, state, m = step_fn(params, state, batch)
+        losses[step] = float(m["loss"])
+        if step == 3:
+            checkpoint.save(ckpt, step=step, params=params,
+                            opt_state=state)
+
+    # "slice dies" — a fresh worker process boots with the env the
+    # failover controller stamped and the jax plugin injected
+    env = {"VTP_CHECKPOINT_DIR": ckpt, "VTP_RESUME_STEP": "3"}
+    p2, s2, _ = train.init_sharded(jax.random.key(99), cfg, mesh, opt)
+    p2, s2, start = checkpoint.resume_state(p2, s2, environ=env)
+    assert start == 3                  # >= the stamped floor
+    resumed = {}
+    for step in range(start + 1, 6):
+        p2, s2, m = step_fn(p2, s2, batch)
+        resumed[step] = float(m["loss"])
+    assert resumed[4] == losses[4] and resumed[5] == losses[5]
+    # and the trajectory is NOT the from-scratch one (the continuity
+    # assert would pass vacuously if steps 4,5 were scratch steps 1,2)
+    assert resumed[4] != losses[1]
+
+
+# -- CLI surfaces ------------------------------------------------------
+
+def test_vtpctl_slices_and_failover_views(tmp_path, capsys):
+    import pickle
+
+    from volcano_tpu.cli.vtpctl import main as vtpctl
+
+    cluster = make_tpu_cluster([("sa", "v5e-16"), ("sb", "v5e-16")])
+    fail_host(cluster, "sa-w0")
+    until = 2_000_000_000.0
+    for node in cluster.nodes.values():
+        if node.labels[TPU_SLICE_LABEL] == "sa":
+            node.annotations[NODE_QUARANTINED_UNTIL_ANNOTATION] = \
+                f"{until:.3f}"
+    from volcano_tpu.uthelper import gang_job
+    pg, pods = gang_job("g", replicas=1)
+    pg.annotations.update({FAILOVER_GENERATION_ANNOTATION: "2",
+                           REQUEUED_ANNOTATION: "true",
+                           RESUME_STEP_ANNOTATION: "42",
+                           CHECKPOINT_DIR_ANNOTATION: "/ckpt/g"})
+    cluster.add_podgroup(pg)
+    path = str(tmp_path / "c.pkl")
+    with open(path, "wb") as f:
+        pickle.dump(cluster, f)
+
+    assert vtpctl(["--state", path, "slices"]) == 0
+    out = capsys.readouterr().out
+    sa_row = next(l for l in out.splitlines() if l.startswith("sa"))
+    assert "Failed" in sa_row and "2033" in sa_row   # until year
+    sb_row = next(l for l in out.splitlines() if l.startswith("sb"))
+    assert "Healthy" in sb_row and "-" in sb_row
+
+    assert vtpctl(["--state", path, "failover"]) == 0
+    out = capsys.readouterr().out
+    assert "sa-w0" in out and "Failed" in out
+    assert "default/g" in out and "42" in out and "/ckpt/g" in out
+
+
+# -- e2e: the full loop through the real HTTP state server -------------
+
+def wait_for(cond, timeout=20.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_failover_loop_over_wire(tmp_path):
+    """Acceptance e2e: agent posts SliceHealthReport over the wire →
+    failover controller (own mirror) drains the gang → scheduler (own
+    mirror) re-places it on a healthy slice with the quarantined one
+    filtered → the rebuilt workers' env carries VTP_RESUME_STEP ≥ the
+    last checkpointed step."""
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.server.state_server import serve
+    from volcano_tpu.simulator import slice_nodes
+
+    httpd, state = serve(port=0)
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    mirrors = []
+
+    def client(**kw):
+        c = RemoteCluster(url, **kw)
+        mirrors.append(c)
+        return c
+
+    mgr = None
+    try:
+        kubectl = client()
+        for sname in ("sa", "sb"):
+            for node in slice_nodes(slice_for(sname, "v5e-16"),
+                                    dcn_pod="dcn-0"):
+                kubectl.add_node(node)
+
+        ctrl_view = client()
+        mgr = ControllerManager(ctrl_view, enabled=[
+            "job", "podgroup", "queue", "hypernode", "failover"])
+        sched_view = client()
+        sched = Scheduler(sched_view, conf=FAILOVER_CONF,
+                          schedule_period=0)
+
+        def cycle():
+            mgr.sync_all()
+            sched.run_once()
+            state.cluster.tick()
+
+        kubectl.add_vcjob(tpu_gang_job(annotations={
+            CHECKPOINT_DIR_ANNOTATION: "/ckpt/train",
+            LAST_STEP_ANNOTATION: "42"}))
+
+        def running():
+            cycle()
+            j = kubectl.vcjobs.get("default/train")
+            return j is not None and j.phase is JobPhase.RUNNING
+        wait_for(running, msg="gang running over the wire")
+        job = kubectl.vcjobs["default/train"]
+        victim = sorted(p.node_name for p in kubectl.pods.values()
+                        if p.owner == job.uid)[0]
+        victim_slice = kubectl.nodes[victim].labels[TPU_SLICE_LABEL]
+        healthy_slice = "sb" if victim_slice == "sa" else "sa"
+
+        # the agent lives on ITS OWN wire mirror, like a real node
+        agent_view = client()
+        provider = FakeUsageProvider()
+        agent = NodeAgent(agent_view, victim, provider)
+        fail_host(agent_view, victim, provider=provider)
+        for _ in range(TpuHealthHandler.FAIL_SYNCS):
+            agent.sync()
+        # the report reached the SERVER and was folded
+        wait_for(lambda: (state.cluster.slicehealthreports.get(victim)
+                          or SliceHealthReport()).verdict
+                 == VERDICT_FAILED, msg="Failed report on server")
+
+        def recovered():
+            cycle()
+            j = kubectl.vcjobs.get("default/train")
+            if j is None or j.phase is not JobPhase.RUNNING or \
+                    j.annotations.get(
+                        FAILOVER_GENERATION_ANNOTATION) != "1":
+                return False
+            placed = [p for p in kubectl.pods.values()
+                      if p.owner == j.uid and p.node_name
+                      and p.phase in (TaskStatus.BOUND,
+                                      TaskStatus.RUNNING)]
+            return len(placed) >= 4 and all(
+                kubectl.nodes[p.node_name].labels[TPU_SLICE_LABEL]
+                == healthy_slice for p in placed)
+        wait_for(recovered, timeout=40,
+                 msg="gang re-placed on the healthy slice")
+
+        job = kubectl.vcjobs["default/train"]
+        # quarantine visible on every mirror via folded node events
+        assert all(
+            NODE_QUARANTINED_UNTIL_ANNOTATION in n.annotations
+            for n in kubectl.nodes.values()
+            if n.labels[TPU_SLICE_LABEL] == victim_slice)
+        # resume contract on the rebuilt workers: env stamped from the
+        # controller's resume-step snapshot
+        pod = next(p for p in state.cluster.pods.values()
+                   if p.owner == job.uid)
+        assert int(pod.containers[0].env["VTP_RESUME_STEP"]) >= 42
+        assert pod.containers[0].env["VTP_CHECKPOINT_DIR"] == \
+            "/ckpt/train"
+        assert any(r == "SliceFailed" for _, r, _ in
+                   state.cluster.events)
+    finally:
+        if mgr is not None:
+            mgr.stop()
+        for m in mirrors:
+            m.close()
+        httpd.shutdown()
+
+
+def test_bench_failover_smoke_mode():
+    """`bench.py --failover-smoke` kills one fake host and asserts the
+    gang re-reaches Running with a bumped failover generation inside
+    the cycle budget — the failover loop guarded on every commit,
+    mirroring --wire-smoke."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"),
+         "--failover-smoke"],
+        capture_output=True, text=True, timeout=180, env=env, cwd=repo)
+    assert proc.returncode == 0, \
+        proc.stdout[-2000:] + proc.stderr[-2000:]
+    line = next(l for l in reversed(proc.stdout.strip().splitlines())
+                if l.startswith("{"))
+    out = json.loads(line)
+    assert out["ok"] is True, out
+    assert out["mttr_p50_s"] > 0
+    assert out["breakdown_p50_s"]["detect"] >= 0
+    assert out["cycles_to_recover"] and \
+        all(c <= 40 for c in out["cycles_to_recover"])

@@ -105,22 +105,36 @@ def test_cronjob_fires_and_respects_forbid():
 
 
 def test_node_agent_reports_and_cordons_unhealthy_tpu():
+    """Chip health cordons with K-consecutive-ticks hysteresis BOTH
+    directions: one bad telemetry sample no longer cordons (and one
+    good one no longer uncordons) — a flapping exporter used to bounce
+    the host in and out of rotation every sync."""
     from volcano_tpu.agent import FakeUsageProvider, NodeAgent
+    from volcano_tpu.agent.handlers import TpuHealthHandler
     cluster = make_tpu_cluster([("sa", "v5e-16")])
     provider = FakeUsageProvider()
     provider.set("sa-w0", cpu_fraction=0.5, tpu_chips_detected=4,
                  tpu_chips_healthy=3)   # one sick chip
     agent = NodeAgent(cluster, "sa-w0", provider)
-    agent.sync()
     node = cluster.nodes["sa-w0"]
+    for _ in range(TpuHealthHandler.FAIL_SYNCS - 1):
+        agent.sync()
+        assert node.unschedulable is False          # suspect, not out
+        assert node.labels["volcano-tpu.io/tpu-healthy"] == "true"
+    agent.sync()                       # Kth consecutive bad -> Failed
     assert node.unschedulable is True
     assert node.labels["volcano-tpu.io/tpu-healthy"] == "false"
     assert node.annotations["volcano-tpu.io/tpu-chips"] == "3/4"
-    # chip recovers -> uncordon
+    # chip recovers: one good sample must NOT uncordon...
     provider.set("sa-w0", cpu_fraction=0.5, tpu_chips_detected=4,
                  tpu_chips_healthy=4)
     agent.sync()
+    assert node.unschedulable is True
+    # ...K consecutive good ones do
+    for _ in range(TpuHealthHandler.RECOVER_SYNCS - 1):
+        agent.sync()
     assert cluster.nodes["sa-w0"].unschedulable is False
+    assert node.labels["volcano-tpu.io/tpu-healthy"] == "true"
 
 
 def test_node_agent_oversubscription_and_pressure_eviction():

@@ -11,7 +11,11 @@ server RSS 31->122MB — linear in RETAINED completed jobs (~115KB/job:
 ttlSecondsAfterFinished unset keeps finished jobs, matching k8s/
 reference semantics), not a leak.
 
-Usage:  python tools/soak.py [seconds]   # default 600; logs /tmp/soak/
+Usage:  python tools/soak.py [seconds] [--kill-slice]
+        # default 600s; logs /tmp/soak/; --kill-slice injects a slice
+        # failure (simulator.fail_host through the wire) ~40% in and
+        # requires the failover loop to quarantine the slice and keep
+        # jobs completing
 """
 import json, os, random, socket, subprocess, sys, time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -51,7 +55,13 @@ for sname in ("sa", "sb", "sc"):
 
 rng = random.Random(42)
 submitted = completed_seen = 0
-t_end = time.time() + (float(sys.argv[1]) if len(sys.argv) > 1 else 600)
+argv = [a for a in sys.argv[1:] if a != "--kill-slice"]
+kill_slice = "--kill-slice" in sys.argv[1:]
+duration = float(argv[0]) if argv else 600
+t_start = time.time()
+t_end = t_start + duration
+t_kill = t_start + duration * 0.4
+killed = None
 i = 0
 rss_samples = []
 def server_rss():
@@ -63,6 +73,15 @@ def server_rss():
     except OSError:
         return -1
 while time.time() < t_end:
+    if kill_slice and killed is None and time.time() >= t_kill:
+        # chaos: one host of slice sc dies mid-soak; the failover
+        # controller in the plane process must quarantine the slice
+        # and the churn must keep completing on sa/sb
+        from volcano_tpu.simulator import fail_host
+        c.resync()
+        killed = "sc-w0"
+        fail_host(c, killed)
+        print(f"killed {killed} (slice sc)", flush=True)
     # submit a short gang job
     n = rng.choice((1, 2, 4))
     job = VCJob(name=f"soak-{i}", min_available=n,
@@ -95,9 +114,19 @@ for j in c.vcjobs.values():
     ph = getattr(j.phase, "value", str(j.phase))
     phases[ph] = phases.get(ph, 0) + 1
 dead = [n for n, p in procs.items() if p.poll() is not None]
-print(json.dumps({"submitted": submitted, "phases": phases,
-                  "dead_processes": dead,
-                  "rss_first": rss_samples[0] if rss_samples else None,
-                  "rss_last": rss_samples[-1] if rss_samples else None}))
+out = {"submitted": submitted, "phases": phases,
+       "dead_processes": dead,
+       "rss_first": rss_samples[0] if rss_samples else None,
+       "rss_last": rss_samples[-1] if rss_samples else None}
+if killed is not None:
+    from volcano_tpu.api.slicehealth import (
+        NODE_QUARANTINED_UNTIL_ANNOTATION)
+    quarantined = [n.name for n in c.nodes.values()
+                   if n.annotations.get(
+                       NODE_QUARANTINED_UNTIL_ANNOTATION)]
+    out["killed_host"] = killed
+    out["quarantined_hosts"] = sorted(quarantined)
+    out["failover_ok"] = any(q.startswith("sc-") for q in quarantined)
+print(json.dumps(out))
 for p in procs.values():
     p.terminate()

@@ -76,17 +76,45 @@ class UsageReporterHandler(Handler):
 
 @register_handler
 class TpuHealthHandler(Handler):
-    """Chip health -> label + cordon.  A slice host with sick chips
-    must not take new work: the ICI mesh is only as healthy as its
-    worst host."""
+    """Chip health -> verdict -> label/cordon + SliceHealthReport.
+    A slice host with sick chips must not take new work: the ICI mesh
+    is only as healthy as its worst host.
+
+    HYSTERESIS both directions (the r6 handler cordoned on ONE bad
+    telemetry sample and uncordoned on one good one — a flapping
+    exporter bounced the host in and out of rotation every sync; same
+    pattern as the netaccounting watermark hysteresis below):
+    FAIL_SYNCS consecutive bad samples escalate Healthy -> Suspect ->
+    Failed (cordon fires only on Failed), RECOVER_SYNCS consecutive
+    good samples walk Failed back to Healthy (uncordon).  Every
+    verdict/chip-count change posts a SliceHealthReport wire object —
+    the store folds the verdict into node annotations and the
+    failover controller declares slice failures from it
+    (api/slicehealth.py)."""
 
     name = "tpuhealth"
     events = (EVENT_USAGE,)
 
+    FAIL_SYNCS = 3
+    RECOVER_SYNCS = 3
+
+    def __init__(self, agent):
+        super().__init__(agent)
+        self._bad = 0
+        self._good = 0
+        self._first_bad_ts = 0.0
+        from volcano_tpu.api.slicehealth import VERDICT_HEALTHY
+        self._verdict = VERDICT_HEALTHY
+        self._last_report = None       # change-elision signature
+
     def handle(self, event: Event) -> None:
+        import time as _time
+
         from volcano_tpu.agent.agent import (
             AGENT_CORDONED_ANNOTATION, TPU_CHIPS_ANNOTATION,
             TPU_HEALTHY_LABEL)
+        from volcano_tpu.api.slicehealth import (
+            VERDICT_FAILED, VERDICT_HEALTHY, VERDICT_SUSPECT)
         node, usage = event.node, event.usage
         declared = self.agent.allocatable(node).get(TPU)
         if usage.tpu_chips_detected == 0:
@@ -98,20 +126,74 @@ class TpuHealthHandler(Handler):
         healthy = (usage.tpu_chips_healthy >= declared > 0) or \
             (declared == 0 and usage.tpu_chips_detected ==
              usage.tpu_chips_healthy)
-        node.labels[TPU_HEALTHY_LABEL] = "true" if healthy else "false"
-        if not healthy:
+
+        if healthy:
+            self._bad = 0
+            self._good += 1
+            if self._verdict != VERDICT_HEALTHY and \
+                    self._good >= self.RECOVER_SYNCS:
+                self._verdict = VERDICT_HEALTHY
+                self._first_bad_ts = 0.0
+                self.agent.cluster.record_event(
+                    self.agent.node_name, "TPURecovered",
+                    f"{usage.tpu_chips_healthy}/"
+                    f"{usage.tpu_chips_detected} chips healthy for "
+                    f"{self._good} syncs")
+        else:
+            self._good = 0
+            self._bad += 1
+            if self._bad == 1:
+                self._first_bad_ts = _time.time()
+            if self._verdict == VERDICT_HEALTHY:
+                self._verdict = VERDICT_SUSPECT
+            if self._verdict == VERDICT_SUSPECT and \
+                    self._bad >= self.FAIL_SYNCS:
+                self._verdict = VERDICT_FAILED
+                self.agent.cluster.record_event(
+                    self.agent.node_name, "TPUUnhealthy",
+                    f"{usage.tpu_chips_healthy}/"
+                    f"{usage.tpu_chips_detected} chips healthy "
+                    f"(declared {declared:g}) for {self._bad} "
+                    f"consecutive syncs")
+
+        # label + cordon follow the VERDICT, not the sample: a Suspect
+        # host keeps taking work until the failure is confirmed, and a
+        # Failed host stays out until recovery is confirmed
+        node.labels[TPU_HEALTHY_LABEL] = \
+            "false" if self._verdict == VERDICT_FAILED else "true"
+        if self._verdict == VERDICT_FAILED:
             node.unschedulable = True
             node.annotations[AGENT_CORDONED_ANNOTATION] = "true"
-            self.agent.cluster.record_event(
-                self.agent.node_name, "TPUUnhealthy",
-                f"{usage.tpu_chips_healthy}/{usage.tpu_chips_detected}"
-                f" chips healthy (declared {declared:g})")
-        elif node.unschedulable and \
-                node.annotations.get(AGENT_CORDONED_ANNOTATION) == \
-                "true":
+        elif self._verdict == VERDICT_HEALTHY and node.unschedulable \
+                and node.annotations.get(AGENT_CORDONED_ANNOTATION) \
+                == "true":
             # only undo OUR cordon — never an admin's maintenance one
             node.unschedulable = False
             node.annotations.pop(AGENT_CORDONED_ANNOTATION, None)
+
+        self._post_report(node, usage)
+
+    def _post_report(self, node, usage) -> None:
+        from volcano_tpu.api.slicehealth import SliceHealthReport
+        from volcano_tpu.api.types import TPU_SLICE_LABEL
+        report = SliceHealthReport(
+            node=self.agent.node_name,
+            slice=node.labels.get(TPU_SLICE_LABEL, ""),
+            verdict=self._verdict,
+            chips_detected=usage.tpu_chips_detected,
+            chips_healthy=usage.tpu_chips_healthy,
+            consecutive_bad=self._bad,
+            consecutive_good=self._good,
+            first_bad_ts=round(self._first_bad_ts, 3))
+        sig = (report.verdict, report.chips_detected,
+               report.chips_healthy)
+        if sig == self._last_report:
+            return                    # unchanged verdict: no wire churn
+        try:
+            self.agent.cluster.put_object("slicehealthreport", report)
+            self._last_report = sig
+        except Exception as e:  # noqa: BLE001 — reporting must never
+            log.warning("slice health report post failed: %s", e)  # kill sync
 
 
 @register_handler

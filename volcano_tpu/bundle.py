@@ -78,6 +78,17 @@ FAMILIES: Dict[str, str] = {
     "node_dcn_measured_mbps": "gauge",
     "bandwidth_violating_pods": "gauge",
     "bandwidth_violations_total": "counter",
+    # slice-failure failover (controllers/failover.py): the detect ->
+    # drain -> reschedule -> resume loop, each phase timed, plus the
+    # end-to-end MTTR and the checkpoint recompute window
+    "failover_detect_seconds": "histogram",
+    "failover_drain_seconds": "histogram",
+    "failover_reschedule_seconds": "histogram",
+    "failover_resume_seconds": "histogram",
+    "failover_mttr_seconds": "histogram",
+    "failover_resume_step_gap": "histogram",
+    "slice_failovers_total": "counter",
+    "quarantined_slices": "gauge",
 }
 
 
@@ -163,6 +174,17 @@ def agent_dashboard() -> dict:
         _panel(6, "Bandwidth watermark violations",
                ["sum by (node) (bandwidth_violating_pods)",
                 "rate(bandwidth_violations_total[5m])"], 12, 16),
+        _panel(7, "Slice failover MTTR breakdown (mean)",
+               [_mean_expr("failover_mttr_seconds"),
+                _mean_expr("failover_detect_seconds"),
+                _mean_expr("failover_drain_seconds"),
+                _mean_expr("failover_reschedule_seconds"),
+                _mean_expr("failover_resume_seconds")], 0, 24,
+               unit="s"),
+        _panel(8, "Slice failures / quarantined slices / resume gap",
+               ["rate(slice_failovers_total[5m])",
+                "quarantined_slices",
+                _mean_expr("failover_resume_step_gap")], 12, 24),
     ]
     return {
         "title": "volcano-tpu / agents", "uid": "vtp-agents",
@@ -200,6 +222,9 @@ DEFAULT_CONF = {
     "tiers": [
         {"plugins": [
             {"name": "priority"}, {"name": "gang"},
+            # failover: quarantined-slice filter + requeued-gang
+            # priority (controllers/failover.py is the other half)
+            {"name": "failover"},
             {"name": "conformance"}]},
         {"plugins": [
             {"name": "overcommit"}, {"name": "drf"},

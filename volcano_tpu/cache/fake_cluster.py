@@ -46,6 +46,7 @@ class FakeCluster(Cluster):
         self.nodeshards: Dict[str, object] = {}   # shard/v1alpha1 NodeShard
         self.numatopologies: Dict[str, object] = {}  # nodeinfo/v1alpha1
         self.bandwidthreports: Dict[str, object] = {}  # api/netusage.py
+        self.slicehealthreports: Dict[str, object] = {}  # api/slicehealth.py
         self.services: Dict[str, dict] = {}       # svc plugin artifacts
         self.config_maps: Dict[str, dict] = {}
         self.secrets: Dict[str, dict] = {}
@@ -92,10 +93,15 @@ class FakeCluster(Cluster):
             node = self.nodes.pop(name, None)
         if node:
             self._notify("node_deleted", node)
-            with self._lock:
-                had = name in self.bandwidthreports
-            if had:    # same lifetime rule as delete_object("node")
-                self.delete_object("bandwidthreport", name)
+            # same lifetime rule as delete_object("node"): the node's
+            # agent reports die with it
+            for kind, attr in (("bandwidthreport", "bandwidthreports"),
+                               ("slicehealthreport",
+                                "slicehealthreports")):
+                with self._lock:
+                    had = name in getattr(self, attr)
+                if had:
+                    self.delete_object(kind, name)
 
     def add_pod(self, pod: Pod):
         if self.admission is not None and pod.key not in self.pods:
@@ -221,19 +227,27 @@ class FakeCluster(Cluster):
             elif kind == "vcjob":
                 obj = self.admission.admit_job_update(obj, self)
         if kind == "node":
-            # keep the accounting fold sticky: a node write from a
-            # mirror that predates the fold (the agent's whole-node
-            # persist) must not erase the measured-bandwidth summary —
-            # re-apply the stored report before the write lands
+            # keep the accounting/health folds sticky: a node write
+            # from a mirror that predates a fold (the agent's
+            # whole-node persist) must not erase the folded summary —
+            # re-apply the stored reports before the write lands
             with self._lock:
                 rep = self.bandwidthreports.get(k)
+                health = self.slicehealthreports.get(k)
+                cur = self.nodes.get(k)
             if rep is not None:
                 self._apply_bandwidth_fold(obj, rep)
+            if health is not None:
+                self._apply_health_fold(obj, health)
+            if cur is not None:
+                self._apply_quarantine_stick(obj, cur)
         with self._lock:
             getattr(self, spec.attr)[k] = obj
         self._notify(kind, obj if spec.key_of else {"key": k, "obj": obj})
         if kind == "bandwidthreport":
             self._fold_bandwidth_report(obj)
+        elif kind == "slicehealthreport":
+            self._fold_health_report(obj)
         return obj
 
     @staticmethod
@@ -281,6 +295,58 @@ class FakeCluster(Cluster):
         if changed:     # unchanged summary: no watch traffic
             self._notify("node", node)
 
+    @staticmethod
+    def _apply_quarantine_stick(obj, cur) -> None:
+        """An ACTIVE quarantine TTL survives whole-node writes from
+        mirrors that predate the stamp (the victim's own agent
+        persists the full node from its mirror copy): if the incoming
+        write lacks the annotation while the stored node carries an
+        unexpired one, re-apply it.  An EXPIRED stamp is not sticky —
+        that is exactly how the failover controller lifts it — and an
+        incoming value always wins (a TTL refresh)."""
+        import time as _time
+
+        from volcano_tpu.api.slicehealth import (
+            NODE_QUARANTINED_UNTIL_ANNOTATION)
+        if NODE_QUARANTINED_UNTIL_ANNOTATION in obj.annotations:
+            return
+        raw = cur.annotations.get(NODE_QUARANTINED_UNTIL_ANNOTATION)
+        if raw is None:
+            return
+        try:
+            if float(raw) > _time.time():
+                obj.annotations[NODE_QUARANTINED_UNTIL_ANNOTATION] = raw
+        except (TypeError, ValueError):
+            pass
+
+    @staticmethod
+    def _apply_health_fold(node, report) -> bool:
+        """Merge a SliceHealthReport's verdict into *node*'s
+        annotations; returns True when it changed."""
+        from volcano_tpu.api.slicehealth import (NODE_HEALTH_ANNOTATION,
+                                                 VERDICT_HEALTHY)
+        ann = node.annotations
+        before = ann.get(NODE_HEALTH_ANNOTATION)
+        if report.verdict == VERDICT_HEALTHY:
+            # healthy is the absence of the key, so nodes that never
+            # ran an agent and nodes that recovered look identical
+            ann.pop(NODE_HEALTH_ANNOTATION, None)
+        else:
+            ann[NODE_HEALTH_ANNOTATION] = report.verdict
+        return before != ann.get(NODE_HEALTH_ANNOTATION)
+
+    def _fold_health_report(self, report) -> None:
+        """Store-side fold of a host health verdict into the node's
+        annotations (same rationale as _fold_bandwidth_report: every
+        watch mirror learns host health from ordinary node events)."""
+        with self._lock:
+            node = self.nodes.get(getattr(report, "node", ""))
+            if node is None:
+                return
+            changed = self._apply_health_fold(node, report)
+        if changed:
+            self._notify("node", node)
+
     def delete_object(self, kind: str, key: str) -> None:
         from volcano_tpu.cache.kinds import KINDS
         spec = KINDS[kind]
@@ -290,14 +356,17 @@ class FakeCluster(Cluster):
             self._notify(f"{kind}_deleted",
                          obj if spec.key_of else {"key": key, "obj": obj})
         if kind == "node" and obj is not None:
-            # the node's accounting report dies with it: the sticky
+            # the node's agent reports die with it: the sticky
             # re-fold (put_object) would otherwise resurrect stale
-            # saturation onto a REPLACEMENT host registering under
-            # the same name
-            with self._lock:
-                had = key in self.bandwidthreports
-            if had:
-                self.delete_object("bandwidthreport", key)
+            # saturation/health onto a REPLACEMENT host registering
+            # under the same name
+            for rkind, attr in (("bandwidthreport", "bandwidthreports"),
+                                ("slicehealthreport",
+                                 "slicehealthreports")):
+                with self._lock:
+                    had = key in getattr(self, attr)
+                if had:
+                    self.delete_object(rkind, key)
 
     def watch(self, fn: Callable[[str, object], None]):
         self._watchers.append(fn)

@@ -48,6 +48,10 @@ KINDS: Dict[str, KindSpec] = {
     # posted by the node agent, folded into node annotations by the
     # store so scheduler mirrors see saturation without decoding it
     "bandwidthreport": KindSpec("bandwidthreports", _name),
+    # per-host chip-health verdict (api/slicehealth.py): posted by the
+    # node agent's hysteresis, folded into node annotations by the
+    # store; the failover controller declares slice failures from it
+    "slicehealthreport": KindSpec("slicehealthreports", _name),
     # plain-dict kinds (plugin/operator supplied payloads)
     # namespace -> annotations dict (podgroup mutate webhook reads the
     # per-namespace default-queue annotation)

@@ -507,6 +507,96 @@ def cmd_node_view(cluster, args):
             print(f"  {p.key} ({p.phase.value})")
 
 
+def cmd_slices(cluster, args):
+    """Per-slice rollup: hosts, cordons, worst health verdict (from
+    the folded SliceHealthReport annotations / the report store) and
+    the failover controller's quarantine TTL."""
+    import datetime
+
+    from volcano_tpu.api.slicehealth import (
+        NODE_HEALTH_ANNOTATION, NODE_QUARANTINED_UNTIL_ANNOTATION,
+        VERDICT_FAILED, VERDICT_HEALTHY, VERDICT_SUSPECT)
+    from volcano_tpu.api.types import TPU_SLICE_LABEL, TPU_TOPOLOGY_LABEL
+    rank = {VERDICT_HEALTHY: 0, VERDICT_SUSPECT: 1, VERDICT_FAILED: 2}
+    reports = getattr(cluster, "slicehealthreports", {})
+    slices = {}
+    for node in cluster.nodes.values():
+        name = node.labels.get(TPU_SLICE_LABEL)
+        if name:
+            slices.setdefault(name, []).append(node)
+    rows = []
+    for name in sorted(slices):
+        nodes = slices[name]
+        health = VERDICT_HEALTHY
+        until = 0.0
+        for n in nodes:
+            rep = reports.get(n.name)
+            verdict = rep.verdict if rep is not None else \
+                n.annotations.get(NODE_HEALTH_ANNOTATION,
+                                  VERDICT_HEALTHY)
+            if rank.get(verdict, 0) > rank.get(health, 0):
+                health = verdict
+            try:
+                until = max(until, float(n.annotations.get(
+                    NODE_QUARANTINED_UNTIL_ANNOTATION, 0) or 0))
+            except (TypeError, ValueError):
+                pass
+        rows.append([
+            name,
+            nodes[0].labels.get(TPU_TOPOLOGY_LABEL, "-"),
+            len(nodes),
+            sum(1 for n in nodes if n.unschedulable),
+            health,
+            datetime.datetime.fromtimestamp(until).isoformat(
+                timespec="seconds") if until else "-",
+        ])
+    print(_table(rows, ["NAME", "TOPOLOGY", "HOSTS", "CORDONED",
+                        "HEALTH", "QUARANTINED-UNTIL"]))
+
+
+def cmd_failover(cluster, args):
+    """Failover view: unhealthy/quarantined slices, drained gangs
+    awaiting re-placement, and the resume metadata stamped on
+    podgroups (generation, resume step, checkpoint dir)."""
+    from volcano_tpu.api.slicehealth import (
+        CHECKPOINT_DIR_ANNOTATION, FAILOVER_GENERATION_ANNOTATION,
+        REQUEUED_ANNOTATION, RESUME_STEP_ANNOTATION, VERDICT_HEALTHY)
+    reports = getattr(cluster, "slicehealthreports", {})
+    sick = [[r.node, r.slice or "-", r.verdict,
+             f"{r.chips_healthy}/{r.chips_detected}",
+             r.consecutive_bad]
+            for r in sorted(reports.values(), key=lambda r: r.node)
+            if r.verdict != VERDICT_HEALTHY]
+    print(_table(sick, ["NODE", "SLICE", "VERDICT", "CHIPS",
+                        "BAD-SYNCS"]))
+    rows = []
+    for pg in cluster.podgroups.values():
+        ann = pg.annotations
+        if FAILOVER_GENERATION_ANNOTATION not in ann and \
+                REQUEUED_ANNOTATION not in ann:
+            continue
+        rows.append([
+            pg.key,
+            ann.get(FAILOVER_GENERATION_ANNOTATION, "0"),
+            "yes" if ann.get(REQUEUED_ANNOTATION) == "true" else "-",
+            ann.get(RESUME_STEP_ANNOTATION, "-"),
+            ann.get(CHECKPOINT_DIR_ANNOTATION, "-"),
+            pg.phase.value,
+        ])
+    if rows:
+        print()
+        print(_table(rows, ["PODGROUP", "GENERATION", "REQUEUED",
+                            "RESUME-STEP", "CHECKPOINT-DIR", "PHASE"]))
+    events = [e for e in getattr(cluster, "events", [])
+              if e[1] in ("SliceFailed", "SliceRecovered",
+                          "FailoverDrain", "FailoverComplete",
+                          "TPUUnhealthy", "TPURecovered")]
+    if events:
+        print()
+        print(_table([[k, r, m] for k, r, m in events[-20:]],
+                     ["OBJECT", "REASON", "MESSAGE"]))
+
+
 def cmd_bandwidth(cluster, args):
     """Per-pod DCN usage as the agents measured it (BandwidthReport
     store, api/netusage.py): node summary line + per-pod rates,
@@ -724,6 +814,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--node", default="",
                    help="limit to one node's report")
     p.set_defaults(fn=cmd_bandwidth)
+
+    p = sub.add_parser("slices", help="per-slice host/health rollup "
+                       "(HEALTH + QUARANTINED-UNTIL from the folded "
+                       "SliceHealthReports)")
+    p.set_defaults(fn=cmd_slices)
+
+    p = sub.add_parser("failover", help="slice-failover view: sick "
+                       "hosts, drained gangs, resume metadata")
+    p.set_defaults(fn=cmd_failover)
 
     p = sub.add_parser("tick",
                        help="advance the standalone control plane")

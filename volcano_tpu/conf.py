@@ -65,8 +65,11 @@ class SchedulerConf:
 DEFAULT_SCHEDULER_CONF = {
     "actions": "enqueue, allocate, backfill",
     "tiers": [
+        # failover: quarantined-slice filter + requeued-gang priority —
+        # a cheap no-op until the failover controller quarantines a
+        # slice (controllers/failover.py)
         {"plugins": [{"name": "priority"}, {"name": "gang"},
-                     {"name": "conformance"}]},
+                     {"name": "failover"}, {"name": "conformance"}]},
         # tier 2 mirrors the reference default's predicates wrap
         # (predicates.go:37 bundles nodeaffinity, podaffinity, taints,
         # ports, volume + spread): here those are separate plugins, so

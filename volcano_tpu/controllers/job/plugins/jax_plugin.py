@@ -88,6 +88,20 @@ class JaxPlugin(JobPlugin):
         return [(t, 0) for t in job.tasks if t.name == name]
 
     def on_pod_create(self, pod, job):
+        # failover resume contract (api/slicehealth.py -> workloads/
+        # bootstrap.py): a job carrying a checkpoint dir passes it to
+        # every worker; after a slice-failure drain the failover
+        # controller stamps the resume step, so the rebuilt gang's
+        # workers restore from orbax instead of recomputing step 0
+        from volcano_tpu.api.slicehealth import (
+            CHECKPOINT_DIR_ANNOTATION, RESUME_STEP_ANNOTATION)
+        ckpt_dir = job.annotations.get(CHECKPOINT_DIR_ANNOTATION)
+        if ckpt_dir:
+            set_env(pod, "VTP_CHECKPOINT_DIR", ckpt_dir)
+        resume_step = job.annotations.get(RESUME_STEP_ANNOTATION)
+        if resume_step:
+            set_env(pod, "VTP_RESUME_STEP", resume_step)
+
         tasks = self._worker_tasks(job)
         num_slices = len({sid for _, sid in tasks})
         hostnames = []

@@ -79,3 +79,71 @@ def make_tpu_cluster(
         ctrl.initialize(cluster)
         ctrl.sync()
     return cluster
+
+
+# -- chaos helpers (failover tooling / tests) --------------------------
+
+def fail_host(cluster, node_name: str, provider=None,
+              chips_healthy: int = 0):
+    """Inject a host failure without hand-editing node objects.
+
+    With *provider* (a FakeUsageProvider whose NodeAgent is being
+    driven): flip the chip telemetry so the agent's K-consecutive-
+    ticks hysteresis detects the failure the production way (drive
+    agent.sync() yourself).  Without one: emulate the agent's FAILED
+    endpoint directly — cordon, label, and post the SliceHealthReport
+    the failover controller reacts to — for tests/chaos tools with no
+    agent in the loop."""
+    from volcano_tpu.api.resource import Resource
+    from volcano_tpu.api.slicehealth import (SliceHealthReport,
+                                             VERDICT_FAILED)
+    from volcano_tpu.api.types import TPU_SLICE_LABEL
+    node = cluster.nodes[node_name]
+    detected = int(Resource.from_resource_list(node.allocatable)
+                   .get(TPU)) or 4
+    if provider is not None:
+        provider.set(node_name, cpu_fraction=0.2,
+                     tpu_chips_detected=detected,
+                     tpu_chips_healthy=chips_healthy)
+        return node
+    from volcano_tpu.agent.agent import (AGENT_CORDONED_ANNOTATION,
+                                         TPU_HEALTHY_LABEL)
+    import time as _time
+    node.labels[TPU_HEALTHY_LABEL] = "false"
+    node.unschedulable = True
+    node.annotations[AGENT_CORDONED_ANNOTATION] = "true"
+    cluster.put_object("node", node)
+    cluster.put_object("slicehealthreport", SliceHealthReport(
+        node=node_name, slice=node.labels.get(TPU_SLICE_LABEL, ""),
+        verdict=VERDICT_FAILED, chips_detected=detected,
+        chips_healthy=chips_healthy, consecutive_bad=3,
+        first_bad_ts=round(_time.time(), 3)))
+    return node
+
+
+def heal_host(cluster, node_name: str, provider=None):
+    """Undo fail_host: healthy telemetry (provider mode) or a Healthy
+    report + uncordon (direct mode)."""
+    from volcano_tpu.api.resource import Resource
+    from volcano_tpu.api.slicehealth import (SliceHealthReport,
+                                             VERDICT_HEALTHY)
+    from volcano_tpu.api.types import TPU_SLICE_LABEL
+    node = cluster.nodes[node_name]
+    detected = int(Resource.from_resource_list(node.allocatable)
+                   .get(TPU)) or 4
+    if provider is not None:
+        provider.set(node_name, cpu_fraction=0.2,
+                     tpu_chips_detected=detected,
+                     tpu_chips_healthy=detected)
+        return node
+    from volcano_tpu.agent.agent import (AGENT_CORDONED_ANNOTATION,
+                                         TPU_HEALTHY_LABEL)
+    node.labels[TPU_HEALTHY_LABEL] = "true"
+    if node.annotations.pop(AGENT_CORDONED_ANNOTATION, None):
+        node.unschedulable = False
+    cluster.put_object("node", node)
+    cluster.put_object("slicehealthreport", SliceHealthReport(
+        node=node_name, slice=node.labels.get(TPU_SLICE_LABEL, ""),
+        verdict=VERDICT_HEALTHY, chips_detected=detected,
+        chips_healthy=detected, consecutive_good=3))
+    return node

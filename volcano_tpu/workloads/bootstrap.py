@@ -28,6 +28,10 @@ ENV_COORDINATOR = "COORDINATOR_ADDRESS"
 ENV_NUM_PROCESSES = "NUM_PROCESSES"
 ENV_SLICE_ID = "TPU_SLICE_ID"
 ENV_NUM_SLICES = "TPU_NUM_SLICES"
+# failover resume contract (controllers/failover.py stamps the job,
+# the jax plugin injects, workloads/checkpoint.resume_state consumes):
+ENV_CHECKPOINT_DIR = "VTP_CHECKPOINT_DIR"
+ENV_RESUME_STEP = "VTP_RESUME_STEP"
 DEFAULT_COORDINATOR_PORT = 8476
 
 
@@ -39,6 +43,10 @@ class BootstrapInfo:
     hostnames: Optional[List[str]] = None
     slice_id: int = 0
     num_slices: int = 1
+    # failover resume: where the job checkpoints, and the step the
+    # control plane asserts was durably saved before the slice died
+    checkpoint_dir: str = ""
+    resume_step: Optional[int] = None
 
     @property
     def is_distributed(self) -> bool:
@@ -56,6 +64,11 @@ def from_env(environ=None) -> BootstrapInfo:
     coordinator = env.get(ENV_COORDINATOR, "")
     if not coordinator and hostnames:
         coordinator = f"{hostnames[0]}:{DEFAULT_COORDINATOR_PORT}"
+    resume_raw = env.get(ENV_RESUME_STEP, "")
+    try:
+        resume_step = int(resume_raw) if resume_raw else None
+    except ValueError:
+        resume_step = None     # malformed env must not kill bootstrap
     return BootstrapInfo(
         process_id=int(env.get(ENV_WORKER_ID, 0)),
         num_processes=num,
@@ -63,6 +76,8 @@ def from_env(environ=None) -> BootstrapInfo:
         hostnames=hostnames or None,
         slice_id=int(env.get(ENV_SLICE_ID, 0)),
         num_slices=int(env.get(ENV_NUM_SLICES, 1)),
+        checkpoint_dir=env.get(ENV_CHECKPOINT_DIR, ""),
+        resume_step=resume_step,
     )
 
 

@@ -35,13 +35,54 @@ def _manager(directory: str, max_to_keep: int = 3):
 
 
 def close_all() -> None:
-    """Release every cached manager (process shutdown / tests)."""
-    for mgr in _MANAGERS.values():
+    """Release every cached manager (process shutdown / tests).
+    Idempotent: a double shutdown (atexit + an explicit drain in a
+    failover teardown) must not raise on already-closed managers."""
+    managers = list(_MANAGERS.values())
+    _MANAGERS.clear()
+    for mgr in managers:
         try:
             mgr.close()
         except Exception:  # noqa: BLE001 - best-effort shutdown
             pass
-    _MANAGERS.clear()
+
+
+def resume_state(params_like: Any, opt_state_like: Any,
+                 directory: str = "",
+                 resume_step: Optional[int] = None,
+                 environ=None) -> Tuple[Any, Any, int]:
+    """Failover-resume entry: restore (params, opt_state, start_step)
+    from the checkpoint the control plane asserts exists, or fall back
+    to the passed fresh state at step 0.
+
+    directory/resume_step default from the jax plugin's injected env
+    (VTP_CHECKPOINT_DIR / VTP_RESUME_STEP, workloads/bootstrap.py).
+    The stamped resume step is a FLOOR, not an exact pin: a newer
+    checkpoint (the workload kept saving between the stamp and the
+    drain) is preferred — restore latest, then sanity-check it is not
+    older than the stamp (an older-only dir means the checkpoint
+    store lost data; restoring silently would quietly rewind
+    training, so that raises)."""
+    import os as _os
+    env = _os.environ if environ is None else environ
+    from volcano_tpu.workloads import bootstrap
+    directory = directory or env.get(bootstrap.ENV_CHECKPOINT_DIR, "")
+    if resume_step is None:
+        raw = env.get(bootstrap.ENV_RESUME_STEP, "")
+        resume_step = int(raw) if raw else None
+    if not directory or latest_step(directory) is None:
+        if resume_step is not None:
+            raise FileNotFoundError(
+                f"control plane stamped resume step {resume_step} but "
+                f"no checkpoint exists under {directory!r}")
+        return params_like, opt_state_like, 0
+    params, opt_state, step = restore(directory, params_like,
+                                      opt_state_like)
+    if resume_step is not None and step < resume_step:
+        raise RuntimeError(
+            f"latest checkpoint step {step} < stamped resume step "
+            f"{resume_step}: checkpoint store lost data")
+    return params, opt_state, step
 
 
 def save(directory: str, step: int, params: Any, opt_state: Any,

@@ -68,6 +68,15 @@ def run(environ=None) -> dict:
     optimizer = train.make_optimizer()
     params, opt_state, _ = train.init_sharded(
         jax.random.key(0), cfg, mesh, optimizer)
+    # failover resume (VTP_CHECKPOINT_DIR / VTP_RESUME_STEP from the
+    # jax plugin): restore the last durable state instead of starting
+    # over — the resume half of the detect→drain→reschedule→resume loop
+    start_step = 0
+    if info.checkpoint_dir or info.resume_step is not None:
+        from volcano_tpu.workloads import checkpoint
+        params, opt_state, start_step = checkpoint.resume_state(
+            params, opt_state, directory=info.checkpoint_dir,
+            resume_step=info.resume_step, environ=environ)
     batch = {"tokens": jax.jit(
         lambda: jax.random.randint(jax.random.key(1), (n_dev, 32), 0,
                                    cfg.vocab_size, dtype=jnp.int32),
@@ -83,6 +92,7 @@ def run(environ=None) -> dict:
         "device_count": n_dev,
         "collective_sum": collective_sum,
         "loss": round(loss, 4),
+        "start_step": start_step,
         "slice_id": info.slice_id,
         "num_slices": info.num_slices,
     }
