@@ -1196,6 +1196,294 @@ def failover_smoke() -> int:
     return 0 if ok else 1
 
 
+# -- control-plane crash chaos (kill -9 + WAL recovery) ----------------
+
+
+class _CrashServer:
+    """One state-server OS process over a durable --data-dir that the
+    scenario can SIGKILL and respawn in place (same port, same dir) —
+    the supervisor's restart loop, minus the supervisor."""
+
+    def __init__(self, data_dir: str, port: int, logdir: str):
+        self.data_dir = data_dir
+        self.port = port
+        self.url = f"http://127.0.0.1:{port}"
+        self.logdir = logdir
+        self.proc = None
+        self.boots = 0
+
+    def spawn(self):
+        import os
+        import subprocess
+        import sys
+        self.boots += 1
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)
+        logf = open(os.path.join(self.logdir,
+                                 f"server-boot{self.boots}.log"), "w")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "volcano_tpu.server",
+             "--port", str(self.port), "--data-dir", self.data_dir],
+            stdout=logf, stderr=logf, env=env, cwd=repo)
+
+    def wait_ready(self, timeout: float = 30.0):
+        import urllib.request
+
+        def up():
+            try:
+                with urllib.request.urlopen(self.url + "/healthz",
+                                            timeout=1):
+                    return True
+            except OSError:
+                return False
+        _wire_wait(up, timeout, "state server /healthz after (re)boot")
+
+    def durability(self) -> dict:
+        import urllib.request
+        with urllib.request.urlopen(self.url + "/durability",
+                                    timeout=5) as r:
+            return json.loads(r.read())
+
+    def kill9(self):
+        import os
+        import signal
+        os.kill(self.proc.pid, signal.SIGKILL)
+        self.proc.wait()
+
+    def shutdown(self):
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                self.proc.kill()
+
+
+def _snapshot_stores(url: str) -> dict:
+    """Ground truth decoded straight off GET /snapshot (no mirror in
+    the middle): {kind: {key: obj}}."""
+    import urllib.request
+
+    from volcano_tpu.api import codec
+    from volcano_tpu.cache.kinds import KINDS
+    req = urllib.request.Request(url + "/snapshot",
+                                 headers={"Accept-Encoding": "gzip"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        from volcano_tpu.server.httputil import read_json_body
+        payload = read_json_body(r)
+    out = {}
+    for kind, spec in KINDS.items():
+        out[kind] = {k: codec.decode(v)
+                     for k, v in payload["stores"].get(kind, {}).items()}
+    return out
+
+
+def _mirror_divergence(mirror, truth: dict) -> int:
+    """Entries where a live mirror disagrees with the server's own
+    snapshot: missing/extra keys per kind, or a pod whose binding
+    (node, phase) differs.  Zero is the no-silent-divergence
+    contract."""
+    from volcano_tpu.cache.kinds import KINDS
+    diverged = 0
+    for kind, spec in KINDS.items():
+        mine = getattr(mirror, spec.attr, {})
+        theirs = truth[kind]
+        diverged += len(set(mine) ^ set(theirs))
+        if kind == "pod":
+            for k in set(mine) & set(theirs):
+                if mine[k].node_name != theirs[k].node_name or \
+                        mine[k].phase is not theirs[k].phase:
+                    diverged += 1
+    return diverged
+
+
+def bench_crash_recovery(smoke: bool = False) -> dict:
+    """Chaos scenario for the durable control plane: a 1k-host
+    cluster's state server takes a bind burst, gets SIGKILLed (not
+    SIGTERMed — no goodbye pickle) mid-flight, restarts from
+    snapshot+WAL, and the scenario measures the recovery time (RTO)
+    and proves the two safety invariants: zero ACKED writes lost
+    across the kill, and zero divergence between live watch mirrors
+    and the recovered server (delta resync across the restart — the
+    epoch BASE survives a durable boot).  Committed as
+    CRASH_r{N}.json."""
+    import shutil
+    import tempfile
+    import threading
+
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.simulator import slice_nodes
+
+    n_slices = 1 if smoke else 16            # 16 x v5e-256 = 1024 hosts
+    slice_kind = "v5e-16" if smoke else "v5e-256"
+    trials = 1 if smoke else 5
+    kills_per_trial = 1 if smoke else 3
+    kill_after_s = 0.2 if smoke else 0.5
+    total_acked = 0
+
+    rtos, client_gaps, replays = [], [], []
+    acked_lost = 0
+    divergence = 0
+    rv_regressions = 0
+    hosts = None
+    logroot = tempfile.mkdtemp(prefix="crash-bench-")
+    for trial in range(trials):
+        data_dir = tempfile.mkdtemp(prefix="crash-wal-")
+        import socket
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        server = _CrashServer(data_dir, port, logroot)
+        kubectl = mirror = stale = None
+        try:
+            server.spawn()
+            server.wait_ready()
+            kubectl = RemoteCluster(server.url, start_watch=False)
+            node_names = []
+            for i in range(n_slices):
+                for node in slice_nodes(
+                        slice_for(f"c{trial}s{i:02d}", slice_kind),
+                        dcn_pod=f"dcn-{i % 4}"):
+                    kubectl.add_node(node)
+                    node_names.append(node.name)
+            hosts = len(node_names)
+            # live mirror watching THROUGH the crash (delta path) and
+            # a frozen one resynced only after recovery
+            mirror = RemoteCluster(server.url)
+            stale = RemoteCluster(server.url, start_watch=False)
+
+            for round_ in range(kills_per_trial):
+                # a CONTINUOUS create+bind burst that the kill lands
+                # inside: chunks of pods created and gang-bound until
+                # the stop mark (set a couple of seconds past the
+                # kill, so acks must resume THROUGH the recovered
+                # server for the tail of the burst)
+                acked: dict = {}     # pod key -> node acked ok
+                ack_times: list = []
+                stop_mark = [float("inf")]
+
+                def burst():
+                    chunk = 16 if smoke else 64
+                    i = 0
+                    while time.monotonic() < stop_mark[0]:
+                        names = [f"burst-t{trial}r{round_}-{i + j}"
+                                 for j in range(chunk)]
+                        i += chunk
+                        try:
+                            for j, name in enumerate(names):
+                                pod = make_pod("t", requests={"cpu": 1})
+                                pod.name = name
+                                pod.namespace = "default"
+                                kubectl.put_object("pod", pod)
+                            binds = [("default", n,
+                                      node_names[(i + j)
+                                                 % len(node_names)])
+                                     for j, n in enumerate(names)]
+                            errs = kubectl.bind_pods(binds)
+                        except Exception:  # noqa: BLE001 — outage ate
+                            continue       # the whole retry budget
+                        now = time.monotonic()
+                        for (ns, n, node), err in zip(binds, errs):
+                            if err is None:
+                                acked[f"{ns}/{n}"] = node
+                                ack_times.append(now)
+
+                burster = threading.Thread(target=burst)
+                burster.start()
+                time.sleep(kill_after_s)
+                # durable-rv checkpoint just before the kill: recovery
+                # must come back at or past it (monotonic across boots)
+                rv_before = server.durability()["visible_rv"]
+                t_kill = time.monotonic()
+                server.kill9()
+                stop_mark[0] = t_kill + (1.0 if smoke else 2.0)
+                server.spawn()
+                server.wait_ready()
+                rtos.append(time.monotonic() - t_kill)
+                dur = server.durability()
+                replays.append({"wal_records": dur["replay_records"],
+                                "replay_s": dur["replay_seconds"]})
+                if dur["rv"] < rv_before:
+                    rv_regressions += 1
+                burster.join(timeout=90)
+                total_acked += len(acked)
+                # ground truth vs every acked bind
+                truth = _snapshot_stores(server.url)
+                for key, node in acked.items():
+                    pod = truth["pod"].get(key)
+                    if pod is None or pod.node_name != node:
+                        acked_lost += 1
+                if ack_times:
+                    before = [t for t in ack_times if t <= t_kill]
+                    after = [t for t in ack_times if t > t_kill]
+                    if before and after:
+                        client_gaps.append(min(after) - max(before))
+                # the watching mirror must converge with zero
+                # divergence (its watch loop delta-resyncs across the
+                # restart: same epoch BASE, bumped boot).  Writes are
+                # quiet now (burster joined), so: catch the revision
+                # first (cheap), then ONE deep compare.
+                settle_rv = server.durability()["visible_rv"]
+                _wire_wait(lambda: mirror._rv >= settle_rv, 30,
+                           "mirror caught up to the recovered rv")
+                divergence += _mirror_divergence(
+                    mirror, _snapshot_stores(server.url))
+                # frozen mirror: explicit resync after recovery must
+                # also land exactly (delta when the WAL tail covers
+                # its revision, full re-list otherwise — never stale)
+                stale.resync()
+                divergence += _mirror_divergence(
+                    stale, _snapshot_stores(server.url))
+        finally:
+            for c in (kubectl, mirror, stale):
+                if c is not None:
+                    c.close()
+            server.shutdown()
+            shutil.rmtree(data_dir, ignore_errors=True)
+    shutil.rmtree(logroot, ignore_errors=True)
+
+    def pct(vals, q):
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1,
+                              int(q * len(vals)))], 4) if vals else None
+
+    return {
+        "hosts": hosts, "trials": trials,
+        "kills_per_trial": kills_per_trial,
+        "binds_acked_total": total_acked,
+        "rto_p50_s": pct(rtos, 0.5),
+        "rto_p95_s": pct(rtos, 0.95),
+        "client_ack_gap_p50_s": pct(client_gaps, 0.5),
+        "replay": replays,
+        "replay_p50_s": pct([r["replay_s"] for r in replays], 0.5),
+        "wal_records_p50": pct(
+            [float(r["wal_records"]) for r in replays], 0.5),
+        "acked_writes_lost": acked_lost,
+        "mirror_divergence": divergence,
+        "rv_regressions": rv_regressions,
+    }
+
+
+def crash_smoke() -> int:
+    """Seconds-scale kill -9 + WAL-replay cycle for tier-1 (small
+    cluster, one kill), mirroring --wire-smoke/--failover-smoke: the
+    crash-safety contract — acked writes survive, mirrors converge,
+    rv monotonic — guarded on every commit.  Prints one JSON line."""
+    try:
+        out = bench_crash_recovery(smoke=True)
+        ok = (out["acked_writes_lost"] == 0
+              and out["mirror_divergence"] == 0
+              and out["rv_regressions"] == 0
+              and out["rto_p50_s"] is not None)
+    except AssertionError as e:
+        out, ok = {"error": str(e)[-600:]}, False
+    print(json.dumps({"metric": "crash_smoke", "ok": ok, **out}))
+    return 0 if ok else 1
+
+
 def _flash_child():
     """Runs in a SUBPROCESS on the real TPU (the axon tunnel hangs at
     backend init when dead — the parent enforces the timeout): time the
@@ -1592,6 +1880,7 @@ def main():
     scale40k = isolated(bench_40k_host_scale)
     net_acct = isolated(bench_net_accounting_overhead)
     failover = isolated(bench_failover_chaos)
+    crash = isolated(bench_crash_recovery)
     wire = isolated(run_wire_benchmarks)
     probe, flash, train_tpu = run_tpu_benchmarks()
     print(json.dumps({
@@ -1621,6 +1910,11 @@ def main():
             # breakdown (`--failover` regenerates standalone ->
             # FAILOVER_r{N}.json)
             "failover": failover,
+            # state-server kill -9 chaos: RTO + WAL replay + the
+            # zero-acked-writes-lost / zero-mirror-divergence
+            # invariants (`--crash` regenerates standalone ->
+            # CRASH_r{N}.json)
+            "crash_recovery": crash,
             # audit-trail-derived latency through the REAL multi-
             # process control plane (state server + leader-elected
             # scheduler + controllers), next to the in-process
@@ -1673,6 +1967,15 @@ if __name__ == "__main__":
         sys.exit(wire_smoke())
     elif "--failover-smoke" in sys.argv:
         sys.exit(failover_smoke())
+    elif "--crash-smoke" in sys.argv:
+        sys.exit(crash_smoke())
+    elif "--crash" in sys.argv:
+        # the standalone kill -9 durability row committed as
+        # CRASH_r{N}.json: bind burst in flight, SIGKILL the state
+        # server, restart from WAL — RTO p50/p95 + zero-acked-writes-
+        # lost + zero-mirror-divergence
+        print(json.dumps({"metric": "crash_recovery_1k_hosts",
+                          **bench_crash_recovery()}))
     elif "--failover" in sys.argv:
         # the standalone chaos row committed as FAILOVER_r{N}.json:
         # kill a host in the 1k-host simulator, p50/p95 MTTR breakdown

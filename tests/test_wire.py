@@ -212,7 +212,11 @@ def test_commands_and_dict_kinds(wire):
     wait_for(lambda: any(c["target"] == "default/j1"
                          for c in b.commands), msg="command propagation")
     got = b.drain_commands("default/j1")
-    assert got == [{"target": "default/j1", "action": "AbortJob"}]
+    # commands carry a unique cid since round 8 (the WAL journals a
+    # drain as the exact set it consumed) — assert the semantic fields
+    assert [(c["target"], c["action"]) for c in got] == \
+        [("default/j1", "AbortJob")]
+    assert got[0].get("cid")
 
     a.put_object("pvc", {"request_gi": 10, "bound_pv": ""}, key="pvc-a")
     wait_for(lambda: "pvc-a" in b.pvcs, msg="pvc propagation")

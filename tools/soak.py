@@ -12,12 +12,20 @@ ttlSecondsAfterFinished unset keeps finished jobs, matching k8s/
 reference semantics), not a leak.
 
 Usage:  python tools/soak.py [seconds] [--kill-slice]
+                             [--kill-server[=EVERY_S]]
         # default 600s; logs /tmp/soak/; --kill-slice injects a slice
         # failure (simulator.fail_host through the wire) ~40% in and
         # requires the failover loop to quarantine the slice and keep
-        # jobs completing
+        # jobs completing.  --kill-server SIGKILLs (never SIGTERMs —
+        # no goodbye save) the state server every EVERY_S seconds
+        # (default 20) and respawns it on the same port over the same
+        # --data-dir: the WAL replay must bring back every acked
+        # write, the scheduler/controller processes must stand by
+        # through each outage (client retry layer + leader lease),
+        # and jobs must keep completing — the control-plane crash
+        # drill for docs/design/durability.md
 """
-import json, os, random, socket, subprocess, sys, time
+import json, os, random, signal, socket, subprocess, sys, time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
@@ -26,15 +34,31 @@ def free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0)); return s.getsockname()[1]
 
+os.makedirs("/tmp/soak", exist_ok=True)
 port = free_port()
 procs = {}
 def spawn(name, *argv):
     procs[name] = subprocess.Popen(
         [sys.executable, *argv], env=env, cwd=REPO,
-        stdout=open(f"/tmp/soak/{name}.log", "w"), stderr=subprocess.STDOUT)
+        stdout=open(f"/tmp/soak/{name}.log", "a"), stderr=subprocess.STDOUT)
 
-spawn("server", "-m", "volcano_tpu.server", "--port", str(port),
-      "--tick-period", "0.2")
+kill_server_every = None
+for a in sys.argv[1:]:
+    if a == "--kill-server":
+        kill_server_every = 20.0
+    elif a.startswith("--kill-server="):
+        kill_server_every = float(a.split("=", 1)[1])
+
+server_args = ["-m", "volcano_tpu.server", "--port", str(port),
+               "--tick-period", "0.2"]
+if kill_server_every:
+    # durable mode: the whole point is recovering from SIGKILL.
+    # Fresh dir per soak — replaying last week's run would skew the
+    # completion accounting.
+    import shutil
+    shutil.rmtree("/tmp/soak/state", ignore_errors=True)
+    server_args += ["--data-dir", "/tmp/soak/state"]
+spawn("server", *server_args)
 time.sleep(2)
 spawn("plane", "-m", "volcano_tpu", "--cluster-url",
       f"http://127.0.0.1:{port}", "--components", "scheduler,controllers",
@@ -55,13 +79,17 @@ for sname in ("sa", "sb", "sc"):
 
 rng = random.Random(42)
 submitted = completed_seen = 0
-argv = [a for a in sys.argv[1:] if a != "--kill-slice"]
+argv = [a for a in sys.argv[1:]
+        if not a.startswith("--kill-")]
 kill_slice = "--kill-slice" in sys.argv[1:]
 duration = float(argv[0]) if argv else 600
 t_start = time.time()
 t_end = t_start + duration
 t_kill = t_start + duration * 0.4
 killed = None
+server_kills = 0
+next_server_kill = (t_start + kill_server_every
+                    if kill_server_every else None)
 i = 0
 rss_samples = []
 def server_rss():
@@ -73,6 +101,16 @@ def server_rss():
     except OSError:
         return -1
 while time.time() < t_end:
+    if next_server_kill is not None and time.time() >= next_server_kill:
+        # kill -9 and respawn in place: WAL replay + mirror delta
+        # resync must carry every live component across the outage
+        os.kill(procs["server"].pid, signal.SIGKILL)
+        procs["server"].wait()
+        spawn("server", *server_args)
+        server_kills += 1
+        next_server_kill = time.time() + kill_server_every
+        print(f"kill -9 state server (#{server_kills}); respawned",
+              flush=True)
     if kill_slice and killed is None and time.time() >= t_kill:
         # chaos: one host of slice sc dies mid-soak; the failover
         # controller in the plane process must quarantine the slice
@@ -118,6 +156,10 @@ out = {"submitted": submitted, "phases": phases,
        "dead_processes": dead,
        "rss_first": rss_samples[0] if rss_samples else None,
        "rss_last": rss_samples[-1] if rss_samples else None}
+if kill_server_every:
+    out["server_kills"] = server_kills
+    out["kill_server_ok"] = (server_kills > 0 and not dead
+                             and phases.get("Completed", 0) > 0)
 if killed is not None:
     from volcano_tpu.api.slicehealth import (
         NODE_QUARANTINED_UNTIL_ANNOTATION)

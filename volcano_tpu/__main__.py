@@ -135,8 +135,12 @@ def main(argv=None) -> int:
             ca_cert=args.ca_cert, insecure=args.insecure)
     elif args.state:
         try:
-            with open(args.state, "rb") as f:
-                cluster = pickle.load(f)
+            # sniffs legacy pickle vs the snapshot-JSON format the
+            # server's graceful save writes now
+            from volcano_tpu.server.durability import load_cluster_file
+            cluster = load_cluster_file(args.state)
+            if cluster.admission is None:
+                cluster.admission = default_admission()
         except FileNotFoundError:
             cluster = FakeCluster()
             cluster.admission = default_admission()

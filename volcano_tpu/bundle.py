@@ -89,6 +89,19 @@ FAMILIES: Dict[str, str] = {
     "failover_resume_step_gap": "histogram",
     "slice_failovers_total": "counter",
     "quarantined_slices": "gauge",
+    # state-server durability (server/durability.py): the WAL journal-
+    # before-ack loop, snapshot compaction cadence, and boot replay
+    "server_wal_fsync_seconds": "histogram",
+    "server_wal_records": "gauge",
+    "server_wal_bytes": "gauge",
+    "server_snapshot_seconds": "histogram",
+    "server_snapshot_total": "counter",
+    "server_snapshot_rv": "gauge",
+    "server_replay_seconds": "histogram",
+    "server_replay_records": "gauge",
+    # client wire resilience: every transient retry the unified
+    # backoff policy performs, labeled by route
+    "client_retries_total": "counter",
 }
 
 
@@ -143,6 +156,16 @@ def scheduler_dashboard() -> dict:
         _panel(8, "Queue allocated mCPU / chips",
                ["queue_allocated_milli_cpu",
                 "queue_allocated_scalar_resources"], 12, 24),
+        _panel(9, "State-server durability (mean)",
+               [_mean_expr("server_wal_fsync_seconds"),
+                _mean_expr("server_snapshot_seconds"),
+                _mean_expr("server_replay_seconds")], 0, 32,
+               unit="s"),
+        _panel(10, "WAL backlog + wire retries",
+               ["server_wal_records",
+                "rate(server_snapshot_total[5m])",
+                "sum by (route) (rate(client_retries_total[5m]))"],
+               12, 32),
     ]
     return {
         "title": "volcano-tpu / scheduler", "uid": "vtp-scheduler",
@@ -240,8 +263,11 @@ DEFAULT_CONF = {
 # THERE, not on the state server), so every role gets a --metrics-port
 # and the scrape config targets all of them.
 ROLES = [
-    ("server", "volcano-tpu-server --port {port} --state "
-               "{data_dir}/state.pkl --token-file {bundle_dir}/token",
+    # --data-dir: the WAL + snapshot durability layer — with
+    # Restart=always a kill -9/OOM replays the journal and loses no
+    # acked write (docs/design/durability.md)
+    ("server", "volcano-tpu-server --port {port} --data-dir "
+               "{data_dir}/state --token-file {bundle_dir}/token",
      0),
     ("scheduler", "volcano-tpu --cluster-url http://127.0.0.1:{port} "
                   "--components scheduler --leader-elect --holder %H "

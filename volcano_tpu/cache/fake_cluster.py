@@ -148,10 +148,17 @@ class FakeCluster(Cluster):
 
     def add_command(self, target_key: str, action: str):
         """Queue a delegated action (abort/resume/restart/...) against a
-        vcjob; the job controller consumes and deletes it."""
+        vcjob; the job controller consumes and deletes it.  The cid
+        uniquely names this command so the state server's WAL can
+        journal a drain as the exact set it consumed — replay is then
+        order-independent of add events whose journal records raced
+        the drain's (docs/design/durability.md)."""
+        import uuid
+        cmd = {"target": target_key, "action": action,
+               "cid": uuid.uuid4().hex[:12]}
         with self._lock:
-            self.commands.append({"target": target_key, "action": action})
-        self._notify("command", {"target": target_key, "action": action})
+            self.commands.append(cmd)
+        self._notify("command", cmd)
 
     def drain_commands(self, target_key: str):
         with self._lock:

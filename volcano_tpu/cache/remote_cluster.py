@@ -18,9 +18,12 @@ from __future__ import annotations
 
 import json
 import logging
+import random
 import threading
+import time
 import urllib.error
 import urllib.request
+import uuid
 from http.client import HTTPException
 from typing import Callable, Dict, List, Optional
 
@@ -30,6 +33,26 @@ from volcano_tpu.cache.cluster import Cluster, ClusterSnapshot
 from volcano_tpu.cache.kinds import KINDS, key_for
 
 log = logging.getLogger(__name__)
+
+# ONE retry policy for every wire call (capped exponential backoff +
+# full jitter + an overall deadline) instead of each caller hand-
+# rolling its own: transient failures — connection refused/reset, a
+# truncated response, a 5xx from a restarting server — are retried
+# until the deadline; 4xx verdicts (auth, admission, conflict,
+# missing) fail fast, every retry would get the same answer.
+RETRY_BASE_S = 0.05
+RETRY_CAP_S = 2.0
+RETRY_DEADLINE_S = 30.0
+
+
+def _transient(e: Exception) -> bool:
+    """Worth retrying?  Connection failures (URLError IS an OSError),
+    truncated/garbled responses (HTTPException), and server-side 5xx
+    (a restarting or overloaded server).  4xx — including 401/403
+    auth and 409/422 verdicts, already mapped to their own exception
+    types — would fail identically forever."""
+    return isinstance(e, (OSError, HTTPException)) or \
+        (isinstance(e, RemoteError) and e.code >= 500)
 
 
 class RemoteError(RuntimeError):
@@ -42,14 +65,18 @@ class RemoteCluster(Cluster):
     def __init__(self, base_url: str, start_watch: bool = True,
                  timeout: float = 10.0, token: str = "",
                  ca_cert: str = "", insecure: bool = False,
-                 tolerate_unreachable: bool = False):
+                 tolerate_unreachable: bool = False,
+                 retry_deadline: float = RETRY_DEADLINE_S):
         """tolerate_unreachable: a dead server at construction time
         leaves the mirror empty instead of raising — the watch loop's
         resync-on-reconnect self-heals once the server returns (the
-        hub's member-cluster clients must survive a member outage)."""
+        hub's member-cluster clients must survive a member outage).
+        retry_deadline: overall per-call budget for the shared
+        transient-retry policy (backoff + jitter)."""
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.token = token
+        self._retry_deadline = retry_deadline
         from volcano_tpu.server.tlsutil import client_ssl_context
         self._ssl_ctx = client_ssl_context(ca_cert, insecure)
         self._mlock = threading.RLock()        # mirror + watchers
@@ -63,17 +90,19 @@ class RemoteCluster(Cluster):
         self.commands: List[dict] = []
         self.events: List[tuple] = []          # local record only
         try:
-            self.resync()
+            if tolerate_unreachable:
+                # a dead member must not stall the hub's boot for the
+                # whole retry budget: one attempt, the watch loop's
+                # backoff owns the healing from here
+                self.resync(_deadline=0.0)
+            else:
+                self.resync()
         except Exception as e:  # noqa: BLE001 — classified below
             # Tolerable: anything the watch loop could heal once the
-            # server is back — connection failures (URLError IS an
-            # OSError), truncated/garbled responses (HTTPException),
-            # and server-side 5xx (a restarting proxy).  NOT
-            # tolerable: 4xx auth/config errors — every retry would
-            # 401 forever, so fail fast even in tolerant mode.
-            transient = isinstance(e, (OSError, HTTPException)) or \
-                (isinstance(e, RemoteError) and e.code >= 500)
-            if not tolerate_unreachable or not transient:
+            # server is back (the shared _transient classification).
+            # NOT tolerable: 4xx auth/config errors — every retry
+            # would 401 forever, so fail fast even in tolerant mode.
+            if not tolerate_unreachable or not _transient(e):
                 raise
             log.warning("state server %s unreachable at startup (%s); "
                         "mirror starts empty and the watch loop will "
@@ -87,7 +116,43 @@ class RemoteCluster(Cluster):
     # -- HTTP ----------------------------------------------------------
 
     def _request(self, method: str, path: str, payload=None,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 deadline: Optional[float] = None, retries: bool = True,
+                 idempotency_key: bool = False):
+        """One wire call under the unified retry policy.
+
+        idempotency_key stamps the payload with a per-REQUEST id
+        (stable across this call's retries): the server records the
+        response it committed for that id, so a retry after a crash-
+        between-commit-and-ack replays the verdict instead of double-
+        applying (e.g. a re-created vcjob minting a second uid, a
+        duplicated Command, a drain losing its commands).  Mutations
+        without a key are replay-safe by state-compare (re-bind to the
+        same node, overwrite-put, repeated evict/delete)."""
+        if idempotency_key and payload is not None:
+            payload = dict(payload, _req_id=uuid.uuid4().hex)
+        budget = self._retry_deadline if deadline is None else deadline
+        t_end = time.monotonic() + budget
+        delay = RETRY_BASE_S
+        while True:
+            try:
+                return self._request_once(method, path, payload, timeout)
+            except Exception as e:  # noqa: BLE001 — classified
+                remain = t_end - time.monotonic()
+                if not retries or not _transient(e) or remain <= 0 \
+                        or self._stop.is_set():
+                    raise
+                from volcano_tpu import metrics
+                metrics.inc("client_retries_total",
+                            route=path.partition("?")[0])
+                log.debug("wire %s %s failed (%s); retrying",
+                          method, path, e)
+                time.sleep(min(remain,
+                               random.uniform(delay / 2, delay)))
+                delay = min(delay * 2, RETRY_CAP_S)
+
+    def _request_once(self, method: str, path: str, payload=None,
+                      timeout: Optional[float] = None):
         data = None
         if payload is not None:
             data = json.dumps(payload, separators=(",", ":")).encode()
@@ -122,7 +187,16 @@ class RemoteCluster(Cluster):
 
     # -- mirror maintenance --------------------------------------------
 
-    def resync(self) -> None:
+    @staticmethod
+    def _epoch_base(epoch: str) -> str:
+        """Durable servers stamp "BASE.BOOT" epochs: the BASE survives
+        restarts as long as the rv history is WAL-continuous, the BOOT
+        half bumps each boot.  Legacy/non-durable epochs are opaque
+        uuids (base == whole epoch), so any restart changes the
+        base."""
+        return epoch.rsplit(".", 1)[0]
+
+    def resync(self, _deadline: Optional[float] = None) -> None:
         """Reconcile the mirror with the server, delta-first.
 
         A mirror that already holds a revision asks the watch endpoint
@@ -130,24 +204,34 @@ class RemoteCluster(Cluster):
         any server vintage) for the events since it: O(churn) work
         and bytes, not O(cluster) — at a few thousand hosts the full
         snapshot is megabytes while a churn window is a handful of
-        events.  Falls back to the full LIST when the mirror is empty
-        (bootstrap), the revision fell off the server's compaction
-        horizon (resync verdict), the server is a new incarnation
-        (epoch change: its counters restarted), or the delta request
-        itself fails."""
+        events.  The delta is also taken ACROSS a server restart when
+        the epoch BASE matches (a durable server replayed its WAL: the
+        rv space is continuous and nothing any mirror ever saw was
+        lost, since the server only releases fsync'd events) — that is
+        the O(churn) recovery path after a kill -9.  Falls back to the
+        full LIST when the mirror is empty (bootstrap), the revision
+        fell off the server's compaction horizon (resync verdict), the
+        server is a different incarnation lineage (epoch BASE change:
+        its rv space is unrelated), the server's rv is BEHIND the
+        mirror's (a restart that lost unacked tail events the snapshot
+        briefly exposed), or the delta request itself fails."""
         # _epoch marks "bootstrapped at least once" — rv 0 is a valid
         # revision (a mirror synced before the first event), so gate on
         # the epoch, not the revision
         if self._epoch:
             try:
                 payload = self._request(
-                    "GET", f"/watch?since={self._rv}&timeout=0")
+                    "GET", f"/watch?since={self._rv}&timeout=0",
+                    deadline=_deadline)
             except Exception as e:  # noqa: BLE001 — fall back to LIST
                 log.debug("delta resync failed (%s); full re-list", e)
                 payload = None
+            epoch = payload.get("epoch", "") if payload else ""
             if payload is not None and not payload.get("resync") \
-                    and payload.get("epoch", "") == self._epoch \
-                    and payload["rv"] >= self._rv:
+                    and payload["rv"] >= self._rv \
+                    and (epoch == self._epoch or
+                         (epoch and self._epoch_base(epoch) ==
+                          self._epoch_base(self._epoch))):
                 from volcano_tpu import metrics
                 metrics.inc("mirror_resync_total", mode="delta")
                 # fold like a watch batch (copy-on-write swap) and
@@ -158,14 +242,15 @@ class RemoteCluster(Cluster):
                     self._notify(kind, obj)
                 with self._mlock:
                     self._rv = max(self._rv, payload["rv"])
+                    self._epoch = epoch or self._epoch
                 return
-        self._full_resync()
+        self._full_resync(_deadline=_deadline)
 
-    def _full_resync(self) -> None:
+    def _full_resync(self, _deadline: Optional[float] = None) -> None:
         """Full LIST: replace the mirror (bootstrap + ring fall-off +
         server restart)."""
         from volcano_tpu import metrics
-        payload = self._request("GET", "/snapshot")
+        payload = self._request("GET", "/snapshot", deadline=_deadline)
         metrics.inc("mirror_resync_total", mode="full")
         with self._mlock:
             self._rv = payload["rv"]
@@ -222,22 +307,40 @@ class RemoteCluster(Cluster):
         return notifications
 
     def _watch_loop(self) -> None:
+        delay = 0.2
         while not self._stop.is_set():
             try:
+                # the loop IS the retry policy here (retries=False):
+                # its backoff must keep ticking between long-polls,
+                # not nest another backoff inside each one
                 payload = self._request(
                     "GET", f"/watch?since={self._rv}&timeout=25",
-                    timeout=60.0)
-            except Exception:  # noqa: BLE001 — server restart etc.
-                if self._stop.wait(1.0):
+                    timeout=60.0, retries=False)
+            except Exception as e:  # noqa: BLE001 — classified
+                if not _transient(e):
+                    # same transient-vs-fatal split the startup path
+                    # applies: a 4xx (revoked token, bad config) would
+                    # 401 on every long-poll forever — stop loudly
+                    # instead of burning a retry loop in the dark
+                    log.error("watch stream got a non-transient error "
+                              "(%s); stopping the watch loop — the "
+                              "mirror will go stale until "
+                              "reconfigured", e)
                     return
+                if self._stop.wait(random.uniform(delay / 2, delay)):
+                    return
+                delay = min(delay * 2, 5.0)
                 continue
+            delay = 0.2
             epoch = payload.get("epoch", "")
             if payload.get("resync") or payload["rv"] < self._rv or \
                     (self._epoch and epoch and epoch != self._epoch):
                 # ring fall-off, rv regression, or a NEW server
                 # incarnation (epoch change — catches a restarted
-                # server whose counter already passed ours): only a
-                # full re-list recovers the stream
+                # server whose counter already passed ours).  resync()
+                # recovers the stream: O(churn) delta when the epoch
+                # BASE matches (durable restart), full re-list
+                # otherwise
                 try:
                     self.resync()
                 except Exception:  # noqa: BLE001
@@ -283,8 +386,13 @@ class RemoteCluster(Cluster):
     # -- Cluster interface: writes (server + local echo) ---------------
 
     def put_object(self, kind: str, obj, key: Optional[str] = None):
+        # keyed: a retried CREATE must not re-run create-side effects
+        # (a vcjob minting a fresh uid, admission mutations) after the
+        # first attempt committed — the server replays the recorded
+        # response instead
         resp = self._request("POST", f"/objects/{kind}",
-                             {"obj": codec.encode(obj), "key": key})
+                             {"obj": codec.encode(obj), "key": key},
+                             idempotency_key=True)
         stored = codec.decode(resp["obj"])
         spec = KINDS[kind]
         k = key_for(kind, stored if spec.key_of else obj, key)
@@ -428,20 +536,30 @@ class RemoteCluster(Cluster):
                      message: str) -> None:
         self.events.append((obj_key, reason, message))
         try:
+            # best-effort AND often on failure paths: a short budget,
+            # never the full retry deadline
             self._request("POST", "/record_event", {
-                "obj_key": obj_key, "reason": reason, "message": message})
+                "obj_key": obj_key, "reason": reason,
+                "message": message}, deadline=2.0)
         except Exception:  # noqa: BLE001 — events are best-effort
             log.debug("record_event failed", exc_info=True)
 
     # -- command bus ---------------------------------------------------
 
     def add_command(self, target_key: str, action: str) -> None:
+        # keyed: a retried Command would otherwise double-queue (two
+        # RestartJobs = two restarts)
         self._request("POST", "/command",
-                      {"target": target_key, "action": action})
+                      {"target": target_key, "action": action},
+                      idempotency_key=True)
 
     def drain_commands(self, target_key: str):
+        # keyed: a retried drain whose first attempt committed would
+        # find an empty bus and LOSE the commands — the replayed
+        # response carries what the first attempt drained
         resp = self._request("POST", "/drain_commands",
-                             {"target": target_key})
+                             {"target": target_key},
+                             idempotency_key=True)
         with self._mlock:
             self.commands = [c for c in self.commands
                              if c.get("target") != target_key]
@@ -460,7 +578,11 @@ class RemoteCluster(Cluster):
     # -- leader election -----------------------------------------------
 
     def lease(self, name: str, holder: str, ttl: float = 15.0,
-              release: bool = False) -> dict:
+              release: bool = False,
+              deadline: Optional[float] = None) -> dict:
+        """deadline bounds the retry budget: a renewal must fail
+        before the caller's next renewal slot, not block past the
+        lease TTL and forfeit leadership to a slow wire."""
         return self._request("POST", "/lease", {
             "name": name, "holder": holder, "ttl": ttl,
-            "release": release})
+            "release": release}, deadline=deadline)

@@ -31,14 +31,17 @@ from volcano_tpu.framework.job_updater import SCHEDULING_REASON_ANNOTATION
 
 def _load(path: str):
     try:
-        with open(path, "rb") as f:
-            return pickle.load(f)
+        # either format: legacy pickle or the snapshot JSON the
+        # server's graceful save writes now
+        from volcano_tpu.server.durability import load_cluster_file
+        cluster = load_cluster_file(path)
     except FileNotFoundError:
         from volcano_tpu.cache.fake_cluster import FakeCluster
-        from volcano_tpu.webhooks import default_admission
         cluster = FakeCluster()
+    if cluster.admission is None:
+        from volcano_tpu.webhooks import default_admission
         cluster.admission = default_admission()
-        return cluster
+    return cluster
 
 
 def _save(cluster, path: str):
@@ -629,6 +632,45 @@ def cmd_bandwidth(cluster, args):
                                "BUDGET", "VIOLATING", "SATURATED"]))
 
 
+def cmd_server(cluster, args):
+    """Durability + lease status of the live state server (GET
+    /durability, GET /leases): whether writes are journaled, how much
+    WAL a crash would replay, when the last snapshot landed, and who
+    holds the control-plane leases.  Server mode only — a state file
+    has no server to ask."""
+    if not getattr(args, "server", ""):
+        print("server status needs --server URL", file=sys.stderr)
+        return
+    dur = cluster._request("GET", "/durability")
+    rows = [["epoch", dur.get("epoch", "-")],
+            ["rv", dur.get("rv")],
+            ["visible-rv", dur.get("visible_rv")],
+            ["durable", "yes" if dur.get("enabled") else
+             "NO (kill -9 loses state)"]]
+    if dur.get("enabled"):
+        age = dur.get("snapshot_age_s")
+        rows += [
+            ["data-dir", dur.get("dir", "-")],
+            ["wal-records", dur.get("wal_records")],
+            ["wal-bytes", dur.get("wal_bytes")],
+            ["synced-rv", dur.get("synced_rv")],
+            ["snapshot-rv", dur.get("snapshot_rv")],
+            ["snapshot-age", f"{age:.1f}s" if age is not None else
+             "never"],
+            ["last-fsync", f"{dur.get('last_fsync_s', 0) * 1e3:.2f}ms"],
+            ["boot-replay", f"{dur.get('replay_records')} records in "
+             f"{dur.get('replay_seconds')}s"],
+        ]
+    print(_table([[k, str(v)] for k, v in rows], ["FIELD", "VALUE"]))
+    leases = cluster._request("GET", "/leases")
+    if leases:
+        print()
+        print(_table(
+            [[n, l["holder"], f"{l['expires_in']:.1f}s"]
+             for n, l in sorted(leases.items())],
+            ["LEASE", "HOLDER", "EXPIRES-IN"]))
+
+
 def cmd_tick(cluster, args):
     """Run controllers + one scheduling cycle + kubelet tick.
 
@@ -823,6 +865,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("failover", help="slice-failover view: sick "
                        "hosts, drained gangs, resume metadata")
     p.set_defaults(fn=cmd_failover)
+
+    p = sub.add_parser("server", help="state-server durability + "
+                       "lease status (WAL/snapshot/replay; needs "
+                       "--server)")
+    p.set_defaults(fn=cmd_server)
 
     p = sub.add_parser("tick",
                        help="advance the standalone control plane")

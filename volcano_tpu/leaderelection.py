@@ -40,8 +40,13 @@ class LeaderElector:
 
     def _renew_once(self) -> None:
         try:
+            # the retry budget must stay inside ONE renewal slot
+            # (ttl/3): a wire retrying past the TTL would hold the
+            # thread while the lease lapses under it — better to fail
+            # this renewal, step down, and re-contend next slot
             res = self.cluster.lease(self.lease_name, self.holder,
-                                     ttl=self.ttl)
+                                     ttl=self.ttl,
+                                     deadline=self.ttl / 3.0)
             acquired = bool(res.get("acquired"))
         except Exception:  # noqa: BLE001 — server blip: step down
             log.warning("lease renewal failed; standing by",
@@ -69,8 +74,11 @@ class LeaderElector:
         self._stop.set()
         if self._leader.is_set():
             try:
+                # shutdown courtesy only (the TTL lapses anyway):
+                # never let a dead wire block process exit
                 self.cluster.lease(self.lease_name, self.holder,
-                                   ttl=self.ttl, release=True)
+                                   ttl=self.ttl, release=True,
+                                   deadline=1.0)
             except Exception:  # noqa: BLE001
                 pass
         self._leader.clear()

@@ -1,7 +1,16 @@
 """Run the state server standalone.
 
-    python -m volcano_tpu.server --port 8700 --state cluster.pkl \
+    python -m volcano_tpu.server --port 8700 --data-dir ./state \
         --tick-period 1.0
+
+--data-dir enables the crash-safe layer (WAL + snapshots, fsync
+before every ack; server/durability.py): a kill -9 loses nothing that
+was acked, and the next boot replays snapshot-then-WAL and resumes
+the event counter monotonically.  --state remains as the legacy
+single-file mode: it loads EITHER the old pickle or the snapshot-JSON
+format, and the graceful save is routed through the same atomic
+snapshot writer (but a hard kill still loses everything since the
+last save — use --data-dir for durability).
 """
 
 from __future__ import annotations
@@ -9,7 +18,6 @@ from __future__ import annotations
 import argparse
 import logging
 import os
-import pickle
 import signal
 import sys
 import threading
@@ -18,8 +26,17 @@ import threading
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="volcano-tpu-server")
     parser.add_argument("--port", type=int, default=8700)
+    parser.add_argument("--data-dir", default="",
+                        help="durable state directory (WAL + "
+                             "snapshots, fsync before ack); survives "
+                             "kill -9")
     parser.add_argument("--state", default="",
-                        help="pickled FakeCluster to load/save")
+                        help="legacy single-file state to load/save "
+                             "on graceful shutdown (pickle or "
+                             "snapshot JSON — both load); when "
+                             "--data-dir already holds state, that "
+                             "wins and --state only receives the "
+                             "shutdown export")
     parser.add_argument("--tick-period", type=float, default=0.0,
                         help="self-tick the simulated kubelet every N "
                              "seconds (0 = external /tick only)")
@@ -65,14 +82,35 @@ def main(argv=None) -> int:
         log.info("self-signed TLS material written to %s / %s",
                  args.tls_cert, args.tls_key)
 
+    from volcano_tpu.server.durability import (DurableStore,
+                                               atomic_write_json,
+                                               load_cluster_file)
+    durable = None
     cluster = None
-    if args.state and os.path.exists(args.state):
-        with open(args.state, "rb") as f:
-            cluster = pickle.load(f)
+    if args.data_dir:
+        durable = DurableStore(args.data_dir)
+        rec = durable.recover()
+        cluster = rec.cluster
+        if cluster is not None:
+            log.info("recovered durable state from %s (%d nodes, %d "
+                     "pods, rv %d, %d WAL records replayed in %.3fs, "
+                     "epoch %s)", args.data_dir, len(cluster.nodes),
+                     len(cluster.pods), rec.rv, rec.replay_records,
+                     rec.replay_seconds, rec.epoch)
+    if cluster is None and args.state and os.path.exists(args.state):
+        # legacy alias: sniffs pickle vs snapshot JSON.  With an empty
+        # --data-dir this seeds the durable store (the initial
+        # snapshot lands before the first ack).
+        cluster = load_cluster_file(args.state)
         if cluster.admission is None:
             cluster.admission = default_admission()
         log.info("loaded state from %s (%d nodes, %d pods)",
                  args.state, len(cluster.nodes), len(cluster.pods))
+    elif args.state and os.path.exists(args.state) and \
+            cluster is not None:
+        log.info("durable state in %s takes precedence; %s will only "
+                 "receive the shutdown export", args.data_dir,
+                 args.state)
 
     from volcano_tpu.webhooks.server import RemoteAdmission
     if args.webhook_url:
@@ -98,11 +136,12 @@ def main(argv=None) -> int:
     httpd, state = serve(port=args.port, cluster=cluster,
                          tick_period=args.tick_period,
                          tls_cert=args.tls_cert, tls_key=args.tls_key,
-                         token=token)
-    log.info("state server on %s://127.0.0.1:%d%s",
+                         token=token, durable=durable)
+    log.info("state server on %s://127.0.0.1:%d%s%s",
              "https" if args.tls_cert else "http",
              httpd.server_address[1],
-             " (bearer auth on writes)" if token else "")
+             " (bearer auth on writes)" if token else "",
+             f" [durable: {args.data_dir}]" if durable else "")
 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -111,14 +150,20 @@ def main(argv=None) -> int:
 
     state.tick_stop.set()   # no kubelet mutations during save
     httpd.shutdown()
+    if durable is not None:
+        # final compaction so the next boot replays zero WAL
+        state.write_snapshot()
+        durable.close()
+        log.info("durable state compacted in %s", args.data_dir)
     if args.state:
-        tmp = f"{args.state}.tmp"
-        # hold the store lock: a straggling handler thread must not
-        # mutate dicts mid-pickle ("dictionary changed size" -> lost save)
-        with state.cluster._lock, open(tmp, "wb") as f:
-            pickle.dump(state.cluster, f)
-        os.replace(tmp, args.state)
-        log.info("state saved to %s", args.state)
+        # the graceful save routes through the same snapshot capture +
+        # atomic writer the WAL compactor uses: the store/event locks
+        # make the capture consistent even if a straggling handler
+        # thread is still mutating (the old direct pickle raced them),
+        # and write-temp + rename means a crash mid-save never tears
+        # the last good file
+        atomic_write_json(args.state, state.disk_snapshot_doc())
+        log.info("state saved to %s (snapshot format)", args.state)
     return 0
 
 

@@ -1,0 +1,494 @@
+"""Crash-safe persistence for the state server: WAL + snapshots.
+
+The authoritative store used to be an in-memory FakeCluster whose only
+durability was a pickle written on graceful shutdown — a SIGKILL/OOM
+lost every acked bind, podgroup phase, quarantine TTL and lease, and
+restarted the event log so every mirror's delta resync silently
+desynced.  The reference keeps all truth behind an apiserver/etcd that
+journals before acking; this module gives volcano-tpu the same
+contract (docs/design/durability.md):
+
+  * every store mutation appends ONE record to a write-ahead log and
+    is fsync'd before the HTTP ack (group commit: concurrent handler
+    threads share one fsync barrier, so a 256-bind burst pays ~1
+    fsync, not 256);
+  * a periodic snapshot (write-temp + atomic rename + dir fsync)
+    compacts the log: snapshot = full store dump + last rv + epoch;
+    WAL segments wholly covered by a durable snapshot are deleted;
+  * boot replays snapshot-then-WAL-tail, resumes the rv counter
+    monotonically, reseeds the watch event ring from the tail, and
+    bumps the boot half of the epoch ("BASE.BOOT") so mirrors KNOW a
+    restart happened — same BASE means the history is WAL-continuous
+    and a delta resync across the restart is exact; a different BASE
+    (fresh dir, legacy pickle boot) forces a full re-list.
+
+Record format — one JSON line per record, self-delimiting so a crash
+mid-append truncates to the last complete line:
+
+    {"rv": N, "k": kind, "o": <codec payload>}       store event
+    {"k": "_lease", "o": {name, holder, expires_wall}} lease CAS
+    {"k": "_drain", "o": {"target": key}}              command drain
+    {"k": "_req",  "o": {"id":..,"code":..,"resp":..}} idempotency key
+
+Only store events carry rv (they are the watch stream); the private
+records replay in file order.  Leases persist wall-clock expiry and
+are rebased onto the monotonic clock at boot, so a restarted server
+refuses a second leader inside an old holder's TTL while a wall-clock
+jump can never mass-expire (or immortalize) live leases.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+import os
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+SNAPSHOT_FILE = "snapshot.json"
+EPOCH_FILE = "epoch.json"
+WAL_PREFIX = "wal-"
+SNAPSHOT_FORMAT = "volcano-tpu-snapshot-v1"
+# compaction thresholds: snapshot once the live WAL holds this many
+# records or bytes (whichever first) — bounds both replay time and
+# disk growth without paying a full store dump per mutation
+SNAPSHOT_EVERY_RECORDS = 20_000
+SNAPSHOT_EVERY_BYTES = 64 * 1024 * 1024
+# replayed idempotency keys retained (snapshot + memory): a retried
+# mutation whose first attempt committed before a crash must find its
+# recorded response, not double-apply
+REQ_CACHE = 2048
+
+
+class Recovery(NamedTuple):
+    cluster: Optional[object]      # FakeCluster, or None (nothing on disk)
+    rv: int                        # resume point for the event counter
+    events: List[Tuple[int, str, object]]   # ring tail [(rv, kind, payload)]
+    leases: Dict[str, Tuple[str, float]]    # name -> (holder, expires_wall)
+    req_cache: "Dict[str, Tuple[int, object]]"  # req id -> (code, payload)
+    epoch: str                     # bumped incarnation id "BASE.BOOT"
+    replay_records: int
+    replay_seconds: float
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:            # platform without dir fds
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write_json(path: str, doc: dict) -> None:
+    """write-temp + fsync + atomic rename + dir fsync — the one
+    snapshot writer every save path routes through (including the
+    legacy --state graceful save), so a crash mid-save can never
+    leave a torn file where the last good state was."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, separators=(",", ":"))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(os.path.abspath(path)) or ".")
+
+
+def decode_stores_into(cluster, stores: dict) -> None:
+    """Fold an encoded snapshot `stores` dict (the /snapshot payload
+    shape) into a FakeCluster's attribute stores."""
+    from volcano_tpu.api import codec
+    from volcano_tpu.cache.kinds import KINDS
+    for kind, spec in KINDS.items():
+        store = {k: codec.decode(enc)
+                 for k, enc in stores.get(kind, {}).items()}
+        if store or not getattr(cluster, spec.attr, None):
+            # merge over construction defaults (e.g. the default
+            # queue) only when the snapshot actually carried the kind
+            getattr(cluster, spec.attr).update(store)
+    cmds = codec.decode(stores.get("_commands", [])) or []
+    cluster.commands = list(cmds)
+
+
+def apply_event(cluster, kind: str, payload) -> None:
+    """Replay ONE WAL store event onto the authoritative store —
+    the server-side twin of RemoteCluster._apply_batch: no admission
+    (it already ran before the event was logged), no watchers (none
+    are attached at boot)."""
+    from volcano_tpu.api import codec
+    from volcano_tpu.cache.kinds import KINDS
+    obj = codec.decode(payload)
+    deleted = kind.endswith("_deleted")
+    base = kind[:-len("_deleted")] if deleted else kind
+    spec = KINDS.get(base)
+    if spec is not None:
+        key = obj["key"] if spec.key_of is None else spec.key_of(obj)
+        store = getattr(cluster, spec.attr)
+        if deleted:
+            store.pop(key, None)
+        else:
+            store[key] = obj if spec.key_of else obj["obj"]
+    elif base == "command":
+        cluster.commands.append(obj)
+    # unknown kinds (a future version's events) replay as no-ops: the
+    # snapshot that follows them will carry whatever they meant
+
+
+def load_cluster_file(path: str):
+    """Load a cluster state file in EITHER format: the legacy pickle
+    or the snapshot JSON the graceful save now writes (--state stays
+    working as an alias across the format change).  Returns a
+    FakeCluster with no admission chain attached."""
+    import pickle
+    with open(path, "rb") as f:
+        head = f.read(1)
+        f.seek(0)
+        if head != b"{":
+            return pickle.load(f)
+        doc = json.load(io.TextIOWrapper(f, encoding="utf-8"))
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+    cluster = FakeCluster()
+    decode_stores_into(cluster, doc.get("stores", {}))
+    return cluster
+
+
+class DurableStore:
+    """Owns the WAL segments + snapshot of one state-server data dir."""
+
+    def __init__(self, data_dir: str,
+                 snapshot_every_records: int = SNAPSHOT_EVERY_RECORDS,
+                 snapshot_every_bytes: int = SNAPSHOT_EVERY_BYTES):
+        self.dir = os.path.abspath(data_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        self.snapshot_every_records = snapshot_every_records
+        self.snapshot_every_bytes = snapshot_every_bytes
+        self._lock = threading.Lock()     # file handle + counters
+        # serializes whole snapshot() sequences: the background
+        # compactor and the graceful-save path must never interleave
+        # rotate/capture/rename/delete (a slower older capture could
+        # overwrite a newer snapshot AFTER the newer call deleted the
+        # WAL segments covering the difference)
+        self._snap_lock = threading.Lock()
+        self._file: Optional[io.TextIOBase] = None
+        self._seg_seq = 0
+        self._appended = 0                # records since last fsync mark
+        self._synced_marker = 0
+        self._tail_rv = 0                 # last store-event rv appended
+        self.synced_rv = 0                # last store-event rv fsync'd
+        self.wal_records = 0              # records in live segments
+        self.wal_bytes = 0
+        self.snapshot_rv = 0
+        self.snapshot_at = 0.0            # wall time of last snapshot
+        self.last_fsync_s = 0.0
+        self.replay_records = 0
+        self.replay_seconds = 0.0
+        self.recovery: Optional[Recovery] = None
+
+    # -- boot ----------------------------------------------------------
+
+    def _segments(self) -> List[str]:
+        try:
+            names = sorted(n for n in os.listdir(self.dir)
+                           if n.startswith(WAL_PREFIX)
+                           and n.endswith(".log"))
+        except FileNotFoundError:
+            return []
+        return [os.path.join(self.dir, n) for n in names]
+
+    def _bump_epoch(self, continuous: bool) -> str:
+        """Read/advance the incarnation id.  BASE survives as long as
+        the WAL history is continuous (mirrors may delta-resync across
+        the restart); a dir with no durable state mints a fresh BASE
+        (mirrors must full re-list — their rv space is meaningless
+        here)."""
+        path = os.path.join(self.dir, EPOCH_FILE)
+        base, boot = "", 0
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            base, boot = doc.get("base", ""), int(doc.get("boot", 0))
+        except (OSError, ValueError):
+            pass
+        if not base or not continuous:
+            base = uuid.uuid4().hex[:12]
+        boot += 1
+        atomic_write_json(path, {"base": base, "boot": boot})
+        return f"{base}.{boot}"
+
+    def recover(self, event_ring: int = 100_000) -> Recovery:
+        """Snapshot + WAL-tail replay; opens a fresh live segment.
+        Returns cluster=None when the dir held no durable state (the
+        caller seeds it and writes the initial snapshot)."""
+        from volcano_tpu import metrics
+        from volcano_tpu.cache.fake_cluster import FakeCluster
+
+        t0 = time.perf_counter()
+        snap_path = os.path.join(self.dir, SNAPSHOT_FILE)
+        doc = None
+        if os.path.exists(snap_path):
+            try:
+                with open(snap_path, encoding="utf-8") as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                log.exception("snapshot %s unreadable; replaying WAL "
+                              "from scratch", snap_path)
+        segments = self._segments()
+        had_state = doc is not None or bool(segments)
+
+        cluster = None
+        rv = 0
+        leases: Dict[str, Tuple[str, float]] = {}
+        req_cache: Dict[str, Tuple[int, object]] = {}
+        if doc is not None:
+            cluster = FakeCluster()
+            decode_stores_into(cluster, doc.get("stores", {}))
+            rv = int(doc.get("rv", 0))
+            for name, rec in (doc.get("leases") or {}).items():
+                leases[name] = (rec["holder"], float(rec["expires_wall"]))
+            for rec in (doc.get("req_cache") or []):
+                req_cache[rec["id"]] = (int(rec["code"]), rec["resp"])
+        self.snapshot_rv = rv
+
+        import collections
+        tail: collections.deque = collections.deque(maxlen=event_ring)
+        replayed = 0
+        drained_cids: set = set()
+        if segments and cluster is None:
+            cluster = FakeCluster()
+        for i, seg in enumerate(segments):
+            last = i == len(segments) - 1
+            for rec in self._read_segment(seg, tolerate_tail=last):
+                kind = rec.get("k")
+                if kind == "_lease":
+                    o = rec["o"]
+                    if o.get("holder"):
+                        leases[o["name"]] = (o["holder"],
+                                             float(o["expires_wall"]))
+                    else:
+                        leases.pop(o["name"], None)
+                elif kind == "_drain":
+                    # collected, applied AFTER the loop: a drained
+                    # command's add event may appear on either side
+                    # of this record in the file (the add's journal
+                    # write races the drain's), and cid filtering is
+                    # order-independent
+                    drained_cids.update(rec["o"].get("cids") or [])
+                elif kind == "_req":
+                    o = rec["o"]
+                    req_cache[o["id"]] = (int(o["code"]), o["resp"])
+                    while len(req_cache) > REQ_CACHE:
+                        req_cache.pop(next(iter(req_cache)))
+                else:
+                    erv = int(rec.get("rv", 0))
+                    if erv <= self.snapshot_rv:
+                        continue    # rotated-then-snapshotted duplicate
+                    apply_event(cluster, kind, rec["o"])
+                    rv = max(rv, erv)
+                    tail.append((erv, kind, rec["o"]))
+                replayed += 1
+        if drained_cids:
+            cluster.commands = [
+                c for c in cluster.commands
+                if not (isinstance(c, dict)
+                        and c.get("cid") in drained_cids)]
+        # drop expired leases now so the boot doesn't resurrect stale
+        # holders (live ones rebase onto the monotonic clock upstairs)
+        now = time.time()
+        leases = {n: (h, exp) for n, (h, exp) in leases.items()
+                  if exp > now}
+
+        self.replay_records = replayed
+        self.replay_seconds = time.perf_counter() - t0
+        if had_state:
+            metrics.observe("server_replay_seconds", self.replay_seconds)
+            metrics.set_gauge("server_replay_records", replayed)
+        epoch = self._bump_epoch(continuous=had_state)
+        # everything replayed IS durable: the new incarnation's synced
+        # horizon starts at the recovered rv
+        self._tail_rv = self.synced_rv = rv
+        self._open_new_segment()
+        self.recovery = Recovery(cluster, rv, list(tail), leases,
+                                 req_cache, epoch, replayed,
+                                 self.replay_seconds)
+        return self.recovery
+
+    @staticmethod
+    def _read_segment(path: str, tolerate_tail: bool):
+        """Yield records; a torn/corrupt line ends the segment — only
+        tolerated silently on the LIVE segment's tail (crash mid-
+        append), logged loudly anywhere else (real corruption: the
+        replay still applies the consistent prefix)."""
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                for lineno, line in enumerate(f, 1):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except ValueError:
+                        if not tolerate_tail:
+                            log.error("WAL %s corrupt at line %d; "
+                                      "replay stops there", path, lineno)
+                        else:
+                            log.info("WAL %s torn tail at line %d "
+                                     "(crash mid-append); dropped",
+                                     path, lineno)
+                        return
+        except OSError:
+            log.exception("WAL segment %s unreadable", path)
+
+    def _open_new_segment(self) -> None:
+        with self._lock:
+            self._open_segment_locked()
+
+    def _open_segment_locked(self) -> None:
+        if self._file is not None:
+            self._file.close()
+        self._seg_seq += 1
+        existing = self._segments()
+        if existing:
+            last = os.path.basename(existing[-1])
+            try:
+                self._seg_seq = int(
+                    last[len(WAL_PREFIX):-len(".log")]) + 1
+            except ValueError:
+                pass
+        path = os.path.join(self.dir,
+                            f"{WAL_PREFIX}{self._seg_seq:08d}.log")
+        self._file = open(path, "a", encoding="utf-8")
+
+    # -- hot path ------------------------------------------------------
+
+    def append(self, rec: dict) -> None:
+        """Buffer one record onto the live segment (no fsync here —
+        commit() is the durability barrier the ack path calls)."""
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        with self._lock:
+            self._file.write(line)
+            self._appended += 1
+            self.wal_records += 1
+            self.wal_bytes += len(line)
+            if "rv" in rec:
+                self._tail_rv = max(self._tail_rv, rec["rv"])
+
+    def append_event(self, rv: int, kind: str, payload) -> None:
+        self.append({"rv": rv, "k": kind, "o": payload})
+
+    def commit(self) -> int:
+        """Make every appended record durable; returns the new synced
+        rv horizon.  Group commit: the fsync that one thread pays
+        covers every record appended before it, so concurrent callers
+        mostly return on the marker check without syncing again."""
+        from volcano_tpu import metrics
+        with self._lock:
+            target = self._appended
+            if self._synced_marker >= target:
+                return self.synced_rv
+            t0 = time.perf_counter()
+            self._file.flush()
+            os.fsync(self._file.fileno())
+            # marker/tail re-read under the SAME lock hold: anything
+            # appended while we blocked in fsync hit the file before
+            # this flush? no — but it will be covered by ITS caller's
+            # commit; only what was appended at flush time is synced
+            self._synced_marker = target
+            self.synced_rv = self._tail_rv
+            self.last_fsync_s = time.perf_counter() - t0
+            metrics.observe("server_wal_fsync_seconds", self.last_fsync_s)
+            return self.synced_rv
+
+    def should_snapshot(self) -> bool:
+        with self._lock:
+            return (self.wal_records >= self.snapshot_every_records or
+                    self.wal_bytes >= self.snapshot_every_bytes)
+
+    # -- compaction ----------------------------------------------------
+
+    def snapshot(self, capture: Callable[[], dict]) -> dict:
+        """Write a snapshot and compact the WAL.
+
+        Order of operations is the crash-safety argument:
+          1. rotate to a fresh segment (old ones frozen, still on disk)
+          2. capture() the store state — at a rv >= everything in the
+             frozen segments, because rotation happened first
+          3. atomic-write the snapshot
+          4. delete the frozen segments
+        A crash after any step replays to the same state: old snapshot
+        + all segments (1-3), or new snapshot + live segment with the
+        pre-capture records skipped by their rv (after 3).
+
+        The freeze (fsync old segment → rotate → reset the commit
+        markers) happens under ONE continuous lock hold: an append
+        sneaking in between the fsync and the marker reset would land
+        un-fsync'd in the frozen segment while its commit() no-ops on
+        the zeroed marker — an acked-but-volatile write, exactly what
+        this module exists to forbid."""
+        from volcano_tpu import metrics
+        with self._snap_lock:
+            t0 = time.perf_counter()
+            with self._lock:
+                self._file.flush()
+                os.fsync(self._file.fileno())
+                self.synced_rv = self._tail_rv
+                frozen = self._segments()
+                self._open_segment_locked()
+                self._appended = self._synced_marker = 0
+                self.wal_records = 0
+                self.wal_bytes = 0
+
+            doc = capture()
+            doc["format"] = SNAPSHOT_FORMAT
+            doc["saved_at"] = time.time()
+            atomic_write_json(os.path.join(self.dir, SNAPSHOT_FILE),
+                              doc)
+            with self._lock:
+                self.snapshot_rv = int(doc.get("rv", 0))
+                self.snapshot_at = doc["saved_at"]
+            for seg in frozen:
+                try:
+                    os.remove(seg)
+                except OSError:
+                    log.warning("could not remove compacted WAL %s",
+                                seg)
+            dt = time.perf_counter() - t0
+        metrics.observe("server_snapshot_seconds", dt)
+        metrics.inc("server_snapshot_total")
+        metrics.set_gauge("server_snapshot_rv", self.snapshot_rv)
+        return doc
+
+    # -- status --------------------------------------------------------
+
+    def status(self) -> dict:
+        from volcano_tpu import metrics
+        with self._lock:
+            st = {
+                "dir": self.dir,
+                "wal_records": self.wal_records,
+                "wal_bytes": self.wal_bytes,
+                "synced_rv": self.synced_rv,
+                "snapshot_rv": self.snapshot_rv,
+                "snapshot_age_s": (round(time.time() - self.snapshot_at, 3)
+                                   if self.snapshot_at else None),
+                "last_fsync_s": round(self.last_fsync_s, 6),
+                "replay_records": self.replay_records,
+                "replay_seconds": round(self.replay_seconds, 4),
+            }
+        metrics.set_gauge("server_wal_records", st["wal_records"])
+        metrics.set_gauge("server_wal_bytes", st["wal_bytes"])
+        return st
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+                os.fsync(self._file.fileno())
+                self._file.close()
+                self._file = None

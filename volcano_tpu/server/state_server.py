@@ -57,6 +57,10 @@ def _error_code(e: Exception) -> int:
 
 
 class Lease:
+    # expires is a MONOTONIC-clock deadline: a wall-clock jump (NTP
+    # step, VM resume) can neither mass-expire live leases nor
+    # immortalize a dead holder's (found while making leases durable —
+    # a wall deadline replayed after downtime did both)
     __slots__ = ("holder", "expires")
 
     def __init__(self, holder: str, expires: float):
@@ -67,23 +71,64 @@ class Lease:
 class StateServer:
     """Owns the authoritative store + event log + leases."""
 
-    def __init__(self, cluster: Optional[FakeCluster] = None):
+    def __init__(self, cluster: Optional[FakeCluster] = None,
+                 durable=None):
+        self.durable = durable                 # DurableStore or None
+        recovery = None
+        if durable is not None:
+            recovery = getattr(durable, "recovery", None)
+            if recovery is None:
+                recovery = durable.recover(event_ring=EVENT_RING)
+            if recovery.cluster is not None:
+                if cluster is not None and cluster is not recovery.cluster:
+                    log.warning("durable state in %s takes precedence "
+                                "over the seed cluster", durable.dir)
+                cluster = recovery.cluster
         if cluster is None:
             from volcano_tpu.webhooks import default_admission
             cluster = FakeCluster()
+            cluster.admission = default_admission()
+        if getattr(cluster, "admission", None) is None and \
+                recovery is not None and cluster is recovery.cluster:
+            # a WAL-recovered store has no admission chain attached
+            # (chains hold process-local callables); default unless
+            # the caller swaps in a RemoteAdmission afterwards
+            from volcano_tpu.webhooks import default_admission
             cluster.admission = default_admission()
         self.cluster = cluster
         # incarnation token: rv counters reset on restart, so clients
         # must detect a different server lifetime and re-list — an rv
         # ordering check alone misses a restarted server whose counter
-        # has already passed the client's position
+        # has already passed the client's position.  Durable boots
+        # keep the BASE and bump only the boot half ("BASE.BOOT"), so
+        # mirrors know the rv history is WAL-continuous and may
+        # delta-resync across the restart instead of re-listing.
         import uuid
-        self.epoch = uuid.uuid4().hex[:12]
+        self.epoch = recovery.epoch if recovery is not None \
+            else uuid.uuid4().hex[:12]
         self._lock = threading.Lock()          # event log + leases
         self._event_cv = threading.Condition(self._lock)
         self._events: collections.deque = collections.deque(maxlen=EVENT_RING)
         self._rv = 0
         self._leases: Dict[str, Lease] = {}
+        # idempotency keys: req id -> (code, payload) of the response
+        # already committed for that request — a client retrying a
+        # mutation whose ack was lost in a crash/partition gets the
+        # recorded verdict instead of double-applying
+        self._req_cache: "collections.OrderedDict[str, tuple]" = \
+            collections.OrderedDict()
+        if recovery is not None:
+            self._rv = recovery.rv
+            self._events.extend(recovery.events)
+            now_m, now_w = time.monotonic(), time.time()
+            for name, (holder, exp_wall) in recovery.leases.items():
+                # rebase the persisted wall expiry onto THIS boot's
+                # monotonic clock: the remaining TTL is honoured, so a
+                # restarted server refuses a second leader inside an
+                # old holder's term
+                self._leases[name] = Lease(holder,
+                                           now_m + (exp_wall - now_w))
+            self._req_cache.update(recovery.req_cache)
         # audit trail: wall-clock-stamped mutation records, the
         # apiserver-audit-log analogue the latency exporter scrapes
         # (reference third_party/kube-apiserver-audit-exporter derives
@@ -94,6 +139,11 @@ class StateServer:
         self._audit_idx = 0
         self._audit_enabled = False
         cluster.watch(self._on_store_event)
+        if durable is not None and recovery.cluster is None:
+            # first boot of this data dir (possibly seeded from a
+            # legacy --state file): the baseline must be durable
+            # BEFORE the first ack, or a crash loses the seed
+            self.write_snapshot()
 
     # -- event log -----------------------------------------------------
 
@@ -106,11 +156,82 @@ class StateServer:
         with self._event_cv:
             self._rv += 1
             self._events.append((self._rv, kind, payload))
+            if self.durable is not None:
+                # journal under the same lock that assigned the rv so
+                # WAL order == rv order; fsync happens in commit(),
+                # on the ack path
+                self.durable.append_event(self._rv, kind, payload)
             if self._audit_enabled:
                 self._audit_idx += 1
                 self._audit.append(self._audit_record(
                     self._audit_idx, kind, obj))
             self._event_cv.notify_all()
+
+    # -- durability ----------------------------------------------------
+
+    def _visible_rv(self) -> int:
+        """Events are released to watchers/snapshots only once their
+        WAL records are fsync'd: a mirror can then never hold an event
+        a crash un-happens, which is what makes a delta resync across
+        a restart exact (docs/design/durability.md)."""
+        if self.durable is None:
+            return self._rv
+        return min(self._rv, self.durable.synced_rv)
+
+    def commit(self) -> None:
+        """Durability barrier before an ack: fsync everything appended
+        so far (group commit — one fsync covers concurrent handlers),
+        then wake watchers gated on the synced horizon."""
+        if self.durable is None:
+            return
+        self.durable.commit()
+        with self._event_cv:
+            self._event_cv.notify_all()
+
+    def disk_snapshot_doc(self) -> dict:
+        """The on-disk snapshot: /snapshot payload + leases (wall-
+        rebased) + the idempotency-key cache, so compaction of the WAL
+        never drops what only the WAL knew."""
+        doc = self.snapshot_payload()
+        now_m, now_w = time.monotonic(), time.time()
+        with self._lock:
+            doc["leases"] = {
+                n: {"holder": l.holder,
+                    "expires_wall": now_w + (l.expires - now_m)}
+                for n, l in self._leases.items() if l.expires > now_m}
+            doc["req_cache"] = [
+                {"id": i, "code": c, "resp": r}
+                for i, (c, r) in self._req_cache.items()]
+        return doc
+
+    def write_snapshot(self) -> None:
+        if self.durable is not None:
+            self.durable.snapshot(self.disk_snapshot_doc)
+
+    def replay_response(self, req_id: str):
+        with self._lock:
+            hit = self._req_cache.get(req_id)
+            if hit is not None:
+                self._req_cache.move_to_end(req_id)
+            return hit
+
+    def record_response(self, req_id: str, code: int, payload) -> None:
+        from volcano_tpu.server.durability import REQ_CACHE
+        with self._lock:
+            self._req_cache[req_id] = (code, payload)
+            while len(self._req_cache) > REQ_CACHE:
+                self._req_cache.popitem(last=False)
+        if self.durable is not None:
+            self.durable.append({"k": "_req", "o": {
+                "id": req_id, "code": code, "resp": payload}})
+
+    def durability_status(self) -> dict:
+        out = {"enabled": self.durable is not None,
+               "epoch": self.epoch, "rv": self._rv,
+               "visible_rv": self._visible_rv()}
+        if self.durable is not None:
+            out.update(self.durable.status())
+        return out
 
     @staticmethod
     def _audit_record(idx: int, kind: str, obj) -> dict:
@@ -138,6 +259,12 @@ class StateServer:
         stall mutations for a 200k-record copy."""
         with self._event_cv:
             self._audit_enabled = True
+            if since > self._audit_idx:
+                # client ahead of the server: the audit index restarted
+                # (the trail is in-memory; a crash resets it) — signal
+                # lost so the exporter re-anchors instead of paging
+                # into a void forever
+                return self._audit_idx, [], True
             if not self._audit:
                 return self._audit_idx, [], False
             first = self._audit[0]["i"]
@@ -153,27 +280,43 @@ class StateServer:
             return idx, records, lost
 
     def events_since(self, since: int, timeout: float = 25.0):
-        """(rv, events, resync) — blocks up to timeout for news."""
+        """(rv, events, resync) — blocks up to timeout for news.
+
+        Only DURABLE events are released (_visible_rv): an event whose
+        WAL record is not yet fsync'd stays invisible, so no mirror
+        can ever hold state a crash would un-happen.  commit() wakes
+        the waiters once the horizon advances."""
         deadline = time.monotonic() + timeout
         with self._event_cv:
             while True:
+                if since > self._rv:
+                    # the client is AHEAD of us: its revision came
+                    # from another incarnation (a restart that did
+                    # not keep this history) — tell it to resync NOW
+                    # instead of letting the long-poll run out first
+                    return self._visible_rv(), [], True
                 if self._events and self._events[0][0] > since + 1:
                     # client fell off the ring: it must re-list
-                    return self._rv, [], True
-                if self._rv > since and self._events:
+                    return self._visible_rv(), [], True
+                vis = self._visible_rv()
+                if vis > since and self._events:
                     # rvs are contiguous: the suffix starts at a known
                     # offset — never scan the whole (up to 100k) ring
                     start = since - self._events[0][0] + 1
-                    news = list(itertools.islice(
-                        self._events, max(0, start), None))
-                    return self._rv, news, False
+                    news = [e for e in itertools.islice(
+                        self._events, max(0, start), None)
+                        if e[0] <= vis]
+                    if news:
+                        return vis, news, False
                 remain = deadline - time.monotonic()
                 if remain <= 0:
-                    return self._rv, [], False
+                    return vis, [], False
                 self._event_cv.wait(remain)
 
     def snapshot_payload(self) -> dict:
-        """Full store dump + current rv (client list+watch bootstrap)."""
+        """Full store dump + current rv (client list+watch bootstrap).
+        The /snapshot route commits BEFORE serving this, so the state
+        a mirror bootstraps from is always durable."""
         with self._event_cv:
             rv = self._rv
             stores = {}
@@ -188,21 +331,37 @@ class StateServer:
 
     # -- leases (leader election) --------------------------------------
 
+    def _wal_lease(self, name: str, holder: str,
+                   expires_wall: float) -> None:
+        """Journal a lease transition (holder "" = release).  Wall
+        expiry on the wire/disk, rebased to the monotonic clock at
+        boot: a restarted server honours the remaining TTL and cannot
+        elect a second leader inside an old holder's term."""
+        if self.durable is not None:
+            self.durable.append({"k": "_lease", "o": {
+                "name": name, "holder": holder,
+                "expires_wall": expires_wall}})
+
     def lease(self, name: str, holder: str, ttl: float,
               release: bool = False) -> dict:
-        now = time.time()
+        now = time.monotonic()
         with self._lock:
             cur = self._leases.get(name)
             if release:
                 if cur and cur.holder == holder:
                     del self._leases[name]
-                return {"acquired": False, "holder": "", "expires": 0}
+                    self._wal_lease(name, "", 0.0)
+                return {"acquired": False, "holder": "", "expires": 0,
+                        "expires_in": 0}
             if cur is None or cur.expires < now or cur.holder == holder:
                 self._leases[name] = Lease(holder, now + ttl)
+                self._wal_lease(name, holder, time.time() + ttl)
                 return {"acquired": True, "holder": holder,
-                        "expires": now + ttl}
+                        "expires": time.time() + ttl,
+                        "expires_in": round(ttl, 3)}
             return {"acquired": False, "holder": cur.holder,
-                    "expires": cur.expires}
+                    "expires": time.time() + (cur.expires - now),
+                    "expires_in": round(cur.expires - now, 3)}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -252,9 +411,16 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._authorized():
             return None
         if url.path == "/snapshot":
-            return self._json(200, st.snapshot_payload())
+            payload = st.snapshot_payload()
+            # fsync-before-serve: the captured state embeds events up
+            # to payload["rv"]; committing them first means no mirror
+            # ever bootstraps from state a crash could un-happen
+            st.commit()
+            return self._json(200, payload)
+        if url.path == "/durability":
+            return self._json(200, st.durability_status())
         if url.path == "/leases":
-            now = time.time()
+            now = time.monotonic()
             with st._lock:
                 return self._json(200, {
                     name: {"holder": l.holder,
@@ -303,88 +469,128 @@ class _Handler(BaseHTTPRequestHandler):
             return None
         url = urlparse(self.path)
         st = self.state
-        cl = st.cluster
         try:
             body = self._body()
         except (ValueError, json.JSONDecodeError) as e:
             return self._json(400, {"error": str(e)})
+        # idempotency key: a retried mutation whose first attempt
+        # committed (crash/partition between commit and ack) must get
+        # the recorded verdict back, never double-apply — the replay-
+        # safe half of the client's retry policy.  The cache itself is
+        # journaled (_req WAL records + snapshots), so it survives the
+        # very crash it exists for.
+        req_id = body.pop("_req_id", None) if isinstance(body, dict) \
+            else None
+        if req_id:
+            hit = st.replay_response(req_id)
+            if hit is not None:
+                return self._json(hit[0], hit[1])
         try:
-            if url.path.startswith("/objects/"):
-                kind = url.path[len("/objects/"):]
-                if kind not in KINDS:
-                    return self._json(404, {"error": f"unknown kind {kind}"})
-                obj = codec.decode(body["obj"])
-                key = body.get("key")
-                stored = cl.put_object(kind, obj, key=key)
-                return self._json(200, {"obj": codec.encode(stored)})
-            if url.path == "/bind":
-                cl.bind_pod(body["namespace"], body["name"],
-                            body["node_name"])
-                return self._json(200, {"ok": True})
-            if url.path == "/bind_batch":
-                # a gang's binds as ONE request (the wire fast lane's
-                # biggest round-trip saving: 256 POSTs -> 1).  Failure
-                # stays per-item — same verdict the per-pod route
-                # would have returned, so a conflict on one pod never
-                # vetoes its gang-mates
-                results = []
-                bound = 0
-                for b in body.get("binds", []):
-                    try:
-                        cl.bind_pod(b["namespace"], b["name"],
-                                    b["node_name"])
-                        results.append({"ok": True})
-                        bound += 1
-                    except Exception as e:  # noqa: BLE001 — per-item
-                        results.append({
-                            "ok": False, "code": _error_code(e),
-                            "error": str(e) or type(e).__name__})
-                return self._json(200, {"bound": bound,
-                                        "results": results})
-            if url.path == "/evict":
-                cl.evict_pod(body["namespace"], body["name"],
-                             body.get("reason", ""))
-                return self._json(200, {"ok": True})
-            if url.path == "/nominate":
-                cl.nominate_pod(body["namespace"], body["name"],
-                                body["node_name"])
-                return self._json(200, {"ok": True})
-            if url.path == "/podgroup_status":
-                cl.update_podgroup_status(codec.decode(body["obj"]))
-                return self._json(200, {"ok": True})
-            if url.path == "/record_event":
-                cl.record_event(body["obj_key"], body["reason"],
-                                body.get("message", ""))
-                return self._json(200, {"ok": True})
-            if url.path == "/command":
-                cl.add_command(body["target"], body["action"])
-                return self._json(200, {"ok": True})
-            if url.path == "/drain_commands":
-                cmds = cl.drain_commands(body["target"])
-                return self._json(200, {"commands": cmds})
-            if url.path == "/lease":
-                return self._json(200, st.lease(
-                    body["name"], body["holder"],
-                    float(body.get("ttl", 15.0)),
-                    release=bool(body.get("release"))))
-            if url.path == "/tick":
-                cl.tick()
-                return self._json(200, {"ok": True})
-            if url.path == "/complete_pod":
-                cl.complete_pod(body["key"],
-                                succeeded=bool(body.get("succeeded", True)),
-                                exit_code=body.get("exit_code"))
-                return self._json(200, {"ok": True})
-            return self._json(404, {"error": f"no route {url.path}"})
+            code, payload = self._route_post(url.path, body, st)
         except KeyError as e:
-            return self._json(404, {"error": str(e)})
+            code, payload = 404, {"error": str(e)}
         except ValueError as e:
             # discriminate by TYPE, never message wording (see
             # _error_code): admission veto 422, conflict 409
-            return self._json(_error_code(e), {"error": str(e)})
+            code, payload = _error_code(e), {"error": str(e)}
         except Exception as e:  # noqa: BLE001 — surface, don't kill thread
             log.exception("POST %s failed", url.path)
-            return self._json(500, {"error": str(e)})
+            code, payload = 500, {"error": str(e)}
+        if req_id and code < 500:
+            # 4xx verdicts are deterministic state-compare outcomes:
+            # recording them keeps a retry's answer stable; 5xx is a
+            # server fault the retry should re-attempt for real
+            st.record_response(req_id, code, payload)
+        # durability barrier BEFORE the ack: every event this request
+        # caused (and its idempotency record) is fsync'd in the WAL —
+        # the journals-before-acking contract the reference gets from
+        # etcd
+        st.commit()
+        return self._json(code, payload)
+
+    def _route_post(self, path: str, body: dict, st) -> tuple:
+        cl = st.cluster
+        if path.startswith("/objects/"):
+            kind = path[len("/objects/"):]
+            if kind not in KINDS:
+                return 404, {"error": f"unknown kind {kind}"}
+            obj = codec.decode(body["obj"])
+            key = body.get("key")
+            stored = cl.put_object(kind, obj, key=key)
+            return 200, {"obj": codec.encode(stored)}
+        if path == "/bind":
+            cl.bind_pod(body["namespace"], body["name"],
+                        body["node_name"])
+            return 200, {"ok": True}
+        if path == "/bind_batch":
+            # a gang's binds as ONE request (the wire fast lane's
+            # biggest round-trip saving: 256 POSTs -> 1).  Failure
+            # stays per-item — same verdict the per-pod route
+            # would have returned, so a conflict on one pod never
+            # vetoes its gang-mates.  Per-item state-compare keeps a
+            # whole-batch retry replay-safe: a pod the first attempt
+            # already bound re-verdicts as success (same node), not
+            # 409.
+            results = []
+            bound = 0
+            for b in body.get("binds", []):
+                try:
+                    cl.bind_pod(b["namespace"], b["name"],
+                                b["node_name"])
+                    results.append({"ok": True})
+                    bound += 1
+                except Exception as e:  # noqa: BLE001 — per-item
+                    results.append({
+                        "ok": False, "code": _error_code(e),
+                        "error": str(e) or type(e).__name__})
+            return 200, {"bound": bound, "results": results}
+        if path == "/evict":
+            cl.evict_pod(body["namespace"], body["name"],
+                         body.get("reason", ""))
+            return 200, {"ok": True}
+        if path == "/nominate":
+            cl.nominate_pod(body["namespace"], body["name"],
+                            body["node_name"])
+            return 200, {"ok": True}
+        if path == "/podgroup_status":
+            cl.update_podgroup_status(codec.decode(body["obj"]))
+            return 200, {"ok": True}
+        if path == "/record_event":
+            cl.record_event(body["obj_key"], body["reason"],
+                            body.get("message", ""))
+            return 200, {"ok": True}
+        if path == "/command":
+            cl.add_command(body["target"], body["action"])
+            return 200, {"ok": True}
+        if path == "/drain_commands":
+            cmds = cl.drain_commands(body["target"])
+            if cmds and st.durable is not None:
+                # drains don't flow through the event log (commands
+                # are consumed, not updated) — journal them directly
+                # or a replayed WAL would resurrect consumed commands.
+                # Journaled by cid: a concurrent add_command's event
+                # record can land on either side of this one in the
+                # file, so replay removes the exact consumed set
+                # regardless of record order
+                st.durable.append({"k": "_drain", "o": {
+                    "target": body["target"],
+                    "cids": [c.get("cid") for c in cmds
+                             if isinstance(c, dict) and c.get("cid")]}})
+            return 200, {"commands": cmds}
+        if path == "/lease":
+            return 200, st.lease(
+                body["name"], body["holder"],
+                float(body.get("ttl", 15.0)),
+                release=bool(body.get("release")))
+        if path == "/tick":
+            cl.tick()
+            return 200, {"ok": True}
+        if path == "/complete_pod":
+            cl.complete_pod(body["key"],
+                            succeeded=bool(body.get("succeeded", True)),
+                            exit_code=body.get("exit_code"))
+            return 200, {"ok": True}
+        return 404, {"error": f"no route {path}"}
 
     # -- DELETE --------------------------------------------------------
 
@@ -401,20 +607,27 @@ class _Handler(BaseHTTPRequestHandler):
         if not key:
             return self._json(400, {"error": "missing key"})
         self.state.cluster.delete_object(kind, key)
+        self.state.commit()
         return self._json(200, {"ok": True})
 
 
 def serve(port: int = 0, cluster: Optional[FakeCluster] = None,
           tick_period: float = 0.0, tls_cert: str = "",
-          tls_key: str = "", token: str = ""
-          ) -> Tuple[ThreadingHTTPServer, StateServer]:
+          tls_key: str = "", token: str = "", data_dir: str = "",
+          durable=None) -> Tuple[ThreadingHTTPServer, StateServer]:
     """Start the server on 127.0.0.1:port (0 = ephemeral); returns
     (http_server, state).  Caller runs http_server.serve_forever()
     or uses the background thread started here.  tls_cert/tls_key
     make the listener TLS-only; token guards every route except
-    /healthz and /metrics."""
+    /healthz and /metrics.  data_dir (or a pre-built DurableStore via
+    durable=) turns on the WAL + snapshot crash-safety layer: every
+    mutation is journaled and fsync'd before its ack, and boot replays
+    snapshot-then-WAL."""
     from volcano_tpu.server.httputil import serve_threaded
-    state = StateServer(cluster)
+    if durable is None and data_dir:
+        from volcano_tpu.server.durability import DurableStore
+        durable = DurableStore(data_dir)
+    state = StateServer(cluster, durable=durable)
     httpd = serve_threaded(_Handler, {"state": state, "token": token},
                            port, "state-server",
                            tls_cert=tls_cert, tls_key=tls_key)
@@ -424,8 +637,22 @@ def serve(port: int = 0, cluster: Optional[FakeCluster] = None,
             while not state.tick_stop.wait(tick_period):
                 try:
                     state.cluster.tick()
+                    # tick mutations have no ack path; commit here so
+                    # they become watch-visible (and durable) promptly
+                    state.commit()
                 except Exception:  # noqa: BLE001
                     log.exception("tick failed")
         threading.Thread(target=tick_loop, name="kubelet-tick",
+                         daemon=True).start()
+    if durable is not None:
+        def compact_loop():
+            while not state.tick_stop.wait(0.5):
+                try:
+                    durable.status()    # refreshes the WAL gauges
+                    if durable.should_snapshot():
+                        state.write_snapshot()
+                except Exception:  # noqa: BLE001
+                    log.exception("snapshot compaction failed")
+        threading.Thread(target=compact_loop, name="wal-compactor",
                          daemon=True).start()
     return httpd, state
