@@ -1484,6 +1484,171 @@ def crash_smoke() -> int:
     return 0 if ok else 1
 
 
+# ---------------------------------------------------------------------
+# Scheduling flight recorder: per-phase latency attribution through
+# the REAL process control plane (volcano_tpu/trace.py).  Gang jobs
+# run create->running over the wire; every lifecycle stamp is read
+# back from the stamped pod/podgroup annotations, decomposed into
+# phase segments, and reconciled against the measured end-to-end
+# latency (the telescoping invariant: segments must sum to the total
+# within 5%).  The server's /traces ring proves session span trees
+# flow through the same wire.  Committed as TRACE_r{N}.json.
+
+def bench_trace(smoke: bool = False) -> dict:
+    from volcano_tpu import trace as trace_mod
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.simulator import slice_nodes
+
+    n_slices = 1 if smoke else 16           # 16 x v5e-256 = 1024 hosts
+    slice_kind = "v5e-16" if smoke else "v5e-256"
+    gang = 4 if smoke else 64
+    trials = 1 if smoke else 5
+
+    plane = _WirePlane()
+    kubectl = None
+    try:
+        plane.start()
+        kubectl = RemoteCluster(plane.url)
+        for i in range(n_slices):
+            for node in slice_nodes(slice_for(f"t{i:02d}", slice_kind),
+                                    dcn_pod=f"dcn-{i % 4}"):
+                kubectl.add_node(node)
+        hosts = len(kubectl.nodes)
+
+        gangs = []
+        for t in range(trials):
+            name = f"tracegang-{t}"
+            kubectl.add_vcjob(_wire_gang_job(name, gang, run_ticks=2))
+            _wire_wait(lambda: _job_running(kubectl, name, gang), 90,
+                       lambda: f"{name} bound "
+                               f"({plane.log_tails()[-800:]})")
+            # completion frees the slice: identical capacity per trial
+            _wire_wait(lambda: _job_completed(kubectl, name), 90,
+                       f"{name} completed")
+            gangs.append(name)
+        # let the final watch events land, then read stamps from a
+        # fresh resync of the mirror
+        time.sleep(0.3)
+        kubectl.resync()
+
+        seg_samples = {seg: [] for seg, _f, _t in trace_mod.SEGMENTS}
+        pod_e2es, reconcile_errs = [], []
+        gang_rows = []
+        for name in gangs:
+            pg = kubectl.podgroups.get(f"default/{name}")
+            pg_ann = pg.annotations if pg is not None else None
+            pods = [p for p in kubectl.pods.values()
+                    if p.labels.get("volcano-tpu.io/job-name") == name]
+            assert len(pods) >= gang, \
+                f"{name}: {len(pods)} pods visible"
+            stamps = {ph: [] for ph in trace_mod.PHASES}
+            for p in pods:
+                segs = trace_mod.phase_segments(p.annotations, pg_ann)
+                created = trace_mod.phase_ts(p.annotations, "created")
+                running = trace_mod.phase_ts(p.annotations, "running")
+                assert created is not None and running is not None, \
+                    f"{p.key} missing lifecycle stamps"
+                e2e = running - created
+                pod_e2es.append(e2e)
+                if e2e > 1e-9:
+                    # the reconciliation invariant, per pod: clamped
+                    # segments must telescope back to the total
+                    reconcile_errs.append(
+                        abs(sum(segs.values()) - e2e) / e2e * 100.0)
+                for seg, dur in segs.items():
+                    seg_samples[seg].append(dur)
+                for ph in trace_mod.PHASES:
+                    ts = trace_mod.phase_ts(p.annotations, ph)
+                    if ts is None and pg_ann is not None:
+                        ts = trace_mod.phase_ts(pg_ann, ph)
+                    if ts is not None:
+                        stamps[ph].append(ts)
+            # gang-level breakdown from edge stamps: created = first
+            # pod created, every later phase = LAST pod through it, so
+            # the segments telescope to the measured gang e2e
+            edges = {}
+            for ph in trace_mod.PHASES:
+                if not stamps[ph]:
+                    continue
+                edges[ph] = (min(stamps[ph]) if ph == "created"
+                             else max(stamps[ph]))
+            gang_e2e = edges["running"] - edges["created"]
+            gsegs, prev = {}, edges["created"]
+            for seg, _f, to in trace_mod.SEGMENTS:
+                if to not in edges:
+                    continue
+                gsegs[seg] = round(max(0.0, edges[to] - prev), 4)
+                prev = max(prev, edges[to])
+            gang_rows.append({"job": name,
+                              "gang_e2e_s": round(gang_e2e, 4),
+                              "segments_s": gsegs,
+                              "reconcile_err_pct": round(
+                                  abs(sum(gsegs.values()) - gang_e2e)
+                                  / max(gang_e2e, 1e-9) * 100.0, 3)})
+
+        # the flight recorder's query surface, through the same wire
+        traces = kubectl._request(
+            "GET", "/traces?limit=64").get("traces", [])
+        span_actions = {}
+        for t in traces:
+            for child in (t.get("root") or {}).get("children", ()):
+                if child.get("kind") == "action":
+                    span_actions.setdefault(child["name"], []).append(
+                        child.get("dur", 0.0))
+
+        def pct(vals, q):
+            vals = sorted(vals)
+            return round(vals[min(len(vals) - 1,
+                                  int(q * len(vals)))], 4) \
+                if vals else None
+
+        return {
+            "hosts": hosts, "gang_replicas": gang, "trials": trials,
+            "pods_measured": len(pod_e2es),
+            "pod_e2e_p50_s": pct(pod_e2es, 0.5),
+            "pod_e2e_p95_s": pct(pod_e2es, 0.95),
+            "phase_p50_s": {seg: pct(vals, 0.5)
+                            for seg, vals in seg_samples.items()},
+            "phase_p95_s": {seg: pct(vals, 0.95)
+                            for seg, vals in seg_samples.items()},
+            "gangs": gang_rows,
+            "gang_e2e_p50_s": pct(
+                [g["gang_e2e_s"] for g in gang_rows], 0.5),
+            "reconcile_err_max_pct": round(max(
+                [g["reconcile_err_pct"] for g in gang_rows]
+                + reconcile_errs), 3),
+            "traces_captured": len(traces),
+            "trace_span_p50_s": {name: pct(vals, 0.5)
+                                 for name, vals in
+                                 sorted(span_actions.items())},
+        }
+    finally:
+        if kubectl is not None:
+            kubectl.close()
+        plane.shutdown()
+
+
+def trace_smoke() -> int:
+    """Seconds-scale flight-recorder drill for tier-1 (small cluster,
+    one gang), mirroring --wire-smoke/--crash-smoke: lifecycle stamps
+    present on every gang pod, phase segments reconcile with the
+    measured e2e within 5%, and session span trees reach the server's
+    /traces ring.  Prints one JSON line."""
+    try:
+        out = bench_trace(smoke=True)
+        ok = (out["pods_measured"] >= out["gang_replicas"]
+              and out["reconcile_err_max_pct"] is not None
+              and out["reconcile_err_max_pct"] < 5.0
+              and out["traces_captured"] > 0
+              and out["pod_e2e_p50_s"] is not None
+              and out["pod_e2e_p50_s"] > 0)
+    except AssertionError as e:
+        out, ok = {"error": str(e)[-600:]}, False
+    print(json.dumps({"metric": "trace_smoke", "ok": ok, **out}))
+    return 0 if ok else 1
+
+
 def _flash_child():
     """Runs in a SUBPROCESS on the real TPU (the axon tunnel hangs at
     backend init when dead — the parent enforces the timeout): time the
@@ -1969,6 +2134,14 @@ if __name__ == "__main__":
         sys.exit(failover_smoke())
     elif "--crash-smoke" in sys.argv:
         sys.exit(crash_smoke())
+    elif "--trace-smoke" in sys.argv:
+        sys.exit(trace_smoke())
+    elif "--trace" in sys.argv:
+        # the standalone flight-recorder row committed as
+        # TRACE_r{N}.json: 1k-host wire run, per-phase p50/p95 whose
+        # segment sums reconcile with the measured gang e2e latency
+        print(json.dumps({"metric": "trace_phase_breakdown_1k_hosts",
+                          **bench_trace()}))
     elif "--crash" in sys.argv:
         # the standalone kill -9 durability row committed as
         # CRASH_r{N}.json: bind burst in flight, SIGKILL the state

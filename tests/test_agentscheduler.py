@@ -407,9 +407,10 @@ def test_agent_batched_bind_conflict_rolls_back_reservation():
     cluster.add_pod(agent_pod("c0", cpu="2"))
     placed = sched._place_one()
     assert placed is not None
-    pod, task, node, attempt, t0 = placed
+    pod, task, node, attempt, t0, ts_alloc = placed
     used_before = node.used.clone()
-    sched._commit_bind(pod, task, node, attempt, t0, "bind conflict")
+    sched._commit_bind(pod, task, node, attempt, t0, ts_alloc,
+                       "bind conflict")
     assert node.used.res.get("cpu", 0) < used_before.res.get("cpu", 0)
     # requeued urgent: the next drain (per-pod lane) binds it
     assert sched.run_until_drained() == 1

@@ -1,0 +1,546 @@
+"""Scheduling flight recorder (volcano_tpu/trace.py) + satellites.
+
+Covers: span trees through real scheduler sessions, lifecycle phase
+stamps and their telescoping reconciliation, unschedulable-reason
+normalization + podgroup aggregation, `vtpctl explain` end-to-end
+through a REAL HTTP state server (the acceptance e2e), the server's
+/traces ring, the dumper's trace section, metrics label escaping and
+summary-window monotonicity (strict Prometheus text-parser round
+trip), and `bench.py --trace-smoke` as a tier-1 guard.
+"""
+
+import json
+import math
+import os
+import re
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from volcano_tpu import metrics, trace
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.simulator import make_tpu_cluster
+from volcano_tpu.uthelper import gang_job
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_registries():
+    metrics.reset()
+    trace.reset()
+    yield
+    metrics.reset()
+    trace.reset()
+
+
+# -- reason normalization ----------------------------------------------
+
+def test_normalize_reason_bounded_enum():
+    cases = {
+        "node's slice is quarantined after failure": "quarantined",
+        "node(s) didn't match Pod's node selector":
+            "node-affinity-mismatch",
+        "node(s) had untolerated taint {dedicated: infra}":
+            "taint-not-tolerated",
+        "node is not ready": "node-not-ready",
+        "Insufficient cpu, google.com/tpu": "insufficient-resources",
+        "not enough free TPU chips": "tpu-shape-mismatch",
+        "no hypernode domain within tier 1 can hold job default/x":
+            "ici-shape-mismatch",
+        "node(s) didn't have free ports": "port-conflict",
+        "node(s) had too many pods": "pod-limit",
+        "task would exceed queue q's deserved share":
+            "queue-share-exceeded",
+        "pod has unresolved scheduling gates ['g']":
+            "scheduling-gated",
+        "some totally novel failure text": "other",
+    }
+    for text, want in cases.items():
+        assert trace.normalize_reason(text) == want, text
+    # every output is a member of the bounded enum — the metric-label
+    # cardinality contract
+    for text in cases:
+        assert trace.normalize_reason(text) in trace.REASON_ENUM
+
+
+def test_phase_segments_reconcile_and_clamp():
+    t0 = 1000.0
+    pod = {}
+    pg = {}
+    trace.stamp_phase(pod, "created", t0)
+    trace.stamp_phase(pg, "enqueued", t0 + 1.0)
+    trace.stamp_phase(pod, "allocated", t0 + 1.5)
+    trace.stamp_phase(pod, "bound", t0 + 1.6)
+    trace.stamp_phase(pod, "admitted", t0 + 2.0)
+    trace.stamp_phase(pod, "running", t0 + 2.25)
+    segs = trace.phase_segments(pod, pg)
+    assert segs == {"queue": 1.0, "schedule": 0.5, "bind": pytest.approx(0.1),
+                    "admit": pytest.approx(0.4),
+                    "start": pytest.approx(0.25)}
+    # telescoping invariant: segments sum to running - created
+    assert math.isclose(sum(segs.values()), 2.25)
+
+    # stamps are first-writer-wins (a retried create can't move them)
+    trace.stamp_phase(pod, "created", t0 + 99)
+    assert trace.phase_ts(pod, "created") == t0
+
+    # a missing middle stamp folds its gap into the next segment and
+    # the sum still telescopes
+    pod2 = {}
+    trace.stamp_phase(pod2, "created", t0)
+    trace.stamp_phase(pod2, "bound", t0 + 2.0)
+    trace.stamp_phase(pod2, "admitted", t0 + 2.5)
+    trace.stamp_phase(pod2, "running", t0 + 3.0)
+    segs2 = trace.phase_segments(pod2, None)
+    assert math.isclose(sum(segs2.values()), 3.0)
+
+    # clock skew: an allocated stamp BEHIND created clamps to 0 and
+    # pushes the skew forward — the sum is preserved, never negative
+    pod3 = {}
+    trace.stamp_phase(pod3, "created", t0)
+    trace.stamp_phase(pod3, "allocated", t0 - 0.5)
+    trace.stamp_phase(pod3, "bound", t0 + 1.0)
+    trace.stamp_phase(pod3, "running", t0 + 1.5)
+    segs3 = trace.phase_segments(pod3, None)
+    assert all(v >= 0 for v in segs3.values())
+    assert math.isclose(sum(segs3.values()), 1.5)
+
+
+# -- span model --------------------------------------------------------
+
+def test_span_tree_sampling_and_export():
+    # sessions with unschedulable jobs are ALWAYS kept
+    root = trace.begin_session(cycle=0)
+    with trace.span("allocate", kind="action"):
+        with trace.span("default/j1", kind="job", job="default/j1"):
+            trace.add_plugin_time("predicate", "predicates", 0.002)
+            trace.add_plugin_time("predicate", "predicates", 0.003)
+            trace.add_plugin_time("nodeOrder", "binpack", 0.001)
+    trace.note_pending("default/j1", {"quarantined": 3},
+                       {"quarantined": "node's slice is quarantined"})
+    doc = trace.end_session(root, jobs_pending=["default/j1"])
+    assert doc is not None and doc["kept_because"] == "unschedulable"
+    action = doc["root"]["children"][0]
+    assert action["name"] == "allocate" and action["kind"] == "action"
+    jobspan = action["children"][0]
+    assert jobspan["labels"]["job"] == "default/j1"
+    agg = {c["name"]: c for c in jobspan["children"]}
+    assert agg["predicates"]["labels"] == {"point": "predicate",
+                                           "calls": "2"}
+    assert agg["predicates"]["dur"] == pytest.approx(0.005)
+    assert trace.matches_job(doc, "default/j1")
+    assert not trace.matches_job(doc, "default/other")
+
+    # outside a session, span() and add_plugin_time are no-ops
+    with trace.span("orphan") as s:
+        assert s is None
+    trace.add_plugin_time("predicate", "predicates", 1.0)
+
+    # quiet sessions are 1-in-SAMPLE_EVERY sampled (seq 1 kept above;
+    # the next SAMPLE_EVERY-1 quiet ones drop, then one keeps)
+    trace.clear_pending("default/j1")
+    kept = 0
+    for _ in range(trace.SAMPLE_EVERY):
+        r = trace.begin_session(cycle=1)
+        kept += trace.end_session(r) is not None
+    assert kept == 1
+    assert len(trace.recent_traces()) == 2
+    assert trace.recent_traces(job="default/j1")[0]["seq"] == doc["seq"]
+
+    # renderers work off the kept doc
+    lines = trace.render_waterfall(doc["root"])
+    assert any("allocate" in ln for ln in lines)
+    chrome = trace.to_chrome_trace([doc])
+    names = {e["name"] for e in chrome["traceEvents"]}
+    assert {"session", "allocate", "predicates"} <= names
+    for e in chrome["traceEvents"]:
+        if e.get("ph") == "X":
+            assert e["dur"] >= 0 and e["ts"] > 0
+
+    # a crash mid-span: end_session closes the dangling spans
+    root = trace.begin_session(cycle=2)
+    trace.span("allocate", kind="action").__enter__()
+    trace.end_session(root)
+    assert root.end is not None
+    assert all(c.end is not None for c in root.children)
+
+
+def test_span_child_cap():
+    root = trace.begin_session(cycle=0)
+    with trace.span("allocate", kind="action") as action:
+        for i in range(trace.MAX_CHILDREN + 10):
+            with trace.span(f"default/j{i}", kind="job"):
+                pass
+    assert len(action.children) == trace.MAX_CHILDREN
+    assert action.dropped == 10
+    trace.end_session(root)
+
+
+# -- scheduler integration (in-process) --------------------------------
+
+def _gang_cluster(stuck_selector=False):
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    pg, pods = gang_job("demo", replicas=2, requests={"cpu": 1})
+    cluster.add_podgroup(pg)
+    for p in pods:
+        cluster.add_pod(p)
+    if stuck_selector:
+        pg2, pods2 = gang_job("stuck", replicas=2,
+                              requests={"cpu": 1})
+        for p in pods2:
+            p.node_selector = {"zone": "nowhere"}
+        cluster.add_podgroup(pg2)
+        for p in pods2:
+            cluster.add_pod(p)
+    return cluster
+
+
+def test_session_trace_via_scheduler():
+    cluster = _gang_cluster(stuck_selector=True)
+    sched = Scheduler(cluster, schedule_period=0)
+    sched.run_once()
+    traces = trace.recent_traces()
+    assert traces, "session with an unschedulable gang must be kept"
+    root = traces[-1]["root"]
+    actions = [c["name"] for c in root["children"]
+               if c["kind"] == "action"]
+    assert "allocate" in actions and "open_session" in actions
+    alloc = next(c for c in root["children"]
+                 if c["name"] == "allocate")
+    jobs = [c["labels"]["job"] for c in alloc["children"]
+            if c["kind"] == "job"]
+    assert "default/stuck" in jobs
+    # plugin aggregates landed somewhere under the tree, with call
+    # counts — never one span per callback
+    flat = []
+
+    def walk(d):
+        flat.append(d)
+        for c in d.get("children", ()):
+            walk(c)
+    walk(root)
+    plugin_spans = [d for d in flat if d["kind"] == "plugin"
+                    and "calls" in d.get("labels", {})]
+    assert plugin_spans
+    # and sched_span_seconds is live with BOUNDED labels
+    dumped = metrics.dump()
+    assert 'sched_span_seconds_count{action="allocate"}' in dumped
+    assert re.search(r'sched_span_seconds_count\{plugin=', dumped)
+    # job keys never label the TRACE families (cardinality rule:
+    # span/phase/reason labels are bounded enums; job_share et al.
+    # are per-object gauges with their own deletion lifecycle)
+    for line in dumped.splitlines():
+        if line.startswith(("sched_span_", "sched_phase_",
+                            "sched_unschedulable_",
+                            "sched_traces_")):
+            assert "default/stuck" not in line, line
+
+
+def test_pending_reasons_published_and_cleared():
+    cluster = _gang_cluster(stuck_selector=True)
+    sched = Scheduler(cluster, schedule_period=0)
+    sched.run_once()
+    pg = cluster.podgroups["default/stuck"]
+    doc = trace.parse_annotation(
+        pg.annotations[trace.PENDING_REASONS_ANNOTATION])
+    assert doc["top"] == "node-affinity-mismatch"
+    # distinct-NODE count: all 4 hosts of the v5e-16 slice
+    assert doc["reasons"]["node-affinity-mismatch"] == 4
+    assert "node selector" in doc["detail"]["node-affinity-mismatch"]
+    assert trace.pending_reasons()["default/stuck"]["top"] == \
+        "node-affinity-mismatch"
+    # the placed gang carries no aggregate
+    assert trace.PENDING_REASONS_ANNOTATION not in \
+        cluster.podgroups["default/demo"].annotations
+
+    # un-stick the job: selector now matches a real label
+    for p in cluster.pods.values():
+        if p.name.startswith("stuck-"):
+            p.node_selector = {}
+    sched.run_once()
+    cluster.tick()
+    sched.run_once()
+    assert trace.PENDING_REASONS_ANNOTATION not in \
+        cluster.podgroups["default/stuck"].annotations
+    assert "default/stuck" not in trace.pending_reasons()
+
+
+def test_phase_stamps_and_metrics_inprocess():
+    cluster = _gang_cluster()
+    sched = Scheduler(cluster, schedule_period=0)
+    sched.run_once()
+    cluster.tick()
+    pod = cluster.pods["default/demo-0"]
+    pg = cluster.podgroups["default/demo"]
+    for phase in ("created", "allocated", "bound", "admitted",
+                  "running"):
+        assert trace.phase_ts(pod.annotations, phase) is not None, phase
+    assert trace.phase_ts(pg.annotations, "enqueued") is not None
+    segs = trace.phase_segments(pod.annotations, pg.annotations)
+    e2e = trace.phase_ts(pod.annotations, "running") - \
+        trace.phase_ts(pod.annotations, "created")
+    assert math.isclose(sum(segs.values()), e2e, rel_tol=1e-9)
+    assert pod.phase is TaskStatus.RUNNING
+    # the cache observer fed sched_phase_seconds exactly once per pod
+    assert metrics.get_observations("sched_phase_seconds",
+                                    phase="e2e")
+    count_before = len(metrics.get_observations(
+        "sched_phase_seconds", phase="e2e"))
+    # re-notifying the same pod must not double-observe
+    cluster._notify("pod", pod)
+    assert len(metrics.get_observations(
+        "sched_phase_seconds", phase="e2e")) == count_before
+
+
+def test_dumper_includes_trace_section(tmp_path):
+    from volcano_tpu.dumper import Dumper
+    cluster = _gang_cluster(stuck_selector=True)
+    sched = Scheduler(cluster, schedule_period=0)
+    sched.run_once()
+    path = tmp_path / "dump.json"
+    Dumper(sched, path=str(path)).dump()
+    doc = json.loads(path.read_text())
+    assert doc["trace"]["recent_traces"], "kept traces in the dump"
+    assert doc["trace"]["pending_reasons"]["default/stuck"]["top"] == \
+        "node-affinity-mismatch"
+
+
+# -- metrics satellites ------------------------------------------------
+
+_NAME_RE = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+
+
+def _parse_prometheus_text(text):
+    """Strict Prometheus text-format parser: returns
+    {(name, ((label, value), ...)): float}.  Raises on any malformed
+    line — the round-trip guard for the exposition writer."""
+    out = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        m = re.match(rf"^({_NAME_RE})(?:\{{(.*)\}})? (\S+)$", line)
+        assert m, f"malformed exposition line: {line!r}"
+        name, raw_labels, raw_value = m.groups()
+        labels = []
+        i = 0
+        s = raw_labels or ""
+        while i < len(s):
+            lm = re.match(rf'({_NAME_RE})="', s[i:])
+            assert lm, f"malformed labels at {s[i:]!r} in {line!r}"
+            key = lm.group(1)
+            i += lm.end()
+            val = []
+            while True:
+                assert i < len(s), f"unterminated label value: {line!r}"
+                c = s[i]
+                if c == "\\":
+                    esc = s[i + 1]
+                    assert esc in ('\\', '"', 'n'), \
+                        f"invalid escape \\{esc} in {line!r}"
+                    val.append({"\\": "\\", '"': '"',
+                                "n": "\n"}[esc])
+                    i += 2
+                elif c == '"':
+                    i += 1
+                    break
+                else:
+                    assert c != "\n"
+                    val.append(c)
+                    i += 1
+            labels.append((key, "".join(val)))
+            if i < len(s):
+                assert s[i] == ",", f"expected ',' at {s[i:]!r}"
+                i += 1
+        out[(name, tuple(labels))] = float(raw_value)
+    return out
+
+
+def test_exposition_escapes_label_values():
+    metrics.inc("sched_test_total", node='host"quoted"',
+                reason="line1\nline2", path="c:\\cgroup")
+    metrics.set_gauge("sched_test_gauge", 1.5, msg='say "hi"\n')
+    metrics.observe("sched_test_seconds", 0.25, who="a\\b")
+    parsed = _parse_prometheus_text(metrics.dump())
+    assert parsed[("sched_test_total",
+                   (("node", 'host"quoted"'), ("path", "c:\\cgroup"),
+                    ("reason", "line1\nline2")))] == 1.0
+    assert parsed[("sched_test_gauge",
+                   (("msg", 'say "hi"\n'),))] == 1.5
+    assert parsed[("sched_test_seconds_count",
+                   (("who", "a\\b"),))] == 1.0
+    # every line is single-line: the newline in a label value must not
+    # produce an extra exposition line
+    assert all(ln.count('"') % 2 == 0
+               for ln in metrics.dump().splitlines() if ln)
+
+
+def test_summary_window_trimming_stays_monotonic():
+    total = metrics.MAX_OBSERVATIONS * 2 + 100
+    prev_count, prev_sum = 0, 0.0
+    expected_sum = 0.0
+    for i in range(total):
+        metrics.observe("trim_test_seconds", 0.001, op="x")
+        expected_sum += 0.001
+        if i % 4096 == 0 or i == total - 1:
+            parsed = _parse_prometheus_text(metrics.dump())
+            count = parsed[("trim_test_seconds_count",
+                            (("op", "x"),))]
+            ssum = parsed[("trim_test_seconds_sum", (("op", "x"),))]
+            # cumulative count/sum NEVER regress across the window
+            # halving (scrapers' rate() would see phantom resets)
+            assert count >= prev_count and ssum >= prev_sum - 1e-9
+            prev_count, prev_sum = count, ssum
+    assert prev_count == total
+    assert prev_sum == pytest.approx(expected_sum, rel=1e-6)
+    # the quantile window really was trimmed (memory bound held)
+    assert len(metrics.get_observations(
+        "trim_test_seconds", op="x")) <= metrics.MAX_OBSERVATIONS
+
+
+# -- e2e: vtpctl explain through a real HTTP state server --------------
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait(cond, timeout, msg):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_explain_unplaceable_gang_e2e_wire(tmp_path):
+    """The acceptance e2e: a deliberately unplaceable gang through the
+    REAL multi-process control plane; `vtpctl explain` against the
+    live server surfaces the correct top unschedulable reason, and the
+    session traces that produced it are queryable at /traces."""
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.vcjob import TaskSpec, VCJob
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.simulator import slice_nodes
+
+    port = _free_port()
+    url = f"http://127.0.0.1:{port}"
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    procs = {}
+
+    def spawn(name, *argv):
+        logf = open(tmp_path / f"{name}.log", "w")
+        procs[name] = subprocess.Popen(
+            [sys.executable, *argv], stdout=logf, stderr=logf,
+            env=env, cwd=REPO)
+
+    kubectl = None
+    try:
+        spawn("server", "-m", "volcano_tpu.server", "--port",
+              str(port), "--tick-period", "0.1")
+
+        def up():
+            try:
+                with urllib.request.urlopen(url + "/healthz",
+                                            timeout=1):
+                    return True
+            except OSError:
+                return False
+        _wait(up, 20, "server /healthz")
+        spawn("plane", "-m", "volcano_tpu", "--cluster-url", url,
+              "--components", "scheduler,controllers",
+              "--period", "0.1")
+        kubectl = RemoteCluster(url)
+        for node in slice_nodes(slice_for("sa", "v5e-16"),
+                                dcn_pod="d0"):
+            kubectl.add_node(node)
+        # unplaceable: the selector matches no node label anywhere
+        tmpl = make_pod("t", requests={"cpu": 1})
+        tmpl.node_selector = {"zone": "nowhere"}
+        kubectl.add_vcjob(VCJob(
+            name="doomed", min_available=2,
+            tasks=[TaskSpec(name="w", replicas=2, template=tmpl)]))
+
+        def aggregated():
+            pg = kubectl.podgroups.get("default/doomed")
+            return pg is not None and \
+                trace.PENDING_REASONS_ANNOTATION in pg.annotations
+        _wait(aggregated, 30, "pending-reasons annotation on the wire")
+
+        out = subprocess.run(
+            [sys.executable, "-m", "volcano_tpu.cli.vtpctl",
+             "--server", url, "explain", "doomed"],
+            capture_output=True, text=True, timeout=60, env=env,
+            cwd=REPO)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "top unschedulable reason: node-affinity-mismatch" in \
+            out.stdout, out.stdout
+        # node count: all 4 hosts of the slice failed the selector
+        m = re.search(r"node-affinity-mismatch\s+(\d+)", out.stdout)
+        assert m and int(m.group(1)) == 4, out.stdout
+        assert "node selector" in out.stdout
+
+        # the flight recorder flowed through the same wire: the
+        # server's ring holds complete traces mentioning the job
+        with urllib.request.urlopen(
+                url + "/traces?job=default/doomed", timeout=5) as r:
+            payload = json.loads(r.read())
+        assert payload["traces"], "no session traces for the job"
+        for t in payload["traces"]:
+            assert "dur" in t["root"]
+        assert any(t.get("pending", {}).get("default/doomed")
+                   for t in payload["traces"])
+
+        # vtpctl trace renders the span waterfall for the same job
+        out = subprocess.run(
+            [sys.executable, "-m", "volcano_tpu.cli.vtpctl",
+             "--server", url, "trace", "doomed"],
+            capture_output=True, text=True, timeout=60, env=env,
+            cwd=REPO)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "session seq=" in out.stdout, out.stdout
+        assert "allocate" in out.stdout
+    finally:
+        if kubectl is not None:
+            kubectl.close()
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                p.kill()
+
+
+def test_bench_trace_smoke_mode():
+    """`bench.py --trace-smoke` runs a gang through the real process
+    plane and asserts stamps, reconciliation (<5%) and trace flow —
+    the flight-recorder drill guarded on every commit, mirroring
+    --wire-smoke/--crash-smoke."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--trace-smoke"],
+        capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
+    assert proc.returncode == 0, \
+        proc.stdout[-2000:] + proc.stderr[-2000:]
+    line = next(ln for ln in
+                reversed(proc.stdout.strip().splitlines())
+                if ln.startswith("{"))
+    out = json.loads(line)
+    assert out["ok"] is True, out
+    assert out["reconcile_err_max_pct"] < 5.0
+    assert out["traces_captured"] > 0
+    assert set(out["phase_p50_s"]) == {"queue", "schedule", "bind",
+                                       "admit", "start"}

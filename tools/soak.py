@@ -25,7 +25,7 @@ Usage:  python tools/soak.py [seconds] [--kill-slice]
         # and jobs must keep completing — the control-plane crash
         # drill for docs/design/durability.md
 """
-import json, os, random, signal, socket, subprocess, sys, time
+import json, os, random, signal, socket, subprocess, sys, time, urllib.request
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
@@ -145,6 +145,25 @@ while time.time() < t_end:
         if dead:
             break
 
+def fetch_traces():
+    """GET /traces from the live server — (epoch, complete traces)."""
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/traces?limit=64",
+                timeout=5) as r:
+            payload = json.loads(r.read())
+        return payload.get("epoch", ""), payload.get("traces", [])
+    except OSError:
+        return "", None
+
+
+def trace_complete(doc):
+    """Every span in the tree closed — the server must never serve
+    half a tree (same definition the server's POST gate enforces)."""
+    from volcano_tpu import trace
+    return trace.is_complete_span(doc.get("root"))
+
+
 time.sleep(5)
 c.resync()
 phases = {}
@@ -160,6 +179,17 @@ if kill_server_every:
     out["server_kills"] = server_kills
     out["kill_server_ok"] = (server_kills > 0 and not dead
                              and phases.get("Completed", 0) > 0)
+    # the flight recorder must keep flowing across every kill -9: the
+    # server ring is in-memory, so after the LAST respawn it reset
+    # with the new epoch — the scheduler must have refilled it, and
+    # every served trace must be a complete tree (the ring either
+    # resets cleanly or serves whole spans, never a half tree)
+    epoch, traces = fetch_traces()
+    out["traces_after_last_kill"] = (len(traces)
+                                     if traces is not None else -1)
+    out["traces_ok"] = bool(traces) and all(
+        trace_complete(t) for t in traces)
+    out["trace_ring_epoch"] = epoch
 if killed is not None:
     from volcano_tpu.api.slicehealth import (
         NODE_QUARANTINED_UNTIL_ANNOTATION)

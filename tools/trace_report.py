@@ -1,0 +1,98 @@
+"""Chrome-trace / Perfetto exporter for the scheduling flight recorder.
+
+Turns captured session traces (volcano_tpu/trace.py span trees) into
+the Chrome trace event format — load the output at chrome://tracing or
+https://ui.perfetto.dev to scrub through a scheduler cycle visually.
+
+Input sources (first match wins):
+  --url URL      fetch GET /traces from a live state server
+                 (optionally --token / --job / --limit)
+  --in FILE      a JSON file holding any of:
+                   * a GET /traces payload   ({"traces": [...]})
+                   * a SIGUSR2 dumper file   ({"trace": {"recent_traces"
+                     : [...]}})
+                   * a bare list of trace docs, or a single trace doc
+
+Usage:
+  python tools/trace_report.py --url http://127.0.0.1:8700 \
+      --job default/train --out timeline.json
+  python tools/trace_report.py --in /tmp/volcano-tpu-dump.json \
+      --out timeline.json
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def load_traces(path: str) -> list:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if isinstance(doc, list):
+        return doc
+    if isinstance(doc, dict):
+        if "traces" in doc:
+            return doc["traces"]
+        if "trace" in doc and isinstance(doc["trace"], dict):
+            return doc["trace"].get("recent_traces", [])
+        if "root" in doc:
+            return [doc]
+    raise SystemExit(f"unrecognized trace JSON shape in {path}")
+
+
+def fetch_traces(url: str, token: str, job: str, limit: int) -> list:
+    import urllib.request
+    from urllib.parse import quote
+    req = urllib.request.Request(
+        url.rstrip("/") + f"/traces?job={quote(job, safe='')}"
+                          f"&limit={limit}")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read()).get("traces", [])
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="export scheduler session traces as a Chrome-trace"
+                    " timeline")
+    parser.add_argument("--in", dest="infile", default="",
+                        help="trace JSON file (GET /traces payload, "
+                             "dumper output, or trace doc list)")
+    parser.add_argument("--url", default="",
+                        help="live state-server URL to fetch from")
+    parser.add_argument("--token", default="")
+    parser.add_argument("--job", default="",
+                        help="filter to traces touching this job key")
+    parser.add_argument("--limit", type=int, default=32)
+    parser.add_argument("--out", default="timeline.json")
+    args = parser.parse_args(argv)
+
+    from volcano_tpu import trace as trace_mod
+    if args.url:
+        traces = fetch_traces(args.url, args.token, args.job,
+                              args.limit)
+    elif args.infile:
+        traces = load_traces(args.infile)
+        if args.job:
+            traces = [t for t in traces
+                      if trace_mod.matches_job(t, args.job)]
+        traces = traces[-args.limit:]
+    else:
+        parser.error("need --url or --in")
+    if not traces:
+        print("no traces matched", file=sys.stderr)
+        return 1
+    doc = trace_mod.to_chrome_trace(traces)
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(doc, f)
+    print(f"{len(traces)} session trace(s), "
+          f"{len(doc['traceEvents'])} events -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,7 +15,7 @@ import logging
 import time
 from typing import Dict, List, Optional
 
-from volcano_tpu import metrics
+from volcano_tpu import metrics, trace
 from volcano_tpu.api.fit_error import (FitError, FitErrors,
                                        unschedulable)
 from volcano_tpu.api.job_info import JobInfo, TaskInfo
@@ -84,7 +84,11 @@ class AllocateAction(Action):
             if jobs.empty():
                 continue
             job = jobs.pop()
-            self._allocate_job(ssn, queue, job)
+            # per-job child span: the trace's unit of latency
+            # attribution (predicate/score aggregates land under it)
+            with trace.span(job.key, kind="job", job=job.key,
+                            queue=queue.name):
+                self._allocate_job(ssn, queue, job)
             from volcano_tpu.api.queue import DEQUEUE_FIFO
             if queue.queue.dequeue_strategy == DEQUEUE_FIFO and \
                     not ssn.job_ready(job):

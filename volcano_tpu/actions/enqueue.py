@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import logging
 
+from volcano_tpu import trace
 from volcano_tpu.api.types import PodGroupPhase
 from volcano_tpu.framework.plugins import Action, register_action
 from volcano_tpu.util import PriorityQueue
@@ -42,6 +43,10 @@ class EnqueueAction(Action):
             job = jobs.pop()
             if ssn.job_enqueueable(job):
                 job.podgroup.phase = PodGroupPhase.INQUEUE
+                # lifecycle stamp: ONE gang admission timestamp on the
+                # podgroup (not N pod writes); pods inherit it in the
+                # e2e phase decomposition (trace.phase_segments)
+                trace.stamp_phase(job.podgroup.annotations, "enqueued")
                 ssn.job_enqueued(job)
                 ssn.dirty_jobs.add(job.uid)
                 log.debug("enqueued job %s", job.key)

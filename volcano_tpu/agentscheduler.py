@@ -460,13 +460,15 @@ class AgentScheduler:
         placed = self._place_one()
         if placed is None:
             return None
-        pod, task, node, attempt, t0 = placed
+        pod, task, node, attempt, t0, ts_alloc = placed
         try:
-            self.cluster.bind_pod(pod.namespace, pod.name, node.name)
+            self.cluster.bind_pod(pod.namespace, pod.name, node.name,
+                                  ts_alloc=ts_alloc)
             err = None
         except Exception as e:  # noqa: BLE001 - conflict path
             err = str(e) or type(e).__name__
-        return self._commit_bind(pod, task, node, attempt, t0, err)
+        return self._commit_bind(pod, task, node, attempt, t0,
+                                 ts_alloc, err)
 
     def _place_one(self):
         """Pop one pod and RESERVE a node for it in the local cache —
@@ -523,13 +525,15 @@ class AgentScheduler:
                 except (KeyError, ValueError):
                     continue
                 node.bind_generation += 1
-            return pod, task, node, attempt, t0
+            # wall-clock decision stamp for the `allocated` lifecycle
+            # phase (t0 is a perf counter, useless across processes)
+            return pod, task, node, attempt, t0, time.time()
 
         self._attempts[pod.key] = attempt + 1
         self.queue.requeue_backoff(pod, attempt)
         return None
 
-    def _commit_bind(self, pod, task, node, attempt, t0,
+    def _commit_bind(self, pod, task, node, attempt, t0, _ts_alloc,
                      err) -> Optional[str]:
         """Finish one reservation given the cluster's bind verdict —
         IDENTICAL bookkeeping for the per-pod and batched lanes.
@@ -592,8 +596,8 @@ class AgentScheduler:
             if not placements:
                 break
             errors = self.cluster.bind_pods(
-                [(p.namespace, p.name, node.name)
-                 for p, _, node, _, _ in placements])
+                [(p.namespace, p.name, node.name, ts)
+                 for p, _, node, _, _, ts in placements])
             for placed, err in zip(placements, errors):
                 if self._commit_bind(*placed, err) is not None:
                     bound += 1

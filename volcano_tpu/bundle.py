@@ -102,6 +102,16 @@ FAMILIES: Dict[str, str] = {
     # client wire resilience: every transient retry the unified
     # backoff policy performs, labeled by route
     "client_retries_total": "counter",
+    # scheduling flight recorder (trace.py): per-phase lifecycle
+    # segments (created->enqueued->allocated->bound->admitted->
+    # running, plus the telescoped e2e), span time by action/plugin,
+    # kept-trace accounting, and the normalized unschedulable-reason
+    # tallies (label values are the bounded REASON_ENUM — free text
+    # never labels a metric)
+    "sched_phase_seconds": "histogram",
+    "sched_span_seconds": "histogram",
+    "sched_traces_total": "counter",
+    "sched_unschedulable_reasons_total": "counter",
 }
 
 
@@ -166,6 +176,29 @@ def scheduler_dashboard() -> dict:
                 "rate(server_snapshot_total[5m])",
                 "sum by (route) (rate(client_retries_total[5m]))"],
                12, 32),
+        # latency waterfall: one series per lifecycle phase, stacked
+        # in the panel they sum to the e2e series — where a pod's
+        # seconds went (queue / schedule / bind / admit / start)
+        _panel(11, "Lifecycle phase waterfall (mean)",
+               ["sum by (phase) (rate(sched_phase_seconds_sum[5m]))"
+                " / sum by (phase) "
+                "(clamp_min(rate(sched_phase_seconds_count[5m]),"
+                " 1e-9))"], 0, 40, unit="s"),
+        _panel(12, "Span time by action / plugin (mean)",
+               ["sum by (action) (rate(sched_span_seconds_sum[5m]))"
+                " / sum by (action) "
+                "(clamp_min(rate(sched_span_seconds_count[5m]),"
+                " 1e-9))",
+                "sum by (plugin, point) "
+                "(rate(sched_span_seconds_sum[5m])) / "
+                "sum by (plugin, point) "
+                "(clamp_min(rate(sched_span_seconds_count[5m]),"
+                " 1e-9))"], 12, 40, unit="s"),
+        _panel(13, "Unschedulable reasons (normalized enum)",
+               ["sum by (reason) "
+                "(rate(sched_unschedulable_reasons_total[5m]))",
+                "sum by (kept) (rate(sched_traces_total[5m]))"],
+               0, 48),
     ]
     return {
         "title": "volcano-tpu / scheduler", "uid": "vtp-scheduler",

@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 from volcano_tpu.api.hypernode import HyperNodesInfo
@@ -73,11 +74,15 @@ class Snapshot:
 
 
 class BindContext:
-    __slots__ = ("task", "node_name")
+    __slots__ = ("task", "node_name", "t_alloc")
 
     def __init__(self, task: TaskInfo, node_name: str):
         self.task = task
         self.node_name = node_name
+        # placement-decision wall time, shipped with the bind so the
+        # store's `allocated` lifecycle stamp reflects the decision,
+        # not the end-of-cycle batch commit (trace.py phases)
+        self.t_alloc = time.time()
 
 
 # statuses that mean a job still has in-flight scheduling state: its
@@ -111,6 +116,10 @@ class SchedulerCache:
         self._dirty_nodes: set = set()
         self._dirty_jobs: set = set()
         self._needs_full = True
+        # pods whose lifecycle-phase segments were already fed to
+        # sched_phase_seconds (once per pod, bounded window)
+        self._phase_seen: set = set()
+        self._phase_seen_order: deque = deque()
         watch = getattr(cluster, "watch", None)
         if watch is not None:
             watch(self._on_cluster_event)
@@ -150,6 +159,38 @@ class SchedulerCache:
             # hypernode/numatopology/vcjob/command/...: not part of
             # the reused model (hypernodes rebuild every snapshot;
             # the rest is controller-side state)
+        if kind == "pod":
+            # outside the dirty lock: phase-metric derivation reads
+            # the podgroup store and feeds the metrics registry
+            self._maybe_observe_phases(obj)
+
+    _PHASE_SEEN_MAX = 8192
+
+    def _maybe_observe_phases(self, pod) -> None:
+        """Feed a pod's lifecycle-phase segments (trace.py stamps) to
+        sched_phase_seconds once it reaches Running — the scheduler-
+        process half of the e2e derivation, driven by ordinary watch
+        events so it works identically in-process and over the wire."""
+        from volcano_tpu import trace
+        if getattr(pod, "phase", None) is not TaskStatus.RUNNING:
+            return
+        ann = getattr(pod, "annotations", None)
+        if not ann or trace.TS_PREFIX + "running" not in ann:
+            return
+        uid = getattr(pod, "uid", None)
+        if uid is None or uid in self._phase_seen:
+            return
+        self._phase_seen.add(uid)
+        self._phase_seen_order.append(uid)
+        while len(self._phase_seen_order) > self._PHASE_SEEN_MAX:
+            self._phase_seen.discard(self._phase_seen_order.popleft())
+        pg_ann = None
+        jkey = self._job_key_for_pod(pod)
+        if jkey:
+            pg = getattr(self.cluster, "podgroups", {}).get(jkey)
+            if pg is not None:
+                pg_ann = pg.annotations
+        trace.observe_phase_metrics(ann, pg_ann)
 
     def note_touched(self, nodes, jobs) -> None:
         """Session mutations (committed OR discarded) — close_session
@@ -391,7 +432,8 @@ class SchedulerCache:
             return 0
         from volcano_tpu import metrics
         errors = self.cluster.bind_pods(
-            [(ctx.task.namespace, ctx.task.name, ctx.node_name)
+            [(ctx.task.namespace, ctx.task.name, ctx.node_name,
+              ctx.t_alloc)
              for ctx in queue])
         bound = 0
         for ctx, err in zip(queue, errors):

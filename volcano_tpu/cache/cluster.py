@@ -53,11 +53,15 @@ class Cluster(abc.ABC):
         """Return the current cluster objects (read-only view)."""
 
     @abc.abstractmethod
-    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
-        """POST pods/binding analogue.  Raises on conflict/missing."""
+    def bind_pod(self, namespace: str, name: str, node_name: str,
+                 ts_alloc: Optional[float] = None) -> None:
+        """POST pods/binding analogue.  Raises on conflict/missing.
+        ts_alloc optionally carries the scheduler's placement-decision
+        wall time for the `allocated` lifecycle stamp (trace.py)."""
 
     def bind_pods(self, binds) -> List[Optional[str]]:
-        """Batch bind: `binds` is [(namespace, name, node_name), ...];
+        """Batch bind: `binds` is [(namespace, name, node_name), ...]
+        — items may carry a 4th element, the ts_alloc decision stamp;
         returns a per-item list of None (bound) or an error string,
         NEVER raising — per-item failure semantics match the per-pod
         path (a conflict on one pod must not veto its gang-mates, the
@@ -65,9 +69,12 @@ class Cluster(abc.ABC):
         bind_pod; wire backends override with ONE request so a 256-pod
         gang's binds don't cost 256 HTTP round-trips."""
         errors: List[Optional[str]] = []
-        for namespace, name, node_name in binds:
+        for item in binds:
+            namespace, name, node_name = item[0], item[1], item[2]
+            ts_alloc = item[3] if len(item) > 3 else None
             try:
-                self.bind_pod(namespace, name, node_name)
+                self.bind_pod(namespace, name, node_name,
+                              ts_alloc=ts_alloc)
                 errors.append(None)
             except Exception as e:  # noqa: BLE001 — per-item verdicts
                 errors.append(str(e) or type(e).__name__)

@@ -106,6 +106,11 @@ class FakeCluster(Cluster):
     def add_pod(self, pod: Pod):
         if self.admission is not None and pod.key not in self.pods:
             pod = self.admission.admit_pod(pod, self)
+        from volcano_tpu import trace
+        # store-side lifecycle stamp (first writer wins, so a retried
+        # create keeps the original timestamp) — the `created` anchor
+        # of the e2e phase decomposition (docs/design/tracing.md)
+        trace.stamp_phase(pod.annotations, "created")
         with self._lock:
             self.pods[pod.key] = pod
         self._notify("pod", pod)
@@ -119,6 +124,8 @@ class FakeCluster(Cluster):
             self._notify("pod_deleted", pod)
 
     def add_podgroup(self, pg: PodGroup):
+        from volcano_tpu import trace
+        trace.stamp_phase(pg.annotations, "created")
         with self._lock:
             self.podgroups[pg.key] = pg
         self._notify("podgroup", pg)
@@ -233,6 +240,10 @@ class FakeCluster(Cluster):
                     obj = getattr(self.admission, method)(obj, self)
             elif kind == "vcjob":
                 obj = self.admission.admit_job_update(obj, self)
+        if (kind == "pod" and k not in self.pods) or \
+                (kind == "podgroup" and k not in self.podgroups):
+            from volcano_tpu import trace
+            trace.stamp_phase(obj.annotations, "created")
         if kind == "node":
             # keep the accounting/health folds sticky: a node write
             # from a mirror that predates a fold (the agent's
@@ -402,7 +413,19 @@ class FakeCluster(Cluster):
                 vcjobs=list(self.vcjobs.values()),
             )
 
-    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
+    def bind_pod(self, namespace: str, name: str, node_name: str,
+                 ts_alloc: Optional[float] = None) -> None:
+        """ts_alloc: the scheduler's placement-decision wall time,
+        carried on the bind request so the `allocated` lifecycle stamp
+        reflects the decision, not the (possibly batched) commit."""
+        from volcano_tpu import trace
+        try:
+            # telemetry must never fail (or half-apply) a bind: an
+            # unparseable decision stamp from a hand-rolled client is
+            # dropped, not raised after the pod already mutated
+            ts_alloc = None if ts_alloc is None else float(ts_alloc)
+        except (TypeError, ValueError):
+            ts_alloc = None
         key = f"{namespace}/{name}"
         with self._lock:
             pod = self.pods.get(key)
@@ -415,6 +438,8 @@ class FakeCluster(Cluster):
                 raise KeyError(f"bind: node {node_name} not found")
             pod.node_name = node_name
             pod.phase = TaskStatus.BOUND
+            trace.stamp_phase(pod.annotations, "allocated", ts_alloc)
+            trace.stamp_phase(pod.annotations, "bound")
             self.binds.append((key, node_name))
         self._notify("pod", pod)
 
@@ -460,9 +485,16 @@ class FakeCluster(Cluster):
             started = []
             completed = []
             progress = self._run_progress
+            from volcano_tpu import trace
             for key, pod in self.pods.items():
                 if pod.phase is TaskStatus.BOUND:
                     pod.phase = TaskStatus.RUNNING
+                    # the simulated kubelet admits and starts the
+                    # container in one tick, so the two stamps
+                    # coincide here; a real kubelet would separate
+                    # image pull / admission from container start
+                    trace.stamp_phase(pod.annotations, "admitted")
+                    trace.stamp_phase(pod.annotations, "running")
                     started.append(pod)
                 elif pod.phase is TaskStatus.RUNNING:
                     spec = pod.annotations.get(RUN_TICKS_ANNOTATION)

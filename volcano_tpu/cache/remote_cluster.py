@@ -462,9 +462,15 @@ class RemoteCluster(Cluster):
 
     # -- scheduler write path ------------------------------------------
 
-    def bind_pod(self, namespace: str, name: str, node_name: str) -> None:
-        self._request("POST", "/bind", {
-            "namespace": namespace, "name": name, "node_name": node_name})
+    def bind_pod(self, namespace: str, name: str, node_name: str,
+                 ts_alloc: Optional[float] = None) -> None:
+        body = {"namespace": namespace, "name": name,
+                "node_name": node_name}
+        if ts_alloc is not None:
+            # decision stamp for the `allocated` lifecycle phase;
+            # servers that predate it ignore unknown body fields
+            body["ts_alloc"] = ts_alloc
+        self._request("POST", "/bind", body)
         with self._mlock:
             pod = self.pods.get(f"{namespace}/{name}")
             if pod is not None:
@@ -480,13 +486,14 @@ class RemoteCluster(Cluster):
         transport failure falls back to the per-pod loop — bind_pod
         re-sent for an already-applied bind is idempotent (same-node
         rebind is accepted), so the fallback never double-faults."""
-        binds = list(binds)
+        binds = [tuple(b) + (None,) * (4 - len(b)) for b in binds]
         if not binds:
             return []
         try:
             resp = self._request("POST", "/bind_batch", {"binds": [
-                {"namespace": ns, "name": n, "node_name": node}
-                for ns, n, node in binds]})
+                dict({"namespace": ns, "name": n, "node_name": node},
+                     **({"ts_alloc": ts} if ts is not None else {}))
+                for ns, n, node, ts in binds]})
             results = resp["results"]
             if len(results) != len(binds):
                 raise RemoteError(500, "bind_batch result count "
@@ -497,7 +504,7 @@ class RemoteCluster(Cluster):
             return super().bind_pods(binds)
         errors: List[Optional[str]] = []
         with self._mlock:
-            for (ns, n, node), r in zip(binds, results):
+            for (ns, n, node, _ts), r in zip(binds, results):
                 if r.get("ok"):
                     pod = self.pods.get(f"{ns}/{n}")
                     if pod is not None:

@@ -24,7 +24,7 @@ from typing import List, Optional
 from volcano_tpu.api.pod import Container, Pod
 from volcano_tpu.api.queue import Queue
 from volcano_tpu.api.resource import TPU
-from volcano_tpu.api.types import JobPhase
+from volcano_tpu.api.types import GROUP_NAME_ANNOTATION, JobPhase
 from volcano_tpu.api.vcjob import TaskSpec, VCJob
 from volcano_tpu.framework.job_updater import SCHEDULING_REASON_ANNOTATION
 
@@ -632,6 +632,119 @@ def cmd_bandwidth(cluster, args):
                                "BUDGET", "VIOLATING", "SATURATED"]))
 
 
+def _job_pods(cluster, namespace: str, name: str):
+    """Pods belonging to job <namespace>/<name>: matched by the
+    group-name annotation the job controller stamps (bare or ns/name
+    form — the same key SchedulerCache uses) or by vcjob-uid
+    ownership.  Never by name prefix: jobs "train" and "train-2"
+    must not claim each other's pods."""
+    job = getattr(cluster, "vcjobs", {}).get(f"{namespace}/{name}")
+    wanted = (name, f"{namespace}/{name}")
+    return [p for p in cluster.pods.values()
+            if p.namespace == namespace
+            and (p.annotations.get(GROUP_NAME_ANNOTATION) in wanted
+                 or (job is not None and p.owner == job.uid))]
+
+
+def cmd_explain(cluster, args):
+    """Why is this job pending?  One place that answers without log
+    grepping: the aggregated unschedulable reasons the scheduler
+    publishes on the podgroup (trace.py: normalized reason ->
+    distinct-node count, with a free-text sample each), the per-pod
+    scheduling reasons, and the podgroup's Unschedulable condition."""
+    from volcano_tpu import trace
+    key = f"{args.namespace}/{args.name}"
+    pg = cluster.podgroups.get(key)
+    if pg is None:
+        sys.exit(f"podgroup {key} not found")
+    print(f"job: {key}")
+    print(f"phase: {pg.phase.value}  "
+          f"(minMember={pg.min_member}, queue={pg.queue})")
+    doc = trace.parse_annotation(
+        pg.annotations.get(trace.PENDING_REASONS_ANNOTATION, ""))
+    if doc and doc.get("reasons"):
+        detail = doc.get("detail", {})
+        rows = [[reason, count, detail.get(reason, "")[:72]]
+                for reason, count in sorted(
+                    doc["reasons"].items(),
+                    key=lambda kv: (-kv[1], kv[0]))]
+        print(f"top unschedulable reason: {doc.get('top')}")
+        print(_table(rows, ["REASON", "NODES", "SAMPLE"]))
+    else:
+        print("no aggregated unschedulable reasons published "
+              "(job not gang-blocked, or no scheduling cycle yet)")
+    for c in pg.conditions:
+        if c.type == "Unschedulable" and c.status == "True":
+            print(f"condition: {c.reason}: {c.message}")
+    pods = [p for p in _job_pods(cluster, args.namespace, args.name)
+            if SCHEDULING_REASON_ANNOTATION in p.annotations]
+    if pods:
+        print()
+        print(_table(
+            [[p.name,
+              p.annotations.get(SCHEDULING_REASON_ANNOTATION, "-"),
+              (p.status_message or "")[:72]]
+             for p in sorted(pods, key=lambda p: p.key)[:16]],
+            ["POD", "VERDICT", "MESSAGE"]))
+
+
+def _phase_waterfall(cluster, pg, pods) -> None:
+    """Per-pod lifecycle-phase segments from the wire annotations —
+    the trace fallback that needs no live scheduler (works against a
+    state file too)."""
+    from volcano_tpu import trace
+    rows = []
+    for p in sorted(pods, key=lambda p: p.key):
+        segs = trace.phase_segments(
+            p.annotations, pg.annotations if pg is not None else None)
+        if not segs:
+            continue
+        rows.append([p.name] +
+                    [f"{segs.get(seg, 0.0) * 1e3:.1f}"
+                     for seg, _f, _t in trace.SEGMENTS] +
+                    [f"{sum(segs.values()) * 1e3:.1f}"])
+    if rows:
+        print(_table(rows, ["POD"] + [s.upper() + "-MS" for s, _f, _t
+                                      in trace.SEGMENTS] + ["E2E-MS"]))
+    else:
+        print("no lifecycle stamps found (pods not yet created?)")
+
+
+def cmd_trace(cluster, args):
+    """Render the scheduling flight recorder for one job: session
+    span waterfalls from the state server's trace ring (server mode),
+    falling back to the per-pod lifecycle-phase waterfall derived
+    from the stamped annotations (any mode)."""
+    from urllib.parse import quote
+
+    from volcano_tpu import trace
+    key = f"{args.namespace}/{args.name}"
+    pg = cluster.podgroups.get(key)
+    pods = _job_pods(cluster, args.namespace, args.name)
+    request = getattr(cluster, "_request", None)
+    traces = []
+    if request is not None:
+        try:
+            payload = request(
+                "GET", f"/traces?job={quote(key, safe='')}"
+                       f"&limit={args.last}")
+            traces = payload.get("traces", [])
+        except Exception as e:  # noqa: BLE001 — fall back to phases
+            print(f"(trace ring unavailable: {e})", file=sys.stderr)
+    if traces:
+        for t in traces[-args.last:]:
+            print(f"-- session seq={t.get('seq')} "
+                  f"kept={t.get('kept_because')} --")
+            for line in trace.render_waterfall(t.get("root", {})):
+                print(line)
+            pending = t.get("pending", {}).get(key)
+            if pending and pending.get("reasons"):
+                print(f"   pending: {pending['reasons']}")
+            print()
+    print("lifecycle phases (from wire annotations):")
+    _phase_waterfall(cluster, pg, pods)
+
+
 def cmd_server(cluster, args):
     """Durability + lease status of the live state server (GET
     /durability, GET /leases): whether writes are journaled, how much
@@ -865,6 +978,22 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("failover", help="slice-failover view: sick "
                        "hosts, drained gangs, resume metadata")
     p.set_defaults(fn=cmd_failover)
+
+    p = sub.add_parser("explain", help="why is this job pending: "
+                       "aggregated unschedulable reasons (normalized "
+                       "reason -> node count) + per-pod verdicts")
+    p.add_argument("name", help="job / podgroup name")
+    p.add_argument("-n", "--namespace", default="default")
+    p.set_defaults(fn=cmd_explain)
+
+    p = sub.add_parser("trace", help="scheduling flight recorder: "
+                       "session span waterfalls (server mode) + the "
+                       "per-pod lifecycle phase breakdown")
+    p.add_argument("name", help="job / podgroup name")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--last", type=int, default=3,
+                   help="how many kept session traces to render")
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser("server", help="state-server durability + "
                        "lease status (WAL/snapshot/replay; needs "

@@ -59,8 +59,14 @@ class Dumper:
         self.path = path
 
     def dump(self) -> str:
+        from volcano_tpu import trace
         snapshot = self.scheduler.cache.snapshot()
         payload = snapshot_to_dict(snapshot)
+        # flight-recorder section: the last kept session span trees
+        # and the live per-job unschedulable-reason aggregate, so a
+        # wedged scheduler is diagnosable post-hoc from ONE artifact
+        # (what was it doing, and why is work pending)
+        payload["trace"] = trace.dump_state()
         with open(self.path, "w") as f:
             json.dump(payload, f, indent=2, sort_keys=True)
         log.info("cache dumped to %s", self.path)

@@ -5,7 +5,7 @@ from __future__ import annotations
 import logging
 import time
 
-from volcano_tpu import metrics
+from volcano_tpu import metrics, trace
 from volcano_tpu.conf import SchedulerConf
 from volcano_tpu.framework import job_updater
 from volcano_tpu.framework.plugins import get_plugin_builder
@@ -27,7 +27,8 @@ def open_session(cache, conf: SchedulerConf) -> Session:
             plugin = builder(opt.arguments)
             ssn.plugins[opt.name] = plugin
             tp = time.perf_counter()
-            plugin.on_session_open(ssn)
+            with trace.span(opt.name, kind="plugin", point="open"):
+                plugin.on_session_open(ssn)
             metrics.observe("plugin_latency_seconds",
                             time.perf_counter() - tp,
                             plugin=opt.name, point="open")
@@ -39,7 +40,8 @@ def open_session(cache, conf: SchedulerConf) -> Session:
 def close_session(ssn: Session) -> None:
     for name, plugin in reversed(list(ssn.plugins.items())):
         tp = time.perf_counter()
-        plugin.on_session_close(ssn)
+        with trace.span(name, kind="plugin", point="close"):
+            plugin.on_session_close(ssn)
         metrics.observe("plugin_latency_seconds",
                         time.perf_counter() - tp,
                         plugin=name, point="close")

@@ -6,6 +6,9 @@ PodGroupOldState diffing (session.go:77-79).
 
 from __future__ import annotations
 
+import json
+
+from volcano_tpu import trace
 from volcano_tpu.api.types import PodGroupPhase, TaskStatus
 
 # Pods may be created gated on queue admission; the gate manager lifts
@@ -51,6 +54,7 @@ def publish_scheduling_reasons(ssn) -> int:
     histogram.  Written only on change: the message stabilizes after
     one session, so steady pending jobs cost no wire traffic."""
     published = 0
+    blocked_keys = []
     for job in ssn.jobs.values():
         pg = job.podgroup
         gang_blocked = (pg is not None
@@ -58,6 +62,16 @@ def publish_scheduling_reasons(ssn) -> int:
                                          PodGroupPhase.INQUEUE)
                         and (job.fit_errors or job.job_fit_errors))
         if not gang_blocked:
+            # aggregate unschedulable reasons cleared with the same
+            # placed-only discipline as the per-pod reasons below —
+            # a merely-skipped job keeps its last published aggregate
+            if pg is not None and \
+                    trace.PENDING_REASONS_ANNOTATION in pg.annotations \
+                    and not job.tasks_in_status(TaskStatus.PENDING):
+                del pg.annotations[trace.PENDING_REASONS_ANNOTATION]
+                trace.clear_pending(job.key)
+                ssn.cache.update_podgroup_status(pg)
+                published += 1
             # CLEAR stale reasons — but only from tasks that actually
             # PLACED: fit errors rebuild empty every snapshot, so a
             # job merely skipped this session (queue overused, FIFO-
@@ -75,6 +89,28 @@ def publish_scheduling_reasons(ssn) -> int:
                     ssn.cache.cluster.put_object("pod", pod)
                     published += 1
             continue
+        # aggregated unschedulable reasons (trace.py): fit-error text
+        # normalized to the bounded enum, counted by DISTINCT node,
+        # published on the podgroup so `vtpctl explain` answers "why
+        # is this gang pending" from any mirror.  Written on change
+        # only, same wire discipline as the per-pod reasons.
+        blocked_keys.append(job.key)
+        counts, samples = trace.aggregate_job_reasons(job)
+        if not counts:
+            # condition-only blocks (e.g. topology_alloc's no-domain
+            # verdict) still deserve an aggregate
+            for cond in pg.conditions:
+                if cond.type == "Unschedulable" and cond.message:
+                    slug = trace.normalize_reason(cond.message)
+                    counts[slug] = counts.get(slug, 0) + 1
+                    samples.setdefault(slug, cond.message)
+        doc = trace.note_pending(job.key, counts, samples)
+        payload = json.dumps(doc, sort_keys=True)
+        if pg.annotations.get(trace.PENDING_REASONS_ANNOTATION) != \
+                payload:
+            pg.annotations[trace.PENDING_REASONS_ANNOTATION] = payload
+            ssn.cache.update_podgroup_status(pg)
+            published += 1
         pending = list(job.tasks_in_status(TaskStatus.PENDING))
         blocked = sum(1 for t in pending
                       if t.uid in job.fit_errors)
@@ -108,6 +144,9 @@ def publish_scheduling_reasons(ssn) -> int:
                 pod.status_message = ""
                 ssn.cache.cluster.put_object("pod", pod)
                 published += 1
+    # the in-process aggregate mirrors THIS session's blocked set (a
+    # deleted job must not haunt the dumper / trace payloads)
+    trace.retain_pending(blocked_keys)
     return published
 
 

@@ -180,20 +180,33 @@ def serve(port: int = 0):
     return server
 
 
+def _escape_label(value) -> str:
+    """Prometheus text-format label-value escaping (the exposition
+    format's only three escapes): a node name or free-text reason
+    carrying a quote, backslash or newline must not corrupt the
+    scrape output."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _label_str(labels) -> str:
+    return ",".join(f'{k}="{_escape_label(v)}"' for k, v in labels)
+
+
 def dump() -> str:
     """Prometheus text exposition."""
     lines = []
     with _lock:
         for (name, labels), value in sorted(_gauges.items()):
-            lbl = ",".join(f'{k}="{v}"' for k, v in labels)
+            lbl = _label_str(labels)
             lines.append(f"{name}{{{lbl}}} {value}" if lbl
                          else f"{name} {value}")
         for (name, labels), value in sorted(_counters.items()):
-            lbl = ",".join(f'{k}="{v}"' for k, v in labels)
+            lbl = _label_str(labels)
             lines.append(f"{name}{{{lbl}}} {value}" if lbl
                          else f"{name} {value}")
         for (name, labels), obs in sorted(_observations.items()):
-            lbl = ",".join(f'{k}="{v}"' for k, v in labels)
+            lbl = _label_str(labels)
             suffix = f"{{{lbl}}}" if lbl else ""
             count, total = _obs_totals[(name, labels)]
             lines.append(f"{name}_count{suffix} {count}")

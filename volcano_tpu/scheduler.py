@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import logging
 import os
+import sys
 import threading
 import time
 from typing import Optional
@@ -17,7 +18,7 @@ from volcano_tpu.cache.cluster import Cluster
 from volcano_tpu.conf import SchedulerConf, load_conf
 from volcano_tpu.framework.framework import close_session, open_session
 from volcano_tpu.framework.plugins import get_action
-from volcano_tpu import metrics
+from volcano_tpu import metrics, trace
 
 log = logging.getLogger(__name__)
 
@@ -57,22 +58,49 @@ class Scheduler:
                 self.conf = load_conf(f.read())
 
     def run_once(self):
-        """One scheduling cycle (scheduler.go runOnce)."""
+        """One scheduling cycle (scheduler.go runOnce).  The whole
+        cycle runs under a trace root span: open/close and every
+        action are timed children, plugin callbacks aggregate under
+        whichever span is innermost when they fire (trace.py)."""
         self._maybe_reload_conf()
         start = time.perf_counter()
-        ssn = open_session(self.cache, self.conf)
+        root = trace.begin_session(cycle=self.cycles)
+        ssn = None
         try:
+            with trace.span("open_session", kind="action"):
+                ssn = open_session(self.cache, self.conf)
+            root.labels["session"] = ssn.uid
             for name in self.conf.actions:
                 action = get_action(name)
                 if action is None:
                     log.warning("unknown action %s (skipped)", name)
                     continue
                 t0 = time.perf_counter()
-                action.execute(ssn)
+                with trace.span(name, kind="action"):
+                    action.execute(ssn)
                 metrics.observe("action_latency_seconds",
                                 time.perf_counter() - t0, action=name)
         finally:
-            close_session(ssn)
+            # a cycle that crashed ANYWHERE (open_session, an action,
+            # close_session below) is exactly what the recorder must
+            # capture: label it so the keep policy always records it
+            exc = sys.exc_info()[1]
+            if exc is not None:
+                root.labels["error"] = type(exc).__name__
+            jobs_pending = []
+            try:
+                if ssn is not None:
+                    with trace.span("close_session", kind="action"):
+                        close_session(ssn)
+                    jobs_pending = list(ssn.touched_jobs
+                                        | ssn.dirty_jobs)
+            finally:
+                exc = sys.exc_info()[1]
+                if exc is not None and "error" not in root.labels:
+                    root.labels["error"] = type(exc).__name__
+                doc = trace.end_session(root,
+                                        jobs_pending=jobs_pending)
+                trace.publish(self.cache.cluster, doc)
         self.cycles += 1
         metrics.observe("e2e_scheduling_latency_seconds",
                         time.perf_counter() - start)

@@ -42,6 +42,7 @@ log = logging.getLogger(__name__)
 
 EVENT_RING = 100_000     # events kept for watchers before forcing resync
 AUDIT_RING = 200_000     # audit records kept for the latency exporter
+TRACE_RING = 512         # kept scheduler session traces (GET /traces)
 
 
 def _error_code(e: Exception) -> int:
@@ -138,6 +139,14 @@ class StateServer:
         self._audit: collections.deque = collections.deque(maxlen=AUDIT_RING)
         self._audit_idx = 0
         self._audit_enabled = False
+        # scheduler session traces (trace.py docs): in-memory ring,
+        # deliberately NOT journaled — across a crash it resets
+        # cleanly with the new epoch (clients see the epoch change and
+        # know history restarted) and the posting scheduler refills it
+        # within a few cycles; a trace is accepted only whole, so the
+        # ring never serves half a tree
+        self._traces: collections.deque = collections.deque(
+            maxlen=TRACE_RING)
         cluster.watch(self._on_store_event)
         if durable is not None and recovery.cluster is None:
             # first boot of this data dir (possibly seeded from a
@@ -278,6 +287,26 @@ class StateServer:
                 # indices stay ring-global, only matching records ship
                 records = [r for r in records if r.get("key") == key]
             return idx, records, lost
+
+    def add_trace(self, doc: dict) -> None:
+        from volcano_tpu import trace as trace_mod
+        # the never-serve-half-a-tree gate on POST /trace (shared
+        # definition: trace.is_complete_span)
+        if not isinstance(doc, dict) or \
+                not trace_mod.is_complete_span(doc.get("root")):
+            raise ValueError("trace rejected: incomplete span tree")
+        with self._lock:
+            self._traces.append(dict(doc, epoch=self.epoch))
+
+    def traces(self, job: str = "", limit: int = 0) -> List[dict]:
+        from volcano_tpu import trace as trace_mod
+        with self._lock:
+            out = list(self._traces)
+        if job:
+            out = [t for t in out if trace_mod.matches_job(t, job)]
+        if limit:
+            out = out[-limit:]
+        return out
 
     def events_since(self, since: int, timeout: float = 25.0):
         """(rv, events, resync) — blocks up to timeout for news.
@@ -453,6 +482,18 @@ class _Handler(BaseHTTPRequestHandler):
                     getattr(st.cluster, "bandwidthreports", {}).items()
                     if not want or name == want}
             return self._json(200, {"reports": reports})
+        if url.path == "/traces":
+            # recent scheduler session traces (the flight recorder's
+            # query surface; vtpctl trace / tools/trace_report.py).
+            # ?job= filters to traces touching one job key; the epoch
+            # tells a client whether the ring's history predates a
+            # server restart
+            q = parse_qs(url.query)
+            job = q.get("job", [""])[0]
+            limit = int(q.get("limit", ["0"])[0])
+            return self._json(200, {
+                "epoch": st.epoch,
+                "traces": st.traces(job=job, limit=limit)})
         if url.path == "/audit":
             q = parse_qs(url.query)
             since = int(q.get("since", ["0"])[0])
@@ -520,7 +561,8 @@ class _Handler(BaseHTTPRequestHandler):
             return 200, {"obj": codec.encode(stored)}
         if path == "/bind":
             cl.bind_pod(body["namespace"], body["name"],
-                        body["node_name"])
+                        body["node_name"],
+                        ts_alloc=body.get("ts_alloc"))
             return 200, {"ok": True}
         if path == "/bind_batch":
             # a gang's binds as ONE request (the wire fast lane's
@@ -536,7 +578,8 @@ class _Handler(BaseHTTPRequestHandler):
             for b in body.get("binds", []):
                 try:
                     cl.bind_pod(b["namespace"], b["name"],
-                                b["node_name"])
+                                b["node_name"],
+                                ts_alloc=b.get("ts_alloc"))
                     results.append({"ok": True})
                     bound += 1
                 except Exception as e:  # noqa: BLE001 — per-item
@@ -577,6 +620,9 @@ class _Handler(BaseHTTPRequestHandler):
                     "cids": [c.get("cid") for c in cmds
                              if isinstance(c, dict) and c.get("cid")]}})
             return 200, {"commands": cmds}
+        if path == "/trace":
+            st.add_trace(body.get("trace"))
+            return 200, {"ok": True}
         if path == "/lease":
             return 200, st.lease(
                 body["name"], body["holder"],
