@@ -1196,6 +1196,424 @@ def failover_smoke() -> int:
     return 0 if ok else 1
 
 
+# -- elastic gangs: shrink/grow/migrate as a scheduler decision --------
+
+ELASTIC_CONF = {
+    "actions": "enqueue, allocate, elastic, gangpreempt, backfill",
+    "tiers": [
+        {"plugins": [{"name": "priority"}, {"name": "gang"},
+                     {"name": "failover"}, {"name": "elastic"},
+                     {"name": "conformance"}]},
+        {"plugins": [{"name": "overcommit"}, {"name": "drf"},
+                     {"name": "predicates"}, {"name": "proportion"},
+                     {"name": "nodeorder"}, {"name": "binpack"},
+                     {"name": "deviceshare"},
+                     {"name": "network-topology-aware"}]},
+    ],
+    "configurations": {"elastic": {"elastic.cooldownSeconds": 0}},
+}
+
+
+def _elastic_vcjob(name, slices, lo, hi, pods_per_slice,
+                   run_ticks=None):
+    from volcano_tpu.api import elastic as eapi
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.api.types import RUN_TICKS_ANNOTATION
+    from volcano_tpu.api.vcjob import TaskSpec, VCJob
+    pod_ann = {} if run_ticks is None else \
+        {RUN_TICKS_ANNOTATION: str(run_ticks)}
+    return VCJob(
+        name=name, min_available=slices * pods_per_slice,
+        annotations={
+            eapi.ELASTIC_MIN_SLICES_ANNOTATION: str(lo),
+            eapi.ELASTIC_MAX_SLICES_ANNOTATION: str(hi),
+            eapi.ELASTIC_SLICES_ANNOTATION: str(slices),
+        },
+        plugins={"jax": []},
+        tasks=[TaskSpec(name="worker",
+                        replicas=slices * pods_per_slice,
+                        template=make_pod(
+                            "t", requests={"cpu": 8, TPU: 4},
+                            annotations=pod_ann))])
+
+
+def _fixed_vcjob(name, replicas, run_ticks=None):
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.api.types import RUN_TICKS_ANNOTATION
+    from volcano_tpu.api.vcjob import TaskSpec, VCJob
+    pod_ann = {} if run_ticks is None else \
+        {RUN_TICKS_ANNOTATION: str(run_ticks)}
+    return VCJob(
+        name=name, min_available=replicas,
+        tasks=[TaskSpec(name="worker", replicas=replicas,
+                        template=make_pod(
+                            "t", requests={"cpu": 8, TPU: 4},
+                            annotations=pod_ann))])
+
+
+def _chip_utilization(cluster) -> float:
+    """Fraction of the cluster's TPU chips held by BOUND/RUNNING pods."""
+    from volcano_tpu.api.resource import Resource, TPU
+    from volcano_tpu.api.types import TaskStatus
+    total = used = 0.0
+    for node in cluster.nodes.values():
+        total += float(Resource.from_resource_list(
+            node.allocatable).get(TPU))
+    for pod in cluster.pods.values():
+        if pod.node_name and pod.phase in (TaskStatus.BOUND,
+                                           TaskStatus.RUNNING):
+            used += float(pod.resource_requests().get(TPU) or 0)
+    return used / total if total else 0.0
+
+
+def bench_elastic(smoke: bool = False) -> dict:
+    """Elastic-gang chaos on a contended cluster (ISSUE 6 acceptance):
+    fixed gangs pin most slices, elastic jobs absorb EVERY idle slice
+    (utilization >= 0.99), a burst of fixed demand forces shrinks
+    (latency measured decision -> slices freed), and a live migration
+    moves a gang between slices through the same drain/resume path
+    (MTTR measured decision -> running on the new slices).  Committed
+    as ELASTIC_r10.json together with the dp-resize loss-continuity
+    dryrun (--elastic-child)."""
+    from volcano_tpu import metrics
+    from volcano_tpu.api import elastic as eapi
+    from volcano_tpu.api.types import JobPhase, TPU_SLICE_LABEL
+    from volcano_tpu.controllers import ControllerManager
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.simulator import make_tpu_cluster
+    from volcano_tpu.webhooks import default_admission
+
+    slice_kind = "v5e-16" if smoke else "v5e-256"   # 4 / 64 hosts
+    n_slices = 4 if smoke else 16                   # 16 / 1024 hosts
+    pods_per_slice = 4 if smoke else 64
+    n_fixed = 1 if smoke else 10
+    n_elastic = 1 if smoke else 2
+    elastic_start = 1 if smoke else 2
+    trials = 1 if smoke else 5
+    cycle_budget = 60
+
+    shrink_lat, grow_lat, migrate_lat = [], [], []
+    utilizations, grow_cycles = [], []
+    hosts = None
+    for trial in range(trials):
+        cluster = make_tpu_cluster(
+            [(f"t{trial}s{i:02d}", slice_kind)
+             for i in range(n_slices)])
+        cluster.admission = default_admission()
+        hosts = len(cluster.nodes)
+        mgr = ControllerManager(cluster, enabled=[
+            "job", "podgroup", "queue", "failover", "elastic"])
+        sched = Scheduler(cluster, conf=ELASTIC_CONF,
+                          schedule_period=0)
+
+        def cycle(n=1):
+            for _ in range(n):
+                mgr.sync_all()
+                sched.run_once()
+                cluster.tick()
+
+        def job_slices(name):
+            j = cluster.vcjobs[f"default/{name}"]
+            return sorted({
+                cluster.nodes[p.node_name].labels[TPU_SLICE_LABEL]
+                for p in cluster.pods.values()
+                if p.owner == j.uid and p.node_name})
+
+        # fixed load pins most of the cluster; elastic jobs start
+        # small — the leftover slices are the utilization gap
+        grow0 = len(metrics.get_observations("elastic_resize_seconds",
+                                             kind="grow"))
+        for i in range(n_fixed):
+            cluster.add_vcjob(_fixed_vcjob(f"fixed-{i}",
+                                           pods_per_slice))
+        for i in range(n_elastic):
+            cluster.add_vcjob(_elastic_vcjob(
+                f"elastic-{i}", elastic_start, 1, n_slices,
+                pods_per_slice))
+
+        # phase 1: place everything, grow until every chip is busy
+        util = 0.0
+        for i in range(cycle_budget):
+            cycle()
+            util = _chip_utilization(cluster)
+            if util >= 0.99:
+                grow_cycles.append(i + 1)
+                break
+        assert util >= 0.99, \
+            f"elastic growth stalled at utilization {util:.3f}"
+        utilizations.append(round(util, 4))
+        # the grow EPISODE resumes (pods running) a cycle or two
+        # after utilization peaks (pods bound): settle before reading
+        # the latency observations
+        for _ in range(cycle_budget):
+            if len(metrics.get_observations(
+                    "elastic_resize_seconds", kind="grow")) > grow0:
+                break
+            cycle()
+        grow_lat.extend(metrics.get_observations(
+            "elastic_resize_seconds", kind="grow")[grow0:])
+
+        # phase 2: burst fixed demand -> shrink frees the slices
+        shrink0 = len(metrics.get_observations(
+            "elastic_shrink_seconds"))
+        burst = 1 if smoke else 2
+        for i in range(burst):
+            cluster.add_vcjob(_fixed_vcjob(
+                f"burst-{i}", pods_per_slice, run_ticks=24))
+        for i in range(cycle_budget):
+            cycle()
+            if all(cluster.vcjobs[f"default/burst-{i}"].phase
+                   is JobPhase.RUNNING for i in range(burst)):
+                break
+        assert all(cluster.vcjobs[f"default/burst-{i}"].phase
+                   is JobPhase.RUNNING for i in range(burst)), \
+            "burst gangs never scheduled (shrink did not free slices)"
+        assert not cluster.evictions, \
+            f"shrink path evicted pods: {cluster.evictions[:4]}"
+        shrink_lat.extend(metrics.get_observations(
+            "elastic_shrink_seconds")[shrink0:])
+
+        # phase 3: the burst completes, then live-migrate one gang
+        # onto the freed slices (policy-initiated, same drain path)
+        for i in range(cycle_budget):
+            cycle()
+            if all(cluster.vcjobs[f"default/burst-{i}"].phase
+                   is JobPhase.COMPLETED for i in range(burst)):
+                break
+        mig0 = len(metrics.get_observations(
+            "elastic_migration_mttr_seconds"))
+        victim = "elastic-0"
+        old_homes = job_slices(victim)
+        pg = cluster.podgroups[f"default/{victim}"]
+        pg.annotations[eapi.ELASTIC_DESIRED_SLICES_ANNOTATION] = \
+            str(eapi.current_slices(pg))
+        pg.annotations[eapi.ELASTIC_RESIZE_REASON_ANNOTATION] = \
+            eapi.RESIZE_MIGRATE
+        pg.annotations[eapi.ELASTIC_AVOID_SLICES_ANNOTATION] = \
+            ",".join(old_homes)
+        for i in range(cycle_budget):
+            cycle()
+            if len(metrics.get_observations(
+                    "elastic_migration_mttr_seconds")) > mig0:
+                break
+        new_homes = job_slices(victim)
+        assert not (set(new_homes) & set(old_homes)), \
+            f"migration landed back on {old_homes}"
+        migrate_lat.extend(metrics.get_observations(
+            "elastic_migration_mttr_seconds")[mig0:])
+        mgr.stop()
+
+    def pct(vals, q):
+        vals = sorted(vals)
+        return round(vals[min(len(vals) - 1,
+                              int(q * len(vals)))], 4) if vals else None
+
+    return {
+        "hosts": hosts, "slices": n_slices,
+        "pods_per_slice": pods_per_slice, "trials": trials,
+        "fixed_jobs": n_fixed, "elastic_jobs": n_elastic,
+        "utilization": min(utilizations),
+        "utilization_target": 0.99,
+        "grow_cycles_to_full": grow_cycles,
+        "grow_latency_p50_s": pct(grow_lat, 0.5),
+        "grow_latency_p95_s": pct(grow_lat, 0.95),
+        "shrink_latency_p50_s": pct(shrink_lat, 0.5),
+        "shrink_latency_p95_s": pct(shrink_lat, 0.95),
+        "migration_mttr_p50_s": pct(migrate_lat, 0.5),
+        "migration_mttr_p95_s": pct(migrate_lat, 0.95),
+        "shrink_samples": len(shrink_lat),
+        "migration_samples": len(migrate_lat),
+        "evictions": 0,
+    }
+
+
+def _elastic_child():
+    """Child process for the dp-resize loss-continuity dryrun (needs
+    its own XLA_FLAGS device count): train at dp=2 over 8 devices
+    with a fixed global batch, checkpoint, resume at dp=1 over 4
+    devices, compare the post-resize losses against the fixed-size
+    trajectory.  Prints ONE JSON line."""
+    import jax
+
+    from volcano_tpu.workloads import checkpoint, model as model_lib, \
+        train
+    from volcano_tpu.workloads.mesh import make_mesh
+
+    import tempfile
+    devices = jax.devices()
+    mesh_big = make_mesh({"dp": 2, "fsdp": 2, "tp": 2, "sp": 1},
+                         devices[:8])
+    mesh_small = make_mesh({"dp": 1, "fsdp": 2, "tp": 2, "sp": 1},
+                           devices[:4])
+    cfg = model_lib.tiny_config()
+    opt = train.make_optimizer(lr=1e-2, warmup_steps=1)
+    params, state, _ = train.init_sharded(jax.random.key(0), cfg,
+                                          mesh_big, opt)
+    step_big = train.make_train_step(cfg, mesh_big, opt)
+    batch = train.synthetic_batch(jax.random.key(1), cfg, 4, 64,
+                                  mesh_big)
+    ckpt = tempfile.mkdtemp(prefix="elastic-ckpt-")
+    losses = {}
+    for step in range(1, 6):
+        params, state, m = step_big(params, state, batch)
+        losses[step] = float(m["loss"])
+        if step == 3:
+            checkpoint.save(ckpt, step=step, params=params,
+                            opt_state=state)
+    env = {"VTP_CHECKPOINT_DIR": ckpt, "VTP_RESUME_STEP": "3"}
+    p2, s2, _ = train.init_sharded(jax.random.key(99), cfg,
+                                   mesh_small, opt)
+    p2, s2, start = checkpoint.resume_state(p2, s2, environ=env)
+    step_small = train.make_train_step(cfg, mesh_small, opt)
+    batch_small = train.synthetic_batch(jax.random.key(1), cfg, 4, 64,
+                                        mesh_small)
+    diffs = []
+    for step in range(start + 1, 6):
+        p2, s2, m = step_small(p2, s2, batch_small)
+        base = losses[step]
+        diffs.append(abs(float(m["loss"]) - base) / max(abs(base),
+                                                        1e-9))
+    out = {
+        "world_before_devices": 8, "world_after_devices": 4,
+        "dp_before": 2, "dp_after": 1, "global_batch": 4,
+        "resume_step": start,
+        "resume_step_never_rewinds": start == 3,
+        "max_rel_loss_diff": round(max(diffs), 8),
+        "tolerance": 1e-3,
+        "loss_continuous": start == 3 and max(diffs) < 1e-3,
+    }
+    print(json.dumps(out), flush=True)
+
+
+def _run_elastic_child(timeout_s: float = 600.0) -> dict:
+    """Run --elastic-child in a subprocess with an 8-device CPU mesh."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"),
+         "--elastic-child"],
+        capture_output=True, text=True, timeout=timeout_s, env=env,
+        cwd=repo)
+    for line in reversed((proc.stdout or "").strip().splitlines()
+                         or [""]):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return {"loss_continuous": False,
+            "error": (proc.stderr or "no output")[-500:]}
+
+
+def bench_elastic_wire_smoke() -> dict:
+    """One grow + one shrink through the REAL process control plane
+    (state server + scheduler + controllers as OS processes) — the
+    tier-1 guard that the elastic loop works over the wire, not just
+    in-process."""
+    import os
+
+    from volcano_tpu.api import elastic as eapi
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.api.types import JobPhase
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.simulator import slice_nodes
+
+    plane = _WirePlane()
+    # the scheduler process needs the elastic action + zero cooldown
+    conf_path = os.path.join(plane.logdir, "elastic-conf.yaml")
+    with open(conf_path, "w") as f:
+        json.dump(ELASTIC_CONF, f)     # JSON is valid YAML
+    kubectl = None
+    try:
+        plane.spawn("server", "-m", "volcano_tpu.server",
+                    "--port", str(plane.port), "--tick-period", "0.05")
+        import urllib.request
+
+        def up():
+            try:
+                with urllib.request.urlopen(plane.url + "/healthz",
+                                            timeout=1):
+                    return True
+            except OSError:
+                return False
+        _wire_wait(up, 20, "state server /healthz")
+        plane.spawn("controllers", "-m", "volcano_tpu",
+                    "--cluster-url", plane.url,
+                    "--components", "controllers", "--period", "0.05")
+        plane.spawn("scheduler", "-m", "volcano_tpu",
+                    "--cluster-url", plane.url,
+                    "--components", "scheduler", "--period", "0.05",
+                    "--conf", conf_path)
+        kubectl = RemoteCluster(plane.url)
+        for i in range(3):
+            for node in slice_nodes(slice_for(f"s{i}", "v5e-16"),
+                                    dcn_pod="dcn-0"):
+                kubectl.add_node(node)
+
+        kubectl.add_vcjob(_fixed_vcjob("pin", 4))
+        kubectl.add_vcjob(_elastic_vcjob("egang", 1, 1, 2, 4))
+
+        def gen_at_least(n):
+            pg = kubectl.podgroups.get("default/egang")
+            j = kubectl.vcjobs.get("default/egang")
+            return (pg is not None and j is not None
+                    and j.phase is JobPhase.RUNNING
+                    and int(pg.annotations.get(
+                        eapi.ELASTIC_GENERATION_ANNOTATION, 0)) >= n)
+
+        # grow: the idle third slice is absorbed
+        _wire_wait(lambda: gen_at_least(1)
+                   and eapi.current_slices(
+                       kubectl.podgroups["default/egang"]) == 2,
+                   60, lambda: "elastic grow over the wire "
+                   f"({plane.log_tails()[-900:]})")
+        grow_ok = True
+        util_at_grow = _chip_utilization(kubectl)
+
+        # shrink: new fixed demand reclaims the slice
+        kubectl.add_vcjob(_fixed_vcjob("burst", 4))
+        _wire_wait(lambda: gen_at_least(2)
+                   and eapi.current_slices(
+                       kubectl.podgroups["default/egang"]) == 1
+                   and (kubectl.vcjobs.get("default/burst") is not None
+                        and kubectl.vcjobs["default/burst"].phase
+                        is JobPhase.RUNNING),
+                   60, lambda: "elastic shrink over the wire "
+                   f"({plane.log_tails()[-900:]})")
+        shrink_ok = True
+        pg = kubectl.podgroups["default/egang"]
+        hist = eapi.resize_history(pg)
+        return {
+            "grow_ok": grow_ok, "shrink_ok": shrink_ok,
+            "utilization": round(util_at_grow, 4),
+            "resize_history": hist[-2:],
+            "hosts": 12,
+        }
+    finally:
+        if kubectl is not None:
+            kubectl.close()
+        plane.shutdown()
+
+
+def elastic_smoke() -> int:
+    """Seconds-scale elastic drill for tier-1: one grow + one shrink
+    through the real process control plane, mirroring --wire-smoke /
+    --failover-smoke.  Prints one JSON line."""
+    try:
+        out = bench_elastic_wire_smoke()
+        ok = out["grow_ok"] and out["shrink_ok"]
+    except AssertionError as e:
+        out, ok = {"error": str(e)[-900:]}, False
+    print(json.dumps({"metric": "elastic_smoke", "ok": ok, **out}))
+    return 0 if ok else 1
+
+
 # -- control-plane crash chaos (kill -9 + WAL recovery) ----------------
 
 
@@ -2045,6 +2463,7 @@ def main():
     scale40k = isolated(bench_40k_host_scale)
     net_acct = isolated(bench_net_accounting_overhead)
     failover = isolated(bench_failover_chaos)
+    elastic = isolated(bench_elastic)
     crash = isolated(bench_crash_recovery)
     wire = isolated(run_wire_benchmarks)
     probe, flash, train_tpu = run_tpu_benchmarks()
@@ -2075,6 +2494,11 @@ def main():
             # breakdown (`--failover` regenerates standalone ->
             # FAILOVER_r{N}.json)
             "failover": failover,
+            # elastic gangs on a contended cluster: idle capacity
+            # absorbed (utilization >= 0.99), shrink-latency +
+            # migration-MTTR percentiles (`--elastic` regenerates
+            # standalone -> ELASTIC_r{N}.json)
+            "elastic": elastic,
             # state-server kill -9 chaos: RTO + WAL replay + the
             # zero-acked-writes-lost / zero-mirror-divergence
             # invariants (`--crash` regenerates standalone ->
@@ -2132,6 +2556,19 @@ if __name__ == "__main__":
         sys.exit(wire_smoke())
     elif "--failover-smoke" in sys.argv:
         sys.exit(failover_smoke())
+    elif "--elastic-smoke" in sys.argv:
+        sys.exit(elastic_smoke())
+    elif "--elastic-child" in sys.argv:
+        _elastic_child()
+    elif "--elastic" in sys.argv:
+        # the standalone elastic chaos row committed as
+        # ELASTIC_r{N}.json: contended 1k-host cluster, elastic jobs
+        # absorb all idle capacity (utilization >= 0.99), shrink
+        # latency + migration MTTR percentiles, and the dp-resize
+        # loss-continuity dryrun
+        out = bench_elastic()
+        out["loss_continuity"] = _run_elastic_child()
+        print(json.dumps({"metric": "elastic_gangs_1k_hosts", **out}))
     elif "--crash-smoke" in sys.argv:
         sys.exit(crash_smoke())
     elif "--trace-smoke" in sys.argv:

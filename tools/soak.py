@@ -16,7 +16,11 @@ Usage:  python tools/soak.py [seconds] [--kill-slice]
         # default 600s; logs /tmp/soak/; --kill-slice injects a slice
         # failure (simulator.fail_host through the wire) ~40% in and
         # requires the failover loop to quarantine the slice and keep
-        # jobs completing.  --kill-server SIGKILLs (never SIGTERMs —
+        # jobs completing.  With --kill-slice a long-running ELASTIC
+        # gang also rides the soak (min 1 / max 2 slices): the
+        # elastic controller must keep resizing it around the churn
+        # and the slice death without ever regressing its resume
+        # step (the resize-vs-failover race, ISSUE 6).  --kill-server SIGKILLs (never SIGTERMs —
         # no goodbye save) the state server every EVERY_S seconds
         # (default 20) and respawns it on the same port over the same
         # --data-dir: the WAL replay must bring back every acked
@@ -79,6 +83,26 @@ for sname in ("sa", "sb", "sc"):
 
 rng = random.Random(42)
 submitted = completed_seen = 0
+elastic_key = None
+if "--kill-slice" in sys.argv[1:]:
+    # one long-running elastic gang in the mix: grows into idle,
+    # shrinks under churn pressure, and must survive the slice kill
+    from volcano_tpu.api import elastic as eapi
+    elastic_key = "default/esoak"
+    c.add_vcjob(VCJob(
+        name="esoak", min_available=4,
+        annotations={
+            eapi.ELASTIC_MIN_SLICES_ANNOTATION: "1",
+            eapi.ELASTIC_MAX_SLICES_ANNOTATION: "2",
+            eapi.ELASTIC_SLICES_ANNOTATION: "1",
+            "failover.volcano-tpu.io/last-checkpoint-step": "500",
+        },
+        plugins={"jax": []},
+        tasks=[TaskSpec(name="worker", replicas=4,
+                        template=make_pod(
+                            "t", requests={"cpu": 4, TPU: 4},
+                            annotations={RUN_TICKS_ANNOTATION:
+                                         "1000000"}))]))
 argv = [a for a in sys.argv[1:]
         if not a.startswith("--kill-")]
 kill_slice = "--kill-slice" in sys.argv[1:]
@@ -199,6 +223,22 @@ if killed is not None:
     out["killed_host"] = killed
     out["quarantined_hosts"] = sorted(quarantined)
     out["failover_ok"] = any(q.startswith("sc-") for q in quarantined)
+if elastic_key is not None:
+    from volcano_tpu.api import elastic as eapi
+    epg = c.podgroups.get(elastic_key)
+    ej = c.vcjobs.get(elastic_key)
+    resume = (epg.annotations.get(
+        "failover.volcano-tpu.io/resume-step") if epg else None)
+    out["elastic_history"] = eapi.resize_history(epg) if epg else []
+    out["elastic_slices"] = eapi.current_slices(epg) if epg else 0
+    out["elastic_resume_step"] = resume
+    # alive at the end, and the resume-step floor never regressed
+    # below the stamped checkpoint step despite resize+failover churn
+    out["elastic_ok"] = (
+        ej is not None
+        and getattr(ej.phase, "value", str(ej.phase))
+        in ("Running", "Pending", "Restarting")
+        and (resume is None or int(resume) >= 500))
 print(json.dumps(out))
 for p in procs.values():
     p.terminate()

@@ -18,7 +18,8 @@ Layer map (mirrors SURVEY.md §1 for the reference):
   api/          object model: Resource, JobInfo, NodeInfo, QueueInfo, ...
   cache/        cluster cache + snapshot + bind/evict queues
   framework/    Session, Statement, plugin registry
-  actions/      enqueue, allocate, backfill, preempt, reclaim, gang*
+  actions/      enqueue, allocate, elastic, backfill, preempt,
+                reclaim, gang*
   plugins/      gang, drf, proportion, capacity, predicates, topology, ...
   controllers/  job, podgroup, queue, jobflow, cronjob, hypernode, ...
   webhooks/     admission validate/mutate

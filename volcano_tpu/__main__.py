@@ -80,7 +80,7 @@ def main(argv=None) -> int:
                         help="also run the fast-path scheduler")
     parser.add_argument("--controllers", default="job,podgroup,queue,"
                         "hypernode,garbagecollector,jobflow,jobtemplate,"
-                        "cronjob,sharding,hyperjob,failover")
+                        "cronjob,sharding,hyperjob,failover,elastic")
     parser.add_argument("--node-agents", default="",
                         help="run per-node QoS agents: 'all' or a "
                              "comma-separated list of node names")

@@ -10,3 +10,4 @@ import volcano_tpu.actions.preempt      # noqa: F401
 import volcano_tpu.actions.reclaim      # noqa: F401
 import volcano_tpu.actions.gangpreempt  # noqa: F401
 import volcano_tpu.actions.shuffle      # noqa: F401
+import volcano_tpu.actions.elastic      # noqa: F401

@@ -112,6 +112,19 @@ FAMILIES: Dict[str, str] = {
     "sched_span_seconds": "histogram",
     "sched_traces_total": "counter",
     "sched_unschedulable_reasons_total": "counter",
+    # elastic gangs (actions/elastic.py decisions, controllers/
+    # elastic.py execution): every label is the bounded resize-kind
+    # enum (grow|shrink|migrate) — job keys and slice names never
+    # label these families (the PR 5 cardinality rule)
+    "elastic_decisions_total": "counter",
+    "elastic_resizes_total": "counter",
+    "elastic_resize_seconds": "histogram",
+    "elastic_drain_seconds": "histogram",
+    "elastic_shrink_seconds": "histogram",
+    "elastic_migration_mttr_seconds": "histogram",
+    "elastic_resume_step_gap": "histogram",
+    "elastic_jobs": "gauge",
+    "elastic_slices_total": "gauge",
 }
 
 
@@ -199,6 +212,19 @@ def scheduler_dashboard() -> dict:
                 "(rate(sched_unschedulable_reasons_total[5m]))",
                 "sum by (kept) (rate(sched_traces_total[5m]))"],
                0, 48),
+        _panel(14, "Elastic resize latency by kind (mean)",
+               ["sum by (kind) (rate(elastic_resize_seconds_sum[5m]))"
+                " / sum by (kind) "
+                "(clamp_min(rate(elastic_resize_seconds_count[5m]),"
+                " 1e-9))",
+                _mean_expr("elastic_shrink_seconds"),
+                _mean_expr("elastic_migration_mttr_seconds")],
+               12, 48, unit="s"),
+        _panel(15, "Elastic gangs / slices / decisions",
+               ["elastic_jobs", "elastic_slices_total",
+                "sum by (kind) (rate(elastic_decisions_total[5m]))",
+                "sum by (kind) (rate(elastic_resizes_total[5m]))",
+                _mean_expr("elastic_resume_step_gap")], 0, 56),
     ]
     return {
         "title": "volcano-tpu / scheduler", "uid": "vtp-scheduler",
@@ -274,13 +300,17 @@ def dashboard_metric_names(dash: dict) -> set:
 
 
 DEFAULT_CONF = {
-    "actions": "enqueue, allocate, backfill, preempt, reclaim",
+    "actions": "enqueue, allocate, elastic, backfill, preempt, reclaim",
     "tiers": [
         {"plugins": [
             {"name": "priority"}, {"name": "gang"},
             # failover: quarantined-slice filter + requeued-gang
             # priority (controllers/failover.py is the other half)
             {"name": "failover"},
+            # elastic: shrink-before-preempt veto + migration steering
+            # (actions/elastic.py decides, controllers/elastic.py
+            # executes)
+            {"name": "elastic"},
             {"name": "conformance"}]},
         {"plugins": [
             {"name": "overcommit"}, {"name": "drf"},

@@ -600,6 +600,80 @@ def cmd_failover(cluster, args):
                      ["OBJECT", "REASON", "MESSAGE"]))
 
 
+def cmd_elastic(cluster, args):
+    """Elastic-gang view: per job current/min/max slices, generation,
+    any in-flight decision, and the resize history the controller
+    appends; --migrate stamps a policy-initiated live migration (the
+    Singularity move: drain -> re-place on OTHER slices -> resume)."""
+    import datetime
+
+    from volcano_tpu.api import elastic as eapi
+    from volcano_tpu.api.types import TPU_SLICE_LABEL
+
+    if args.migrate:
+        ns, _, name = args.migrate.rpartition("/")
+        ns = ns or "default"
+        key = f"{ns}/{name}"
+        pg = cluster.podgroups.get(key)
+        if pg is None or not eapi.is_elastic(pg):
+            sys.exit(f"{key} is not an elastic podgroup")
+        current = sorted({
+            cluster.nodes[p.node_name].labels.get(TPU_SLICE_LABEL, "")
+            for p in _job_pods(cluster, ns, name)
+            if p.node_name and p.node_name in cluster.nodes})
+        current = [s for s in current if s]
+        pg.annotations[eapi.ELASTIC_DESIRED_SLICES_ANNOTATION] = \
+            str(eapi.current_slices(pg))
+        pg.annotations[eapi.ELASTIC_RESIZE_REASON_ANNOTATION] = \
+            eapi.RESIZE_MIGRATE
+        if current:
+            pg.annotations[eapi.ELASTIC_AVOID_SLICES_ANNOTATION] = \
+                ",".join(current)
+        cluster.update_podgroup_status(pg)
+        print(f"migration requested: {key} off "
+              f"{','.join(current) or '(unplaced)'}")
+        return
+
+    rows, history_rows = [], []
+    for pg in sorted(cluster.podgroups.values(), key=lambda g: g.key):
+        if not eapi.is_elastic(pg):
+            continue
+        rng = eapi.elastic_range(pg) or ("?", "?")
+        desired = eapi.desired_slices(pg)
+        reason = pg.annotations.get(
+            eapi.ELASTIC_RESIZE_REASON_ANNOTATION, "")
+        resizing = f"->{desired} ({reason})" if desired is not None \
+            else "-"
+        try:
+            last = float(pg.annotations.get(
+                eapi.ELASTIC_LAST_RESIZE_TS_ANNOTATION, 0) or 0)
+        except (TypeError, ValueError):
+            last = 0.0
+        rows.append([
+            pg.key, eapi.current_slices(pg), rng[0], rng[1],
+            pg.annotations.get(eapi.ELASTIC_GENERATION_ANNOTATION,
+                               "0"),
+            resizing,
+            datetime.datetime.fromtimestamp(last).isoformat(
+                timespec="seconds") if last else "-",
+            pg.phase.value,
+        ])
+        for rec in eapi.resize_history(pg):
+            history_rows.append([
+                pg.key, rec.get("gen", "?"), rec.get("kind", "?"),
+                f"{rec.get('from', '?')} -> {rec.get('to', '?')}",
+                datetime.datetime.fromtimestamp(
+                    rec.get("ts", 0)).isoformat(timespec="seconds")
+                if rec.get("ts") else "-",
+            ])
+    print(_table(rows, ["PODGROUP", "SLICES", "MIN", "MAX", "GEN",
+                        "RESIZING", "LAST-RESIZE", "PHASE"]))
+    if history_rows:
+        print()
+        print(_table(history_rows,
+                     ["PODGROUP", "GEN", "KIND", "SLICES", "AT"]))
+
+
 def cmd_bandwidth(cluster, args):
     """Per-pod DCN usage as the agents measured it (BandwidthReport
     store, api/netusage.py): node summary line + per-pod rates,
@@ -978,6 +1052,16 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("failover", help="slice-failover view: sick "
                        "hosts, drained gangs, resume metadata")
     p.set_defaults(fn=cmd_failover)
+
+    p = sub.add_parser("elastic", help="elastic gangs: current/min/"
+                       "max slices, in-flight resizes, history — or "
+                       "trigger a live migration off a gang's "
+                       "current slices")
+    p.add_argument("--migrate", default="",
+                   help="<ns>/<name> (or name): drain this elastic "
+                        "gang and re-place it on DIFFERENT slices at "
+                        "the same world size")
+    p.set_defaults(fn=cmd_elastic)
 
     p = sub.add_parser("explain", help="why is this job pending: "
                        "aggregated unschedulable reasons (normalized "

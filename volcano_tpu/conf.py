@@ -63,13 +63,18 @@ class SchedulerConf:
 
 
 DEFAULT_SCHEDULER_CONF = {
-    "actions": "enqueue, allocate, backfill",
+    # elastic runs after allocate (fixed-size placement first) and
+    # before backfill/gangpreempt: a free no-op when no job declares
+    # an elastic range (actions/elastic.py)
+    "actions": "enqueue, allocate, elastic, backfill",
     "tiers": [
         # failover: quarantined-slice filter + requeued-gang priority —
         # a cheap no-op until the failover controller quarantines a
-        # slice (controllers/failover.py)
+        # slice (controllers/failover.py); elastic: shrink-before-
+        # preempt veto + migration steering (plugins/elastic.py)
         {"plugins": [{"name": "priority"}, {"name": "gang"},
-                     {"name": "failover"}, {"name": "conformance"}]},
+                     {"name": "failover"}, {"name": "elastic"},
+                     {"name": "conformance"}]},
         # tier 2 mirrors the reference default's predicates wrap
         # (predicates.go:37 bundles nodeaffinity, podaffinity, taints,
         # ports, volume + spread): here those are separate plugins, so

@@ -272,7 +272,15 @@ class FailoverController(Controller):
         pg.annotations[FAILOVER_GENERATION_ANNOTATION] = str(gen)
         pg.annotations[REQUEUED_ANNOTATION] = "true"
         if last_step is not None:
-            pg.annotations[RESUME_STEP_ANNOTATION] = str(last_step)
+            try:
+                stamped = int(pg.annotations.get(
+                    RESUME_STEP_ANNOTATION, ""))
+            except (TypeError, ValueError):
+                stamped = None
+            # floor-guard: never rewind a step a racing resize stamped
+            pg.annotations[RESUME_STEP_ANNOTATION] = \
+                str(max(last_step, stamped)
+                    if stamped is not None else last_step)
         self.cluster.update_podgroup_status(pg)
 
     def _drain_job(self, job, pg, slice_name: str) -> None:
@@ -288,6 +296,15 @@ class FailoverController(Controller):
                                       0) or 0) + 1
         job.annotations[FAILOVER_GENERATION_ANNOTATION] = str(gen)
         if last_step is not None:
+            # floor-guard: an elastic resize racing this drain may
+            # already have stamped a resume step — never rewind it
+            try:
+                stamped = int(job.annotations.get(
+                    RESUME_STEP_ANNOTATION, ""))
+            except (TypeError, ValueError):
+                stamped = None
+            if stamped is not None and stamped > last_step:
+                last_step = stamped
             job.annotations[RESUME_STEP_ANNOTATION] = str(last_step)
         if pg is not None:
             # keep the podgroup's copy in lockstep (vtpctl failover and
@@ -302,6 +319,15 @@ class FailoverController(Controller):
             f"slice {slice_name} failed: restarting gang "
             f"(generation {gen}, resume step "
             f"{last_step if last_step is not None else 'none'})")
+        if job.phase is JobPhase.RESTARTING:
+            # a drain is already in flight (elastic resize or policy
+            # restart racing this failure): the version bump it issued
+            # tears down every stale pod, and the quarantine stamped
+            # above keeps the re-place off the sick slice — a second
+            # RestartJob would only double-churn the gang
+            log.info("job %s already RESTARTING: failover adopts the "
+                     "in-flight drain", job.key)
+            return
         self.cluster.add_command(job.key, JobAction.RESTART_JOB.value)
 
     # -- episode progression (drain -> reschedule -> resume) -----------

@@ -149,6 +149,12 @@ class JobController(Controller):
             if pg is not None:
                 pg.phase = PodGroupPhase.PENDING
                 self.cluster.update_podgroup_status(pg)
+            # materialize the new version NOW, not next sync: a
+            # drained gang with no pods yet cannot claim its requeued
+            # priority, so waiting a round lets any pending job steal
+            # the capacity the drain just freed (failover/elastic
+            # re-placement would lose its fast lane)
+            self._materialize_pods(job, [])
 
     def _sync_completing(self, job: VCJob, pods: List[Pod]) -> None:
         remaining = [p for p in pods if not p.is_terminated()]

@@ -113,14 +113,32 @@ class JaxPlugin(JobPlugin):
         set_env(pod, "COORDINATOR_ADDRESS",
                 f"{hostnames[0]}:{self.port}")
         set_env(pod, "NUM_PROCESSES", str(len(hostnames)))
+        # elastic jobs: the CURRENT slice count (resized by the
+        # elastic controller) defines the dcn axis — rank blocks of
+        # pods-per-slice map onto slices, so the workload's hybrid
+        # mesh follows every resize without a subgroup per slice
+        from volcano_tpu.api import elastic as eapi
+        elastic_slices = (eapi.current_slices(job)
+                          if eapi.is_elastic(job) and not num_slices > 1
+                          else 0)
         offset = 0
         for t, slice_id in tasks:
             if pod.task_spec == t.name:
-                set_env(pod, "TPU_WORKER_ID",
-                        str(offset + pod.task_index))
+                global_id = offset + pod.task_index
+                set_env(pod, "TPU_WORKER_ID", str(global_id))
                 if num_slices > 1:
                     set_env(pod, "TPU_SLICE_ID", str(slice_id))
                     set_env(pod, "TPU_NUM_SLICES", str(num_slices))
+                elif elastic_slices > 1 and \
+                        len(hostnames) % elastic_slices == 0:
+                    per_slice = len(hostnames) // elastic_slices
+                    set_env(pod, "TPU_SLICE_ID",
+                            str(global_id // per_slice))
+                    set_env(pod, "TPU_NUM_SLICES",
+                            str(elastic_slices))
+                if eapi.is_elastic(job):
+                    self._set_global_batch(pod, job, t,
+                                           len(hostnames))
                 break
             offset += t.replicas
 
@@ -131,3 +149,29 @@ class JaxPlugin(JobPlugin):
             pod.tolerations.append(
                 Toleration(key=TPU, operator="Exists",
                            effect="NoSchedule"))
+
+    @staticmethod
+    def _set_global_batch(pod, job, task_spec, num_workers: int):
+        """Pin WORKER_GLOBAL_BATCH across resizes: the same
+        samples-per-step at ANY world size is what makes a
+        dp-dimension shrink/grow loss-continuous.  An explicit
+        annotation wins; the default is one sample per device at the
+        FLOOR world (min-slices x pods-per-slice x chips-per-pod) —
+        a constant derived only from resize-invariant quantities."""
+        from volcano_tpu.api import elastic as eapi
+        explicit = job.annotations.get(
+            eapi.ELASTIC_GLOBAL_BATCH_ANNOTATION)
+        if explicit:
+            set_env(pod, "WORKER_GLOBAL_BATCH", str(explicit))
+            return
+        rng = eapi.elastic_range(job)
+        cur = eapi.current_slices(job)
+        if rng is None or num_workers <= 0 or num_workers % cur:
+            return
+        per_pod = int(float(task_spec.template_pod()
+                            .resource_requests().get(TPU) or 0))
+        if per_pod <= 0:
+            return
+        pods_per_slice = num_workers // cur
+        set_env(pod, "WORKER_GLOBAL_BATCH",
+                str(rng[0] * pods_per_slice * per_pod))

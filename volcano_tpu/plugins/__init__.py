@@ -30,6 +30,7 @@ import volcano_tpu.plugins.numaaware     # noqa: F401
 import volcano_tpu.plugins.extender      # noqa: F401
 import volcano_tpu.plugins.rescheduling  # noqa: F401
 import volcano_tpu.plugins.failover      # noqa: F401
+import volcano_tpu.plugins.elastic       # noqa: F401
 import volcano_tpu.plugins.datalocality  # noqa: F401
 import volcano_tpu.plugins.volumebinding # noqa: F401
 import volcano_tpu.plugins.dra           # noqa: F401

@@ -27,7 +27,16 @@ def generate_self_signed(cert_path: str, key_path: str,
                          days: int = 365) -> None:
     """Write a self-signed server certificate + key (PEM).  The same
     cert file doubles as the clients' CA bundle (self-signed ==
-    self-CA), mirroring the reference's gen-admission-secret flow."""
+    self-CA), mirroring the reference's gen-admission-secret flow.
+
+    Uses the `cryptography` package when importable, else falls back
+    to the system `openssl` binary (deploy images bake the ML stack,
+    not pyca/cryptography — the cert material is identical)."""
+    try:
+        from cryptography import x509  # noqa: F401
+    except ImportError:
+        _generate_self_signed_openssl(cert_path, key_path, hosts, days)
+        return
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
@@ -65,6 +74,46 @@ def generate_self_signed(cert_path: str, key_path: str,
         f.write(key_pem)
     with open(cert_path, "wb") as f:
         f.write(cert.public_bytes(serialization.Encoding.PEM))
+
+
+def _generate_self_signed_openssl(cert_path: str, key_path: str,
+                                  hosts: Tuple[str, ...],
+                                  days: int) -> None:
+    """`openssl req -x509` fallback producing the same PEM pair (SANs
+    for every host, CA:TRUE so the cert self-anchors as the clients'
+    bundle).  Key lands first with a restrictive mode, like the
+    library path."""
+    import shutil
+    import subprocess
+    openssl = shutil.which("openssl")
+    if openssl is None:
+        raise RuntimeError(
+            "cannot generate a self-signed cert: neither the "
+            "`cryptography` package nor an `openssl` binary is "
+            "available")
+    alt = []
+    for h in hosts:
+        try:
+            ipaddress.ip_address(h)
+            alt.append(f"IP:{h}")
+        except ValueError:
+            alt.append(f"DNS:{h}")
+    # pre-create the key with a restrictive mode so openssl's write
+    # lands on 0600 (openssl honors existing modes on POSIX)
+    fd = os.open(key_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    os.close(fd)
+    # NOTE: no explicit basicConstraints — `req -x509` already emits
+    # CA:TRUE, and a duplicated extension makes OpenSSL-backed clients
+    # reject the chain with `unknown ca`
+    cmd = [openssl, "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+           "-keyout", key_path, "-out", cert_path,
+           "-days", str(days), "-subj", "/CN=volcano-tpu",
+           "-addext", f"subjectAltName={','.join(alt)}"]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"openssl cert generation failed: {proc.stderr[-500:]}")
+    os.chmod(key_path, 0o600)
 
 
 def server_ssl_context(cert_path: str, key_path: str) -> ssl.SSLContext:

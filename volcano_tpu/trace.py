@@ -129,6 +129,7 @@ def observe_phase_metrics(pod_annotations: Dict[str, str],
 # messages NEVER become labels — they ride in trace payloads and the
 # podgroup annotation's `detail` samples only.
 REASON_ENUM = (
+    "elastic-waiting-for-capacity",
     "quarantined",
     "node-affinity-mismatch",
     "taint-not-tolerated",
@@ -151,6 +152,13 @@ REASON_ENUM = (
 
 # keyword -> enum, first match wins (ordered: specific before generic)
 _REASON_RULES: Tuple[Tuple[Tuple[str, ...], str], ...] = (
+    # before the generic rules: an elastic gang parked at its floor
+    # names the wait explicitly (actions/elastic.py records it).
+    # Keyed on the message PREFIX, not the bare word "elastic" — the
+    # migration predicate's "slice vacated by elastic migration" must
+    # not read as a capacity wait
+    (("elastic: waiting", "waiting for capacity"),
+     "elastic-waiting-for-capacity"),
     (("quarantin",), "quarantined"),
     (("warm spare",), "warm-spare-reserved"),
     (("node selector", "node affinity", "nodegroup", "affinity "),

@@ -39,7 +39,21 @@ def mutate_job(job: VCJob) -> VCJob:
     if job.min_available <= 0:
         job.min_available = job.total_replicas()
     _mutate_mpi(job)
+    _mutate_elastic(job)
     return job
+
+
+def _mutate_elastic(job: VCJob) -> None:
+    """Elastic defaulting: a job declaring min/max-slices starts at
+    its floor unless it names a size — submit small, let the
+    scheduler grow it into idle capacity (actions/elastic.py)."""
+    from volcano_tpu.api import elastic as eapi
+    if not eapi.is_elastic(job):
+        return
+    ann = job.annotations
+    if eapi.ELASTIC_SLICES_ANNOTATION not in ann:
+        ann[eapi.ELASTIC_SLICES_ANNOTATION] = \
+            ann[eapi.ELASTIC_MIN_SLICES_ANNOTATION]
 
 
 def _mutate_mpi(job: VCJob) -> None:
@@ -137,11 +151,58 @@ def validate_job(job: VCJob, cluster=None) -> None:
                 f"task {task.name!r}: conflicting networkTopology for "
                 f"subGroup {task.subgroup!r} (one constraint per "
                 "subgroup gang)")
+    _validate_elastic(job)
     if cluster is not None and job.queue:
         if job.queue not in cluster.queues:
             raise AdmissionError(f"queue {job.queue!r} does not exist")
         if not cluster.queues[job.queue].is_open():
             raise AdmissionError(f"queue {job.queue!r} is not open")
+
+
+def _validate_elastic(job: VCJob) -> None:
+    """Elastic-range sanity: integers with 1 <= min <= slices <= max,
+    and the TPU worker replicas must divide evenly by the slice count
+    (the quotient — pods-per-slice — is the invariant every resize
+    preserves, so a fractional one can never be materialized)."""
+    from volcano_tpu.api import elastic as eapi
+    from volcano_tpu.api.resource import TPU
+    ann = job.annotations
+    declared = [k for k in (eapi.ELASTIC_MIN_SLICES_ANNOTATION,
+                            eapi.ELASTIC_MAX_SLICES_ANNOTATION)
+                if k in ann]
+    if not declared:
+        return
+    if len(declared) == 1:
+        raise AdmissionError(
+            f"elastic jobs must declare BOTH min-slices and "
+            f"max-slices (got only {declared[0]})")
+    rng = eapi.elastic_range(job)
+    if rng is None:
+        raise AdmissionError(
+            "elastic min/max-slices must be integers with "
+            "1 <= min <= max")
+    if any(t.subgroup for t in job.tasks):
+        # the resize machinery scales ONE process grid (the jax
+        # plugin's elastic env path keys slice ids on rank blocks);
+        # subgrouped gangs pin slice ids to static subgroups, which a
+        # resize cannot re-shape — reject instead of mis-meshing
+        raise AdmissionError(
+            "elastic ranges are not supported on subgrouped gangs "
+            "(the subgroup count pins the slice topology)")
+    slices = eapi.current_slices(job)
+    if not rng[0] <= slices <= rng[1]:
+        raise AdmissionError(
+            f"elastic slices {slices} outside the declared range "
+            f"[{rng[0]}, {rng[1]}]")
+    scalable = [t for t in job.tasks
+                if float((t.template_pod().resource_requests()
+                          .get(TPU)) or 0) > 0] or job.tasks
+    for task in scalable:
+        if task.replicas % slices:
+            raise AdmissionError(
+                f"task {task.name!r}: {task.replicas} replicas do not "
+                f"divide into {slices} slice(s) — elastic resize "
+                f"needs an integral pods-per-slice")
 
 
 # -- queues -----------------------------------------------------------

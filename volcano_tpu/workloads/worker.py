@@ -77,8 +77,14 @@ def run(environ=None) -> dict:
         params, opt_state, start_step = checkpoint.resume_state(
             params, opt_state, directory=info.checkpoint_dir,
             resume_step=info.resume_step, environ=environ)
+    # WORKER_GLOBAL_BATCH pins the GLOBAL batch across elastic
+    # resizes: the same tokens-per-step at any world size is what
+    # makes a dp-dimension shrink/grow loss-continuous (defaults to
+    # one sample per device, the pre-elastic behavior)
+    global_batch = int(os.environ.get("WORKER_GLOBAL_BATCH", n_dev))
     batch = {"tokens": jax.jit(
-        lambda: jax.random.randint(jax.random.key(1), (n_dev, 32), 0,
+        lambda: jax.random.randint(jax.random.key(1),
+                                   (global_batch, 32), 0,
                                    cfg.vocab_size, dtype=jnp.int32),
         out_shardings=train.batch_sharding(mesh))()}
     step = train.make_train_step(cfg, mesh, optimizer)
