@@ -13,9 +13,12 @@ real scheduler, wall-clock latency of the full scheduling cycle
 from __future__ import annotations
 
 import json
+import random
 import statistics
 import time
 from typing import Optional, Tuple
+
+from volcano_tpu.api import elastic as eapi
 
 
 BENCH_CONF = {
@@ -1614,6 +1617,431 @@ def elastic_smoke() -> int:
     return 0 if ok else 1
 
 
+# -- goodput observatory (ISSUE 9) -------------------------------------
+
+
+def _post_job_reports(cluster, job_name, rate, ts, ledger, dt=1.0,
+                      productive_frac=1.0, step=0):
+    """Simulate the agent fleet for one gang: one GoodputReport per
+    hosting node, entries carrying the simulated step rate and the
+    CUMULATIVE per-pod ledger (*ledger*: uid -> (alloc, prod),
+    advanced by one dt window per call — the store diffs against the
+    node's previous report exactly as it would for real agents)."""
+    from volcano_tpu.api import goodput as gapi
+    from volcano_tpu.api.types import TaskStatus
+    j = cluster.vcjobs[f"default/{job_name}"]
+    by_node = {}
+    for p in cluster.pods.values():
+        if p.owner == j.uid and p.node_name and \
+                p.phase in (TaskStatus.BOUND, TaskStatus.RUNNING):
+            by_node.setdefault(p.node_name, []).append(p)
+    for node, pods in by_node.items():
+        gen = gapi.generation_of(cluster.nodes[node].labels)
+        usages = []
+        for p in pods:
+            alloc, prod = ledger.get(p.uid, (0.0, 0.0))
+            alloc += dt
+            prod += dt * productive_frac
+            ledger[p.uid] = (alloc, prod)
+            usages.append(gapi.PodGoodput(
+                pod_key=p.key, uid=p.uid, job=f"default/{job_name}",
+                generation=gen, step=step,
+                steps_per_s=round(rate, 4),
+                goodput=productive_frac,
+                allocated_s=round(alloc, 4),
+                productive_s=round(prod, 4)))
+        cluster.put_object("goodputreport", gapi.GoodputReport(
+            node=node, ts=ts, usages=usages))
+    return len(by_node)
+
+
+def bench_goodput(smoke: bool = False) -> dict:
+    """Goodput observatory acceptance (ISSUE 9), three committed
+    claims in one artifact (GOODPUT_r{N}.json):
+
+      1. learned per-(job, generation) throughput vectors converge to
+         the simulator's ground-truth step rates within 10% on a
+         1k-host contended cluster with deliberately heterogeneous
+         rates (v5e vs v5p generations, per-job multipliers);
+      2. the goodput ledger (REAL GoodputCollector over a synthetic
+         progress filesystem with an injected clock) reconciles with
+         wall-clock allocated time within 5% across a stall + a
+         resize-epoch restart;
+      3. gating elastic grow on measured marginal throughput (the
+         minimal Pollux step) lifts aggregate ground-truth steps/s
+         vs greedy absorption on the same scenario.
+    """
+    import time as _time
+
+    from volcano_tpu import metrics
+    from volcano_tpu.api import goodput as gapi
+    from volcano_tpu.api.types import JobPhase
+    from volcano_tpu.controllers import ControllerManager
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.simulator import make_tpu_cluster
+    from volcano_tpu.webhooks import default_admission
+
+    # -- phase 1: vector convergence at scale --------------------------
+    n_per_gen = 2 if smoke else 8
+    kinds = ("v5e-16", "v5p-128") if smoke else ("v5e-256", "v5p-256")
+    pods_per_job = 4 if smoke else 64
+    # more jobs than one generation holds, so placement spreads the
+    # fleet across BOTH generations and the learned vectors prove the
+    # per-generation split (v5p ground truth is 2.2x v5e)
+    n_jobs = 2 if smoke else 10
+    rounds = 6
+    cluster = make_tpu_cluster(
+        [(f"e{i:02d}", kinds[0]) for i in range(n_per_gen)]
+        + [(f"p{i:02d}", kinds[1]) for i in range(n_per_gen)])
+    cluster.admission = default_admission()
+    hosts = len(cluster.nodes)
+    sched = Scheduler(cluster, schedule_period=0)
+    mgr = ControllerManager(cluster, enabled=["job", "podgroup",
+                                              "queue"])
+    for j in range(n_jobs):
+        cluster.add_vcjob(_fixed_vcjob(f"gp-{j}", pods_per_job))
+    for _ in range(20):
+        mgr.sync_all()
+        sched.run_once()
+        cluster.tick()
+        if all(cluster.vcjobs[f"default/gp-{j}"].phase
+               is JobPhase.RUNNING for j in range(n_jobs)):
+            break
+    assert all(cluster.vcjobs[f"default/gp-{j}"].phase
+               is JobPhase.RUNNING for j in range(n_jobs)), \
+        "goodput bench jobs never all ran"
+
+    # deliberately heterogeneous ground truth: per-job base rate,
+    # v5p 2.2x faster than v5e — the vector the estimator must learn
+    def gt_rate(j, gen):
+        base = 5.0 + 2.0 * j
+        return base * (2.2 if gen == "v5p" else 1.0)
+
+    def job_generation(j):
+        jj = cluster.vcjobs[f"default/gp-{j}"]
+        for p in cluster.pods.values():
+            if p.owner == jj.uid and p.node_name:
+                return gapi.generation_of(
+                    cluster.nodes[p.node_name].labels)
+        return "other"
+
+    rng = random.Random(11)
+    dt = 1.0
+    ledger = {}
+    for r in range(rounds):
+        for j in range(n_jobs):
+            gen = job_generation(j)
+            noisy = gt_rate(j, gen) * rng.uniform(0.97, 1.03)
+            _post_job_reports(cluster, f"gp-{j}", noisy,
+                              ts=1000.0 + r, ledger=ledger, dt=dt,
+                              step=10 * (r + 1))
+    book = sched.cache.goodput_book
+    vec_errs = []
+    for j in range(n_jobs):
+        gen = job_generation(j)
+        learned = book.vector(f"default/gp-{j}").get(gen, 0.0)
+        truth = gt_rate(j, gen)
+        vec_errs.append(abs(learned - truth) / truth)
+    vector_max_rel_err = round(max(vec_errs), 4)
+    assert vector_max_rel_err < 0.10, \
+        f"learned vectors off by {vector_max_rel_err}"
+
+    # the scale-side ledger: every pod contributed dt per round; the
+    # accumulated podgroup ledger must equal pods x rounds x dt
+    ledger_errs = []
+    for j in range(n_jobs):
+        pg = cluster.podgroups[f"default/gp-{j}"]
+        acc = gapi.ann_float(pg.annotations,
+                             gapi.PG_ALLOCATED_S_ANNOTATION)
+        want = pods_per_job * rounds * dt
+        ledger_errs.append(abs(acc - want) / want)
+    ssn = sched.run_once()          # export the session gauges
+    fleet_rate = metrics.get_gauge("goodput_fleet_steps_per_second")
+    mgr.stop()
+
+    # -- phase 2: ledger vs wall clock (REAL collector, fake clock) ----
+    import tempfile
+
+    from volcano_tpu.agent.collect import GoodputCollector
+    from volcano_tpu.workloads.progress import ProgressReporter
+    root = tempfile.mkdtemp(prefix="goodput-bench-")
+    t = [0.0]
+    col = GoodputCollector(root, now=lambda: t[0])
+    n_pods = 4
+
+    def write(uid, step, epoch):
+        ProgressReporter(gapi.progress_file_for(root, uid),
+                         epoch=epoch,
+                         now=lambda: t[0]).report(step=step)
+
+    # timeline per pod: 60s stepping at 1 step/s, 20s stalled
+    # (drain), epoch bump + resume from the checkpoint floor (step
+    # 30), 40s stepping — sampled every 2s like an agent sync
+    for uid in range(n_pods):
+        write(f"u{uid}", 0, 0)
+    col.collect("n0")
+    while t[0] < 120.0:
+        t[0] += 2.0
+        for uid in range(n_pods):
+            if t[0] <= 60.0:
+                write(f"u{uid}", int(t[0]), 0)
+            elif t[0] <= 80.0:
+                pass                      # stalled: file untouched
+            else:
+                write(f"u{uid}", 30 + int(t[0] - 80), 1)
+        col.collect("n0")
+    wall_alloc = 120.0 * n_pods
+    acc_alloc = sum(st.allocated_s for st in col.rates().values())
+    acc_prod = sum(st.productive_s for st in col.rates().values())
+    reconcile_err = abs(acc_alloc - wall_alloc) / wall_alloc
+    # expected productive: 60s stepping + 40s resumed, minus the one
+    # post-epoch boundary window that earns no credit
+    expected_prod = (60.0 + 40.0 - 2.0) * n_pods
+    goodput_err = abs(acc_prod - expected_prod) / expected_prod
+    assert reconcile_err < 0.05, \
+        f"ledger {acc_alloc} vs wall {wall_alloc}"
+    assert goodput_err < 0.05, \
+        f"productive {acc_prod} vs expected {expected_prod}"
+    rate_after = max(st.steps_per_s for st in col.rates().values())
+    assert rate_after <= 1.1, \
+        f"post-restart rate spiked to {rate_after}"
+
+    # -- phase 3: goodput-gated grow vs greedy absorption --------------
+    def run_grow_scenario(gate_on: bool):
+        slice_kind = "v5e-16"
+        conf = json.loads(json.dumps(ELASTIC_CONF))
+        conf["configurations"]["elastic"][
+            "elastic.goodputGateGrow"] = "true" if gate_on else "false"
+        from volcano_tpu.cache.cluster import PriorityClass
+        c = make_tpu_cluster([(f"g{i}", slice_kind) for i in range(4)])
+        c.admission = default_admission()
+        m = ControllerManager(c, enabled=["job", "podgroup", "queue",
+                                          "failover", "elastic"])
+        s = Scheduler(c, conf=conf, schedule_period=0)
+        # the bad scaler outranks the good one in job order (priority
+        # class), so GREEDY absorption always hands it the next idle
+        # slice — only the measured-throughput gate redirects capacity
+        c.add_priority_class(PriorityClass(name="hot", value=1000))
+        bad = _elastic_vcjob("bad", 1, 1, 3, 4)
+        bad.priority_class = "hot"
+        c.add_vcjob(bad)
+        c.add_vcjob(_elastic_vcjob("good", 1, 1, 3, 4))
+        c.add_vcjob(_fixed_vcjob("pin-a", 4, run_ticks=8))
+        c.add_vcjob(_fixed_vcjob("pin-b", 4, run_ticks=30))
+
+        def rate_of(name, slices):
+            # bad: near-flat scaling; good: linear
+            return 10.0 * (slices ** 0.1) if name == "bad" \
+                else 10.0 * slices
+
+        ts = [2000.0]
+        run_ledger = {}
+        for cycle in range(120):
+            m.sync_all()
+            s.run_once()
+            c.tick()
+            ts[0] += 1.0
+            for name in ("bad", "good"):
+                pg = c.podgroups.get(f"default/{name}")
+                jj = c.vcjobs.get(f"default/{name}")
+                if pg is None or jj is None or \
+                        jj.phase is not JobPhase.RUNNING:
+                    continue
+                cur = eapi.current_slices(pg)
+                _post_job_reports(c, name, rate_of(name, cur),
+                                  ts=ts[0], ledger=run_ledger,
+                                  step=cycle)
+            if c.vcjobs["default/pin-b"].phase is JobPhase.COMPLETED \
+                    and cycle > 60:
+                break
+        sizes = {name: eapi.current_slices(
+            c.podgroups[f"default/{name}"]) for name in ("bad",
+                                                         "good")}
+        agg = sum(rate_of(name, n) for name, n in sizes.items())
+        m.stop()
+        return sizes, round(agg, 3)
+
+    gated_sizes, gated_agg = run_grow_scenario(gate_on=True)
+    greedy_sizes, greedy_agg = run_grow_scenario(gate_on=False)
+    lift = round(gated_agg / greedy_agg, 4) if greedy_agg else None
+    assert lift and lift > 1.0, \
+        f"goodput gating did not beat greedy: {gated_agg} vs " \
+        f"{greedy_agg} ({gated_sizes} vs {greedy_sizes})"
+
+    return {
+        "hosts": hosts,
+        "generations": sorted({job_generation(j)
+                               for j in range(n_jobs)}),
+        "jobs": n_jobs, "report_rounds": rounds,
+        "vector_max_rel_err": vector_max_rel_err,
+        "vector_err_target": 0.10,
+        "ledger_scale_max_rel_err": round(max(ledger_errs), 6),
+        "fleet_steps_per_second_gauge": round(fleet_rate, 3),
+        "reconcile": {
+            "pods": n_pods,
+            "allocated_wall_s": wall_alloc,
+            "allocated_accounted_s": round(acc_alloc, 3),
+            "rel_err": round(reconcile_err, 6),
+            "target": 0.05,
+            "productive_accounted_s": round(acc_prod, 3),
+            "productive_expected_s": expected_prod,
+            "goodput_measured": round(acc_prod / acc_alloc, 4),
+            "post_restart_rate_max": round(rate_after, 4),
+        },
+        "grow_gating": {
+            "gated_slices": gated_sizes,
+            "greedy_slices": greedy_sizes,
+            "gated_agg_steps_per_s": gated_agg,
+            "greedy_agg_steps_per_s": greedy_agg,
+            "aggregate_lift": lift,
+        },
+    }
+
+
+def bench_goodput_wire_smoke() -> dict:
+    """Worker progress files -> REAL agent collector/handler ->
+    GoodputReport over the wire -> store fold -> podgroup annotations,
+    through the real process control plane (state server + scheduler +
+    controllers as OS processes) — the tier-1 guard that the goodput
+    stream works over the wire, not just in-process."""
+    import os
+    import time as _time
+
+    from volcano_tpu.agent.agent import FakeUsageProvider, NodeAgent
+    from volcano_tpu.agent.collect import GoodputCollector
+    from volcano_tpu.agent.handlers import GoodputHandler
+    from volcano_tpu.api import goodput as gapi
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.api.types import JobPhase, TaskStatus
+    from volcano_tpu.api.vcjob import TaskSpec, VCJob
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.simulator import slice_nodes
+
+    plane = _WirePlane()
+    kubectl = None
+    agents = []
+    try:
+        plane.spawn("server", "-m", "volcano_tpu.server",
+                    "--port", str(plane.port), "--tick-period", "0.05")
+        import urllib.request
+
+        def up():
+            try:
+                with urllib.request.urlopen(plane.url + "/healthz",
+                                            timeout=1):
+                    return True
+            except OSError:
+                return False
+        _wire_wait(up, 20, "state server /healthz")
+        plane.spawn("controllers", "-m", "volcano_tpu",
+                    "--cluster-url", plane.url,
+                    "--components", "controllers", "--period", "0.05")
+        plane.spawn("scheduler", "-m", "volcano_tpu",
+                    "--cluster-url", plane.url,
+                    "--components", "scheduler", "--period", "0.05")
+        kubectl = RemoteCluster(plane.url)
+        for node in slice_nodes(slice_for("sa", "v5e-16"),
+                                dcn_pod="dcn-0"):
+            kubectl.add_node(node)
+
+        progress_dir = os.path.join(plane.logdir, "progress")
+        os.makedirs(progress_dir, exist_ok=True)
+        kubectl.add_vcjob(VCJob(
+            name="gp", min_available=4,
+            annotations={gapi.PROGRESS_DIR_ANNOTATION: progress_dir},
+            plugins={"jax": []},
+            tasks=[TaskSpec(name="worker", replicas=4,
+                            template=make_pod(
+                                "t", requests={"cpu": 4, TPU: 4}))]))
+
+        def workers_running():
+            j = kubectl.vcjobs.get("default/gp")
+            if j is None or j.phase is not JobPhase.RUNNING:
+                return False
+            pods = [p for p in kubectl.pods.values()
+                    if p.owner == j.uid
+                    and p.phase is TaskStatus.RUNNING and p.node_name]
+            return len(pods) == 4
+        _wire_wait(workers_running, 60,
+                   lambda: "goodput smoke workers never ran "
+                   f"({plane.log_tails()[-900:]})")
+
+        j = kubectl.vcjobs["default/gp"]
+        pods = [p for p in kubectl.pods.values() if p.owner == j.uid]
+        env_ok = all(
+            gapi.ENV_PROGRESS_FILE in p.containers[0].env
+            for p in pods)
+
+        # one REAL agent per host, sharing the collector over the
+        # progress root (each handler pairs only its node's pods)
+        col = GoodputCollector(progress_dir)
+        for p in sorted(pods, key=lambda p: p.node_name):
+            agents.append(NodeAgent(
+                kubectl, p.node_name, FakeUsageProvider(),
+                handlers=[GoodputHandler], goodput_collector=col))
+
+        step = 0
+        for _ in range(8):
+            step += 2
+            for p in pods:
+                ProgressReporterFor(progress_dir, p.uid, step)
+            for a in agents:
+                a.sync()
+            _time.sleep(0.25)
+
+        def folded():
+            pg = kubectl.podgroups.get("default/gp")
+            return pg is not None and \
+                gapi.ann_float(pg.annotations,
+                               gapi.PG_STEP_RATE_ANNOTATION) > 0
+        _wire_wait(folded, 30,
+                   lambda: "goodput fold never reached the podgroup "
+                   f"({plane.log_tails()[-900:]})")
+        pg = kubectl.podgroups["default/gp"]
+        ann = pg.annotations
+        return {
+            "fold_ok": True,
+            "env_ok": env_ok,
+            "steps_per_s": gapi.ann_float(
+                ann, gapi.PG_STEP_RATE_ANNOTATION),
+            "step": int(gapi.ann_float(ann, gapi.PG_STEP_ANNOTATION)),
+            "goodput": gapi.ann_float(ann, gapi.PG_GOODPUT_ANNOTATION),
+            "allocated_pod_s": gapi.ann_float(
+                ann, gapi.PG_ALLOCATED_S_ANNOTATION),
+            "reports": len(getattr(kubectl, "goodputreports", {})),
+            "generation": ann.get(gapi.PG_GENERATION_ANNOTATION, ""),
+            "hosts": 4,
+        }
+    finally:
+        if kubectl is not None:
+            kubectl.close()
+        plane.shutdown()
+
+
+def ProgressReporterFor(root, uid, step):
+    from volcano_tpu.api import goodput as gapi
+    from volcano_tpu.workloads.progress import ProgressReporter
+    ProgressReporter(gapi.progress_file_for(root, uid)).report(
+        step=step, examples=step * 32.0)
+
+
+def goodput_smoke() -> int:
+    """Seconds-scale goodput drill for tier-1: progress files ->
+    real agents -> wire -> fold, mirroring --wire-smoke /
+    --elastic-smoke.  Prints one JSON line."""
+    try:
+        out = bench_goodput_wire_smoke()
+        ok = (out["fold_ok"] and out["env_ok"]
+              and out["steps_per_s"] > 0 and 0 < out["goodput"] <= 1
+              and out["step"] > 0)
+    except AssertionError as e:
+        out, ok = {"error": str(e)[-900:]}, False
+    print(json.dumps({"metric": "goodput_smoke", "ok": ok, **out}))
+    return 0 if ok else 1
+
+
 # -- control-plane crash chaos (kill -9 + WAL recovery) ----------------
 
 
@@ -2464,6 +2892,7 @@ def main():
     net_acct = isolated(bench_net_accounting_overhead)
     failover = isolated(bench_failover_chaos)
     elastic = isolated(bench_elastic)
+    goodput = isolated(bench_goodput)
     crash = isolated(bench_crash_recovery)
     wire = isolated(run_wire_benchmarks)
     probe, flash, train_tpu = run_tpu_benchmarks()
@@ -2499,6 +2928,10 @@ def main():
             # migration-MTTR percentiles (`--elastic` regenerates
             # standalone -> ELASTIC_r{N}.json)
             "elastic": elastic,
+            # goodput observatory: learned throughput vectors, ledger
+            # reconciliation, grow gating (`--goodput` regenerates
+            # standalone -> GOODPUT_r{N}.json)
+            "goodput": goodput,
             # state-server kill -9 chaos: RTO + WAL replay + the
             # zero-acked-writes-lost / zero-mirror-divergence
             # invariants (`--crash` regenerates standalone ->
@@ -2558,6 +2991,15 @@ if __name__ == "__main__":
         sys.exit(failover_smoke())
     elif "--elastic-smoke" in sys.argv:
         sys.exit(elastic_smoke())
+    elif "--goodput-smoke" in sys.argv:
+        sys.exit(goodput_smoke())
+    elif "--goodput" in sys.argv:
+        # the standalone goodput-observatory row committed as
+        # GOODPUT_r{N}.json: learned throughput vectors within 10% of
+        # simulator ground truth, ledger reconciles with wall-clock
+        # allocated time within 5%, goodput-gated grow beats greedy
+        print(json.dumps({"metric": "goodput_observatory_1k_hosts",
+                          **bench_goodput()}))
     elif "--elastic-child" in sys.argv:
         _elastic_child()
     elif "--elastic" in sys.argv:

@@ -1,0 +1,731 @@
+"""Goodput observatory (ISSUE 9): measured step rates, learned
+per-generation throughput vectors, fragmentation/starvation gauges.
+
+The subsystem spans four layers:
+
+  workload   (workloads/progress.py + worker.py): per-step progress
+      records written atomically to the jax-plugin-injected
+      VTP_PROGRESS_FILE, stamped with the control plane's
+      restart/resize epoch;
+  agent      (agent/collect.py GoodputCollector + handlers.py
+      GoodputHandler): EWMA step rates off the SHARED RateWindow
+      machinery (util.py — the netaccounting counter logic, factored),
+      a productive-vs-allocated time ledger, one GoodputReport per
+      node per sync;
+  store      (cache/fake_cluster.py): the report folds into PODGROUP
+      annotations, accumulating the ledger across nodes and sticking
+      across whole-podgroup writes from stale mirrors;
+  scheduler  (volcano_tpu/goodput.py + cache/cache.py): the
+      ThroughputBook learns per-(job, generation) vectors from watch
+      events, sessions export frag_*/starvation_*/goodput_* gauges,
+      and the elastic action's grow gate declines a grow whose last
+      measured speedup fell below threshold (the minimal Pollux step).
+"""
+
+import json
+import os
+import pickle
+import subprocess
+import sys
+import time
+
+import pytest
+
+from volcano_tpu import goodput as gp
+from volcano_tpu import metrics, trace
+from volcano_tpu.agent.agent import FakeUsageProvider, NodeAgent
+from volcano_tpu.agent.collect import GoodputCollector
+from volcano_tpu.agent.handlers import GoodputHandler
+from volcano_tpu.api import elastic as eapi
+from volcano_tpu.api import goodput as gapi
+from volcano_tpu.api.pod import make_pod
+from volcano_tpu.api.podgroup import PodGroup
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.types import (
+    GROUP_NAME_ANNOTATION,
+    JobPhase,
+    PodGroupPhase,
+    TaskStatus,
+)
+from volcano_tpu.api.vcjob import TaskSpec, VCJob
+from volcano_tpu.controllers import ControllerManager
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.simulator import make_tpu_cluster
+from volcano_tpu.util import RateWindow
+from volcano_tpu.webhooks import default_admission
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ELASTIC_CONF = {
+    "actions": "enqueue, allocate, elastic, gangpreempt, backfill",
+    "tiers": [
+        {"plugins": [{"name": "priority"}, {"name": "gang"},
+                     {"name": "failover"}, {"name": "elastic"},
+                     {"name": "conformance"}]},
+        {"plugins": [{"name": "overcommit"}, {"name": "drf"},
+                     {"name": "predicates"}, {"name": "proportion"},
+                     {"name": "nodeorder"}, {"name": "binpack"},
+                     {"name": "deviceshare"},
+                     {"name": "network-topology-aware"}]},
+    ],
+    "configurations": {"elastic": {"elastic.cooldownSeconds": 0}},
+}
+
+
+class Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float = 1.0) -> None:
+        self.t += dt
+
+
+def write_progress(root, uid, step, ts, epoch=0, examples=0.0):
+    from volcano_tpu.workloads.progress import ProgressReporter
+    r = ProgressReporter(gapi.progress_file_for(root, uid),
+                        epoch=epoch, now=lambda: ts)
+    assert r.report(step=step, examples=examples)
+
+
+# -- shared RateWindow helper (satellite: one copy of the EWMA /
+#    counter-reset machinery) ------------------------------------------
+
+def test_rate_window_policies():
+    w = RateWindow(alpha=0.5, reset="absolute", scale=1.0)
+    assert w.fold(0, 0.0) == 0.0            # opens the window
+    assert w.fold(100, 10.0) == pytest.approx(10.0)
+    # EWMA folds the next window
+    assert w.fold(100, 20.0) == pytest.approx(5.0)
+    # absolute reset: the new value IS the delta
+    assert w.fold(50, 30.0) == pytest.approx(0.5 * 5 + 0.5 * 5.0)
+
+    r = RateWindow(alpha=0.5, reset="restart")
+    r.fold(100, 0.0)
+    assert r.fold(110, 10.0) == pytest.approx(1.0)
+    # restart policy: a lower reading re-opens the window, NO delta
+    assert r.fold(40, 20.0) == pytest.approx(1.0)
+    assert r.fold(50, 30.0) == pytest.approx(1.0)   # 10/10 folded
+    # a None reading leaves the window untouched (spans to next read)
+    assert r.fold(None, 40.0) == pytest.approx(1.0)
+    assert r.fold(70, 50.0) == pytest.approx(
+        0.5 * (20 / 20) + 0.5 * 1.0)
+    # explicit restart (epoch signal) drops the window, keeps the rate
+    r.restart()
+    assert r.last is None and r.rate > 0
+
+    # net-accounting parity: the refactored collector still computes
+    # the exact rates the pre-refactor inline fold did (tested in
+    # test_net_accounting.py against the fake cgroup fs)
+    with pytest.raises(ValueError):
+        RateWindow(reset="bogus")
+
+
+# -- collector: progress files -> rates + goodput ledger ---------------
+
+def test_collector_step_rate_and_goodput_ledger(tmp_path):
+    root = str(tmp_path)
+    clock = Clock()
+    col = GoodputCollector(root, now=clock)
+    write_progress(root, "u1", step=100, ts=1000.0)
+    col.collect("n0")                        # baseline
+    clock.tick(10)
+    write_progress(root, "u1", step=110, ts=1010.0)
+    totals = col.collect("n0")
+    st = col.rates()["u1"]
+    assert st.steps_per_s == pytest.approx(1.0)
+    assert totals["goodput_steps_per_s"] == pytest.approx(1.0)
+    assert st.allocated_s == pytest.approx(10.0)
+    assert st.productive_s == pytest.approx(10.0)
+    assert st.goodput == pytest.approx(1.0)
+    assert not st.stalled
+
+    # a stalled window (no step advance) is allocated-but-unproductive
+    clock.tick(10)
+    col.collect("n0")
+    st = col.rates()["u1"]
+    assert st.allocated_s == pytest.approx(20.0)
+    assert st.productive_s == pytest.approx(10.0)
+    assert st.goodput == pytest.approx(0.5)
+    assert st.stalled
+
+    # the ledger reconciles: productive + unproductive == allocated
+    assert st.allocated_s == pytest.approx(
+        st.productive_s + (st.allocated_s - st.productive_s))
+
+    # productive credit is bounded by the WORKER's own clock: a pod
+    # that stepped for 2s of a 10s window gets 2s, not 10
+    clock.tick(10)
+    write_progress(root, "u1", step=111, ts=1012.0)
+    col.collect("n0")
+    st = col.rates()["u1"]
+    assert st.productive_s == pytest.approx(12.0)
+    assert st.allocated_s == pytest.approx(30.0)
+
+    # a vanished file drops its state
+    os.unlink(gapi.progress_file_for(root, "u1"))
+    clock.tick(1)
+    col.collect("n0")
+    assert "u1" not in col.rates()
+
+
+def test_counter_reset_and_resize_epoch_never_inflate(tmp_path):
+    """Satellite acceptance: a restarted worker (checkpoint-floor
+    step count, bumped resize epoch) never produces a negative or
+    inflated step rate — with OR without the epoch signal."""
+    root = str(tmp_path)
+    clock = Clock()
+    col = GoodputCollector(root, now=clock)
+    write_progress(root, "u1", step=100, ts=1000.0, epoch=0)
+    col.collect("n0")
+    clock.tick(10)
+    write_progress(root, "u1", step=110, ts=1010.0, epoch=0)
+    col.collect("n0")
+    steady = col.rates()["u1"].steps_per_s
+    assert steady == pytest.approx(1.0)
+
+    # elastic resize: worker resumes from the checkpoint floor (40 <
+    # 110) with the epoch bumped — the window restarts, the rate
+    # neither goes negative nor spikes from the absolute counter
+    clock.tick(5)
+    write_progress(root, "u1", step=40, ts=1015.0, epoch=1)
+    col.collect("n0")
+    st = col.rates()["u1"]
+    assert st.restarts == 1
+    assert 0 <= st.steps_per_s <= steady * 1.01
+    # and the restart window granted no phantom productive credit
+    assert st.productive_s == pytest.approx(10.0)
+
+    # epoch bumped AGAIN but the resumed counter happens to be HIGHER
+    # (resume step past the old step): the out-of-band epoch still
+    # restarts the window — a 500-step jump must not read as rate
+    clock.tick(5)
+    write_progress(root, "u1", step=600, ts=1020.0, epoch=2)
+    col.collect("n0")
+    st = col.rates()["u1"]
+    assert st.restarts == 2
+    assert 0 <= st.steps_per_s <= steady * 1.01
+
+    # same-epoch regress (writer crash without control-plane drain):
+    # the "restart" reset policy still refuses the absolute delta
+    clock.tick(5)
+    write_progress(root, "u1", step=10, ts=1025.0, epoch=2)
+    col.collect("n0")
+    st = col.rates()["u1"]
+    assert 0 <= st.steps_per_s <= steady * 1.01
+
+    # ... and steady stepping after the chaos converges back to 1/s
+    for i in range(1, 9):
+        clock.tick(10)
+        write_progress(root, "u1", step=10 + 10 * i,
+                       ts=1025.0 + 10 * i, epoch=2)
+        col.collect("n0")
+    assert col.rates()["u1"].steps_per_s == pytest.approx(1.0,
+                                                          rel=0.05)
+
+
+# -- agent handler + store fold ----------------------------------------
+
+def agent_with_goodput(cluster, node, root, clock):
+    provider = FakeUsageProvider()
+    provider.set(node, cpu_fraction=0.2, tpu_chips_detected=4,
+                 tpu_chips_healthy=4)
+    return NodeAgent(cluster, node, provider,
+                     handlers=[GoodputHandler],
+                     goodput_collector=GoodputCollector(
+                         root, now=clock))
+
+
+def running_pod(name, node, uid, job="tj"):
+    return make_pod(name, requests={"cpu": 4, TPU: 4},
+                    node_name=node, phase=TaskStatus.RUNNING,
+                    uid=uid,
+                    annotations={GROUP_NAME_ANNOTATION: job})
+
+
+def test_handler_posts_and_store_folds_into_podgroup(tmp_path):
+    root = str(tmp_path)
+    clock = Clock()
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    cluster.add_podgroup(PodGroup(name="tj", namespace="default"))
+    cluster.add_pod(running_pod("tj-w0", "sa-w0", "u1"))
+    agent = agent_with_goodput(cluster, "sa-w0", root, clock)
+
+    write_progress(root, "u1", step=100, ts=1000.0)
+    agent.sync()
+    clock.tick(10)
+    write_progress(root, "u1", step=110, ts=1010.0)
+    agent.sync()
+
+    # pod annotations carry step + published rate
+    pod = cluster.pods["default/tj-w0"]
+    assert pod.annotations[gapi.POD_STEP_ANNOTATION] == "110"
+    assert float(pod.annotations[gapi.POD_STEP_RATE_ANNOTATION]) == \
+        pytest.approx(1.0)
+
+    # the report reached the store and folded into the PODGROUP
+    rep = cluster.goodputreports["sa-w0"]
+    assert rep.usages[0].job == "default/tj"
+    assert rep.usages[0].generation == "v5e"
+    pg = cluster.podgroups["default/tj"]
+    ann = pg.annotations
+    assert ann[gapi.PG_STEP_ANNOTATION] == "110"
+    assert float(ann[gapi.PG_STEP_RATE_ANNOTATION]) == \
+        pytest.approx(1.0)
+    assert float(ann[gapi.PG_ALLOCATED_S_ANNOTATION]) == \
+        pytest.approx(10.0)
+    assert float(ann[gapi.PG_PRODUCTIVE_S_ANNOTATION]) == \
+        pytest.approx(10.0)
+    assert float(ann[gapi.PG_GOODPUT_ANNOTATION]) == pytest.approx(1.0)
+    assert ann[gapi.PG_GENERATION_ANNOTATION] == "v5e"
+
+    # a stalled sync accumulates allocated, not productive; goodput
+    # debits toward 0.5
+    clock.tick(10)
+    agent.sync()
+    ann = cluster.podgroups["default/tj"].annotations
+    assert float(ann[gapi.PG_ALLOCATED_S_ANNOTATION]) == \
+        pytest.approx(20.0)
+    assert float(ann[gapi.PG_PRODUCTIVE_S_ANNOTATION]) == \
+        pytest.approx(10.0)
+    assert float(ann[gapi.PG_GOODPUT_ANNOTATION]) == pytest.approx(0.5)
+
+    # the node's report dies with the node (never resurrected onto a
+    # replacement host registering under the same name)
+    cluster.remove_node("sa-w0")
+    assert "sa-w0" not in cluster.goodputreports
+
+
+def test_fold_accumulates_across_nodes_and_sticks(tmp_path):
+    """Two nodes hosting one gang accumulate the ledger without
+    double counting (the store diffs each report against THAT node's
+    previous one), a RE-POSTED report after a lost ack is idempotent,
+    and a whole-podgroup write from a mirror that predates the fold
+    keeps the accounting (sticky re-apply)."""
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    cluster.add_podgroup(PodGroup(name="tj", namespace="default"))
+
+    def report(node, uid, alloc, prod, ts):
+        return gapi.GoodputReport(node=node, ts=ts, usages=[
+            gapi.PodGoodput(
+                pod_key=f"default/{uid}", uid=uid, job="default/tj",
+                generation="v5e", step=50, steps_per_s=2.0,
+                goodput=1.0, allocated_s=alloc, productive_s=prod)])
+
+    cluster.put_object("goodputreport",
+                       report("sa-w0", "u1", 10.0, 8.0, 1000.0))
+    cluster.put_object("goodputreport",
+                       report("sa-w1", "u2", 10.0, 8.0, 1000.0))
+    ann = cluster.podgroups["default/tj"].annotations
+    assert float(ann[gapi.PG_ALLOCATED_S_ANNOTATION]) == \
+        pytest.approx(20.0)
+    assert float(ann[gapi.PG_PRODUCTIVE_S_ANNOTATION]) == \
+        pytest.approx(16.0)
+    assert float(ann[gapi.PG_GOODPUT_ANNOTATION]) == pytest.approx(0.8)
+
+    # lost-ack retry: the agent re-sends the SAME cumulative values —
+    # the fold contributes nothing new (no double count)
+    cluster.put_object("goodputreport",
+                       report("sa-w0", "u1", 10.0, 8.0, 1001.0))
+    ann = cluster.podgroups["default/tj"].annotations
+    assert float(ann[gapi.PG_ALLOCATED_S_ANNOTATION]) == \
+        pytest.approx(20.0)
+    # ... and the next grown cumulative contributes only the growth
+    cluster.put_object("goodputreport",
+                       report("sa-w0", "u1", 15.0, 12.0, 1002.0))
+    ann = cluster.podgroups["default/tj"].annotations
+    assert float(ann[gapi.PG_ALLOCATED_S_ANNOTATION]) == \
+        pytest.approx(25.0)
+    assert float(ann[gapi.PG_PRODUCTIVE_S_ANNOTATION]) == \
+        pytest.approx(20.0)
+
+    # a restarted collector (cumulative below the previous report)
+    # contributes its new absolute value, never a negative
+    cluster.put_object("goodputreport",
+                       report("sa-w0", "u1", 2.0, 1.0, 1003.0))
+    ann = cluster.podgroups["default/tj"].annotations
+    assert float(ann[gapi.PG_ALLOCATED_S_ANNOTATION]) == \
+        pytest.approx(27.0)
+
+    # stale-mirror whole write: no goodput keys on the incoming copy
+    stale = PodGroup(name="tj", namespace="default")
+    cluster.put_object("podgroup", stale)
+    ann = cluster.podgroups["default/tj"].annotations
+    assert float(ann[gapi.PG_ALLOCATED_S_ANNOTATION]) == \
+        pytest.approx(27.0)
+
+
+def test_goodput_report_codec_roundtrip():
+    from volcano_tpu.api import codec
+    rep = gapi.GoodputReport(node="n1", ts=123.0, usages=[
+        gapi.PodGoodput(pod_key="d/p", uid="u1", job="d/j",
+                        generation="v5p", epoch=2, step=42,
+                        steps_per_s=3.25, examples_per_s=13.0,
+                        goodput=0.75, allocated_s=4.0,
+                        productive_s=3.0, stalled=True)])
+    back = codec.loads(codec.dumps(rep))
+    assert back.node == "n1" and back.usages[0].step == 42
+    assert back.usages[0].stalled is True
+    assert back.usages[0].generation == "v5p"
+
+
+# -- scheduler cache: the learned throughput vectors -------------------
+
+def test_book_learns_vectors_and_sessions_see_them():
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    sched = Scheduler(cluster, schedule_period=0)
+    cluster.add_podgroup(PodGroup(name="tj", namespace="default"))
+    for i, rate in enumerate((4.0, 4.0, 4.0)):
+        cluster.put_object("goodputreport", gapi.GoodputReport(
+            node="sa-w0", ts=1000.0 + i, usages=[gapi.PodGoodput(
+                pod_key="default/p", uid="u1", job="default/tj",
+                generation="v5e", step=10 * (i + 1),
+                steps_per_s=rate, allocated_s=1.0 * (i + 1),
+                productive_s=1.0 * (i + 1))]))
+    book = sched.cache.goodput_book
+    assert book.vector("default/tj")["v5e"] == pytest.approx(4.0)
+    assert book.rate("default/tj") == pytest.approx(4.0)
+    # re-delivering the SAME fold timestamp is deduped (watch churn
+    # must not over-weight one observation)
+    updates_before = book._vectors["default/tj"]["v5e"].updates
+    cluster.put_object(
+        "podgroup", cluster.podgroups["default/tj"])
+    assert book._vectors["default/tj"]["v5e"].updates == \
+        updates_before
+
+    # sessions expose the book to plugins/actions via the snapshot
+    ssn = sched.run_once()
+    assert ssn.goodput is book
+
+    # deleted podgroups are forgotten (no leak across job churn)
+    cluster.delete_podgroup("default/tj")
+    assert "default/tj" not in book.jobs()
+
+
+# -- session gauges: fragmentation + starvation ------------------------
+
+def test_session_gauges_fragmentation_and_starvation():
+    metrics.reset()
+    trace.reset()
+    # sa+sb share DCN pod d0 (whole idle); sc alone in d1 with one
+    # busy host -> 12 stranded idle chips there
+    cluster = make_tpu_cluster(
+        [("sa", "v5e-16"), ("sb", "v5e-16"), ("sc", "v5e-16")],
+        dcn_pods={"sa": "d0", "sb": "d0", "sc": "d1"})
+    cluster.add_pod(make_pod("busy", requests={"cpu": 4, TPU: 4},
+                             node_name="sc-w0",
+                             phase=TaskStatus.RUNNING))
+    # a feasible-but-pending gang: 48 chips demanded == total, only
+    # 44 idle -> waits; its age feeds starvation_age_seconds{queue=}
+    pg = PodGroup(name="starved", namespace="default",
+                  min_member=12)
+    pg.phase = PodGroupPhase.PENDING
+    cluster.add_podgroup(pg)
+    for i in range(12):
+        cluster.add_pod(make_pod(
+            f"starved-{i}", requests={"cpu": 4, TPU: 4},
+            annotations={GROUP_NAME_ANNOTATION: "starved"}))
+    sched = Scheduler(cluster, schedule_period=0)
+    time.sleep(0.02)
+    sched.run_once()
+
+    assert metrics.get_gauge("frag_idle_chips",
+                             generation="v5e") == pytest.approx(44.0)
+    assert metrics.get_gauge("frag_largest_block_chips",
+                             generation="v5e") == pytest.approx(32.0)
+    assert metrics.get_gauge("frag_index", generation="v5e") == \
+        pytest.approx(1 - 32 / 44, abs=1e-3)
+    assert metrics.get_gauge("starvation_age_seconds",
+                             queue="default") > 0
+    assert metrics.get_gauge("starvation_pending_gangs",
+                             queue="default") == 1
+
+    # an INFEASIBLE gang (demand beyond total capacity) never counts
+    # as starving — waiting cannot fix it
+    ssn = sched.run_once()
+    ages = gp.starvation_ages(ssn)
+    big = PodGroup(name="impossible", namespace="default",
+                   min_member=100)
+    big.phase = PodGroupPhase.PENDING
+    cluster.add_podgroup(big)
+    for i in range(100):
+        cluster.add_pod(make_pod(
+            f"impossible-{i}", requests={"cpu": 4, TPU: 4},
+            annotations={GROUP_NAME_ANNOTATION: "impossible"}))
+    ssn = sched.run_once()
+    ages2 = gp.starvation_ages(ssn)
+    assert ages2["default"]["gangs"] == ages["default"]["gangs"]
+
+
+# -- metric-label cardinality (PR 5 rule extended) ---------------------
+
+def test_goodput_metric_labels_are_bounded():
+    """goodput_*/frag_*/starvation_* families may carry ONLY bounded
+    labels: generation (the GENERATIONS enum), decision
+    (allowed|declined), queue (operator config).  Job keys, pod and
+    node names never label them — a 10k-job fleet must not mint 10k
+    series."""
+    metrics.reset()
+    trace.reset()
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    cluster.add_podgroup(PodGroup(name="etrain", namespace="default"))
+    cluster.put_object("goodputreport", gapi.GoodputReport(
+        node="sa-w0", ts=1.0, usages=[gapi.PodGoodput(
+            pod_key="default/p", uid="u1", job="default/etrain",
+            generation="v5e", step=10, steps_per_s=2.0,
+            allocated_s=1.0, productive_s=1.0)]))
+    sched = Scheduler(cluster, schedule_period=0)
+    sched.run_once()
+    metrics.inc("goodput_gated_grows_total", decision="declined")
+
+    allowed_keys = {"generation", "queue", "decision"}
+    lines = [l for l in metrics.dump().splitlines()
+             if l.startswith(("goodput_", "frag_", "starvation_"))]
+    assert lines                              # families are live
+    assert any(l.startswith("frag_index") for l in lines)
+    for line in lines:
+        assert "etrain" not in line, line     # no job keys
+        assert "sa-w0" not in line, line      # no node names
+        if "{" in line:
+            labels = line.split("{", 1)[1].split("}", 1)[0]
+            for pair in labels.split(","):
+                k, _, v = pair.partition("=")
+                assert k in allowed_keys, line
+                v = v.strip('"')
+                if k == "generation":
+                    assert v in gapi.GENERATIONS, line
+                elif k == "decision":
+                    assert v in gp.GATE_DECISIONS, line
+
+
+# -- the closed loop: goodput-gated elastic grow -----------------------
+
+def elastic_job(name="etrain", slices=1, lo=1, hi=3, pods_per_slice=4):
+    return VCJob(
+        name=name, min_available=slices * pods_per_slice,
+        annotations={
+            eapi.ELASTIC_MIN_SLICES_ANNOTATION: str(lo),
+            eapi.ELASTIC_MAX_SLICES_ANNOTATION: str(hi),
+            eapi.ELASTIC_SLICES_ANNOTATION: str(slices),
+        },
+        plugins={"jax": []},
+        tasks=[TaskSpec(name="worker",
+                        replicas=slices * pods_per_slice,
+                        template=make_pod("t",
+                                          requests={"cpu": 8,
+                                                    TPU: 4}))])
+
+
+def drive(cluster, mgr, sched, n=1):
+    for _ in range(n):
+        mgr.sync_all()
+        sched.run_once()
+        cluster.tick()
+
+
+def test_grow_gate_declines_poor_scaler_then_reopens():
+    """The minimal Pollux step: an elastic job whose last grow bought
+    almost no measured speedup is DECLINED further grows (idle
+    capacity left for better scalers); once the measured rate at the
+    current size improves, the gate reopens and the grow proceeds."""
+    metrics.reset()
+    cluster = make_tpu_cluster([("sa", "v5e-16"), ("sb", "v5e-16"),
+                                ("sc", "v5e-16")])
+    cluster.admission = default_admission()
+    mgr = ControllerManager(cluster, enabled=[
+        "job", "podgroup", "queue", "failover", "elastic"])
+    sched = Scheduler(cluster, conf=ELASTIC_CONF, schedule_period=0)
+    from volcano_tpu.api.types import RUN_TICKS_ANNOTATION
+    cluster.add_vcjob(VCJob(
+        name="pin", min_available=4,
+        tasks=[TaskSpec(name="worker", replicas=4,
+                        template=make_pod(
+                            "t", requests={"cpu": 8, TPU: 4},
+                            annotations={RUN_TICKS_ANNOTATION:
+                                         "60"}))]))
+    cluster.add_vcjob(elastic_job())
+    # elastic grows 1 -> 2 into the one idle slice (the pin holds sc)
+    for _ in range(30):
+        drive(cluster, mgr, sched)
+        pg = cluster.podgroups["default/etrain"]
+        if eapi.current_slices(pg) == 2 and \
+                eapi.ELASTIC_RESIZING_ANNOTATION not in pg.annotations \
+                and cluster.vcjobs["default/etrain"].phase \
+                is JobPhase.RUNNING:
+            break
+    pg = cluster.podgroups["default/etrain"]
+    assert eapi.current_slices(pg) == 2
+
+    # observatory verdict: the 1 -> 2 grow bought 10 -> 11 steps/s
+    # (speedup 1.1 < required 1.5) — decline the third slice
+    book = sched.cache.goodput_book
+    for _ in range(2):
+        book.note("default/etrain", "v5e", 10.0, slices=1)
+        book.note("default/etrain", "v5e", 11.0, slices=2)
+    assert book.grow_verdict("default/etrain", 2) is False
+
+    # free the pinned slice, give the action cycles to (not) grow
+    for _ in range(80):
+        drive(cluster, mgr, sched)
+        if cluster.vcjobs["default/pin"].phase is JobPhase.COMPLETED:
+            break
+    assert cluster.vcjobs["default/pin"].phase is JobPhase.COMPLETED
+    drive(cluster, mgr, sched, 5)
+    pg = cluster.podgroups["default/etrain"]
+    assert eapi.current_slices(pg) == 2       # grow declined
+    assert metrics.get_counter("goodput_gated_grows_total",
+                               decision="declined") > 0
+    assert any(r == "ElasticGrowDeclined"
+               for _, r, _ in cluster.events)
+
+    # measured rate at 2 slices improves -> the gate reopens
+    for _ in range(6):
+        book.note("default/etrain", "v5e", 25.0, slices=2)
+    assert book.grow_verdict("default/etrain", 2) is True
+    for _ in range(30):
+        drive(cluster, mgr, sched)
+        pg = cluster.podgroups["default/etrain"]
+        if eapi.current_slices(pg) == 3:
+            break
+    assert eapi.current_slices(pg) == 3
+    assert metrics.get_counter("goodput_gated_grows_total",
+                               decision="allowed") > 0
+    mgr.stop()
+
+
+def test_grow_verdict_shapes():
+    book = gp.ThroughputBook()
+    # no data -> no opinion (cold start stays greedy)
+    assert book.grow_verdict("j", 2) is None
+    book.note("j", "v5e", 10.0, slices=1)
+    book.note("j", "v5e", 10.0, slices=1)
+    # current size unmeasured -> still no opinion
+    assert book.grow_verdict("j", 2) is None
+    book.note("j", "v5e", 19.0, slices=2)
+    book.note("j", "v5e", 19.0, slices=2)
+    # 1.9x of linear 2.0x beats the 1.5 threshold
+    assert book.grow_verdict("j", 2) is True
+    assert book.grow_verdict("j", 2, frac=0.95) is False
+    # per-world-size rates are tracked separately from the
+    # per-generation vector (which EWMAs across sizes)
+    assert book.rate_at("j", 2)[0] == pytest.approx(19.0)
+    assert book.rate_at("j", 1)[0] == pytest.approx(10.0)
+    # vectors per generation stay separate
+    book.note("j", "v5p", 40.0, slices=2)
+    assert book.vector("j")["v5p"] == pytest.approx(40.0)
+    assert "v5e" in book.vector("j")
+
+
+# -- surfaces: vtpctl, dumper ------------------------------------------
+
+def test_vtpctl_goodput_and_fleet_views(tmp_path, capsys):
+    from volcano_tpu.cli.vtpctl import main as vtpctl
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    cluster.add_podgroup(PodGroup(name="tj", namespace="default"))
+    cluster.put_object("goodputreport", gapi.GoodputReport(
+        node="sa-w0", ts=time.time(), usages=[gapi.PodGoodput(
+            pod_key="default/tj-w0", uid="u1", job="default/tj",
+            generation="v5e", step=1042, steps_per_s=3.5,
+            goodput=0.9, allocated_s=10.0,
+            productive_s=9.0)]))
+    path = str(tmp_path / "c.pkl")
+    with open(path, "wb") as f:
+        pickle.dump(cluster, f)
+
+    assert vtpctl(["--state", path, "goodput", "tj"]) == 0
+    out = capsys.readouterr().out
+    assert "1042" in out and "3.5" in out and "0.9" in out
+    assert "v5e" in out
+
+    assert vtpctl(["--state", path, "fleet"]) == 0
+    out = capsys.readouterr().out
+    assert "default/tj" in out
+    assert "FRAG-INDEX" in out and "v5e" in out
+
+
+def test_dumper_embeds_goodput_section(tmp_path):
+    from volcano_tpu.dumper import Dumper
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    sched = Scheduler(cluster, schedule_period=0)
+    sched.cache.goodput_book.note("default/tj", "v5e", 5.0, slices=2)
+    path = str(tmp_path / "dump.json")
+    Dumper(sched, path).dump()
+    doc = json.load(open(path))
+    assert doc["goodput"]["vectors"]["default/tj"]["v5e"] == \
+        pytest.approx(5.0)
+    assert doc["goodput"]["rates_by_world_size"]["default/tj"]["2"] \
+        == pytest.approx(5.0)
+
+
+# -- workload contract -------------------------------------------------
+
+def test_progress_reporter_and_jax_plugin_env(tmp_path):
+    from volcano_tpu.controllers.job.plugins.jax_plugin import JaxPlugin
+    from volcano_tpu.workloads import bootstrap
+    from volcano_tpu.workloads.progress import ProgressReporter
+
+    # atomic write + record shape
+    path = str(tmp_path / "p" / "vtp-u1.json")
+    r = ProgressReporter(path, epoch=3, now=lambda: 123.5)
+    assert r.report(step=7, examples=112.0)
+    rec = json.load(open(path))
+    assert rec == {"step": 7, "examples": 112.0, "ts": 123.5,
+                   "epoch": 3}
+    assert not os.path.exists(path + f".tmp.{os.getpid()}")
+
+    # the jax plugin injects the per-pod path + the combined
+    # failover+elastic epoch when the job declares a progress dir
+    from volcano_tpu.api.slicehealth import (
+        FAILOVER_GENERATION_ANNOTATION)
+    job = VCJob(
+        name="tj",
+        annotations={
+            gapi.PROGRESS_DIR_ANNOTATION: str(tmp_path),
+            FAILOVER_GENERATION_ANNOTATION: "2",
+            eapi.ELASTIC_GENERATION_ANNOTATION: "3",
+        },
+        tasks=[TaskSpec(name="worker", replicas=1,
+                        template=make_pod("t", requests={TPU: 4}))])
+    pod = make_pod("tj-worker-0", requests={TPU: 4}, uid="u9",
+                   task_spec="worker", task_index=0)
+    JaxPlugin().on_pod_create(pod, job)
+    env = pod.containers[0].env
+    assert env[gapi.ENV_PROGRESS_FILE] == \
+        gapi.progress_file_for(str(tmp_path), "u9")
+    assert env[gapi.ENV_EPOCH] == "5"
+
+    # bootstrap parses the same contract
+    info = bootstrap.from_env({gapi.ENV_PROGRESS_FILE: "/x/y.json",
+                               gapi.ENV_EPOCH: "5"})
+    assert info.progress_file == "/x/y.json" and info.epoch == 5
+    # ... and a ProgressReporter built from that env targets the file
+    rep = ProgressReporter.from_env({gapi.ENV_PROGRESS_FILE: path,
+                                     gapi.ENV_EPOCH: "9"})
+    assert rep.path == path and rep.epoch == 9
+    assert ProgressReporter.from_env({}) is None
+
+
+# -- tier-1 smoke: the stream through real processes -------------------
+
+def test_bench_goodput_smoke_mode():
+    """`bench.py --goodput-smoke` drives worker progress -> agent
+    collector -> GoodputReport over the wire -> store fold ->
+    podgroup annotations through a REAL process control plane (state
+    server + scheduler + controllers as OS processes), mirroring
+    --wire-smoke — the goodput stream guarded on every commit."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--goodput-smoke"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, \
+        proc.stdout[-2000:] + proc.stderr[-2000:]
+    line = next(l for l in reversed(proc.stdout.strip().splitlines())
+                if l.startswith("{"))
+    out = json.loads(line)
+    assert out["ok"] is True, out
+    assert out["fold_ok"] and out["steps_per_s"] > 0
+    assert 0 < out["goodput"] <= 1

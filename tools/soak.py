@@ -84,11 +84,21 @@ for sname in ("sa", "sb", "sc"):
 rng = random.Random(42)
 submitted = completed_seen = 0
 elastic_key = None
-if "--kill-slice" in sys.argv[1:]:
+kill_slice_mode = "--kill-slice" in sys.argv[1:]
+if kill_slice_mode or kill_server_every:
     # one long-running elastic gang in the mix: grows into idle,
     # shrinks under churn pressure, and must survive the slice kill
+    # AND every server kill -9.  Its goodput stream (progress files ->
+    # real agents -> GoodputReport -> podgroup fold) rides the soak:
+    # the accumulated ledger must never regress across a server
+    # respawn (WAL persistence of the folded annotations) and the
+    # measured step rate must never spike after a resize restart
+    # (the collector's epoch-aware window restart).
     from volcano_tpu.api import elastic as eapi
+    from volcano_tpu.api import goodput as gapi
     elastic_key = "default/esoak"
+    progress_root = "/tmp/soak/progress"
+    os.makedirs(progress_root, exist_ok=True)
     c.add_vcjob(VCJob(
         name="esoak", min_available=4,
         annotations={
@@ -96,6 +106,7 @@ if "--kill-slice" in sys.argv[1:]:
             eapi.ELASTIC_MAX_SLICES_ANNOTATION: "2",
             eapi.ELASTIC_SLICES_ANNOTATION: "1",
             "failover.volcano-tpu.io/last-checkpoint-step": "500",
+            gapi.PROGRESS_DIR_ANNOTATION: progress_root,
         },
         plugins={"jax": []},
         tasks=[TaskSpec(name="worker", replicas=4,
@@ -103,6 +114,78 @@ if "--kill-slice" in sys.argv[1:]:
                             "t", requests={"cpu": 4, TPU: 4},
                             annotations={RUN_TICKS_ANNOTATION:
                                          "1000000"}))]))
+
+from volcano_tpu.agent.agent import FakeUsageProvider, NodeAgent
+from volcano_tpu.agent.collect import GoodputCollector
+from volcano_tpu.agent.handlers import GoodputHandler
+from volcano_tpu.workloads.progress import ProgressReporter
+
+goodput_agents = {}
+goodput_col = None
+fed = {"step": 500, "epoch": 0, "rate_max": 0.0, "alloc": 0.0,
+       "alloc_monotonic": True}
+
+
+def _iann(ann, key):
+    try:
+        return int(ann.get(key, 0) or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+def feed_goodput():
+    """One soak iteration of the goodput loop: play the workers
+    (write progress records, epoch-aware across resize/failover
+    drains) and the node agents (REAL GoodputCollector + handler
+    posting over the wire), then sample the folded podgroup ledger."""
+    global goodput_col
+    if elastic_key is None:
+        return
+    from volcano_tpu.api import elastic as eapi
+    from volcano_tpu.api import goodput as gapi
+    epg = c.podgroups.get(elastic_key)
+    ej = c.vcjobs.get(elastic_key)
+    if epg is None or ej is None:
+        return
+    if goodput_col is None:
+        goodput_col = GoodputCollector(progress_root)
+    epoch = _iann(epg.annotations,
+                  "failover.volcano-tpu.io/generation") + \
+        _iann(epg.annotations, eapi.ELASTIC_GENERATION_ANNOTATION)
+    if epoch != fed["epoch"]:
+        # drained + rebuilt: resume from the stamped floor, exactly
+        # like a real worker restoring its checkpoint
+        fed["epoch"] = epoch
+        fed["step"] = max(500, _iann(
+            epg.annotations, "failover.volcano-tpu.io/resume-step"))
+    fed["step"] += 1
+    pods = [p for p in c.pods.values()
+            if p.owner == ej.uid and p.node_name
+            and getattr(p.phase, "value", p.phase) == "Running"]
+    for p in pods:
+        ProgressReporter(
+            gapi.progress_file_for(progress_root, p.uid),
+            epoch=fed["epoch"]).report(step=fed["step"],
+                                       examples=fed["step"] * 8.0)
+        if p.node_name not in goodput_agents:
+            goodput_agents[p.node_name] = NodeAgent(
+                c, p.node_name, FakeUsageProvider(),
+                handlers=[GoodputHandler],
+                goodput_collector=goodput_col)
+    for agent in goodput_agents.values():
+        try:
+            agent.sync()
+        except Exception as e:  # noqa: BLE001 — soak must keep going
+            print("goodput agent sync failed:", e, flush=True)
+    epg = c.podgroups.get(elastic_key) or epg
+    rate = gapi.ann_float(epg.annotations,
+                          gapi.PG_STEP_RATE_ANNOTATION)
+    fed["rate_max"] = max(fed["rate_max"], rate)
+    alloc = gapi.ann_float(epg.annotations,
+                           gapi.PG_ALLOCATED_S_ANNOTATION)
+    if alloc + 1e-6 < fed["alloc"]:
+        fed["alloc_monotonic"] = False   # a kill -9 ate acked ledger
+    fed["alloc"] = max(fed["alloc"], alloc)
 argv = [a for a in sys.argv[1:]
         if not a.startswith("--kill-")]
 kill_slice = "--kill-slice" in sys.argv[1:]
@@ -157,6 +240,7 @@ while time.time() < t_end:
     except Exception as e:
         print("submit failed:", e, flush=True)
     i += 1
+    feed_goodput()
     time.sleep(rng.uniform(0.3, 1.2))
     if i % 20 == 0:
         done = sum(1 for j in c.vcjobs.values()
@@ -239,6 +323,19 @@ if elastic_key is not None:
         and getattr(ej.phase, "value", str(ej.phase))
         in ("Running", "Pending", "Restarting")
         and (resume is None or int(resume) >= 500))
+if elastic_key is not None:
+    # the goodput stream survived the drill: the podgroup ledger only
+    # ever grew (folded annotations are WAL-durable — a server kill -9
+    # must not roll back acked accounting) and the measured step rate
+    # never spiked past the fed cadence (a resize restart resets the
+    # window via the epoch, it must not read the resumed absolute
+    # counter as rate).  Feeder cadence is ~1 step / 0.3-1.2s loop.
+    out["goodput_allocated_pod_s"] = round(fed["alloc"], 3)
+    out["goodput_rate_max"] = round(fed["rate_max"], 3)
+    out["goodput_alloc_monotonic"] = fed["alloc_monotonic"]
+    out["goodput_ok"] = (fed["alloc"] > 0
+                         and fed["alloc_monotonic"]
+                         and 0 < fed["rate_max"] <= 5.0)
 print(json.dumps(out))
 for p in procs.values():
     p.terminate()

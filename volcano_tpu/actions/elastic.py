@@ -58,6 +58,12 @@ from volcano_tpu.util import PriorityQueue
 log = logging.getLogger(__name__)
 
 DEFAULT_COOLDOWN_S = 30.0
+# goodput grow gate (the minimal Pollux step): a further grow is
+# declined when the LAST grow's measured speedup fell below
+# 1 + frac * (linear - 1) — with 0.5, growing 1 -> 2 slices must have
+# bought at least 1.5x measured steps/s before a third slice is
+# granted.  A job the observatory has no data on is never blocked.
+GROW_MARGINAL_FRACTION = 0.5
 
 
 class SliceView:
@@ -161,6 +167,14 @@ class ElasticAction(Action):
                                       DEFAULT_COOLDOWN_S))
         except (TypeError, ValueError):
             cooldown = DEFAULT_COOLDOWN_S
+        self._gate_on = str(conf.get("elastic.goodputGateGrow",
+                                     "true")).lower() not in (
+            "false", "0", "no", "off")
+        try:
+            self._gate_frac = float(conf.get(
+                "elastic.growMarginalFraction", GROW_MARGINAL_FRACTION))
+        except (TypeError, ValueError):
+            self._gate_frac = GROW_MARGINAL_FRACTION
         now = time.time()
         slices = slice_views(ssn)
         idle = [s for s in slices.values() if s.idle]
@@ -281,6 +295,8 @@ class ElasticAction(Action):
                 break
             pg = job.podgroup
             cur = eapi.current_slices(pg)
+            if not self._grow_pays(ssn, job, pg, cur):
+                continue
             per_slice = _chips_per_slice(job, pg)
             usable = [s for s in pool if s.chips >= per_slice > 0]
             take = min(eapi.elastic_range(pg)[1] - cur, len(usable))
@@ -292,6 +308,33 @@ class ElasticAction(Action):
             self._stamp(ssn, job, cur + take, eapi.RESIZE_GROW,
                         f"absorbing {take} idle slice(s) "
                         f"({', '.join(s.name for s in taken)})")
+
+    def _grow_pays(self, ssn, job: JobInfo, pg, cur: int) -> bool:
+        """Goodput grow gate (closed loop over the observatory):
+        consult the session's ThroughputBook for the measured marginal
+        throughput the job's LAST grow bought.  Declining is a
+        per-cycle decision, not a latch — once the measured rate at
+        the current size improves (or the data ages into a better
+        EWMA), the gate reopens.  No data -> no opinion -> allow:
+        greedy absorption stays the cold-start behavior."""
+        book = getattr(ssn, "goodput", None)
+        if not self._gate_on or book is None:
+            return True
+        verdict = book.grow_verdict(pg.key, cur, self._gate_frac)
+        if verdict is None:
+            return True
+        if verdict:
+            metrics.inc("goodput_gated_grows_total",
+                        decision="allowed")
+            return True
+        metrics.inc("goodput_gated_grows_total", decision="declined")
+        ssn.cache.record_event(
+            job.key, "ElasticGrowDeclined",
+            f"measured marginal throughput below threshold at {cur} "
+            f"slice(s); idle capacity left for better scalers")
+        log.info("elastic: grow of %s declined by goodput gate at %d "
+                 "slice(s)", job.key, cur)
+        return False
 
     # -- shrink (running victims, topology-aware) ------------------------
 

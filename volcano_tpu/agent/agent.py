@@ -96,7 +96,7 @@ class NodeAgent:
                  oversub_factor: float = 0.6,
                  eviction_threshold: float = 0.95,
                  enforcer=None, handlers=None, probes=None,
-                 net_collector=None):
+                 net_collector=None, goodput_collector=None):
         from volcano_tpu.agent import handlers as _default  # registers
         from volcano_tpu.agent.enforcer import NullEnforcer
         from volcano_tpu.agent.framework import (
@@ -115,6 +115,8 @@ class NodeAgent:
         # CompositeUsageProvider's collector list (so 'collectors:
         # local,netaccounting:ROOT' needs no extra wiring)
         self.net_collector = net_collector
+        # same contract for the goodput handler's progress collector
+        self.goodput_collector = goodput_collector
         # probe -> queue -> handler pipeline; handlers come from the
         # registry unless injected (tests can run a subset)
         self.probes = list(probes) if probes is not None \

@@ -160,26 +160,39 @@ class LocalProcCollector(Collector):
 
 class PodNetRate:
     """One pod's accounting state kept by NetAccountingCollector.
-    Timestamps are per direction: a one-sided failed read (exporter
-    mid-rewrite) must not advance the other counter's window, or the
-    returning counter's next delta would span two windows over one
-    window's dt and read ~2x hot."""
+    Windows are per direction (util.RateWindow): a one-sided failed
+    read (exporter mid-rewrite) must not advance the other counter's
+    window, or the returning counter's next delta would span two
+    windows over one window's dt and read ~2x hot."""
 
-    __slots__ = ("uid", "classid", "tx_mbps", "rx_mbps",
-                 "tx_bytes", "rx_bytes", "_last_tx", "_last_rx",
-                 "_last_ts_tx", "_last_ts_rx")
+    __slots__ = ("uid", "classid", "_tx", "_rx")
 
-    def __init__(self, uid: str):
+    def __init__(self, uid: str, alpha: float = 0.5):
+        from volcano_tpu.util import RateWindow
         self.uid = uid
         self.classid = 0
-        self.tx_mbps = 0.0       # windowed EWMA egress rate
-        self.rx_mbps = 0.0
-        self.tx_bytes = 0        # last raw counter reading
-        self.rx_bytes = 0
-        self._last_tx: Optional[int] = None
-        self._last_rx: Optional[int] = None
-        self._last_ts_tx: Optional[float] = None
-        self._last_ts_rx: Optional[float] = None
+        # bytes -> mbps; a reading below the last is an exporter
+        # restart, so the absolute value is the delta ("absolute")
+        self._tx = RateWindow(alpha=alpha, reset="absolute",
+                              scale=8.0 / 1e6)
+        self._rx = RateWindow(alpha=alpha, reset="absolute",
+                              scale=8.0 / 1e6)
+
+    @property
+    def tx_mbps(self) -> float:      # windowed EWMA egress rate
+        return self._tx.rate
+
+    @property
+    def rx_mbps(self) -> float:
+        return self._rx.rate
+
+    @property
+    def tx_bytes(self) -> int:       # last raw counter reading
+        return int(self._tx.last or 0)
+
+    @property
+    def rx_bytes(self) -> int:
+        return int(self._rx.last or 0)
 
 
 @register_collector("netaccounting")
@@ -247,29 +260,10 @@ class NetAccountingCollector(Collector):
         cid = self._read_int(os.path.join(d, "net_cls.classid"))
         if cid is not None:
             rate.classid = cid & 0xFFFF
-
-        def fold(cur, last, last_ts, ewma):
-            """-> (last reading, window start ts, ewma); a failed
-            read leaves all three untouched so the direction's window
-            simply spans to the next successful read."""
-            if cur is None:
-                return last, last_ts, ewma
-            if last is None:         # first reading: no window yet
-                return cur, ts, ewma
-            delta = cur - last if cur >= last else cur   # reset: cur
-            dt = ts - last_ts if last_ts else 0.0
-            if dt > 0:
-                inst = delta * 8.0 / dt / 1e6            # bytes->mbps
-                ewma = inst if ewma == 0.0 else \
-                    self.alpha * inst + (1 - self.alpha) * ewma
-            return cur, ts, ewma
-
-        rate._last_tx, rate._last_ts_tx, rate.tx_mbps = fold(
-            tx, rate._last_tx, rate._last_ts_tx, rate.tx_mbps)
-        rate._last_rx, rate._last_ts_rx, rate.rx_mbps = fold(
-            rx, rate._last_rx, rate._last_ts_rx, rate.rx_mbps)
-        rate.tx_bytes = rate._last_tx or 0
-        rate.rx_bytes = rate._last_rx or 0
+        # counter-delta/EWMA/reset semantics live in util.RateWindow
+        # (shared with the GoodputCollector's step counters)
+        rate._tx.fold(tx, ts)
+        rate._rx.fold(rx, ts)
 
     def collect(self, node_name: str) -> Dict[str, float]:
         """Walk the pod cgroups once; returns node-level totals (the
@@ -296,7 +290,7 @@ class NetAccountingCollector(Collector):
             seen.add(uid)
             rate = self._rates.get(uid)
             if rate is None:
-                rate = self._rates[uid] = PodNetRate(uid)
+                rate = self._rates[uid] = PodNetRate(uid, self.alpha)
             self._sample_one(rate, d, ts)
         for uid in set(self._rates) - seen:   # departed: drop state
             del self._rates[uid]
@@ -310,6 +304,203 @@ class NetAccountingCollector(Collector):
     def rates(self) -> Dict[str, PodNetRate]:
         """uid -> PodNetRate as of the last collect() (the handler's
         read surface; no re-walk)."""
+        return dict(self._rates)
+
+
+class PodProgressRate:
+    """One pod's training-progress accounting state kept by
+    GoodputCollector: step/example EWMA rates (util.RateWindow with
+    the "restart" reset policy — a resumed worker's checkpoint-floor
+    step count must never read as a negative or inflated delta) plus
+    the productive-vs-allocated time ledger goodput is computed from.
+    """
+
+    __slots__ = ("uid", "epoch", "step", "examples", "restarts",
+                 "allocated_s", "productive_s", "stalled",
+                 "_steps", "_examples", "_last_rec_ts",
+                 "_last_walk_ts")
+
+    def __init__(self, uid: str, alpha: float = 0.5):
+        from volcano_tpu.util import RateWindow
+        self.uid = uid
+        self.epoch: Optional[int] = None
+        self.step = 0
+        self.examples = 0.0
+        self.restarts = 0            # observed epoch bumps
+        # cumulative ledger over this pod's lifetime on this node; the
+        # handler ships the CUMULATIVE values and the store folds the
+        # per-pod diff against the node's previous report, so a
+        # re-posted report after a lost ack never double-counts
+        self.allocated_s = 0.0       # cumulative pod-residency seconds
+        self.productive_s = 0.0      # subset with step progress
+        self.stalled = False         # last window saw no step
+        self._steps = RateWindow(alpha=alpha, reset="restart")
+        self._examples = RateWindow(alpha=alpha, reset="restart")
+        self._last_rec_ts: Optional[float] = None
+        self._last_walk_ts: Optional[float] = None
+
+    @property
+    def steps_per_s(self) -> float:
+        return self._steps.rate
+
+    @property
+    def examples_per_s(self) -> float:
+        return self._examples.rate
+
+    @property
+    def goodput(self) -> float:
+        """Cumulative productive/allocated fraction (0 when no time
+        has been accounted yet)."""
+        return (self.productive_s / self.allocated_s
+                if self.allocated_s > 0 else 0.0)
+
+
+@register_collector("goodput")
+class GoodputCollector(Collector):
+    """Per-pod training-progress accounting off the workload progress
+    files (api/goodput.py contract: workers write one JSON record per
+    step to VTP_PROGRESS_FILE under a shared root, named by pod uid —
+    the same uid-keyed convention the enforcer uses for cgroup dirs).
+
+    Per walk, for every vtp-<uid>.json under the root:
+
+      * step/example counters fold into EWMA rates via the SHARED
+        RateWindow machinery (util.py) with the "restart" policy: a
+        counter below the last reading (worker resumed from a
+        checkpoint floor) restarts the window with no delta;
+      * an EPOCH change (the control plane bumped the restart/resize
+        epoch) force-restarts the windows even when the resumed step
+        count happens to be higher — the out-of-band signal beats the
+        counter heuristic;
+      * the time ledger: every inter-walk dt while the file exists is
+        ALLOCATED time (the chip belongs to the pod); it is PRODUCTIVE
+        only when the step counter advanced, credited no more than
+        the worker's own inter-record wall time — so queue-adjacent
+        ramps (compile, checkpoint restore) and wedged workers debit
+        goodput = productive / allocated;
+      * a vanished file drops its state (the pod left the node — the
+        drain window itself is accounted by the control-plane side,
+        which sees the gang hold no chips); a file not rewritten for
+        STALE_FILE_S is treated the same (dead pods' leftovers in a
+        shared per-job dir must not grow the walk forever).
+    """
+
+    FILE_PREFIX = "vtp-"
+    FILE_SUFFIX = ".json"
+    ALPHA = 0.5
+    # second collect() inside this window is a no-op returning cached
+    # totals (same double-sample guard as NetAccountingCollector)
+    MIN_INTERVAL_S = 0.05
+    # a record not rewritten for this long is treated as absent:
+    # progress dirs are per-job and often shared (NFS) across nodes,
+    # so no agent can safely unlink another pod's file — bounding by
+    # write-freshness instead keeps the per-sync parse set and the
+    # in-memory state proportional to LIVE pods across job churn.
+    # Generous on purpose: a wedged-but-alive worker keeps debiting
+    # goodput (reported stalled) for this long before it reads as dead.
+    STALE_FILE_S = 3600.0
+
+    def __init__(self, root: str = "/var/run/volcano/progress",
+                 alpha: float = ALPHA, now=None):
+        import time
+        self.root = root
+        self.alpha = float(alpha)
+        self._now = now if now is not None else time.monotonic
+        self._rates: Dict[str, PodProgressRate] = {}
+        self._last_walk: Optional[float] = None
+        self._totals: Dict[str, float] = {}
+
+    @staticmethod
+    def _read_record(path: str) -> Optional[dict]:
+        import json
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None       # mid-rewrite/corrupt: window spans on
+        return doc if isinstance(doc, dict) else None
+
+    def _sample_one(self, st: PodProgressRate, path: str,
+                    ts: float) -> None:
+        rec = self._read_record(path)
+        if rec is None:
+            return
+        try:
+            step = int(rec.get("step", 0))
+            epoch = int(rec.get("epoch", 0))
+            rec_ts = float(rec.get("ts", 0.0) or 0.0)
+            examples = float(rec.get("examples", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            return
+        prev_step: Optional[int] = st.step
+        prev_rec_ts = st._last_rec_ts
+        if epoch != st.epoch:
+            if st.epoch is not None:
+                st.restarts += 1
+            st.epoch = epoch
+            st._steps.restart()
+            st._examples.restart()
+            prev_step = None        # no productive credit across it
+        st._steps.fold(step, ts)
+        st._examples.fold(examples, ts)
+        st.step = step
+        st.examples = examples
+        if st._last_walk_ts is not None:
+            dt = max(0.0, ts - st._last_walk_ts)
+            st.allocated_s += dt
+            advanced = prev_step is not None and step > prev_step
+            st.stalled = not advanced
+            if advanced:
+                credit = dt
+                if rec_ts and prev_rec_ts:
+                    credit = min(dt, max(0.0, rec_ts - prev_rec_ts))
+                st.productive_s += credit
+        st._last_rec_ts = rec_ts
+        st._last_walk_ts = ts
+
+    def collect(self, node_name: str) -> Dict[str, float]:
+        """Walk the progress files once; returns node totals (extra
+        keys NodeUsage ignores); per-pod detail via rates()."""
+        ts = self._now()
+        if self._last_walk is not None and \
+                ts - self._last_walk < self.MIN_INTERVAL_S:
+            return dict(self._totals)
+        self._last_walk = ts
+        seen = set()
+        try:
+            entries = os.listdir(self.root)
+        except OSError:
+            return {}
+        import time as _time
+        wall = _time.time()
+        for e in entries:
+            if not (e.startswith(self.FILE_PREFIX)
+                    and e.endswith(self.FILE_SUFFIX)):
+                continue
+            uid = e[len(self.FILE_PREFIX):-len(self.FILE_SUFFIX)]
+            if not uid:
+                continue
+            path = os.path.join(self.root, e)
+            try:
+                if wall - os.stat(path).st_mtime > self.STALE_FILE_S:
+                    continue        # dead pod's leftover: not ours to
+            except OSError:         # unlink, but not ours to track
+                continue
+            seen.add(uid)
+            st = self._rates.get(uid)
+            if st is None:
+                st = self._rates[uid] = PodProgressRate(uid, self.alpha)
+            self._sample_one(st, path, ts)
+        for uid in set(self._rates) - seen:   # departed: drop state
+            del self._rates[uid]
+        self._totals = {
+            "goodput_steps_per_s": sum(r.steps_per_s
+                                       for r in self._rates.values())}
+        return dict(self._totals)
+
+    def rates(self) -> Dict[str, PodProgressRate]:
+        """uid -> PodProgressRate as of the last collect() (the
+        GoodputHandler's read surface; no re-walk)."""
         return dict(self._rates)
 
 

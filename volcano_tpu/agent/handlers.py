@@ -1,4 +1,4 @@
-"""The default agent handler pipeline — ten registered handlers.
+"""The default agent handler pipeline — eleven registered handlers.
 
 Reference parity: pkg/agent/events/handlers/* (one package per
 concern, self-registered via registry.go).  Each handler here carries
@@ -10,6 +10,8 @@ order is dispatch order, which matters only where stated:
     NetAccounting                                (EVENT_PODS, AFTER
         NetworkQoS: this sync's per-pod caps are the offline
         watermarks it verifies measured rates against)
+    Goodput                                      (EVENT_PODS: workload
+        step progress -> GoodputReport, docs/design/goodput.md)
     NumaExporter                                 (EVENT_PODS)
     Enforcement                                  (EVENT_PODS, LAST:
         applies the decision set the QoS handlers built and
@@ -578,6 +580,126 @@ class NetAccountingHandler(Handler):
             self._last_report = sig
         except Exception as e:  # noqa: BLE001 — reporting must never
             log.warning("bandwidth report post failed: %s", e)  # kill sync
+
+
+@register_handler
+class GoodputHandler(Handler):
+    """Workload-progress half of the goodput observatory (docs/design/
+    goodput.md): the GoodputCollector turns per-pod progress files
+    into step rates and a productive-vs-allocated time ledger; this
+    handler pairs that state with the node's pods, publishes per-pod
+    step/rate annotations, and posts one GoodputReport per sync —
+    the store folds the per-job summary into PODGROUP annotations the
+    scheduler's throughput-vector estimator learns from.
+
+    Posting discipline: the report carries the CUMULATIVE per-pod
+    ledger; the store folds the diff against this node's previous
+    report, so a re-post after a lost ack (server folded, response
+    died) is idempotent and a dead server loses nothing — the next
+    acked cumulative covers the gap.  Elision: nothing posted while
+    no pod has progress state; an unchanged signature is still posted
+    once the unreported allocated time passes POST_DEBT_S (stalled
+    pods must keep debiting goodput at the store)."""
+
+    name = "goodput"
+    events = (EVENT_PODS,)
+
+    POST_DEBT_S = 5.0
+    # published rates move only outside a dead-band (same rationale
+    # as netaccounting: raw EWMAs jitter; publishing the jitter
+    # defeats pod-annotation change-elision)
+    PUBLISH_DEADBAND_FRAC = 0.05
+
+    def __init__(self, agent):
+        super().__init__(agent)
+        self._published = {}           # uid -> published rate
+        self._last_report = None       # change-elision signature
+        self._posted_alloc = 0.0       # total allocated_s last posted
+
+    def _collector(self):
+        col = getattr(self.agent, "goodput_collector", None)
+        if col is not None:
+            return col
+        from volcano_tpu.agent.collect import GoodputCollector
+        for c in getattr(self.agent.provider, "collectors", ()):
+            if isinstance(c, GoodputCollector):
+                return c
+        return None
+
+    def _publish_rate(self, uid: str, rate: float) -> float:
+        pub = self._published.get(uid)
+        if pub is not None and abs(rate - pub) <= \
+                max(0.01, self.PUBLISH_DEADBAND_FRAC * pub):
+            return pub
+        pub = round(rate, 3)
+        self._published[uid] = pub
+        return pub
+
+    @staticmethod
+    def _job_key(pod) -> str:
+        from volcano_tpu.api.types import GROUP_NAME_ANNOTATION
+        group = pod.annotations.get(GROUP_NAME_ANNOTATION) or pod.owner
+        if not group:
+            return ""
+        return group if "/" in group else f"{pod.namespace}/{group}"
+
+    def handle(self, event: Event) -> None:
+        import time as _time
+
+        from volcano_tpu.api.goodput import (
+            POD_STEP_ANNOTATION, POD_STEP_RATE_ANNOTATION,
+            GoodputReport, PodGoodput, generation_of)
+        collector = self._collector()
+        if collector is None:
+            return                    # goodput not deployed: no-op
+        agent = self.agent
+        try:
+            collector.collect(agent.node_name)
+        except Exception as e:  # noqa: BLE001 — degrade, keep sync
+            log.warning("goodput sample failed: %s", e)
+        rates = collector.rates()
+        generation = generation_of(event.node.labels)
+        usages = []
+        current_uids = set()
+        for pod in event.pods:
+            st = rates.get(pod.uid)
+            if st is None:
+                continue              # no progress file for this pod
+            current_uids.add(pod.uid)
+            rate_pub = self._publish_rate(pod.uid, st.steps_per_s)
+            pod.annotations[POD_STEP_ANNOTATION] = str(st.step)
+            pod.annotations[POD_STEP_RATE_ANNOTATION] = \
+                f"{rate_pub:.3f}"
+            usages.append(PodGoodput(
+                pod_key=pod.key, uid=pod.uid,
+                job=self._job_key(pod), generation=generation,
+                epoch=st.epoch or 0, step=st.step,
+                steps_per_s=rate_pub,
+                examples_per_s=round(st.examples_per_s, 3),
+                goodput=round(st.goodput, 4),
+                allocated_s=round(st.allocated_s, 3),
+                productive_s=round(st.productive_s, 3),
+                stalled=st.stalled))
+        for uid in set(self._published) - current_uids:
+            del self._published[uid]
+        if not usages:
+            return
+        sig = tuple((u.uid, u.step, u.epoch, u.steps_per_s)
+                    for u in usages)
+        total_alloc = sum(u.allocated_s for u in usages)
+        if sig == self._last_report and \
+                total_alloc - self._posted_alloc < self.POST_DEBT_S:
+            return                    # steady and little unreported
+        report = GoodputReport(node=agent.node_name,
+                               ts=round(_time.time(), 3),
+                               usages=usages)
+        try:
+            agent.cluster.put_object("goodputreport", report)
+        except Exception as e:  # noqa: BLE001 — reporting must never
+            log.warning("goodput report post failed: %s", e)  # kill sync
+            return
+        self._last_report = sig
+        self._posted_alloc = total_alloc
 
 
 @register_handler

@@ -87,13 +87,13 @@ def _build_registry() -> None:
     global _built
     if _built:
         return
-    from volcano_tpu.api import (hypernode, jobflow, netusage,
+    from volcano_tpu.api import (goodput, hypernode, jobflow, netusage,
                                  node_info, numatopology, pod, podgroup,
                                  queue, shard, slicehealth, types, vcjob)
     from volcano_tpu.cache import cluster as cluster_mod
     from volcano_tpu.controllers import cronjob, hyperjob
     for mod in (types, pod, node_info, podgroup, queue, hypernode,
-                vcjob, jobflow, netusage, numatopology, shard,
+                vcjob, jobflow, netusage, goodput, numatopology, shard,
                 slicehealth, cluster_mod, cronjob, hyperjob):
         _scan(mod)
     _built = True

@@ -125,6 +125,21 @@ FAMILIES: Dict[str, str] = {
     "elastic_resume_step_gap": "histogram",
     "elastic_jobs": "gauge",
     "elastic_slices_total": "gauge",
+    # goodput observatory (volcano_tpu/goodput.py + agent goodput
+    # handler): measured fleet throughput, learned-vector update
+    # tally, grow-gate decisions, ICI fragmentation and per-queue
+    # starvation — labels are bounded (generation enum,
+    # allowed|declined, operator queue config; never job/pod/node)
+    "goodput_jobs": "gauge",
+    "goodput_fleet_steps_per_second": "gauge",
+    "goodput_fraction": "gauge",
+    "goodput_vector_updates_total": "counter",
+    "goodput_gated_grows_total": "counter",
+    "frag_index": "gauge",
+    "frag_idle_chips": "gauge",
+    "frag_largest_block_chips": "gauge",
+    "starvation_age_seconds": "gauge",
+    "starvation_pending_gangs": "gauge",
 }
 
 
@@ -225,6 +240,21 @@ def scheduler_dashboard() -> dict:
                 "sum by (kind) (rate(elastic_decisions_total[5m]))",
                 "sum by (kind) (rate(elastic_resizes_total[5m]))",
                 _mean_expr("elastic_resume_step_gap")], 0, 56),
+        # goodput observatory: measured fleet throughput + goodput
+        # fraction, learned-vector updates and the grow-gate verdicts
+        _panel(16, "Goodput: fleet steps/s, fraction, gated grows",
+               ["goodput_fleet_steps_per_second", "goodput_jobs",
+                "goodput_fraction",
+                "sum by (generation) "
+                "(rate(goodput_vector_updates_total[5m]))",
+                "sum by (decision) "
+                "(rate(goodput_gated_grows_total[5m]))"], 12, 56),
+        _panel(17, "ICI fragmentation / queue starvation",
+               ["frag_index",
+                "sum by (generation) (frag_idle_chips)",
+                "sum by (generation) (frag_largest_block_chips)",
+                "max by (queue) (starvation_age_seconds)",
+                "sum by (queue) (starvation_pending_gangs)"], 0, 64),
     ]
     return {
         "title": "volcano-tpu / scheduler", "uid": "vtp-scheduler",
@@ -343,10 +373,12 @@ ROLES = [
                     "--token-file {bundle_dir}/token", 2),
     # netaccounting reads the same volcano-owned cgroup subtree the
     # cgroup enforcer narrows to (its default root), closing the
-    # shape->measure loop in the deployed agent
+    # shape->measure loop in the deployed agent; goodput reads the
+    # workload progress files (api/goodput.py default root)
     ("agents", "volcano-tpu --cluster-url http://127.0.0.1:{port} "
                "--components none --agent-scheduler --node-agents all "
-               "--usage-source collectors:local,tpu,netaccounting "
+               "--usage-source collectors:local,tpu,netaccounting,"
+               "goodput "
                "--enforcer cgroup:/sys/fs/cgroup,tc:eth0 "
                "--metrics-port {port3} "
                "--token-file {bundle_dir}/token", 3),

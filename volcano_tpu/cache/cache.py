@@ -59,6 +59,10 @@ class Snapshot:
         self.queues: Dict[str, QueueInfo] = {}
         self.hypernodes: Optional[HyperNodesInfo] = None
         self.priority_classes: Dict[str, PriorityClass] = {}
+        # the cache's ThroughputBook (volcano_tpu/goodput.py): learned
+        # per-(job, generation) step-rate vectors, exposed to plugins
+        # and actions as session.goodput
+        self.goodput = None
 
     def total_resource(self):
         from volcano_tpu.api.resource import Resource
@@ -120,6 +124,11 @@ class SchedulerCache:
         # sched_phase_seconds (once per pod, bounded window)
         self._phase_seen: set = set()
         self._phase_seen_order: deque = deque()
+        # learned per-(job, generation) throughput vectors, fed from
+        # folded podgroup goodput annotations on ordinary watch
+        # events — works identically in-process and over the wire
+        from volcano_tpu.goodput import ThroughputBook
+        self.goodput_book = ThroughputBook()
         watch = getattr(cluster, "watch", None)
         if watch is not None:
             watch(self._on_cluster_event)
@@ -163,6 +172,10 @@ class SchedulerCache:
             # outside the dirty lock: phase-metric derivation reads
             # the podgroup store and feeds the metrics registry
             self._maybe_observe_phases(obj)
+        elif kind == "podgroup":
+            self._maybe_observe_goodput(obj)
+        elif kind == "podgroup_deleted":
+            self.goodput_book.forget(getattr(obj, "key", ""))
 
     _PHASE_SEEN_MAX = 8192
 
@@ -192,6 +205,27 @@ class SchedulerCache:
                 pg_ann = pg.annotations
         trace.observe_phase_metrics(ann, pg_ann)
 
+    def _maybe_observe_goodput(self, pg) -> None:
+        """Feed a podgroup's folded goodput annotations (store-side
+        GoodputReport fold) into the throughput-vector book — the
+        learn half of the Gavel loop, driven by ordinary watch events
+        so it works identically in-process and over the wire.  The
+        fold timestamp dedupes watch re-deliveries."""
+        from volcano_tpu.api import elastic as eapi
+        from volcano_tpu.api import goodput as gapi
+        ann = getattr(pg, "annotations", None)
+        if not ann or gapi.PG_STEP_RATE_ANNOTATION not in ann:
+            return
+        rate = gapi.ann_float(ann, gapi.PG_STEP_RATE_ANNOTATION)
+        if rate <= 0:
+            return
+        self.goodput_book.note(
+            pg.key,
+            ann.get(gapi.PG_GENERATION_ANNOTATION, "other"),
+            rate,
+            eapi.current_slices(pg),
+            gapi.ann_float(ann, gapi.PG_UPDATED_TS_ANNOTATION))
+
     def note_touched(self, nodes, jobs) -> None:
         """Session mutations (committed OR discarded) — close_session
         reports them; the touched objects rebuild next cycle."""
@@ -219,6 +253,7 @@ class SchedulerCache:
             snap = self._build_full(raw)
         else:
             snap = self._build_incremental(raw, dirty_nodes, dirty_jobs)
+        snap.goodput = self.goodput_book
         self._base = snap
         return snap
 

@@ -47,6 +47,7 @@ class FakeCluster(Cluster):
         self.numatopologies: Dict[str, object] = {}  # nodeinfo/v1alpha1
         self.bandwidthreports: Dict[str, object] = {}  # api/netusage.py
         self.slicehealthreports: Dict[str, object] = {}  # api/slicehealth.py
+        self.goodputreports: Dict[str, object] = {}    # api/goodput.py
         self.services: Dict[str, dict] = {}       # svc plugin artifacts
         self.config_maps: Dict[str, dict] = {}
         self.secrets: Dict[str, dict] = {}
@@ -97,7 +98,8 @@ class FakeCluster(Cluster):
             # agent reports die with it
             for kind, attr in (("bandwidthreport", "bandwidthreports"),
                                ("slicehealthreport",
-                                "slicehealthreports")):
+                                "slicehealthreports"),
+                               ("goodputreport", "goodputreports")):
                 with self._lock:
                     had = name in getattr(self, attr)
                 if had:
@@ -226,6 +228,13 @@ class FakeCluster(Cluster):
 
     def put_object(self, kind: str, obj, key: Optional[str] = None):
         from volcano_tpu.cache.kinds import KINDS, key_for
+        prev_goodput = None
+        if kind == "goodputreport":
+            # the node's PREVIOUS report is the fold's diff base (the
+            # wire carries cumulative ledgers; see _fold_goodput_report)
+            with self._lock:
+                prev_goodput = self.goodputreports.get(
+                    key_for(kind, obj, key))
         if kind == "vcjob" and key is None:
             # keep the admission-gated create path authoritative
             # (an explicit key marks an update/status flush — the
@@ -244,6 +253,15 @@ class FakeCluster(Cluster):
                 (kind == "podgroup" and k not in self.podgroups):
             from volcano_tpu import trace
             trace.stamp_phase(obj.annotations, "created")
+        if kind == "podgroup":
+            # keep the goodput fold sticky: a whole-podgroup write
+            # from a mirror predating a fold (controllers persist
+            # podgroups from THEIR copies every sync) must not erase
+            # the accumulated accounting
+            with self._lock:
+                cur = self.podgroups.get(k)
+            if cur is not None:
+                self._apply_goodput_stick(obj, cur)
         if kind == "node":
             # keep the accounting/health folds sticky: a node write
             # from a mirror that predates a fold (the agent's
@@ -266,6 +284,8 @@ class FakeCluster(Cluster):
             self._fold_bandwidth_report(obj)
         elif kind == "slicehealthreport":
             self._fold_health_report(obj)
+        elif kind == "goodputreport":
+            self._fold_goodput_report(obj, prev_goodput)
         return obj
 
     @staticmethod
@@ -365,6 +385,108 @@ class FakeCluster(Cluster):
         if changed:
             self._notify("node", node)
 
+    @staticmethod
+    def _apply_goodput_stick(obj, cur) -> None:
+        """A whole-podgroup write from a mirror that predates a
+        goodput fold must not erase the folded summary: copy over any
+        goodput key the incoming write lacks, and for the ACCUMULATED
+        keys (allocated/productive pod-seconds, step, epoch) keep the
+        larger value — the ledger only ever grows, so max() is the
+        conflict-free merge of a stale-copy write racing a fold."""
+        from volcano_tpu.api import goodput as gapi
+        ann, cur_ann = obj.annotations, cur.annotations
+        for key in gapi.PG_FOLD_KEYS:
+            if key not in cur_ann:
+                continue
+            if key not in ann:
+                ann[key] = cur_ann[key]
+            elif key in (gapi.PG_ALLOCATED_S_ANNOTATION,
+                         gapi.PG_PRODUCTIVE_S_ANNOTATION,
+                         gapi.PG_STEP_ANNOTATION,
+                         gapi.PG_EPOCH_ANNOTATION,
+                         gapi.PG_UPDATED_TS_ANNOTATION):
+                if gapi.ann_float(cur_ann, key) > \
+                        gapi.ann_float(ann, key):
+                    ann[key] = cur_ann[key]
+
+    def _fold_goodput_report(self, report, prev=None) -> None:
+        """Fold a node agent's GoodputReport into the owning PODGROUP
+        annotations AT THE STORE — the per-job half of the goodput
+        loop (docs/design/goodput.md).  Doing it here (not in the
+        agent) means every watch mirror — the scheduler's throughput-
+        vector estimator included — learns per-job step rates and the
+        productive/allocated ledger from ordinary podgroup events.
+
+        The wire ledger is CUMULATIVE per pod; the fold accumulates
+        the per-pod diff against *prev* (this node's previous stored
+        report).  That makes the fold idempotent under retries — an
+        agent whose post was folded but whose ack died re-sends the
+        same cumulative values and contributes only the growth — while
+        several nodes hosting one gang still accumulate without
+        double counting.  A cumulative value BELOW the previous one is
+        a restarted collector: the new absolute value is the diff."""
+        from volcano_tpu.api import goodput as gapi
+        prev_by_uid = {u.uid: u for u in getattr(prev, "usages", ())} \
+            if prev is not None else {}
+
+        def ledger_diff(u, field):
+            cur = getattr(u, field)
+            p = prev_by_uid.get(u.uid)
+            base = getattr(p, field) if p is not None else 0.0
+            return cur - base if cur >= base else cur
+
+        by_job: Dict[str, list] = {}
+        for u in getattr(report, "usages", ()):
+            if u.job:
+                by_job.setdefault(u.job, []).append(u)
+        for job_key, usages in by_job.items():
+            with self._lock:
+                pg = self.podgroups.get(job_key)
+                if pg is None:
+                    continue
+                ann = pg.annotations
+                before = {k: ann.get(k) for k in gapi.PG_FOLD_KEYS}
+                step = max(u.step for u in usages)
+                if step > gapi.ann_float(ann, gapi.PG_STEP_ANNOTATION):
+                    ann[gapi.PG_STEP_ANNOTATION] = str(step)
+                # the gang steps in lockstep: any healthy pod's rate
+                # approximates the job's — take this report's max so
+                # one straggling stale file cannot drag the estimate
+                rate = max(u.steps_per_s for u in usages)
+                ann[gapi.PG_STEP_RATE_ANNOTATION] = f"{rate:.3f}"
+                ex_rate = max(u.examples_per_s for u in usages)
+                if ex_rate > 0:
+                    ann[gapi.PG_EXAMPLES_RATE_ANNOTATION] = \
+                        f"{ex_rate:.3f}"
+                alloc = gapi.ann_float(
+                    ann, gapi.PG_ALLOCATED_S_ANNOTATION) + \
+                    sum(ledger_diff(u, "allocated_s") for u in usages)
+                prod = gapi.ann_float(
+                    ann, gapi.PG_PRODUCTIVE_S_ANNOTATION) + \
+                    sum(ledger_diff(u, "productive_s")
+                        for u in usages)
+                ann[gapi.PG_ALLOCATED_S_ANNOTATION] = f"{alloc:.3f}"
+                ann[gapi.PG_PRODUCTIVE_S_ANNOTATION] = f"{prod:.3f}"
+                if alloc > 0:
+                    ann[gapi.PG_GOODPUT_ANNOTATION] = \
+                        f"{min(1.0, prod / alloc):.4f}"
+                ann[gapi.PG_GENERATION_ANNOTATION] = \
+                    usages[0].generation
+                epoch = max(u.epoch for u in usages)
+                if epoch >= gapi.ann_float(ann,
+                                           gapi.PG_EPOCH_ANNOTATION):
+                    ann[gapi.PG_EPOCH_ANNOTATION] = str(epoch)
+                ts = getattr(report, "ts", 0.0)
+                # max-merge: a behind-wall-clock node's fold must not
+                # regress the stamp (the estimator dedupes on it)
+                if ts > gapi.ann_float(ann,
+                                       gapi.PG_UPDATED_TS_ANNOTATION):
+                    ann[gapi.PG_UPDATED_TS_ANNOTATION] = f"{ts:.3f}"
+                changed = before != {k: ann.get(k)
+                                     for k in gapi.PG_FOLD_KEYS}
+            if changed:     # unchanged summary: no watch traffic
+                self._notify("podgroup", pg)
+
     def delete_object(self, kind: str, key: str) -> None:
         from volcano_tpu.cache.kinds import KINDS
         spec = KINDS[kind]
@@ -380,7 +502,8 @@ class FakeCluster(Cluster):
             # under the same name
             for rkind, attr in (("bandwidthreport", "bandwidthreports"),
                                 ("slicehealthreport",
-                                 "slicehealthreports")):
+                                 "slicehealthreports"),
+                                ("goodputreport", "goodputreports")):
                 with self._lock:
                     had = key in getattr(self, attr)
                 if had:

@@ -52,6 +52,11 @@ KINDS: Dict[str, KindSpec] = {
     # node agent's hysteresis, folded into node annotations by the
     # store; the failover controller declares slice failures from it
     "slicehealthreport": KindSpec("slicehealthreports", _name),
+    # per-node workload step-progress report (api/goodput.py): posted
+    # by the node agent, folded into PODGROUP annotations by the store
+    # so scheduler mirrors learn per-job step rates / goodput from
+    # ordinary podgroup events
+    "goodputreport": KindSpec("goodputreports", _name),
     # plain-dict kinds (plugin/operator supplied payloads)
     # namespace -> annotations dict (podgroup mutate webhook reads the
     # per-namespace default-queue annotation)

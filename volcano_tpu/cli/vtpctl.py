@@ -674,6 +674,126 @@ def cmd_elastic(cluster, args):
                      ["PODGROUP", "GEN", "KIND", "SLICES", "AT"]))
 
 
+def cmd_goodput(cluster, args):
+    """One job's measured throughput: the store-folded podgroup
+    summary (step, steps/s, goodput = productive/allocated
+    pod-seconds) plus the per-pod progress the node agents last
+    reported (GoodputReport store) and the elastic resize history —
+    the operator's answer to "is this gang actually training, and how
+    fast"."""
+    import datetime
+
+    from volcano_tpu.api import elastic as eapi
+    from volcano_tpu.api import goodput as gapi
+    key = f"{args.namespace}/{args.name}"
+    pg = cluster.podgroups.get(key)
+    if pg is None:
+        sys.exit(f"podgroup {key} not found")
+    ann = pg.annotations
+    print(f"job: {key}")
+    print(f"phase: {pg.phase.value}  (queue={pg.queue})")
+    if gapi.PG_STEP_RATE_ANNOTATION not in ann:
+        print("no goodput data published (no worker progress "
+              "reported yet — does the job declare "
+              f"{gapi.PROGRESS_DIR_ANNOTATION}?)")
+        return
+    alloc = gapi.ann_float(ann, gapi.PG_ALLOCATED_S_ANNOTATION)
+    prod = gapi.ann_float(ann, gapi.PG_PRODUCTIVE_S_ANNOTATION)
+    updated = gapi.ann_float(ann, gapi.PG_UPDATED_TS_ANNOTATION)
+    print(f"step: {int(gapi.ann_float(ann, gapi.PG_STEP_ANNOTATION))}"
+          f"  steps/s: "
+          f"{gapi.ann_float(ann, gapi.PG_STEP_RATE_ANNOTATION):g}"
+          f"  examples/s: "
+          f"{gapi.ann_float(ann, gapi.PG_EXAMPLES_RATE_ANNOTATION):g}")
+    print(f"goodput: "
+          f"{ann.get(gapi.PG_GOODPUT_ANNOTATION, '-')}"
+          f"  (productive {prod:.1f}s / allocated {alloc:.1f}s "
+          f"pod-seconds)")
+    print(f"generation: "
+          f"{ann.get(gapi.PG_GENERATION_ANNOTATION, '-')}"
+          f"  epoch: {int(gapi.ann_float(ann, gapi.PG_EPOCH_ANNOTATION))}"
+          f"  updated: "
+          + (datetime.datetime.fromtimestamp(updated).isoformat(
+              timespec='seconds') if updated else "-"))
+    rows = []
+    for name in sorted(getattr(cluster, "goodputreports", {})):
+        rep = cluster.goodputreports[name]
+        for u in rep.usages:
+            if u.job != key:
+                continue
+            rows.append([
+                rep.node, u.pod_key, u.step, f"{u.steps_per_s:g}",
+                f"{u.goodput:g}",
+                "STALLED" if u.stalled else "stepping", u.epoch])
+    if rows:
+        print()
+        print(_table(rows, ["NODE", "POD", "STEP", "STEPS/S",
+                            "GOODPUT", "STATE", "EPOCH"]))
+    hist = eapi.resize_history(pg)
+    if hist:
+        print()
+        print(_table(
+            [[rec.get("gen", "?"), rec.get("kind", "?"),
+              f"{rec.get('from', '?')} -> {rec.get('to', '?')}"]
+             for rec in hist],
+            ["GEN", "KIND", "SLICES"]))
+
+
+def cmd_fleet(cluster, args):
+    """Fleet observatory rollup: per-job measured throughput (from
+    the folded podgroup annotations), then the cluster gauges the
+    scheduler exports — ICI fragmentation per generation (largest
+    placeable idle block vs total idle chips, volcano_tpu/goodput.py)
+    and pending-gang counts per queue — computed here from the same
+    store objects so the view works against a state file or mirror
+    with no scheduler attached."""
+    from volcano_tpu import goodput as gp
+    from volcano_tpu import trace
+    from volcano_tpu.api import elastic as eapi
+    from volcano_tpu.api import goodput as gapi
+    from volcano_tpu.api.types import PodGroupPhase
+    import time as _time
+
+    rows = []
+    pending_by_queue = {}
+    now = _time.time()
+    for pg in sorted(cluster.podgroups.values(), key=lambda g: g.key):
+        if pg.phase in (PodGroupPhase.PENDING, PodGroupPhase.INQUEUE):
+            born = trace.phase_ts(pg.annotations, "created")
+            cur = pending_by_queue.setdefault(
+                pg.queue, {"gangs": 0, "age_s": 0.0})
+            cur["gangs"] += 1
+            if born is not None:
+                cur["age_s"] = max(cur["age_s"], now - born)
+        ann = pg.annotations
+        if gapi.PG_STEP_RATE_ANNOTATION not in ann:
+            continue
+        rows.append([
+            pg.key, pg.phase.value,
+            ann.get(gapi.PG_GENERATION_ANNOTATION, "-"),
+            eapi.current_slices(pg) if eapi.is_elastic(pg) else "-",
+            int(gapi.ann_float(ann, gapi.PG_STEP_ANNOTATION)),
+            f"{gapi.ann_float(ann, gapi.PG_STEP_RATE_ANNOTATION):g}",
+            ann.get(gapi.PG_GOODPUT_ANNOTATION, "-"),
+        ])
+    print(_table(rows, ["JOB", "PHASE", "GEN", "SLICES", "STEP",
+                        "STEPS/S", "GOODPUT"]))
+    frag = gp.fragmentation(gp._slice_stats_from_cluster(
+        cluster.nodes.values(), cluster.pods.values()))
+    if frag:
+        print()
+        print(_table(
+            [[gen, doc["idle_chips"], doc["largest_block_chips"],
+              doc["index"]] for gen, doc in sorted(frag.items())],
+            ["GENERATION", "IDLE-CHIPS", "LARGEST-BLOCK", "FRAG-INDEX"]))
+    if pending_by_queue:
+        print()
+        print(_table(
+            [[q, doc["gangs"], f"{doc['age_s']:.1f}"]
+             for q, doc in sorted(pending_by_queue.items())],
+            ["QUEUE", "PENDING-GANGS", "OLDEST-AGE-S"]))
+
+
 def cmd_bandwidth(cluster, args):
     """Per-pod DCN usage as the agents measured it (BandwidthReport
     store, api/netusage.py): node summary line + per-pod rates,
@@ -1062,6 +1182,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "gang and re-place it on DIFFERENT slices at "
                         "the same world size")
     p.set_defaults(fn=cmd_elastic)
+
+    p = sub.add_parser("goodput", help="one job's measured "
+                       "throughput: step rate, goodput = productive/"
+                       "allocated, per-pod progress, resize history")
+    p.add_argument("name", help="job / podgroup name")
+    p.add_argument("-n", "--namespace", default="default")
+    p.set_defaults(fn=cmd_goodput)
+
+    p = sub.add_parser("fleet", help="fleet observatory rollup: "
+                       "per-job measured steps/s + goodput, ICI "
+                       "fragmentation per generation, pending gangs "
+                       "per queue")
+    p.set_defaults(fn=cmd_fleet)
 
     p = sub.add_parser("explain", help="why is this job pending: "
                        "aggregated unschedulable reasons (normalized "

@@ -101,6 +101,31 @@ class JaxPlugin(JobPlugin):
         resume_step = job.annotations.get(RESUME_STEP_ANNOTATION)
         if resume_step:
             set_env(pod, "VTP_RESUME_STEP", resume_step)
+        # goodput observatory (api/goodput.py): a job declaring a
+        # progress dir gets a per-pod progress-file path plus the
+        # restart/resize epoch (failover generation + elastic
+        # generation — any drain bumps one of them) so the agent's
+        # collector can tell a resumed step counter from a rollback
+        from volcano_tpu.api.goodput import (
+            ENV_EPOCH, ENV_PROGRESS_FILE, PROGRESS_DIR_ANNOTATION,
+            progress_file_for)
+        from volcano_tpu.api.slicehealth import (
+            FAILOVER_GENERATION_ANNOTATION)
+        from volcano_tpu.api import elastic as _eapi
+
+        def _int_ann(key: str) -> int:
+            try:
+                return int(job.annotations.get(key, 0) or 0)
+            except (TypeError, ValueError):
+                return 0
+
+        progress_dir = job.annotations.get(PROGRESS_DIR_ANNOTATION)
+        if progress_dir:
+            set_env(pod, ENV_PROGRESS_FILE,
+                    progress_file_for(progress_dir, pod.uid))
+            set_env(pod, ENV_EPOCH, str(
+                _int_ann(FAILOVER_GENERATION_ANNOTATION)
+                + _int_ann(_eapi.ELASTIC_GENERATION_ANNOTATION)))
 
         tasks = self._worker_tasks(job)
         num_slices = len({sid for _, sid in tasks})

@@ -67,6 +67,13 @@ class Dumper:
         # wedged scheduler is diagnosable post-hoc from ONE artifact
         # (what was it doing, and why is work pending)
         payload["trace"] = trace.dump_state()
+        # goodput observatory section: the learned per-(job,
+        # generation) throughput vectors and per-world-size rates —
+        # what the grow gate and (later) a Gavel policy would decide
+        # from (volcano_tpu/goodput.py)
+        book = getattr(self.scheduler.cache, "goodput_book", None)
+        if book is not None:
+            payload["goodput"] = book.dump_state()
         with open(self.path, "w") as f:
             json.dump(payload, f, indent=2, sort_keys=True)
         log.info("cache dumped to %s", self.path)

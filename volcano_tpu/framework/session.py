@@ -66,6 +66,10 @@ class Session:
         self.hypernodes = snapshot.hypernodes
         self.priority_classes = snapshot.priority_classes
         self.total_resource = snapshot.total_resource()
+        # learned per-(job, generation) throughput vectors
+        # (volcano_tpu/goodput.py ThroughputBook; None in harnesses
+        # that build bare snapshots) — read-only for plugins/actions
+        self.goodput = getattr(snapshot, "goodput", None)
 
         self.plugins: Dict[str, object] = {}
 

@@ -18,7 +18,7 @@ from volcano_tpu.cache.cluster import Cluster
 from volcano_tpu.conf import SchedulerConf, load_conf
 from volcano_tpu.framework.framework import close_session, open_session
 from volcano_tpu.framework.plugins import get_action
-from volcano_tpu import metrics, trace
+from volcano_tpu import goodput, metrics, trace
 
 log = logging.getLogger(__name__)
 
@@ -80,6 +80,16 @@ class Scheduler:
                     action.execute(ssn)
                 metrics.observe("action_latency_seconds",
                                 time.perf_counter() - t0, action=name)
+            # goodput observatory: per-session fragmentation /
+            # starvation / fleet-throughput gauges off the post-action
+            # state (one O(nodes)+O(jobs) pass; volcano_tpu/goodput.py).
+            # Degrade-don't-crash: a metrics-only bug must never stop
+            # scheduling — same posture as the agent-side handlers.
+            try:
+                with trace.span("observe", kind="action"):
+                    goodput.observe_session(ssn)
+            except Exception:  # noqa: BLE001
+                log.exception("goodput session observation failed")
         finally:
             # a cycle that crashed ANYWHERE (open_session, an action,
             # close_session below) is exactly what the recorder must

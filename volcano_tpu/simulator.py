@@ -31,6 +31,7 @@ ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
 def slice_nodes(slice_topo: SliceTopology, dcn_pod: str = "",
                 cpu_per_host: int = 112, mem_gi: int = 192) -> List[Node]:
     """Materialize one slice as its host nodes with full TPU labels."""
+    from volcano_tpu.api.goodput import GENERATION_LABEL, generation_of
     nodes = []
     for worker in range(slice_topo.num_hosts):
         coords = slice_topo.host_coords(worker)
@@ -41,6 +42,9 @@ def slice_nodes(slice_topo: SliceTopology, dcn_pod: str = "",
             TPU_COORDS_LABEL: ",".join(str(c) for c in coords),
             ACCELERATOR_LABEL: slice_topo.accelerator,
         }
+        # hardware generation attribute (api/goodput.py): the key the
+        # throughput-vector estimator and frag gauges group by
+        labels[GENERATION_LABEL] = generation_of(labels)
         if dcn_pod:
             labels[DCN_POD_LABEL] = dcn_pod
         nodes.append(Node(

@@ -32,6 +32,10 @@ ENV_NUM_SLICES = "TPU_NUM_SLICES"
 # the jax plugin injects, workloads/checkpoint.resume_state consumes):
 ENV_CHECKPOINT_DIR = "VTP_CHECKPOINT_DIR"
 ENV_RESUME_STEP = "VTP_RESUME_STEP"
+# goodput progress contract (api/goodput.py: the jax plugin injects,
+# workloads/progress.ProgressReporter consumes):
+ENV_PROGRESS_FILE = "VTP_PROGRESS_FILE"
+ENV_EPOCH = "VTP_EPOCH"
 DEFAULT_COORDINATOR_PORT = 8476
 
 
@@ -47,6 +51,10 @@ class BootstrapInfo:
     # control plane asserts was durably saved before the slice died
     checkpoint_dir: str = ""
     resume_step: Optional[int] = None
+    # goodput: where this worker publishes step progress, and the
+    # control plane's restart/resize epoch for the record
+    progress_file: str = ""
+    epoch: int = 0
 
     @property
     def is_distributed(self) -> bool:
@@ -69,6 +77,10 @@ def from_env(environ=None) -> BootstrapInfo:
         resume_step = int(resume_raw) if resume_raw else None
     except ValueError:
         resume_step = None     # malformed env must not kill bootstrap
+    try:
+        epoch = int(env.get(ENV_EPOCH, 0) or 0)
+    except ValueError:
+        epoch = 0
     return BootstrapInfo(
         process_id=int(env.get(ENV_WORKER_ID, 0)),
         num_processes=num,
@@ -78,6 +90,8 @@ def from_env(environ=None) -> BootstrapInfo:
         num_slices=int(env.get(ENV_NUM_SLICES, 1)),
         checkpoint_dir=env.get(ENV_CHECKPOINT_DIR, ""),
         resume_step=resume_step,
+        progress_file=env.get(ENV_PROGRESS_FILE, ""),
+        epoch=epoch,
     )
 
 

@@ -88,10 +88,23 @@ def run(environ=None) -> dict:
                                    cfg.vocab_size, dtype=jnp.int32),
         out_shardings=train.batch_sharding(mesh))()}
     step = train.make_train_step(cfg, mesh, optimizer)
+    # goodput observatory: publish per-step progress (step counter,
+    # examples, wall ts, restart/resize epoch) to the injected
+    # VTP_PROGRESS_FILE so the node agent can measure step rate and
+    # productive time (workloads/progress.py; best-effort)
+    from volcano_tpu.workloads.progress import ProgressReporter
+    reporter = ProgressReporter.from_env(environ)
+    if reporter is not None:
+        reporter.report(step=start_step, examples=0.0)
     loss = float("nan")
+    steps_done = 0
     for _ in range(int(os.environ.get("WORKER_STEPS", "3"))):
         params, opt_state, metrics = step(params, opt_state, batch)
         loss = float(metrics["loss"])
+        steps_done += 1
+        if reporter is not None:
+            reporter.report(step=start_step + steps_done,
+                            examples=steps_done * global_batch)
     return {
         "process_id": info.process_id,
         "num_processes": info.num_processes,
