@@ -2330,6 +2330,203 @@ def crash_smoke() -> int:
     return 0 if ok else 1
 
 
+# -- gray-failure chaos smoke (wire + disk faults, real processes) -----
+
+
+def bench_chaos_smoke() -> dict:
+    """The gray-failure contract on every commit, seconds-scale,
+    through real OS processes (docs/design/chaos.md):
+
+      1. ACK-LOST BIND: the server commits a /bind and DROPS the
+         response (seeded fault plan, exactly one injection); the
+         client's retry must converge by state-compare — bound once,
+         no double effects.
+      2. ENOSPC DEGRADE-AND-RECOVER: an injected ENOSPC window poisons
+         the WAL; writes must 503 with Retry-After (read-only
+         degrade), reads and leases must keep serving, and once the
+         window passes the heal loop must make the server writable
+         again with the rv monotonic across the whole episode.
+      3. CRC-CORRUPT REPLAY: kill -9, flip one bit mid-WAL, reboot —
+         the server must REFUSE to start (exit 3, CRC detection);
+         rebooting with --wal-force-truncate must come up with every
+         record before the corruption intact.
+    """
+    import os
+    import shutil
+    import tempfile
+    import urllib.error
+    import urllib.request
+
+    from tools import chaoslib
+    from volcano_tpu import faults as faults_mod
+    from volcano_tpu import metrics
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.simulator import slice_nodes
+
+    logdir = tempfile.mkdtemp(prefix="chaos-smoke-")
+    data_dir = os.path.join(logdir, "state")
+    port = chaoslib.free_port()
+    url = f"http://127.0.0.1:{port}"
+    # the seeded plan: exactly one dropped /bind ack + one ENOSPC
+    # window a few seconds after boot
+    plan_doc = {"seed": 12, "rules": [
+        {"site": "server", "kind": "drop_response", "route": "/bind",
+         "max_injections": 1},
+        {"site": "disk", "kind": "enospc_append",
+         "after_s": 3.0, "until_s": 5.0},
+    ]}
+    plan_path = os.path.join(logdir, "plan.json")
+    with open(plan_path, "w", encoding="utf-8") as f:
+        json.dump(plan_doc, f)
+    zoo = chaoslib.ProcessZoo(logdir)
+    out = {"seed": plan_doc["seed"]}
+    kubectl = None
+    try:
+        t_boot = time.monotonic()
+        zoo.spawn_server(port, "--data-dir", data_dir,
+                         "--fault-plan", f"@{plan_path}")
+        chaoslib.wait_server(url)
+        kubectl = RemoteCluster(url, start_watch=False)
+        node = next(iter(slice_nodes(slice_for("sa", "v5e-4"),
+                                     dcn_pod="d0")))
+        kubectl.add_node(node)
+
+        # (1) the ack-lost bind: commit lands, response dropped, the
+        # client retry must converge (state-compare rebind)
+        pod = make_pod("t", requests={"cpu": 1})
+        pod.name, pod.namespace = "p0", "default"
+        kubectl.put_object("pod", pod)
+        retries_before = metrics.get_counter("client_retries_total",
+                                             route="/bind")
+        kubectl.bind_pod("default", "p0", node.name)
+        faults_fired = {r["kind"]: r["injected"]
+                        for r in (chaoslib.http_json(url + "/faults")
+                                  or {}).get("rules", [])}
+        truth = chaoslib.snapshot_stores(url)
+        out["ack_lost_bind"] = {
+            "fault_injected": faults_fired.get("drop_response", 0),
+            "client_retried": metrics.get_counter(
+                "client_retries_total", route="/bind")
+            > retries_before,
+            "bound_once": truth["pod"]["default/p0"].node_name
+            == node.name,
+        }
+
+        # (2) ENOSPC degrade-and-recover: inside the window writes
+        # must 503 (readonly) while reads + leases still serve; after
+        # it the heal loop must restore writability, rv monotonic
+        rv_before = int(kubectl._request(
+            "GET", "/durability")["visible_rv"])
+        degrade = {"writes_503": False, "reads_served": False,
+                   "leases_served": False, "retry_after": None}
+        while time.monotonic() - t_boot < 10.0:
+            body = json.dumps({"namespace": "default", "name": "p0",
+                               "node_name": node.name}).encode()
+            req = urllib.request.Request(
+                url + "/bind", data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=5).read()
+            except urllib.error.HTTPError as e:
+                if e.code == 503 and json.loads(
+                        e.read()).get("readonly"):
+                    degrade["writes_503"] = True
+                    degrade["retry_after"] = e.headers.get(
+                        "Retry-After")
+                    break
+            time.sleep(0.15)
+        # mid-degrade: reads and leases must still answer
+        ro = kubectl._request("GET", "/durability")
+        degrade["readonly_reason"] = ro.get("readonly") or ""
+        degrade["reads_served"] = bool(ro)
+        degrade["leases_served"] = bool(kubectl.lease(
+            "chaos-smoke", "smoker", ttl=5.0).get("acquired"))
+        # a write WITH the retry policy must wait out the heal
+        # (Retry-After honoured) and land once writable again
+        pod2 = make_pod("t", requests={"cpu": 1})
+        pod2.name, pod2.namespace = "p1", "default"
+        kubectl.put_object("pod", pod2)
+        chaoslib.wait_for(
+            lambda: not (kubectl._request("GET", "/durability")
+                         .get("readonly") or ""),
+            20, "server healed back to writable")
+        dur = kubectl._request("GET", "/durability")
+        degrade["healed_writable"] = not (dur.get("readonly") or "")
+        degrade["rv_monotonic"] = int(dur["visible_rv"]) >= rv_before
+        truth = chaoslib.snapshot_stores(url)
+        degrade["post_heal_write_durable"] = "default/p1" in truth["pod"]
+        out["enospc_degrade"] = degrade
+
+        # a little more WAL tail so the bit flip below is mid-segment
+        for i in range(4):
+            p = make_pod("t", requests={"cpu": 1})
+            p.name, p.namespace = f"tail{i}", "default"
+            kubectl.put_object("pod", p)
+
+        # (3) CRC-corrupt replay: bit-rot one mid-WAL record; boot
+        # must refuse; --wal-force-truncate must keep the prefix
+        zoo.kill9("server")
+        seg = idx = None
+        for name in sorted(os.listdir(data_dir)):
+            if name.startswith("wal-") and name.endswith(".log"):
+                path = os.path.join(data_dir, name)
+                with open(path, "rb") as f:
+                    n = sum(1 for ln in f if ln.strip())
+                if n >= 3:
+                    seg, idx = path, n // 2
+                    break
+        assert seg is not None, "no WAL segment thick enough to flip"
+        faults_mod.flip_record_bit(seg, idx)
+        zoo.spawn("server2", "-m", "volcano_tpu.server",
+                  "--port", str(port), "--data-dir", data_dir)
+        code = zoo.wait_exit("server2", timeout=30)
+        crc = {"refused": code == 3 and bool(
+            zoo.scrape("server2", "refusing to boot"))}
+        zoo.spawn("server3", "-m", "volcano_tpu.server",
+                  "--port", str(port), "--data-dir", data_dir,
+                  "--wal-force-truncate")
+        chaoslib.wait_server(url)
+        crc["force_truncate_boots"] = True
+        truth = chaoslib.snapshot_stores(url)
+        # everything acked BEFORE the flipped record must be intact
+        # (p0's bind + p1 landed well before the tail writes)
+        crc["prefix_intact"] = (
+            truth["pod"].get("default/p0") is not None
+            and truth["pod"]["default/p0"].node_name == node.name
+            and "default/p1" in truth["pod"])
+        out["crc_corrupt_replay"] = crc
+        out["ok"] = (
+            out["ack_lost_bind"]["fault_injected"] == 1
+            and out["ack_lost_bind"]["bound_once"]
+            and degrade["writes_503"]
+            and degrade["reads_served"] and degrade["leases_served"]
+            and degrade["healed_writable"] and degrade["rv_monotonic"]
+            and degrade["post_heal_write_durable"]
+            and crc["refused"] and crc["prefix_intact"])
+        return out
+    finally:
+        if kubectl is not None:
+            kubectl.close()
+        zoo.terminate_all()
+        shutil.rmtree(logdir, ignore_errors=True)
+
+
+def chaos_smoke() -> int:
+    """Seconds-scale gray-failure drill for tier-1 (one ack-lost
+    bind, one ENOSPC degrade-and-recover, one CRC-corrupt replay
+    refusal through real processes), mirroring --crash-smoke.  Prints
+    one JSON line."""
+    try:
+        out = bench_chaos_smoke()
+        ok = out.get("ok", False)
+    except AssertionError as e:
+        out, ok = {"error": str(e)[-600:]}, False
+    print(json.dumps({"metric": "chaos_smoke", "ok": ok, **out}))
+    return 0 if ok else 1
+
+
 # ---------------------------------------------------------------------
 # Scheduling flight recorder: per-phase latency attribution through
 # the REAL process control plane (volcano_tpu/trace.py).  Gang jobs
@@ -3013,6 +3210,8 @@ if __name__ == "__main__":
         print(json.dumps({"metric": "elastic_gangs_1k_hosts", **out}))
     elif "--crash-smoke" in sys.argv:
         sys.exit(crash_smoke())
+    elif "--chaos-smoke" in sys.argv:
+        sys.exit(chaos_smoke())
     elif "--trace-smoke" in sys.argv:
         sys.exit(trace_smoke())
     elif "--trace" in sys.argv:

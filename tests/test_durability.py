@@ -463,8 +463,12 @@ def test_watch_loop_fails_fast_on_auth_error(tmp_path):
         url = f"http://127.0.0.1:{httpd.server_address[1]}"
         mirror = RemoteCluster(url)
         assert mirror._watch_thread.is_alive()
-        # rotate the server token out from under the mirror
+        # rotate the server token out from under the mirror, then
+        # poke an event: a long-poll already in flight (issued with
+        # the old anonymous auth) may otherwise sit out its full 25s
+        # window before the next — now 401ing — request is even made
         httpd.RequestHandlerClass.token = "rotated-secret"
+        state.cluster.add_command("default/poke", "Wake")
         mirror._watch_thread.join(timeout=10)
         assert not mirror._watch_thread.is_alive(), \
             "watch loop kept retrying a hopeless 401"
@@ -472,6 +476,262 @@ def test_watch_loop_fails_fast_on_auth_error(tmp_path):
         if mirror is not None:
             mirror.close()
         httpd.shutdown()
+
+
+# -- WAL corruption matrix (the gray-failure hardening, ISSUE 10) ----
+
+
+def _seeded_store(tmp_path, n_pods=8, **store_kw):
+    """A StateServer over a fresh durable dir with a few committed
+    mutations; returns (state, data_dir)."""
+    from volcano_tpu.server.durability import DurableStore
+    from volcano_tpu.server.state_server import StateServer
+
+    data_dir = str(tmp_path / "d")
+    st = StateServer(durable=DurableStore(data_dir, **store_kw))
+    for node in slice_nodes(slice_for("sa", "v5e-16"), dcn_pod="d0"):
+        st.cluster.add_node(node)
+    for i in range(n_pods):
+        pod = make_pod("t", requests={"cpu": 1})
+        pod.name, pod.namespace = f"p{i}", "default"
+        st.cluster.add_pod(pod)
+    st.cluster.bind_pod("default", "p0", "sa-w0")
+    st.commit()
+    st.durable.close()
+    return st, data_dir
+
+
+def _wal_segments(data_dir):
+    return sorted(os.path.join(data_dir, n)
+                  for n in os.listdir(data_dir)
+                  if n.startswith("wal-") and n.endswith(".log"))
+
+
+def test_wal_bitflip_mid_segment_refuses_boot(tmp_path):
+    """Bit rot MID-segment: the record still parses as a line, only
+    the CRC knows.  Boot must refuse loudly (a silent partial replay
+    drops every later acked write) — and --wal-force-truncate must
+    boot with exactly the prefix before the flip."""
+    from volcano_tpu import faults
+    from volcano_tpu.server.durability import (DurableStore,
+                                               WALCorruptionError)
+    from volcano_tpu.server.state_server import StateServer
+
+    st, data_dir = _seeded_store(tmp_path)
+    rv_full = st._rv
+    seg = _wal_segments(data_dir)[0]
+    with open(seg, "rb") as f:
+        n_records = sum(1 for ln in f if ln.strip())
+    assert n_records >= 5
+    faults.flip_record_bit(seg, n_records // 2)
+
+    with pytest.raises(WALCorruptionError) as ei:
+        DurableStore(str(tmp_path / "d")).recover()
+    assert "refusing to boot" in str(ei.value)
+
+    # the explicit operator override: prefix intact, tail gone
+    st2 = StateServer(durable=DurableStore(
+        str(tmp_path / "d"), force_truncate=True))
+    assert 0 < st2._rv < rv_full
+    assert len(st2.cluster.nodes) == 4   # nodes landed before the flip
+
+
+def test_wal_json_preserving_corruption_caught_by_crc(tmp_path):
+    """THE case the CRC exists for: corruption that still parses as
+    JSON (pre-CRC it replayed silently, applying garbage).  Flip a
+    digit inside a payload value — the line stays valid JSON but the
+    checksum disagrees."""
+    from volcano_tpu.server.durability import (DurableStore,
+                                               WALCorruptionError)
+
+    _st, data_dir = _seeded_store(tmp_path)
+    seg = _wal_segments(data_dir)[0]
+    with open(seg, "rb") as f:
+        lines = f.readlines()
+    # mutate a mid-file line's body: swap two distinct alphanumerics
+    # (guaranteed JSON-safe inside a string/number, CRC-visible)
+    idx = len(lines) // 2
+    body = lines[idx]
+    swapped = body.replace(b'"rv"', b'"vr"', 1)
+    assert swapped != body
+    lines[idx] = swapped
+    with open(seg, "wb") as f:
+        f.writelines(lines)
+
+    with pytest.raises(WALCorruptionError) as ei:
+        DurableStore(str(tmp_path / "d")).recover()
+    assert "crc-mismatch" in str(ei.value)
+
+
+def test_wal_truncated_final_record_is_torn_tail(tmp_path):
+    """A crash mid-append tears the LAST record: that (and only
+    that) is dropped quietly — the consistent prefix replays."""
+    from volcano_tpu import faults
+    from volcano_tpu.server.durability import DurableStore
+    from volcano_tpu.server.state_server import StateServer
+
+    st, data_dir = _seeded_store(tmp_path)
+    seg = _wal_segments(data_dir)[0]
+    faults.truncate_at(seg, -7)       # cut into the final record
+
+    st2 = StateServer(durable=DurableStore(str(tmp_path / "d")))
+    assert st2._rv == st._rv - 1      # exactly the torn record lost
+    assert len(st2.cluster.nodes) == 4
+
+
+def test_wal_duplicated_segment_replays_idempotently(tmp_path):
+    """Operator copy-restore accident: a WAL segment duplicated into
+    a second file.  Sequence numbers make replay skip every
+    already-applied record — same state, commands not doubled."""
+    import shutil
+
+    from volcano_tpu import metrics
+    from volcano_tpu.server.durability import DurableStore
+    from volcano_tpu.server.state_server import StateServer
+
+    store = DurableStore(str(tmp_path / "d"))
+    st = StateServer(durable=store)
+    for node in slice_nodes(slice_for("sa", "v5e-4"), dcn_pod="d0"):
+        st.cluster.add_node(node)
+    st.cluster.add_command("default/j", "RestartJob")
+    st.commit()
+    store.close()
+    rv1 = st._rv
+
+    segs = _wal_segments(str(tmp_path / "d"))
+    last = os.path.basename(segs[-1])
+    nxt = int(last[len("wal-"):-len(".log")]) + 1
+    shutil.copy(segs[-1], os.path.join(
+        os.path.dirname(segs[-1]), f"wal-{nxt:08d}.log"))
+    dups_before = metrics.get_counter(
+        "server_wal_dropped_records_total", reason="duplicate-seq")
+    st2 = StateServer(durable=DurableStore(str(tmp_path / "d")))
+    assert st2._rv == rv1
+    assert len(st2.cluster.nodes) == 1
+    assert len(st2.cluster.commands) == 1, \
+        "duplicated segment doubled a command"
+    assert metrics.get_counter("server_wal_dropped_records_total",
+                               reason="duplicate-seq") > dups_before
+
+
+def test_wal_sequence_gap_refuses_boot(tmp_path):
+    """Records MISSING mid-stream (a hole a truncation or partial
+    restore left): replaying past it would apply later state onto a
+    base that never existed — refuse."""
+    from volcano_tpu.server.durability import (DurableStore,
+                                               WALCorruptionError)
+
+    _st, data_dir = _seeded_store(tmp_path)
+    seg = _wal_segments(data_dir)[0]
+    with open(seg, "rb") as f:
+        lines = [ln for ln in f.readlines() if ln.strip()]
+    del lines[len(lines) // 2]        # excise one whole record
+    with open(seg, "wb") as f:
+        f.writelines(lines)
+
+    with pytest.raises(WALCorruptionError) as ei:
+        DurableStore(str(tmp_path / "d")).recover()
+    assert "sequence gap" in str(ei.value)
+
+
+def _disk_fault_plan(kind: str, count: int):
+    from volcano_tpu import faults
+    return faults.FaultPlan(99, [faults.FaultRule(
+        "disk", kind, max_injections=count)])
+
+
+def test_enospc_mid_append_degrades_then_heals(tmp_path):
+    """ENOSPC on a WAL append poisons the store: commit refuses (no
+    un-durable acks), reads keep working, and once the disk clears a
+    heal (fresh segment + probe fsync + full snapshot) makes it
+    writable again with rv continuity."""
+    from volcano_tpu import faults, metrics
+    from volcano_tpu.server.durability import (DurableStore,
+                                               ReadOnlyError)
+    from volcano_tpu.server.state_server import StateServer
+
+    store = DurableStore(str(tmp_path / "d"))
+    st = StateServer(durable=store)
+    for node in slice_nodes(slice_for("sa", "v5e-4"), dcn_pod="d0"):
+        st.cluster.add_node(node)
+    st.commit()
+    rv_before = st._rv
+    # the disk goes bad NOW (vfs swap: boot + seeding ran clean)
+    store.vfs = faults.FaultyVFS(_disk_fault_plan("enospc_append",
+                                                  count=1))
+
+    # the injected ENOSPC lands on this append -> poison
+    pod = make_pod("t", requests={"cpu": 1})
+    pod.name, pod.namespace = "px", "default"
+    st.cluster.add_pod(pod)
+    with pytest.raises(ReadOnlyError):
+        st.commit()
+    assert st.readonly_reason.startswith("append")
+    assert metrics.get_gauge("server_readonly") == 1.0
+    # reads still served off the store; the un-fsyncable event is NOT
+    # visible (no mirror may hold what a crash could un-happen)
+    assert "default/px" in st.cluster.pods
+    assert st._visible_rv() < st._rv
+
+    # disk clears (the plan's single injection is spent): heal
+    assert st.try_heal()
+    assert st.readonly_reason == ""
+    assert metrics.get_gauge("server_readonly") == 0.0
+    # the healed snapshot made the in-memory state durable wholesale
+    # and released the stuck events; rv never went backwards
+    assert st._visible_rv() == st._rv >= rv_before
+    st.cluster.bind_pod("default", "px", "sa-w0")
+    st.commit()                       # writable again
+
+    # a fresh boot over the healed dir has everything
+    st2 = StateServer(durable=DurableStore(str(tmp_path / "d")))
+    assert st2.cluster.pods["default/px"].node_name == "sa-w0"
+    assert st2._rv == st._rv
+
+
+def test_fsync_eio_never_retried_degrades_then_heals(tmp_path):
+    """The fsyncgate case: fsync fails ONCE — the records it covered
+    are in an unknown state, so the store must poison immediately
+    (never retry the fsync) and heal only through a fresh segment +
+    full snapshot, with the rv monotonic across the episode."""
+    from volcano_tpu import faults
+    from volcano_tpu.server.durability import (DurableStore,
+                                               ReadOnlyError)
+    from volcano_tpu.server.state_server import StateServer
+
+    store = DurableStore(str(tmp_path / "d"))
+    st = StateServer(durable=store)
+    for node in slice_nodes(slice_for("sa", "v5e-4"), dcn_pod="d0"):
+        st.cluster.add_node(node)
+    st.commit()
+    # the disk starts lying NOW (vfs swap: boot ran clean)
+    store.vfs = faults.FaultyVFS(_disk_fault_plan("eio_fsync",
+                                                  count=1))
+    pod = make_pod("t", requests={"cpu": 1})
+    pod.name, pod.namespace = "dirty", "default"
+    st.cluster.add_pod(pod)
+    with pytest.raises(ReadOnlyError):
+        st.commit()                    # the lying fsync
+    assert st.readonly_reason.startswith("fsync")
+    # a second commit must NOT retry the fsync (FaultyVFS would let a
+    # retry through — the plan's injection budget is spent — but the
+    # fsyncgate rule says the poisoned file is never fsync'd again)
+    with pytest.raises(ReadOnlyError):
+        st.commit()
+
+    rv_poisoned = st._rv
+    assert st.try_heal()
+    assert st._visible_rv() == st._rv == rv_poisoned
+    pod = make_pod("t", requests={"cpu": 1})
+    pod.name, pod.namespace = "post", "default"
+    st.cluster.add_pod(pod)
+    st.commit()
+    assert st._rv > rv_poisoned
+
+    st2 = StateServer(durable=DurableStore(str(tmp_path / "d")))
+    assert "default/post" in st2.cluster.pods
+    assert len(st2.cluster.nodes) == 1
+    assert st2._rv == st._rv
 
 
 def test_bench_crash_smoke_mode():

@@ -1,0 +1,445 @@
+"""The gray-failure chaos engine (volcano_tpu/faults.py + the chaos
+conductor): deterministic seeded fault plans, wire faults at the real
+HTTP handler, connection faults at the reusable TCP proxy, clock
+skew vs the lease/goodput dedupe machinery, and the tier-1
+--chaos-smoke drill (ack-lost bind, ENOSPC degrade-and-recover,
+CRC-corrupt replay refusal) through real OS processes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from volcano_tpu import faults, metrics
+from volcano_tpu.cache.remote_cluster import RemoteCluster, RemoteError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+from tools import chaoslib  # noqa: E402
+from tools import chaos_conductor  # noqa: E402
+
+
+# -- the plan itself ---------------------------------------------------
+
+
+def test_fault_plan_is_deterministic_and_replayable():
+    """Same seed -> the exact same injection sequence over the same
+    opportunity stream (the replay contract every logged seed
+    promises); a different seed diverges; to_doc/from_doc round-trips
+    so the logged plan IS the reproduction."""
+    rules = [{"site": "server", "kind": "drop_response", "prob": 0.3},
+             {"site": "server", "kind": "delay", "prob": 0.4,
+              "ms": 10.0}]
+
+    def draw(plan):
+        return [getattr(plan.decide("server", "/bind"), "kind", None)
+                for _ in range(300)]
+
+    doc = {"seed": 42, "rules": rules}
+    a = draw(faults.FaultPlan.from_doc(doc))
+    b = draw(faults.FaultPlan.from_doc(doc))
+    assert a == b
+    assert any(k is not None for k in a)
+    c = draw(faults.FaultPlan.from_doc({"seed": 43, "rules": rules}))
+    assert c != a
+    # round-trip: serialize -> parse -> identical behaviour
+    plan = faults.FaultPlan.from_doc(doc)
+    again = faults.FaultPlan.from_doc(plan.to_doc())
+    assert draw(plan) == draw(again)
+
+
+def test_fault_rule_windows_caps_and_routes():
+    plan = faults.FaultPlan(7, [
+        faults.FaultRule("server", "http_503", route="/bind*",
+                         max_injections=2),
+        faults.FaultRule("server", "delay", route="/watch",
+                         after_s=3600.0),     # window far in the future
+    ])
+    assert plan.decide("server", "/snapshot") is None   # route miss
+    assert plan.decide("server", "/watch") is None      # window not open
+    assert plan.decide("server", "/bind_batch").kind == "http_503"
+    assert plan.decide("server", "/bind").kind == "http_503"
+    assert plan.decide("server", "/bind") is None       # cap spent
+    # the env loader (inline JSON) arms the same plan
+    env = {faults.FAULT_PLAN_ENV: json.dumps(plan.to_doc())}
+    loaded = faults.FaultPlan.from_env(env)
+    assert loaded.seed == 7 and len(loaded.rules) == 2
+    assert faults.FaultPlan.from_env({}) is None
+
+
+def test_fault_injected_total_labels_are_bounded():
+    """fault_injected_total carries ONLY the bounded site/kind enums
+    (the PR 5 label-cardinality rule): every label value a plan can
+    emit is a member of the closed sets."""
+    for kind in faults.ALL_KINDS:
+        site = ("server" if kind in faults.WIRE_KINDS else
+                "proxy" if kind in faults.PROXY_KINDS else
+                "disk" if kind in faults.DISK_KINDS else "clock")
+        faults.FaultRule(site, kind)     # constructor validates
+    with pytest.raises(ValueError):
+        faults.FaultRule("server", "made-up-kind")
+    with pytest.raises(ValueError):
+        faults.FaultRule("nowhere", "delay")
+    with pytest.raises(ValueError):
+        # right kind, wrong seam: a server rule can't blackhole — it
+        # would be drawn (burning budget + counter) yet never applied
+        faults.FaultRule("server", "blackhole")
+
+
+# -- wire faults at the real HTTP handler ------------------------------
+
+
+def test_wire_faults_injected_at_state_server_handler():
+    """Through a real (in-process) StateServer with an armed plan:
+    an injected 503 is retried through; an ack-lost (drop_response)
+    idempotency-keyed command converges to ONE queued command; an
+    in-network duplicate collapses the same way; every injection is
+    counted in fault_injected_total{site,kind}."""
+    from volcano_tpu.server.state_server import serve
+
+    plan = faults.FaultPlan(5, [
+        faults.FaultRule("server", "http_503", route="/tick",
+                         max_injections=1),
+        faults.FaultRule("server", "drop_response", route="/command",
+                         max_injections=1),
+        faults.FaultRule("server", "delay", route="/evict",
+                         ms=30.0, max_injections=1),
+    ])
+    c503 = metrics.get_counter("fault_injected_total", site="server",
+                               kind="http_503")
+    cdrop = metrics.get_counter("fault_injected_total", site="server",
+                                kind="drop_response")
+    httpd, state = serve(port=0, faults=plan)
+    c = None
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        c = RemoteCluster(url, start_watch=False)
+        # 503 on the first /tick: the retry policy rides it out
+        c.tick()
+        assert metrics.get_counter("fault_injected_total",
+                                   site="server",
+                                   kind="http_503") == c503 + 1
+        # ack-lost command: server queues it, drops the ack; the
+        # keyed retry replays the verdict — exactly one command
+        c.add_command("default/j1", "RestartJob")
+        assert len(state.cluster.commands) == 1, \
+            "ack-lost retry double-queued the command"
+        assert metrics.get_counter("fault_injected_total",
+                                   site="server",
+                                   kind="drop_response") == cdrop + 1
+    finally:
+        if c is not None:
+            c.close()
+        httpd.shutdown()
+
+
+def test_duplicate_fault_collapses_via_idempotency():
+    """The network delivers a mutation twice: with the duplicate
+    fault armed on /command, the handler processes both deliveries —
+    the idempotency key makes the pair collapse to one application."""
+    from volcano_tpu.server.state_server import serve
+
+    plan = faults.FaultPlan(6, [
+        faults.FaultRule("server", "duplicate", route="/command",
+                         max_injections=1)])
+    httpd, state = serve(port=0, faults=plan)
+    c = None
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        c = RemoteCluster(url, start_watch=False)
+        c.add_command("default/j2", "RestartJob")
+        assert len(state.cluster.commands) == 1, \
+            "duplicated delivery double-queued"
+    finally:
+        if c is not None:
+            c.close()
+        httpd.shutdown()
+
+
+def test_proxy_fault_modes_against_real_server():
+    """chaoslib.ChaosProxy between a client and a real server:
+    pass forwards, latency delays but completes, reset surfaces a
+    transient the retry policy absorbs, heal restores service."""
+    from volcano_tpu.server.state_server import serve
+
+    httpd, _state = serve(port=0)
+    proxy = None
+    c = None
+    try:
+        proxy = chaoslib.ChaosProxy(httpd.server_address[1])
+        proxy.start()
+        url = f"http://127.0.0.1:{proxy.port}"
+        c = RemoteCluster(url, start_watch=False, retry_deadline=6.0)
+        c.tick()                       # pass mode works
+        proxy.set_mode("latency")
+        t0 = time.monotonic()
+        c.tick()
+        assert time.monotonic() - t0 >= 0.1   # the brownout is real
+        proxy.set_mode("reset")
+        with pytest.raises(Exception):
+            c._request("POST", "/tick", retries=False)
+        proxy.set_mode("pass")
+        c.tick()                       # healed
+    finally:
+        if c is not None:
+            c.close()
+        if proxy is not None:
+            proxy.close()
+        httpd.shutdown()
+
+
+# -- clock faults ------------------------------------------------------
+
+
+def test_clock_wall_jump_leaves_leases_fenced():
+    """A real server subprocess with an armed wall-jump (+1 day via
+    VTP_FAULT_PLAN): leases run on the monotonic clock, so the jump
+    neither expires the holder nor lets a contender in — the PR 4
+    rebase property, now verified under INJECTED skew."""
+    plan = {"seed": 3, "rules": [
+        {"site": "clock", "kind": "wall_jump", "offset_s": 86400.0}]}
+    port = chaoslib.free_port()
+    url = f"http://127.0.0.1:{port}"
+    env = chaoslib.repo_env(**{faults.FAULT_PLAN_ENV: json.dumps(plan)})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "volcano_tpu.server", "--port",
+         str(port)], env=env, cwd=REPO,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    c = None
+    try:
+        chaoslib.wait_server(url)
+        c = RemoteCluster(url, start_watch=False)
+        assert c.lease("sched", "holder-a", ttl=30.0)["acquired"]
+        # the wall clock in that process reads tomorrow; the lease
+        # must still fence (monotonic expiry)
+        r = c.lease("sched", "holder-b", ttl=5.0)
+        assert not r["acquired"] and r["holder"] == "holder-a"
+        # and real expiry still works under the jumped wall clock
+        assert c.lease("fast", "a", ttl=0.3)["acquired"]
+        time.sleep(0.5)
+        assert c.lease("fast", "b", ttl=0.3)["acquired"]
+    finally:
+        if c is not None:
+            c.close()
+        proc.kill()
+        proc.wait()
+
+
+def test_goodput_dedupe_stamp_immune_to_wall_skew():
+    """PR 7's estimator dedupes on the folded updated-ts stamp, max-
+    merged at the store.  A node whose wall clock skews BACKWARD
+    (faults.install_clock_faults) posts a report with an older ts —
+    the stamp must not regress and the ledger must keep
+    accumulating."""
+    from volcano_tpu.api import goodput as gapi
+    from volcano_tpu.api.podgroup import PodGroup
+    from volcano_tpu.cache.fake_cluster import FakeCluster
+
+    cluster = FakeCluster()
+    cluster.add_podgroup(PodGroup(name="gp", namespace="default"))
+    pg_key = "default/gp"
+
+    def report(node, ts, alloc):
+        return gapi.GoodputReport(node=node, ts=ts, usages=[
+            gapi.PodGoodput(pod_key="default/p", uid="u1", job=pg_key,
+                            step=10, steps_per_s=1.0,
+                            allocated_s=alloc, productive_s=alloc)])
+
+    t_honest = time.time()
+    cluster.put_object("goodputreport", report("n1", t_honest, 5.0))
+    ann = cluster.podgroups[pg_key].annotations
+    assert gapi.ann_float(ann, gapi.PG_UPDATED_TS_ANNOTATION) == \
+        pytest.approx(t_honest, abs=0.01)
+
+    # node n2's wall clock is an hour behind (injected skew) — its
+    # report carries an old ts but NEW ledger growth
+    plan = faults.FaultPlan(9, [faults.FaultRule(
+        "clock", "wall_jump", offset_s=-3600.0)])
+    try:
+        faults.install_clock_faults(plan)
+        t_skewed = time.time()
+        assert t_skewed < t_honest - 3000
+        cluster.put_object("goodputreport", report("n2", t_skewed, 7.0))
+    finally:
+        faults.uninstall_clock_faults()
+    ann = cluster.podgroups[pg_key].annotations
+    # stamp did not regress; the behind-clock node's ledger counted
+    assert gapi.ann_float(ann, gapi.PG_UPDATED_TS_ANNOTATION) >= \
+        t_honest - 0.01
+    assert gapi.ann_float(ann, gapi.PG_ALLOCATED_S_ANNOTATION) == \
+        pytest.approx(12.0, abs=0.1)
+
+
+# -- read-only degrade over the wire -----------------------------------
+
+
+def test_readonly_degrade_503_retry_after_and_heal(tmp_path):
+    """In-process server over a durable dir: poison the WAL (vfs
+    swap), assert mutations 503 with Retry-After while watch/lease
+    reads serve, then heal and assert writability + rv continuity —
+    the HTTP half of the degrade contract (the process-level half
+    lives in --chaos-smoke)."""
+    import urllib.error
+    import urllib.request
+
+    from volcano_tpu.server.durability import DurableStore
+    from volcano_tpu.server.state_server import serve
+
+    httpd, state = serve(port=0, data_dir=str(tmp_path / "d"))
+    c = None
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        c = RemoteCluster(url, start_watch=False, retry_deadline=10.0)
+        c.add_command("default/j", "a")
+        rv_before = state._rv
+        # the disk stays full for ~1.5s (heal probes inside the
+        # window fail too), so the degraded state is observable
+        plan = faults.FaultPlan(4, [faults.FaultRule(
+            "disk", "enospc_append", until_s=1.5)])
+        state.durable.vfs = faults.FaultyVFS(plan)
+        # the poisoning write, no retries: the raw 503 + Retry-After
+        with pytest.raises(RemoteError) as ei:
+            c._request("POST", "/command",
+                       {"target": "default/j", "action": "b"},
+                       retries=False)
+        assert ei.value.code == 503
+        assert ei.value.retry_after == pytest.approx(1.0)
+        # reads + leases still served while degraded
+        assert state.readonly_reason
+        dur = c._request("GET", "/durability")
+        assert dur["readonly"]
+        assert c.lease("l", "h", ttl=5.0)["acquired"]
+        # snapshot LISTs wait out the degrade (503, never un-durable
+        # state)
+        with pytest.raises(RemoteError) as ei2:
+            c._request("GET", "/snapshot", retries=False)
+        assert ei2.value.code == 503
+        # a write UNDER the retry policy rides out the whole episode:
+        # Retry-After honoured, lands once the compact loop heals
+        c.add_command("default/j", "c")
+        assert not state.readonly_reason
+        assert state._rv >= rv_before
+        # the degraded-then-healed dir boots clean
+        from volcano_tpu.server.state_server import StateServer
+        httpd.shutdown()
+        state.tick_stop.set()
+        state.durable.close()
+        st2 = StateServer(durable=DurableStore(str(tmp_path / "d")))
+        actions = [cmd.get("action") for cmd in st2.cluster.commands]
+        assert "c" in actions and "a" in actions
+        # "c" was first delivered DURING the degrade: its 503'd
+        # attempt applied in memory, the heal snapshot made it
+        # durable, and the keyed retry replayed the kept verdict —
+        # exactly one command, never two (forgetting the verdict on
+        # the readonly trip would double-apply here)
+        assert actions.count("c") == 1
+    finally:
+        if c is not None:
+            c.close()
+        httpd.shutdown()
+
+
+def test_server_refuses_chip_overcommit_bind():
+    """The apiserver-side overcommit backstop the conductor forced:
+    under ack-lost faults a scheduler whose bind acks died un-assumes
+    the gang, and its stale mirror re-allocates chips the server
+    already committed to another gang.  The server now refuses a
+    bind that would exceed the node's chip allocatable (409), while
+    idempotent re-binds and post-release re-use stay allowed."""
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.server.state_server import serve
+    from volcano_tpu.simulator import slice_nodes
+
+    httpd, state = serve(port=0)
+    c = None
+    try:
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        c = RemoteCluster(url, start_watch=False)
+        node = next(iter(slice_nodes(slice_for("sa", "v5e-4"),
+                                     dcn_pod="d0")))
+        c.add_node(node)
+        for name in ("a", "b", "cpuonly"):
+            req = {"cpu": 1} if name == "cpuonly" else \
+                {"cpu": 4, TPU: 4}
+            p = make_pod("t", requests=req)
+            p.name, p.namespace = name, "default"
+            c.put_object("pod", p)
+        c.bind_pod("default", "a", node.name)
+        c.bind_pod("default", "a", node.name)     # idempotent re-bind
+        # the stale-scheduler double-book: node is full (4/4 chips)
+        with pytest.raises(ValueError, match="overcommit"):
+            c._request("POST", "/bind", {
+                "namespace": "default", "name": "b",
+                "node_name": node.name}, retries=False)
+        # the batched lane gives the same verdict PER ITEM
+        resp = c._request("POST", "/bind_batch", {"binds": [
+            {"namespace": "default", "name": "b",
+             "node_name": node.name},
+            {"namespace": "default", "name": "cpuonly",
+             "node_name": node.name}]})
+        assert resp["results"][0]["ok"] is False
+        assert resp["results"][0]["code"] == 409
+        assert resp["results"][1]["ok"] is True   # cpu pods unguarded
+        # chips free when the holder leaves Bound/Running: b then fits
+        state.cluster.complete_pod("default/a", succeeded=True)
+        c.bind_pod("default", "b", node.name)
+        assert state.cluster.pods["default/b"].node_name == node.name
+    finally:
+        if c is not None:
+            c.close()
+        httpd.shutdown()
+
+
+# -- conductor reproducibility + the tier-1 smoke ----------------------
+
+
+def test_conductor_schedule_reproducible():
+    """tools/chaos_conductor.py --seed N derives the EXACT same fault
+    schedule every time (the replay contract), both in-process and
+    through the CLI."""
+    a = chaos_conductor.build_plan(11, 30.0, {"wire", "disk", "clock"})
+    b = chaos_conductor.build_plan(11, 30.0, {"wire", "disk", "clock"})
+    assert a == b
+    assert a != chaos_conductor.build_plan(12, 30.0,
+                                           {"wire", "disk", "clock"})
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    outs = [subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "chaos_conductor.py"),
+         "--seed", "11", "--print-schedule"],
+        capture_output=True, text=True, timeout=60, env=env,
+        cwd=REPO).stdout for _ in range(2)]
+    assert outs[0] == outs[1] and json.loads(outs[0])["seed"] == 11
+
+
+def test_bench_chaos_smoke_mode():
+    """`bench.py --chaos-smoke` drives the three headline gray
+    failures through real OS processes — one ack-lost bind, one
+    ENOSPC degrade-and-recover, one CRC-corrupt replay refusal —
+    guarded on every commit, mirroring --crash-smoke."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--chaos-smoke"],
+        capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
+    assert proc.returncode == 0, \
+        proc.stdout[-2000:] + proc.stderr[-2000:]
+    line = next(l for l in reversed(proc.stdout.strip().splitlines())
+                if l.startswith("{"))
+    out = json.loads(line)
+    assert out["ok"] is True, out
+    assert out["ack_lost_bind"]["fault_injected"] == 1
+    assert out["ack_lost_bind"]["bound_once"]
+    assert out["enospc_degrade"]["writes_503"]
+    assert out["enospc_degrade"]["leases_served"]
+    assert out["enospc_degrade"]["healed_writable"]
+    assert out["enospc_degrade"]["rv_monotonic"]
+    assert out["crc_corrupt_replay"]["refused"]
+    assert out["crc_corrupt_replay"]["prefix_intact"]

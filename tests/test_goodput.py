@@ -356,6 +356,20 @@ def test_fold_accumulates_across_nodes_and_sticks(tmp_path):
     assert float(ann[gapi.PG_ALLOCATED_S_ANNOTATION]) == \
         pytest.approx(27.0)
 
+    # the SCHEDULER'S status-flush lane is a whole-podgroup write
+    # too: a stale copy (old ledger values, seconds behind under
+    # gray failure) must not rewind the folds that landed in between
+    # — found by the chaos conductor (goodput_monotonic violation),
+    # fixed by applying the same stick in update_podgroup_status
+    stale2 = PodGroup(name="tj", namespace="default")
+    stale2.annotations[gapi.PG_ALLOCATED_S_ANNOTATION] = "1.5"
+    stale2.annotations[gapi.PG_STEP_ANNOTATION] = "1"
+    cluster.update_podgroup_status(stale2)
+    ann = cluster.podgroups["default/tj"].annotations
+    assert float(ann[gapi.PG_ALLOCATED_S_ANNOTATION]) == \
+        pytest.approx(27.0)
+    assert float(ann[gapi.PG_STEP_ANNOTATION]) == pytest.approx(50.0)
+
 
 def test_goodput_report_codec_roundtrip():
     from volcano_tpu.api import codec

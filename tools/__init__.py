@@ -1,0 +1,2 @@
+# makes tools/ importable (tools.chaoslib) from the repo root —
+# the scripts themselves still run standalone (python tools/chaos.py)

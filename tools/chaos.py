@@ -10,50 +10,34 @@ SURVEY §5).
 Round-4 result on the dev machine: 404/404 jobs Completed across 18
 scheduler SIGKILLs, zero overcommitted nodes.
 
+A thin schedule over tools/chaoslib.py (shared proxy/zoo/audit
+plumbing); the randomized gray-failure conductor lives in
+tools/chaos_conductor.py.
+
 Usage:  python tools/chaos.py          # logs to /tmp/chaos/
 """
-import json, os, random, signal, socket, subprocess, sys, time
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
-env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+import json
+import os
+import random
+import sys
+import time
 
-def free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0)); return s.getsockname()[1]
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from tools import chaoslib  # noqa: E402
 
-port = free_port()
-server = subprocess.Popen(
-    [sys.executable, "-m", "volcano_tpu.server", "--port", str(port),
-     "--tick-period", "0.2"], env=env, cwd=REPO,
-    stdout=open("/tmp/chaos/server.log", "w"), stderr=subprocess.STDOUT)
-time.sleep(2)
-ctrl = subprocess.Popen(
-    [sys.executable, "-m", "volcano_tpu", "--cluster-url",
-     f"http://127.0.0.1:{port}", "--components", "controllers",
-     "--period", "0.2"], env=env, cwd=REPO,
-    stdout=open("/tmp/chaos/ctrl.log", "w"), stderr=subprocess.STDOUT)
+port = chaoslib.free_port()
+url = f"http://127.0.0.1:{port}"
+zoo = chaoslib.ProcessZoo("/tmp/chaos")
+zoo.spawn_server(port)
+chaoslib.wait_server(url)
+zoo.spawn_plane("ctrl", url, "controllers")
+zoo.spawn_plane("sched", url, "scheduler")
 
-def spawn_sched():
-    return subprocess.Popen(
-        [sys.executable, "-m", "volcano_tpu", "--cluster-url",
-         f"http://127.0.0.1:{port}", "--components", "scheduler",
-         "--period", "0.2"], env=env, cwd=REPO,
-        stdout=open("/tmp/chaos/sched.log", "a"), stderr=subprocess.STDOUT)
+from volcano_tpu.cache.remote_cluster import RemoteCluster  # noqa: E402
 
-sched = spawn_sched()
-
-from volcano_tpu.cache.remote_cluster import RemoteCluster
-from volcano_tpu.api.devices.tpu.topology import slice_for
-from volcano_tpu.simulator import slice_nodes
-from volcano_tpu.api.vcjob import TaskSpec, VCJob
-from volcano_tpu.api.pod import make_pod
-from volcano_tpu.api.resource import TPU
-from volcano_tpu.api.types import RUN_TICKS_ANNOTATION
-
-c = RemoteCluster(f"http://127.0.0.1:{port}")
-for sname in ("sa", "sb"):
-    for node in slice_nodes(slice_for(sname, "v5e-16"), dcn_pod="d0"):
-        c.put_object("node", node)
+c = RemoteCluster(url)
+chaoslib.seed_slices(c, ("sa", "sb"))
 
 rng = random.Random(99)
 submitted = 0
@@ -63,44 +47,25 @@ last_kill = time.time()
 i = 0
 while time.time() < t_end:
     n = rng.choice((1, 2, 4))
-    job = VCJob(name=f"chaos-{i}", min_available=n,
-                tasks=[TaskSpec(name="worker", replicas=n,
-                                template=make_pod("t", requests={"cpu": 4, TPU: 4},
-                                                  annotations={RUN_TICKS_ANNOTATION: "3"}))],
-                plugins={"jax": [], "svc": []})
     try:
-        c.add_vcjob(job); submitted += 1
-    except Exception as e:
+        c.add_vcjob(chaoslib.gang_job(f"chaos-{i}", n))
+        submitted += 1
+    except Exception as e:  # noqa: BLE001
         print("submit failed:", e, flush=True)
     i += 1
     time.sleep(rng.uniform(0.4, 1.0))
     if time.time() - last_kill > 15:
-        os.kill(sched.pid, signal.SIGKILL)
-        sched.wait()
+        zoo.kill9("sched")
         kills += 1
         time.sleep(rng.uniform(0.0, 2.0))   # dead window
-        sched = spawn_sched()
+        zoo.respawn("sched")
         last_kill = time.time()
 
 # let the dust settle
 time.sleep(20)
 c.resync()
-phases = {}
-for j in c.vcjobs.values():
-    ph = getattr(j.phase, "value", str(j.phase))
-    phases[ph] = phases.get(ph, 0) + 1
-# double-bind check: every bound/running pod appears on exactly one node,
-# and no node exceeds its chip capacity
-overcommit = []
-node_chips = {}
-for p in c.pods.values():
-    if p.node_name and getattr(p.phase, "value", "") in ("Running", "Bound"):
-        node_chips[p.node_name] = node_chips.get(p.node_name, 0) + \
-            p.resource_requests().get(TPU)
-for n, used in node_chips.items():
-    if used > 4.01:
-        overcommit.append((n, used))
-print(json.dumps({"submitted": submitted, "kills": kills,
-                  "phases": phases, "overcommitted_nodes": overcommit}))
-for p in (server, ctrl, sched):
-    p.terminate()
+print(json.dumps({
+    "submitted": submitted, "kills": kills,
+    "phases": chaoslib.phase_counts(c),
+    "overcommitted_nodes": chaoslib.overcommit_audit(c)}))
+zoo.terminate_all()

@@ -1,0 +1,695 @@
+"""Randomized gray-failure conductor: mixed wire+disk+clock fault
+schedules against the REAL process plane, with the safety invariants
+checked continuously.
+
+Where tools/chaos.py kills processes (the clean failure), this drives
+the faults that merely make infrastructure SICK — dropped acks,
+duplicated retries, injected 503s/resets/reorders/trickle on the
+wire; ENOSPC and lying fsyncs on the WAL (the read-only degrade +
+heal path); wall-clock jumps under live leases — all drawn
+deterministically from ONE seed (volcano_tpu/faults.py), so any
+failing run is replayed exactly:
+
+    python tools/chaos_conductor.py --seed 7 --duration 30
+
+The invariants, checked while the faults fly and audited at the end:
+
+    acked_durable     every acked vcjob create survives to the final
+                      snapshot (and every reboot in between)
+    rv_monotonic      the durable revision never goes backwards —
+                      polled across degrade, heal, and reboots
+    no_overcommit     no node's bound/running pods exceed its chips
+    no_double_bind    no pod silently moves nodes while bound/running
+                      (same uid, no drain in between)
+    resume_floor      failover.volcano-tpu.io/resume-step never
+                      rewinds (elastic/failover churn on a long gang)
+    goodput_monotonic the folded goodput ledger never regresses
+                      (progress files -> real agents -> wire -> fold)
+    mirror_converged  a live mirror that watched THROUGH all faults
+                      matches the server's snapshot exactly at the end
+    clock_lease       the lease holder stays stable across the
+                      injected wall jump (monotonic-clock leases)
+    crc_refusal       a mid-WAL bit flip is detected by CRC at the
+                      next boot and REFUSED (exit 3), not silently
+                      replayed; restoring the byte boots cleanly
+
+``--matrix N`` runs seeds 1..N and writes the committed artifact
+(CHAOS_r{NN}.json shape): per-fault-class recovery latencies and the
+invariant pass matrix.  ``--print-schedule`` dumps the derived plan
+without booting anything (reproducibility is testable offline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from tools import chaoslib  # noqa: E402
+
+DEFAULT_CLASSES = "wire,disk,clock"
+FLOOR_STEP = 500           # checkpoint floor stamped on the long gang
+
+
+def build_plan(seed: int, duration: float, classes) -> dict:
+    """Derive the deterministic fault plan for one run.  Everything —
+    probabilities, window placement, delay magnitudes, clock offset —
+    comes off random.Random(seed), so the same seed always produces
+    the same plan doc (tested offline via --print-schedule)."""
+    import random
+    rng = random.Random(seed)
+    rules = []
+    if "wire" in classes:
+        d = duration
+        rules += [
+            # the ack-lost case: committed, response dropped
+            {"site": "server", "kind": "drop_response", "route": "*",
+             "prob": round(rng.uniform(0.02, 0.05), 3), "until_s": d},
+            {"site": "server", "kind": "drop_request", "route": "*",
+             "prob": round(rng.uniform(0.01, 0.03), 3), "until_s": d},
+            {"site": "server", "kind": "delay", "route": "*",
+             "prob": round(rng.uniform(0.05, 0.10), 3),
+             "ms": round(rng.uniform(20, 80), 1), "until_s": d},
+            {"site": "server", "kind": "duplicate", "route": "*",
+             "prob": round(rng.uniform(0.02, 0.05), 3), "until_s": d},
+            {"site": "server", "kind": "reorder", "route": "*",
+             "prob": round(rng.uniform(0.02, 0.04), 3),
+             "ms": 120.0, "until_s": d},
+            {"site": "server", "kind": "http_503", "route": "*",
+             "prob": round(rng.uniform(0.02, 0.04), 3), "until_s": d},
+            {"site": "server", "kind": "reset", "route": "*",
+             "prob": round(rng.uniform(0.01, 0.03), 3), "until_s": d},
+            {"site": "server", "kind": "trickle", "route": "*",
+             "prob": round(rng.uniform(0.005, 0.02), 3),
+             "ms": 10.0, "until_s": d},
+        ]
+    windows = {}
+    if "disk" in classes:
+        # one ENOSPC brownout and one lying-fsync episode, placed so
+        # both end well before the settle phase
+        w0 = round(duration * rng.uniform(0.15, 0.25), 2)
+        w1 = round(w0 + min(4.0, duration * 0.12), 2)
+        rules.append({"site": "disk", "kind": "enospc_append",
+                      "after_s": w0, "until_s": w1})
+        windows["enospc"] = (w0, w1)
+        f0 = round(duration * rng.uniform(0.45, 0.55), 2)
+        f1 = round(f0 + min(3.0, duration * 0.08), 2)
+        rules.append({"site": "disk", "kind": "eio_fsync",
+                      "after_s": f0, "until_s": f1})
+        windows["eio"] = (f0, f1)
+    if "clock" in classes:
+        j0 = round(duration * rng.uniform(0.65, 0.75), 2)
+        j1 = round(min(duration * 0.9, j0 + duration * 0.15), 2)
+        off = rng.choice((-1, 1)) * rng.uniform(600.0, 3600.0)
+        rules.append({"site": "clock", "kind": "wall_jump",
+                      "after_s": j0, "until_s": j1,
+                      "offset_s": round(off, 1)})
+        windows["clock_jump"] = (j0, j1)
+    slice_kill_at = None
+    if "slice" in classes:
+        slice_kill_at = round(duration * rng.uniform(0.3, 0.45), 2)
+    return {"seed": seed, "rules": rules, "windows": windows,
+            "slice_kill_at": slice_kill_at}
+
+
+def _iann(ann, key, default=0):
+    try:
+        return int(ann.get(key, default) or default)
+    except (TypeError, ValueError):
+        return default
+
+
+class InvariantTracker:
+    """Continuous safety checks over the conductor's live mirror +
+    the server's /durability endpoint."""
+
+    def __init__(self, cluster, url: str, floor_key: str):
+        self.c = cluster
+        self.url = url
+        self.floor_key = floor_key
+        self.violations = []
+        self.max_rv = 0
+        self.max_resume = 0
+        self.max_alloc = 0.0
+        self.resume_seen = False
+        self.goodput_seen = False
+        self._pod_nodes = {}
+
+    def note(self, inv: str, detail: str):
+        if any(v["invariant"] == inv and v["detail"] == detail
+               for v in self.violations):
+            return          # same finding, next poll — log once
+        self.violations.append({"invariant": inv, "detail": detail})
+        print(f"INVARIANT VIOLATION [{inv}]: {detail}", flush=True)
+
+    def _node_forensics(self, node: str) -> str:
+        return "; ".join(
+            f"{p.key} uid={getattr(p, 'uid', '')[:8]} "
+            f"phase={getattr(p.phase, 'value', p.phase)} "
+            f"owner={getattr(p, 'owner', '')[:8]}"
+            for p in self.c.pods.values() if p.node_name == node)
+
+    def poll(self):
+        dur = chaoslib.http_json(self.url + "/durability", timeout=2)
+        if dur:
+            rv = int(dur.get("visible_rv") or 0)
+            if rv < self.max_rv:
+                self.note("rv_monotonic",
+                          f"visible_rv {rv} < seen {self.max_rv}")
+            self.max_rv = max(self.max_rv, rv)
+        over = chaoslib.overcommit_audit(self.c)
+        if over:
+            # the mirror can run seconds stale under injected faults
+            # (that is the point of them): only a double-booking the
+            # SERVER's own snapshot confirms is a safety violation.
+            # Unconfirmable (snapshot 503 during a degrade window) =
+            # recheck next poll; staleness that truth refutes = noise.
+            import types
+            try:
+                truth = chaoslib.snapshot_stores(self.url)
+                confirmed = chaoslib.overcommit_audit(
+                    types.SimpleNamespace(pods=truth["pod"]))
+            except Exception:  # noqa: BLE001 — degrade window
+                confirmed = None
+            if confirmed:
+                self.note("no_overcommit",
+                          f"{confirmed} :: " + " | ".join(
+                              self._node_forensics(n)
+                              for n, _u in confirmed))
+        for p in list(self.c.pods.values()):
+            ph = getattr(p.phase, "value", "")
+            key = (p.key, getattr(p, "uid", ""))
+            if ph in ("Bound", "Running") and p.node_name:
+                prev = self._pod_nodes.get(key)
+                if prev is not None and prev != p.node_name:
+                    self.note("no_double_bind",
+                              f"{p.key} moved {prev} -> "
+                              f"{p.node_name} while {ph}")
+                self._pod_nodes[key] = p.node_name
+            elif ph in ("Releasing", "Succeeded", "Failed"):
+                self._pod_nodes.pop(key, None)
+        pg = self.c.podgroups.get(self.floor_key)
+        if pg is not None:
+            resume = _iann(pg.annotations,
+                           "failover.volcano-tpu.io/resume-step", -1)
+            if resume >= 0:
+                self.resume_seen = True
+                if resume < self.max_resume:
+                    self.note("resume_floor",
+                              f"resume-step {resume} < seen "
+                              f"{self.max_resume}")
+                if resume < FLOOR_STEP:
+                    self.note("resume_floor",
+                              f"resume-step {resume} below the "
+                              f"stamped checkpoint {FLOOR_STEP}")
+                self.max_resume = max(self.max_resume, resume)
+            from volcano_tpu.api import goodput as gapi
+            alloc = gapi.ann_float(pg.annotations,
+                                   gapi.PG_ALLOCATED_S_ANNOTATION)
+            if alloc > 0:
+                self.goodput_seen = True
+                if alloc + 1e-6 < self.max_alloc:
+                    self.note("goodput_monotonic",
+                              f"allocated ledger {alloc} < seen "
+                              f"{self.max_alloc} (pg uid="
+                              f"{getattr(pg, 'uid', '')[:8]} ann="
+                              f"{dict(pg.annotations)})")
+                self.max_alloc = max(self.max_alloc, alloc)
+
+    def summary(self) -> dict:
+        failed = {v["invariant"] for v in self.violations}
+        return {
+            "violations": self.violations,
+            "passed": {inv: inv not in failed for inv in (
+                "acked_durable", "rv_monotonic", "no_overcommit",
+                "no_double_bind", "resume_floor", "goodput_monotonic",
+                "mirror_converged", "crc_refusal", "clock_lease")},
+            "resume_floor_exercised": self.resume_seen,
+            "goodput_ledger_exercised": self.goodput_seen,
+        }
+
+
+def run_conductor(seed: int, duration: float,
+                  classes=DEFAULT_CLASSES, logdir: str = "") -> dict:
+    classes = set(classes.split(",")) if isinstance(classes, str) \
+        else set(classes)
+    sched = build_plan(seed, duration, classes)
+    plan_doc = {"seed": seed, "rules": sched["rules"]}
+    logdir = logdir or f"/tmp/chaos_conductor/seed-{seed}"
+    import shutil
+    shutil.rmtree(logdir, ignore_errors=True)
+    zoo = chaoslib.ProcessZoo(logdir)
+    data_dir = os.path.join(logdir, "state")
+    progress_root = os.path.join(logdir, "progress")
+    os.makedirs(progress_root, exist_ok=True)
+    plan_path = os.path.join(logdir, "fault_plan.json")
+    with open(plan_path, "w", encoding="utf-8") as f:
+        json.dump(plan_doc, f)
+    port = chaoslib.free_port()
+    url = f"http://127.0.0.1:{port}"
+    server_faulted = ["--data-dir", data_dir,
+                      "--fault-plan", f"@{plan_path}"]
+    server_clean = ["--data-dir", data_dir]
+
+    print(f"chaos conductor: seed={seed} duration={duration}s "
+          f"classes={sorted(classes)} logs={logdir}", flush=True)
+    print(f"  schedule: {json.dumps(sched['windows'])} "
+          f"{len(sched['rules'])} rules", flush=True)
+
+    result = {"seed": seed, "duration_s": duration,
+              "classes": sorted(classes),
+              "windows": sched["windows"]}
+    c = None
+    try:
+        zoo.spawn_server(port, *server_faulted)
+        chaoslib.wait_server(url)
+        t_plan0 = time.monotonic()     # ~ the server plan's t0
+        # leader-elected scheduler: the clock-jump invariant is about
+        # the LEASE surviving a wall step — there must be a lease
+        zoo.spawn_plane("sched", url, "scheduler", "--leader-elect",
+                        "--holder", "s1", "--lease-ttl", "1.5")
+        zoo.spawn_plane("ctrl", url, "controllers")
+
+        # high-rate sampler: the main loop slows down under injected
+        # faults (that is the point), so the degrade/heal windows and
+        # the lease holder are sampled on their own 100ms thread
+        import threading
+        samples = []            # (t_rel, readonly_reason, visible_rv)
+        leader_track = []       # (t_rel, holder)
+        sampler_stop = threading.Event()
+
+        def sampler():
+            while not sampler_stop.wait(0.1):
+                t_rel = time.monotonic() - t_plan0
+                dur = chaoslib.http_json(url + "/durability",
+                                         timeout=2)
+                if dur:
+                    samples.append((t_rel, dur.get("readonly") or "",
+                                    int(dur.get("visible_rv") or 0)))
+                leader_track.append((t_rel, chaoslib.leader(url)))
+
+        threading.Thread(target=sampler, daemon=True).start()
+
+        from volcano_tpu.api import goodput as gapi
+        from volcano_tpu.api import elastic as eapi
+        from volcano_tpu.api.pod import make_pod
+        from volcano_tpu.api.resource import TPU
+        from volcano_tpu.api.types import RUN_TICKS_ANNOTATION
+        from volcano_tpu.api.vcjob import TaskSpec, VCJob
+        from volcano_tpu.cache.remote_cluster import RemoteCluster
+
+        c = RemoteCluster(url)          # watches THROUGH every fault
+        chaoslib.seed_slices(c, ("sa", "sb", "sc"))
+        acked_jobs = set()
+
+        # the long elastic gang: resizes + failover churn exercise
+        # the resume-step floor; its progress stream (real agents)
+        # exercises the goodput ledger
+        elastic_key = "default/echaos"
+        c.add_vcjob(VCJob(
+            name="echaos", min_available=4,
+            annotations={
+                eapi.ELASTIC_MIN_SLICES_ANNOTATION: "1",
+                eapi.ELASTIC_MAX_SLICES_ANNOTATION: "2",
+                eapi.ELASTIC_SLICES_ANNOTATION: "1",
+                "failover.volcano-tpu.io/last-checkpoint-step":
+                    str(FLOOR_STEP),
+                gapi.PROGRESS_DIR_ANNOTATION: progress_root,
+            },
+            plugins={"jax": []},
+            tasks=[TaskSpec(name="worker", replicas=4,
+                            template=make_pod(
+                                "t", requests={"cpu": 4, TPU: 4},
+                                annotations={RUN_TICKS_ANNOTATION:
+                                             "1000000"}))]))
+        acked_jobs.add(elastic_key)
+
+        from volcano_tpu.agent.agent import FakeUsageProvider, NodeAgent
+        from volcano_tpu.agent.collect import GoodputCollector
+        from volcano_tpu.agent.handlers import GoodputHandler
+        from volcano_tpu.workloads.progress import ProgressReporter
+
+        goodput_col = GoodputCollector(progress_root)
+        goodput_agents = {}
+        fed = {"step": FLOOR_STEP, "epoch": 0}
+
+        def feed_goodput():
+            """Play the long gang's workers + node agents for one
+            beat (the soak.py contract: epoch-aware progress files ->
+            REAL GoodputCollector/Handler -> wire -> store fold)."""
+            epg = c.podgroups.get(elastic_key)
+            ej = c.vcjobs.get(elastic_key)
+            if epg is None or ej is None:
+                return
+            epoch = _iann(epg.annotations,
+                          "failover.volcano-tpu.io/generation") + \
+                _iann(epg.annotations, eapi.ELASTIC_GENERATION_ANNOTATION)
+            if epoch != fed["epoch"]:
+                fed["epoch"] = epoch
+                fed["step"] = max(FLOOR_STEP, _iann(
+                    epg.annotations,
+                    "failover.volcano-tpu.io/resume-step"))
+            fed["step"] += 1
+            pods = [p for p in c.pods.values()
+                    if p.owner == ej.uid and p.node_name
+                    and getattr(p.phase, "value", p.phase) == "Running"]
+            for p in pods:
+                ProgressReporter(
+                    gapi.progress_file_for(progress_root, p.uid),
+                    epoch=fed["epoch"]).report(
+                        step=fed["step"], examples=fed["step"] * 8.0)
+                if p.node_name not in goodput_agents:
+                    goodput_agents[p.node_name] = NodeAgent(
+                        c, p.node_name, FakeUsageProvider(),
+                        handlers=[GoodputHandler],
+                        goodput_collector=goodput_col)
+            for agent in goodput_agents.values():
+                try:
+                    agent.sync()
+                except Exception as e:  # noqa: BLE001 — chaos is on
+                    print("goodput agent sync failed:", e, flush=True)
+
+        inv = InvariantTracker(c, url, elastic_key)
+        import random as _random
+        churn_rng = _random.Random(seed * 7919 + 13)
+        submit_latencies = []
+        submit_failures = 0
+        submitted = 1    # the elastic gang
+        killed_host = None
+        i = 0
+        t_end = time.monotonic() + duration
+        while time.monotonic() < t_end:
+            now_s = time.monotonic() - t_plan0
+            n = churn_rng.choice((1, 2, 4))
+            t0 = time.monotonic()
+            try:
+                c.add_vcjob(chaoslib.gang_job(f"cj-{seed}-{i}", n))
+                acked_jobs.add(f"default/cj-{seed}-{i}")
+                submitted += 1
+                submit_latencies.append(time.monotonic() - t0)
+            except Exception as e:  # noqa: BLE001 — chaos is on
+                submit_failures += 1
+                print(f"submit failed at t={now_s:.1f}s: {e}",
+                      flush=True)
+            i += 1
+            if sched["slice_kill_at"] is not None and \
+                    killed_host is None and \
+                    now_s >= sched["slice_kill_at"]:
+                from volcano_tpu.simulator import fail_host
+                killed_host = "sc-w0"
+                try:
+                    fail_host(c, killed_host)
+                    print(f"slice fault: killed {killed_host} at "
+                          f"t={now_s:.1f}s", flush=True)
+                except Exception as e:  # noqa: BLE001
+                    print("fail_host failed:", e, flush=True)
+                    killed_host = None
+            feed_goodput()
+            inv.poll()
+            time.sleep(churn_rng.uniform(0.25, 0.6))
+
+        # settle: every fault window is over (until_s <= duration);
+        # give the plane a fault-free tail to finish the short gangs
+        settle_until = time.monotonic() + min(30.0, duration)
+        while time.monotonic() < settle_until:
+            feed_goodput()
+            inv.poll()
+            done = sum(1 for j in c.vcjobs.values()
+                       if getattr(j.phase, "value", j.phase)
+                       == "Completed")
+            if done >= submitted - 1:   # all short gangs
+                break
+            time.sleep(0.5)
+
+        # -- end-of-run audits ---------------------------------------
+        sampler_stop.set()
+        time.sleep(1.0)
+        c.resync()
+        inv.poll()
+        phases = chaoslib.phase_counts(c)
+        truth = chaoslib.snapshot_stores(url)
+        missing = [k for k in acked_jobs if k not in truth["vcjob"]]
+        if missing:
+            inv.note("acked_durable",
+                     f"{len(missing)} acked vcjobs missing: "
+                     f"{missing[:5]}")
+        # the mirror that watched THROUGH everything must converge.
+        # The plane is still live (ticks, status flushes), so compare
+        # snapshot-vs-mirror repeatedly until a quiescent pair
+        # matches — only a divergence that never settles is real.
+        final_rv = int((chaoslib.http_json(url + "/durability") or {})
+                       .get("visible_rv") or 0)
+        try:
+            chaoslib.wait_for(lambda: c._rv >= final_rv, 20,
+                              "mirror caught up after heal")
+        except AssertionError as e:
+            inv.note("mirror_converged", str(e))
+        div = None
+        for _ in range(8):
+            truth = chaoslib.snapshot_stores(url)
+            div = chaoslib.mirror_divergence(c, truth)
+            if div == 0:
+                break
+            time.sleep(0.5)
+        if div:
+            inv.note("mirror_converged", f"{div} diverged entries "
+                     "(stable across 8 compares)")
+        faults_fired = chaoslib.http_json(url + "/faults") or {}
+
+        # -- CRC bit-rot drill: kill -9, flip one bit mid-WAL, boot
+        # must REFUSE (exit 3); restore the byte, boot must recover —
+        # then every acked job must still be there
+        crc = {"checked": False}
+        if "disk" in classes or "wire" in classes:
+            rv_before = inv.max_rv
+            zoo.kill9("server")
+            seg, idx = _flippable_record(data_dir)
+            if seg is not None:
+                from volcano_tpu import faults as faults_mod
+                off = faults_mod.flip_record_bit(seg, idx)
+                crc["checked"] = True
+                crc["segment"] = os.path.basename(seg)
+                crc["record"] = idx
+                zoo.spawn("server", "-m", "volcano_tpu.server",
+                          "--port", str(port), "--tick-period", "0.2",
+                          *server_clean)
+                code = zoo.wait_exit("server", timeout=30)
+                refused = code == 3 and bool(zoo.scrape(
+                    "server", "refusing to boot"))
+                crc["refused"] = refused
+                if not refused:
+                    inv.note("crc_refusal",
+                             f"corrupt WAL boot exit={code}, "
+                             "no refusal banner")
+                # restore the flipped byte: the log is whole again
+                faults_mod.flip_bit(seg, off)
+                zoo.spawn("server", "-m", "volcano_tpu.server",
+                          "--port", str(port), "--tick-period", "0.2",
+                          *server_clean)
+                chaoslib.wait_server(url)
+                dur = chaoslib.http_json(url + "/durability") or {}
+                crc["recovered_rv"] = int(dur.get("rv") or 0)
+                if crc["recovered_rv"] < rv_before:
+                    inv.note("rv_monotonic",
+                             f"post-restore rv {crc['recovered_rv']} "
+                             f"< {rv_before}")
+                truth2 = chaoslib.snapshot_stores(url)
+                missing2 = [k for k in acked_jobs
+                            if k not in truth2["vcjob"]]
+                if missing2:
+                    inv.note("acked_durable",
+                             f"{len(missing2)} acked vcjobs lost "
+                             "across the CRC drill")
+            else:
+                crc["skipped"] = "no WAL segment with >=3 records"
+
+        # the sampler saw the durable revision at 10Hz: it must never
+        # have gone backwards, degrade or not
+        rv_seen = 0
+        for t_rel, _ro, rv in samples:
+            if rv < rv_seen:
+                inv.note("rv_monotonic",
+                         f"sampler saw rv {rv} < {rv_seen} at "
+                         f"t={t_rel:.1f}s")
+            rv_seen = max(rv_seen, rv)
+
+        summary = inv.summary()
+        recovery = {}
+        for wname, (w0, w1) in sched["windows"].items():
+            if wname == "clock_jump":
+                continue
+            # 10Hz readonly trace: degrade must have been observable
+            # inside the window (+heal slack), and the first writable
+            # sample after the last readonly one dates the recovery
+            ro_ts = [t for t, ro, _rv in samples
+                     if ro and w0 <= t <= w1 + 3.0]
+            ep = {"window": [w0, w1],
+                  "degrade_observed": bool(ro_ts)}
+            if ro_ts:
+                after = [t for t, ro, _rv in samples
+                         if not ro and t > max(ro_ts)]
+                if after:
+                    ep["readonly_recover_s"] = round(
+                        min(after) - w1, 3)
+            recovery[wname] = ep
+        if "clock" in classes and "clock_jump" in sched["windows"]:
+            j0, j1 = sched["windows"]["clock_jump"]
+            during = {l for t, l in leader_track
+                      if j0 <= t <= j1 and l}
+            before = {l for t, l in leader_track if t < j0 and l}
+            recovery["clock_jump"] = {
+                "window": [j0, j1],
+                "leaders_during_jump": sorted(during),
+                "leader_stable": bool(during) and
+                len(during | before) <= 1}
+            if not recovery["clock_jump"]["leader_stable"]:
+                inv.note("clock_lease",
+                         f"lease holder changed across the wall jump:"
+                         f" before={sorted(before)} "
+                         f"during={sorted(during)}")
+        if submit_latencies:
+            sl = sorted(submit_latencies)
+            recovery["wire"] = {
+                "submit_p50_s": round(sl[len(sl) // 2], 4),
+                "submit_p95_s": round(
+                    sl[min(len(sl) - 1, int(0.95 * len(sl)))], 4),
+                "submit_failures": submit_failures}
+
+        result.update({
+            "submitted": submitted,
+            "phases": phases,
+            "completed": phases.get("Completed", 0),
+            "killed_host": killed_host,
+            "faults_injected": faults_fired.get("rules"),
+            "invariants": summary,
+            "recovery": recovery,
+            "crc_drill": crc,
+            "ok": not summary["violations"],
+        })
+        if summary["violations"]:
+            print(f"\nREPRODUCE: python tools/chaos_conductor.py "
+                  f"--seed {seed} --duration {duration} "
+                  f"--classes {','.join(sorted(classes))}",
+                  flush=True)
+        return result
+    finally:
+        if c is not None:
+            c.close()
+        zoo.terminate_all()
+
+
+def _flippable_record(data_dir: str):
+    """A (segment, record_index) whose corruption is unambiguously
+    MID-segment: at least 3 records, index in the middle."""
+    try:
+        segs = sorted(n for n in os.listdir(data_dir)
+                      if n.startswith("wal-") and n.endswith(".log"))
+    except OSError:
+        return None, None
+    for name in segs:
+        path = os.path.join(data_dir, name)
+        with open(path, "rb") as f:
+            n = sum(1 for ln in f if ln.strip())
+        if n >= 3:
+            return path, n // 2
+    return None, None
+
+
+def run_matrix(seeds, duration: float, classes: str,
+               out: str = "") -> dict:
+    rows = []
+    for seed in seeds:
+        rows.append(run_conductor(seed, duration, classes))
+        print(json.dumps({"seed": seed, "ok": rows[-1]["ok"]}),
+              flush=True)
+    invariant_names = sorted(rows[0]["invariants"]["passed"])
+    matrix = {inv: all(r["invariants"]["passed"][inv] for r in rows)
+              for inv in invariant_names}
+    recover = [r["recovery"].get("enospc", {}).get("readonly_recover_s")
+               for r in rows]
+    recover = sorted(x for x in recover if x is not None)
+    eio = sorted(x for x in (
+        r["recovery"].get("eio", {}).get("readonly_recover_s")
+        for r in rows) if x is not None)
+    doc = {
+        "metric": "gray_failure_chaos_matrix",
+        "seeds": [r["seed"] for r in rows],
+        "duration_s": duration,
+        "classes": rows[0]["classes"],
+        "hosts": 12,
+        "invariant_matrix": matrix,
+        "zero_violations": all(r["ok"] for r in rows),
+        "total_faults_injected": sum(
+            sum(rule.get("injected", 0)
+                for rule in (r.get("faults_injected") or []))
+            for r in rows),
+        "submitted_total": sum(r["submitted"] for r in rows),
+        "completed_total": sum(r["completed"] for r in rows),
+        "enospc_readonly_recover_s": {
+            "p50": recover[len(recover) // 2] if recover else None,
+            "max": recover[-1] if recover else None},
+        "eio_readonly_recover_s": {
+            "p50": eio[len(eio) // 2] if eio else None,
+            "max": eio[-1] if eio else None},
+        "crc_refusals": sum(
+            1 for r in rows if r["crc_drill"].get("refused")),
+        "clock_jump_leader_stable": all(
+            r["recovery"].get("clock_jump", {}).get("leader_stable",
+                                                    True)
+            for r in rows),
+        "wire_submit_p95_s": max(
+            (r["recovery"].get("wire", {}).get("submit_p95_s") or 0)
+            for r in rows),
+        "resume_floor_exercised": any(
+            r["invariants"]["resume_floor_exercised"] for r in rows),
+        "goodput_ledger_exercised": any(
+            r["invariants"]["goodput_ledger_exercised"] for r in rows),
+        "per_seed": rows,
+    }
+    if out:
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1)
+        print(f"wrote {out}", flush=True)
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="chaos-conductor")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--duration", type=float, default=30.0)
+    ap.add_argument("--classes", default=DEFAULT_CLASSES,
+                    help="comma set of wire,disk,clock,slice")
+    ap.add_argument("--logdir", default="")
+    ap.add_argument("--matrix", type=int, default=0,
+                    help="run seeds 1..N and aggregate the "
+                         "invariant pass matrix")
+    ap.add_argument("--out", default="",
+                    help="write the matrix JSON here")
+    ap.add_argument("--print-schedule", action="store_true",
+                    help="dump the derived fault plan for --seed and "
+                         "exit (no processes; reproducibility check)")
+    args = ap.parse_args(argv)
+    classes = args.classes
+    if args.print_schedule:
+        print(json.dumps(build_plan(
+            args.seed, args.duration, set(classes.split(","))),
+            indent=1, sort_keys=True))
+        return 0
+    if args.matrix:
+        doc = run_matrix(range(1, args.matrix + 1), args.duration,
+                         classes, out=args.out)
+        print(json.dumps({k: v for k, v in doc.items()
+                          if k != "per_seed"}, indent=1))
+        return 0 if doc["zero_violations"] else 1
+    out = run_conductor(args.seed, args.duration, classes,
+                        logdir=args.logdir)
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

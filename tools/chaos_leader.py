@@ -10,61 +10,41 @@ Round-4 result on the dev machine: 393/393 jobs Completed across 13
 leader SIGKILLs, follower takeover in 1.4-2.2s each time (lease TTL
 1.5s), zero chip overcommit.
 
+A thin schedule over tools/chaoslib.py (shared proxy/zoo/audit
+plumbing).
+
 Usage:  python tools/chaos_leader.py     # logs to /tmp/chaos2/
 """
-import json, os, random, signal, socket, subprocess, sys, time, urllib.request
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
-env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+import json
+import os
+import random
+import sys
+import time
 
-def free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0)); return s.getsockname()[1]
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from tools import chaoslib  # noqa: E402
 
-port = free_port()
+port = chaoslib.free_port()
 url = f"http://127.0.0.1:{port}"
-server = subprocess.Popen(
-    [sys.executable, "-m", "volcano_tpu.server", "--port", str(port),
-     "--tick-period", "0.2"], env=env, cwd=REPO,
-    stdout=open("/tmp/chaos2/server.log", "w"), stderr=subprocess.STDOUT)
-time.sleep(2)
-ctrl = subprocess.Popen(
-    [sys.executable, "-m", "volcano_tpu", "--cluster-url", url,
-     "--components", "controllers", "--period", "0.2"], env=env, cwd=REPO,
-    stdout=open("/tmp/chaos2/ctrl.log", "w"), stderr=subprocess.STDOUT)
+zoo = chaoslib.ProcessZoo("/tmp/chaos2")
+zoo.spawn_server(port)
+chaoslib.wait_server(url)
+zoo.spawn_plane("ctrl", url, "controllers")
 
-scheds = {}
+
 def spawn_sched(name):
-    scheds[name] = subprocess.Popen(
-        [sys.executable, "-m", "volcano_tpu", "--cluster-url", url,
-         "--components", "scheduler", "--period", "0.2",
-         "--leader-elect", "--holder", name, "--lease-ttl", "1.5"],
-        env=env, cwd=REPO,
-        stdout=open(f"/tmp/chaos2/{name}.log", "a"), stderr=subprocess.STDOUT)
+    zoo.spawn_plane(name, url, "scheduler", "--leader-elect",
+                    "--holder", name, "--lease-ttl", "1.5")
+
 
 spawn_sched("s1")
 spawn_sched("s2")
 
-def leader():
-    try:
-        with urllib.request.urlopen(url + "/leases", timeout=2) as r:
-            leases = json.loads(r.read())
-        return leases.get("scheduler", {}).get("holder")
-    except Exception:
-        return None
-
-from volcano_tpu.cache.remote_cluster import RemoteCluster
-from volcano_tpu.api.devices.tpu.topology import slice_for
-from volcano_tpu.simulator import slice_nodes
-from volcano_tpu.api.vcjob import TaskSpec, VCJob
-from volcano_tpu.api.pod import make_pod
-from volcano_tpu.api.resource import TPU
-from volcano_tpu.api.types import RUN_TICKS_ANNOTATION
+from volcano_tpu.cache.remote_cluster import RemoteCluster  # noqa: E402
 
 c = RemoteCluster(url)
-for sname in ("sa", "sb"):
-    for node in slice_nodes(slice_for(sname, "v5e-16"), dcn_pod="d0"):
-        c.put_object("node", node)
+chaoslib.seed_slices(c, ("sa", "sb"))
 
 rng = random.Random(7)
 submitted = kills = 0
@@ -74,28 +54,23 @@ last_kill = time.time()
 i = 0
 while time.time() < t_end:
     n = rng.choice((1, 2, 4))
-    job = VCJob(name=f"le-{i}", min_available=n,
-                tasks=[TaskSpec(name="worker", replicas=n,
-                                template=make_pod("t", requests={"cpu": 4, TPU: 4},
-                                                  annotations={RUN_TICKS_ANNOTATION: "3"}))],
-                plugins={"jax": [], "svc": []})
     try:
-        c.add_vcjob(job); submitted += 1
-    except Exception as e:
+        c.add_vcjob(chaoslib.gang_job(f"le-{i}", n))
+        submitted += 1
+    except Exception as e:  # noqa: BLE001
         print("submit failed:", e, flush=True)
     i += 1
     time.sleep(rng.uniform(0.4, 1.0))
     if time.time() - last_kill > 20:
-        ldr = leader()
-        if ldr in scheds:
-            os.kill(scheds[ldr].pid, signal.SIGKILL)
-            scheds[ldr].wait()
+        ldr = chaoslib.leader(url)
+        if ldr in ("s1", "s2"):
+            zoo.kill9(ldr)
             kills += 1
             # wait for the OTHER one to take the lease
             other = "s2" if ldr == "s1" else "s1"
             t0 = time.time()
             while time.time() - t0 < 15:
-                if leader() == other:
+                if chaoslib.leader(url) == other:
                     takeovers.append(round(time.time() - t0, 2))
                     break
                 time.sleep(0.2)
@@ -104,19 +79,9 @@ while time.time() < t_end:
 
 time.sleep(20)
 c.resync()
-phases = {}
-for j in c.vcjobs.values():
-    ph = getattr(j.phase, "value", str(j.phase))
-    phases[ph] = phases.get(ph, 0) + 1
-overcommit = []
-node_chips = {}
-for p in c.pods.values():
-    if p.node_name and getattr(p.phase, "value", "") in ("Running", "Bound"):
-        node_chips[p.node_name] = node_chips.get(p.node_name, 0) + \
-            p.resource_requests().get(TPU)
-overcommit = [(n, u) for n, u in node_chips.items() if u > 4.01]
-print(json.dumps({"submitted": submitted, "leader_kills": kills,
-                  "takeover_s": takeovers, "phases": phases,
-                  "overcommitted_nodes": overcommit}))
-for p in [server, ctrl] + list(scheds.values()):
-    p.terminate()
+print(json.dumps({
+    "submitted": submitted, "leader_kills": kills,
+    "takeover_s": takeovers,
+    "phases": chaoslib.phase_counts(c),
+    "overcommitted_nodes": chaoslib.overcommit_audit(c)}))
+zoo.terminate_all()

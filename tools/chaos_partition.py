@@ -8,183 +8,57 @@ lease design: a leader that cannot renew must stand by (stop binding)
 BEFORE the standby acquires the lease, or the healed partition would
 replay stale binds into double-bookings.
 
-Each scheduler talks to the server through its own in-process TCP
-proxy with three modes:
-    pass       — forward bytes both ways
-    blackhole  — accept then stall (connect succeeds, requests hang:
-                 the worst partition shape — timeouts, not errors)
-    latency    — forward with +LAT_S per chunk (slow-link brownout)
-
-Every ~20s the CURRENT leader's proxy is blackholed for ~2x the lease
-TTL (forcing a takeover while the old leader is alive-but-dark), then
-healed; between partitions both proxies take short latency brownouts.
-Pass criteria: every job completes, no chip overcommit, at least one
-takeover per partition, and the healed ex-leader rejoins as standby.
+Each scheduler talks to the server through its own chaoslib.ChaosProxy
+(pass / blackhole / latency — see tools/chaoslib.py for the shared
+proxy).  Every ~20s the CURRENT leader's proxy is blackholed for ~2x
+the lease TTL (forcing a takeover while the old leader is
+alive-but-dark), then healed; between partitions both proxies take
+short latency brownouts.  Pass criteria: every job completes, no chip
+overcommit, at least one takeover per partition, and the healed
+ex-leader rejoins as standby.
 
 Usage:  python tools/chaos_partition.py [seconds]   # logs /tmp/chaos3/
 """
 import json
 import os
 import random
-import select
-import socket
-import subprocess
 import sys
-import threading
 import time
-import urllib.request
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
-env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
-os.makedirs("/tmp/chaos3", exist_ok=True)
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from tools import chaoslib  # noqa: E402
+
 DURATION = float(sys.argv[1]) if len(sys.argv) > 1 else 300.0
 LEASE_TTL = 1.5
 LAT_S = 0.15
 
-
-def free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-class ChaosProxy(threading.Thread):
-    """TCP proxy with a switchable fault mode."""
-
-    def __init__(self, upstream_port: int):
-        super().__init__(daemon=True)
-        self.upstream_port = upstream_port
-        self.mode = "pass"
-        self.listener = socket.socket()
-        self.listener.setsockopt(socket.SOL_SOCKET,
-                                 socket.SO_REUSEADDR, 1)
-        self.listener.bind(("127.0.0.1", 0))
-        self.listener.listen(64)
-        self.port = self.listener.getsockname()[1]
-        self._conns = []
-        self._lock = threading.Lock()
-
-    def run(self):
-        while True:
-            try:
-                client, _ = self.listener.accept()
-            except OSError:
-                return
-            threading.Thread(target=self._serve, args=(client,),
-                             daemon=True).start()
-
-    def _serve(self, client):
-        with self._lock:
-            self._conns.append(client)
-        try:
-            if self.mode == "blackhole":
-                # connect succeeds, bytes go nowhere: the client's
-                # request hangs until ITS timeout fires (mirrors a
-                # mid-network partition, not a refused connection)
-                while self.mode == "blackhole":
-                    r, _, _ = select.select([client], [], [], 0.2)
-                    if r and not client.recv(65536):
-                        return
-                # healed mid-connection: drop it; the client retries
-                return
-            upstream = socket.create_connection(
-                ("127.0.0.1", self.upstream_port), timeout=5)
-            with self._lock:
-                self._conns.append(upstream)
-            socks = [client, upstream]
-            peer = {client: upstream, upstream: client}
-            while True:
-                r, _, _ = select.select(socks, [], [], 1.0)
-                if self.mode == "blackhole":
-                    return      # partition started mid-flight: cut it
-                for s in r:
-                    data = s.recv(65536)
-                    if not data:
-                        return
-                    if self.mode == "latency":
-                        time.sleep(LAT_S)
-                    peer[s].sendall(data)
-        except OSError:
-            pass
-        finally:
-            for s in (client,) + tuple(
-                    x for x in (locals().get("upstream"),) if x):
-                try:
-                    s.close()
-                except OSError:
-                    pass
-
-    def set_mode(self, mode: str):
-        self.mode = mode
-        if mode == "blackhole":
-            # sever in-flight connections so keep-alive sockets don't
-            # tunnel through the partition
-            with self._lock:
-                for s in self._conns:
-                    try:
-                        s.close()
-                    except OSError:
-                        pass
-                self._conns.clear()
-
-
-port = free_port()
+port = chaoslib.free_port()
 url = f"http://127.0.0.1:{port}"
-server = subprocess.Popen(
-    [sys.executable, "-m", "volcano_tpu.server", "--port", str(port),
-     "--tick-period", "0.2"], env=env, cwd=REPO,
-    stdout=open("/tmp/chaos3/server.log", "w"), stderr=subprocess.STDOUT)
-time.sleep(2)
-ctrl = subprocess.Popen(
-    [sys.executable, "-m", "volcano_tpu", "--cluster-url", url,
-     "--components", "controllers", "--period", "0.2"], env=env,
-    cwd=REPO, stdout=open("/tmp/chaos3/ctrl.log", "w"),
-    stderr=subprocess.STDOUT)
+zoo = chaoslib.ProcessZoo("/tmp/chaos3")
+zoo.spawn_server(port)
+chaoslib.wait_server(url)
+zoo.spawn_plane("ctrl", url, "controllers")
 
-proxies = {"s1": ChaosProxy(port), "s2": ChaosProxy(port)}
+proxies = {"s1": chaoslib.ChaosProxy(port, latency_s=LAT_S),
+           "s2": chaoslib.ChaosProxy(port, latency_s=LAT_S)}
 for p in proxies.values():
     p.start()
 
-scheds = {}
-
 
 def spawn_sched(name):
-    scheds[name] = subprocess.Popen(
-        [sys.executable, "-m", "volcano_tpu", "--cluster-url",
-         f"http://127.0.0.1:{proxies[name].port}",
-         "--components", "scheduler", "--period", "0.2",
-         "--leader-elect", "--holder", name,
-         "--lease-ttl", str(LEASE_TTL)],
-        env=env, cwd=REPO,
-        stdout=open(f"/tmp/chaos3/{name}.log", "a"),
-        stderr=subprocess.STDOUT)
+    zoo.spawn_plane(name, f"http://127.0.0.1:{proxies[name].port}",
+                    "scheduler", "--leader-elect", "--holder", name,
+                    "--lease-ttl", str(LEASE_TTL))
 
 
 spawn_sched("s1")
 spawn_sched("s2")
 
-
-def leader():
-    try:
-        with urllib.request.urlopen(url + "/leases", timeout=2) as r:
-            return json.loads(r.read()).get("scheduler", {}).get("holder")
-    except Exception:
-        return None
-
-
-from volcano_tpu.api.devices.tpu.topology import slice_for  # noqa: E402
-from volcano_tpu.api.pod import make_pod  # noqa: E402
-from volcano_tpu.api.resource import TPU  # noqa: E402
-from volcano_tpu.api.types import RUN_TICKS_ANNOTATION  # noqa: E402
-from volcano_tpu.api.vcjob import TaskSpec, VCJob  # noqa: E402
 from volcano_tpu.cache.remote_cluster import RemoteCluster  # noqa: E402
-from volcano_tpu.simulator import slice_nodes  # noqa: E402
 
 c = RemoteCluster(url)
-for sname in ("sa", "sb"):
-    for node in slice_nodes(slice_for(sname, "v5e-16"), dcn_pod="d0"):
-        c.put_object("node", node)
+chaoslib.seed_slices(c, ("sa", "sb"))
 
 rng = random.Random(23)
 submitted = partitions = brownouts = 0
@@ -194,15 +68,8 @@ last_fault = time.time()
 i = 0
 while time.time() < t_end:
     n = rng.choice((1, 2, 4))
-    job = VCJob(name=f"part-{i}", min_available=n,
-                tasks=[TaskSpec(
-                    name="worker", replicas=n,
-                    template=make_pod(
-                        "t", requests={"cpu": 4, TPU: 4},
-                        annotations={RUN_TICKS_ANNOTATION: "3"}))],
-                plugins={"jax": [], "svc": []})
     try:
-        c.add_vcjob(job)
+        c.add_vcjob(chaoslib.gang_job(f"part-{i}", n))
         submitted += 1
     except Exception as e:  # noqa: BLE001
         print("submit failed:", e, flush=True)
@@ -210,7 +77,7 @@ while time.time() < t_end:
     time.sleep(rng.uniform(0.4, 1.0))
     if time.time() - last_fault <= 20:
         continue
-    victim = leader()
+    victim = chaoslib.leader(url)
     if victim not in proxies:
         last_fault = time.time()
         continue
@@ -231,7 +98,7 @@ while time.time() < t_end:
     # the standby must take the lease within ~2 TTLs of expiry
     new_leader, deadline = None, time.time() + 4 * LEASE_TTL + 2
     while time.time() < deadline:
-        cur = leader()
+        cur = chaoslib.leader(url)
         if cur and cur != victim:
             new_leader = cur
             break
@@ -249,49 +116,20 @@ while time.time() < t_end:
 # settle and audit
 time.sleep(25)
 c.resync()
-phases = {}
+phases = chaoslib.phase_counts(c)
 for j in c.vcjobs.values():
-    ph = getattr(j.phase, "value", str(j.phase))
-    phases[ph] = phases.get(ph, 0) + 1
-    if ph not in ("Completed",):
+    if getattr(j.phase, "value", str(j.phase)) not in ("Completed",):
         # forensic dump for any straggler: what does the control
         # plane think is blocking it?
-        pg = c.podgroups.get(j.key)
-        pods = {p.name: (getattr(p.phase, "value", str(p.phase)),
-                         p.node_name)
-                for p in c.pods.values() if p.owner == j.uid}
-        print(json.dumps({
-            "straggler": j.key, "phase": ph,
-            "pg_phase": getattr(getattr(pg, "phase", None), "value",
-                                None),
-            "pg_conditions": [
-                {"type": cond.type, "reason": cond.reason,
-                 "message": cond.message[:300]}
-                for cond in getattr(pg, "conditions", [])],
-            "pods": pods}), flush=True)
-overcommit = []
-node_chips = {}
-for p in c.pods.values():
-    if p.node_name and getattr(p.phase, "value", "") in ("Running",
-                                                         "Bound"):
-        node_chips[p.node_name] = node_chips.get(p.node_name, 0) + \
-            p.resource_requests().get(TPU)
-for nname, used in node_chips.items():
-    if used > 4.01:
-        overcommit.append((nname, used))
+        print(json.dumps(chaoslib.straggler_report(c, j)), flush=True)
+overcommit = chaoslib.overcommit_audit(c)
 failed_takeovers = [t for t in takeovers if not t["new_leader"]]
 print(json.dumps({
     "submitted": submitted, "partitions": partitions,
     "latency_brownouts": brownouts, "takeovers": takeovers,
     "failed_takeovers": len(failed_takeovers), "phases": phases,
     "overcommitted_nodes": overcommit}))
-for proc in (server, ctrl, *scheds.values()):
-    proc.terminate()
-for proc in (server, ctrl, *scheds.values()):
-    try:
-        proc.wait(timeout=5)
-    except subprocess.TimeoutExpired:
-        proc.kill()     # a blackholed client can be stuck in a read
+zoo.terminate_all()
 ok = (not overcommit and not failed_takeovers
       and phases.get("Completed", 0) == submitted)
 sys.exit(0 if ok else 1)

@@ -102,6 +102,14 @@ FAMILIES: Dict[str, str] = {
     # client wire resilience: every transient retry the unified
     # backoff policy performs, labeled by route
     "client_retries_total": "counter",
+    # gray-failure chaos engine (volcano_tpu/faults.py +
+    # docs/design/chaos.md): every injected fault counted by bounded
+    # site/kind enums, the read-only degrade flag (1 while the WAL is
+    # poisoned and writes 503), and WAL records dropped by bounded
+    # reason (readonly, append-error, duplicate-seq, force-truncate)
+    "fault_injected_total": "counter",
+    "server_readonly": "gauge",
+    "server_wal_dropped_records_total": "counter",
     # scheduling flight recorder (trace.py): per-phase lifecycle
     # segments (created->enqueued->allocated->bound->admitted->
     # running, plus the telescoped e2e), span time by action/plugin,

@@ -253,31 +253,34 @@ class FakeCluster(Cluster):
                 (kind == "podgroup" and k not in self.podgroups):
             from volcano_tpu import trace
             trace.stamp_phase(obj.annotations, "created")
-        if kind == "podgroup":
-            # keep the goodput fold sticky: a whole-podgroup write
-            # from a mirror predating a fold (controllers persist
-            # podgroups from THEIR copies every sync) must not erase
-            # the accumulated accounting
-            with self._lock:
-                cur = self.podgroups.get(k)
-            if cur is not None:
-                self._apply_goodput_stick(obj, cur)
-        if kind == "node":
-            # keep the accounting/health folds sticky: a node write
-            # from a mirror that predates a fold (the agent's
-            # whole-node persist) must not erase the folded summary —
-            # re-apply the stored reports before the write lands
-            with self._lock:
+        with self._lock:
+            if kind == "node":
+                # keep the accounting/health folds sticky: a node
+                # write from a mirror that predates a fold (the
+                # agent's whole-node persist) must not erase the
+                # folded summary — re-apply the stored reports before
+                # the write lands.  Read-stick-store under this one
+                # lock hold (RLock): a fold racing a dropped-lock
+                # stick would still be erased.
                 rep = self.bandwidthreports.get(k)
                 health = self.slicehealthreports.get(k)
                 cur = self.nodes.get(k)
-            if rep is not None:
-                self._apply_bandwidth_fold(obj, rep)
-            if health is not None:
-                self._apply_health_fold(obj, health)
-            if cur is not None:
-                self._apply_quarantine_stick(obj, cur)
-        with self._lock:
+                if rep is not None:
+                    self._apply_bandwidth_fold(obj, rep)
+                if health is not None:
+                    self._apply_health_fold(obj, health)
+                if cur is not None:
+                    self._apply_quarantine_stick(obj, cur)
+            if kind == "podgroup":
+                # keep the goodput fold sticky: a whole-podgroup
+                # write from a mirror predating a fold (controllers
+                # persist podgroups from THEIR copies every sync)
+                # must not erase the accumulated accounting.  Read-
+                # stick-store under this one lock hold: a fold racing
+                # a dropped-lock stick would still be erased.
+                cur = self.podgroups.get(k)
+                if cur is not None:
+                    self._apply_goodput_stick(obj, cur)
             getattr(self, spec.attr)[k] = obj
         self._notify(kind, obj if spec.key_of else {"key": k, "obj": obj})
         if kind == "bandwidthreport":
@@ -586,7 +589,22 @@ class FakeCluster(Cluster):
             self._notify("pod", pod)
 
     def update_podgroup_status(self, pg: PodGroup) -> None:
+        # the scheduler's per-cycle status flush is a WHOLE-podgroup
+        # write from ITS mirror copy.  Normally that copy is a cycle
+        # old at worst, but under gray failure (read-only degrade,
+        # slow watch) it can be SECONDS stale — and without the same
+        # goodput stick put_object applies, a stale flush erased the
+        # folds that landed in between, visibly rewinding the
+        # accumulated ledger (found by tools/chaos_conductor.py:
+        # goodput_monotonic violation).  Max-merge is conflict-free,
+        # so re-applying here is always safe.
         with self._lock:
+            # read-stick-store under ONE lock hold: a fold landing
+            # between a dropped-lock read and the store would still
+            # be erased (the exact race the stick closes)
+            cur = self.podgroups.get(pg.key)
+            if cur is not None:
+                self._apply_goodput_stick(pg, cur)
             self.podgroups[pg.key] = pg
         self._notify("podgroup", pg)
 

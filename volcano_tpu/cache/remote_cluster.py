@@ -35,14 +35,32 @@ from volcano_tpu.cache.kinds import KINDS, key_for
 log = logging.getLogger(__name__)
 
 # ONE retry policy for every wire call (capped exponential backoff +
-# full jitter + an overall deadline) instead of each caller hand-
+# FULL jitter + an overall deadline) instead of each caller hand-
 # rolling its own: transient failures — connection refused/reset, a
 # truncated response, a 5xx from a restarting server — are retried
 # until the deadline; 4xx verdicts (auth, admission, conflict,
-# missing) fail fast, every retry would get the same answer.
+# missing) fail fast, every retry would get the same answer.  A 503
+# carrying Retry-After (the server's read-only degrade) is HONOURED:
+# the sleep is at least the server's ask, plus jitter — so a fleet of
+# mirrors waits out a full-disk episode instead of hammering it in
+# lockstep.
 RETRY_BASE_S = 0.05
 RETRY_CAP_S = 2.0
 RETRY_DEADLINE_S = 30.0
+# per-attempt budget the WATCH LOOP hands resync(): the loop's own
+# exponential backoff owns the pacing between attempts — an unbounded
+# resync would reset the deadline budget every iteration and turn a
+# sick server's recovery into a retry storm
+WATCH_RESYNC_BUDGET_S = 3.0
+
+
+def _retry_sleep(delay: float, e: Exception, remain: float) -> float:
+    """One backoff sleep under the shared policy: full jitter over the
+    current delay, floored at the server's Retry-After when it sent
+    one, capped by the remaining deadline."""
+    retry_after = float(getattr(e, "retry_after", 0.0) or 0.0)
+    return min(max(remain, 0.0),
+               retry_after + random.uniform(0, delay))
 
 
 def _transient(e: Exception) -> bool:
@@ -56,9 +74,14 @@ def _transient(e: Exception) -> bool:
 
 
 class RemoteError(RuntimeError):
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str,
+                 retry_after: float = 0.0):
         super().__init__(message)
         self.code = code
+        # parsed from the Retry-After header (seconds); 0 = none.
+        # The read-only degrade's 503s carry it so clients pace their
+        # retries to the server's heal cadence.
+        self.retry_after = retry_after
 
 
 class RemoteCluster(Cluster):
@@ -147,8 +170,7 @@ class RemoteCluster(Cluster):
                             route=path.partition("?")[0])
                 log.debug("wire %s %s failed (%s); retrying",
                           method, path, e)
-                time.sleep(min(remain,
-                               random.uniform(delay / 2, delay)))
+                time.sleep(_retry_sleep(delay, e, remain))
                 delay = min(delay * 2, RETRY_CAP_S)
 
     def _request_once(self, method: str, path: str, payload=None,
@@ -183,7 +205,12 @@ class RemoteCluster(Cluster):
                 raise ValueError(msg) from None
             if e.code == 404:
                 raise KeyError(msg) from None
-            raise RemoteError(e.code, msg) from None
+            try:
+                retry_after = float(e.headers.get("Retry-After") or 0.0)
+            except (TypeError, ValueError):
+                retry_after = 0.0
+            raise RemoteError(e.code, msg,
+                              retry_after=retry_after) from None
 
     # -- mirror maintenance --------------------------------------------
 
@@ -327,11 +354,15 @@ class RemoteCluster(Cluster):
                               "mirror will go stale until "
                               "reconfigured", e)
                     return
-                if self._stop.wait(random.uniform(delay / 2, delay)):
+                # FULL jitter, floored at any Retry-After the server
+                # sent: a read-only (healing) server told every
+                # mirror when to come back — spreading the retries
+                # stops the whole fleet reconnecting in lockstep
+                if self._stop.wait(_retry_sleep(delay, e,
+                                                float("inf"))):
                     return
                 delay = min(delay * 2, 5.0)
                 continue
-            delay = 0.2
             epoch = payload.get("epoch", "")
             if payload.get("resync") or payload["rv"] < self._rv or \
                     (self._epoch and epoch and epoch != self._epoch):
@@ -340,12 +371,23 @@ class RemoteCluster(Cluster):
                 # server whose counter already passed ours).  resync()
                 # recovers the stream: O(churn) delta when the epoch
                 # BASE matches (durable restart), full re-list
-                # otherwise
+                # otherwise.  The attempt budget is BOUNDED: an
+                # unbounded resync would re-arm its own 30s retry
+                # storm every loop iteration, so the loop's backoff —
+                # not resync's — owns the pacing between attempts.
                 try:
-                    self.resync()
-                except Exception:  # noqa: BLE001
-                    log.exception("resync failed")
+                    self.resync(_deadline=WATCH_RESYNC_BUDGET_S)
+                except Exception as e:  # noqa: BLE001
+                    log.debug("watch resync attempt failed (%s); "
+                              "backing off", e)
+                    if self._stop.wait(_retry_sleep(delay, e,
+                                                    float("inf"))):
+                        return
+                    delay = min(delay * 2, 5.0)
+                    continue
+                delay = 0.2
                 continue
+            delay = 0.2
             for kind, obj in self._apply_batch(payload["events"]):
                 self._notify(kind, obj)
             self._rv = max(self._rv, payload["rv"])

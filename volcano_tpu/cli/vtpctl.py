@@ -954,6 +954,10 @@ def cmd_server(cluster, args):
             ["visible-rv", dur.get("visible_rv")],
             ["durable", "yes" if dur.get("enabled") else
              "NO (kill -9 loses state)"]]
+    if dur.get("readonly"):
+        # the degraded state an operator must see first: writes are
+        # 503ing until the heal loop clears the poison
+        rows.insert(0, ["READ-ONLY", dur["readonly"]])
     if dur.get("enabled"):
         age = dur.get("snapshot_age_s")
         rows += [
@@ -1254,8 +1258,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         # writes hit the live server; no state file is touched
         from volcano_tpu.cache.remote_cluster import RemoteCluster
         from volcano_tpu.server.tlsutil import load_token
+        # `vtpctl server` is the incident command: it reads only
+        # /durability + /leases, and a READ-ONLY (degraded) server
+        # 503s the /snapshot bootstrap — the status view must not
+        # block behind the mirror it never uses
+        tolerant = getattr(args, "fn", None) is cmd_server
         cluster = RemoteCluster(
             args.server, start_watch=False,
+            tolerate_unreachable=tolerant,
             token=load_token(args.token, args.token_file),
             ca_cert=args.ca_cert, insecure=args.insecure)
     else:

@@ -58,6 +58,18 @@ def main(argv=None) -> int:
                              "route except /healthz and /metrics "
                              "(also presented on webhook callouts)")
     parser.add_argument("--token-file", default="")
+    parser.add_argument("--fault-plan", default="",
+                        help="ARM THE CHAOS ENGINE: a faults.FaultPlan "
+                             "JSON doc (inline, or @/path/to/plan.json)"
+                             " injecting wire/disk/clock faults in "
+                             "this process; also read from the "
+                             "VTP_FAULT_PLAN env var.  Never use in "
+                             "production")
+    parser.add_argument("--wal-force-truncate", action="store_true",
+                        help="explicit operator override for mid-WAL "
+                             "corruption: truncate the log at the "
+                             "corrupt record and ACCEPT THE DATA LOSS "
+                             "instead of refusing to boot")
     parser.add_argument("--webhook-ca-cert", default="",
                         help="CA bundle for --webhook-url callouts")
     parser.add_argument("--webhook-insecure", action="store_true")
@@ -82,14 +94,43 @@ def main(argv=None) -> int:
         log.info("self-signed TLS material written to %s / %s",
                  args.tls_cert, args.tls_key)
 
+    from volcano_tpu import faults as faults_mod
     from volcano_tpu.server.durability import (DurableStore,
+                                               WALCorruptionError,
                                                atomic_write_json,
                                                load_cluster_file)
+    plan = None
+    if args.fault_plan:
+        raw = args.fault_plan
+        if raw.startswith("@"):
+            with open(raw[1:], encoding="utf-8") as f:
+                raw = f.read()
+        import json as _json
+        plan = faults_mod.FaultPlan.from_doc(_json.loads(raw))
+        log.warning("fault plan ACTIVE (seed=%d, %d rules)",
+                    plan.seed, len(plan.rules))
+    else:
+        plan = faults_mod.FaultPlan.from_env()
+    if plan is not None:
+        faults_mod.install_clock_faults(plan)
+
     durable = None
     cluster = None
     if args.data_dir:
-        durable = DurableStore(args.data_dir)
-        rec = durable.recover()
+        vfs = None
+        if plan is not None and any(r.site == "disk"
+                                    for r in plan.rules):
+            vfs = faults_mod.FaultyVFS(plan)
+        durable = DurableStore(args.data_dir, vfs=vfs,
+                               force_truncate=args.wal_force_truncate)
+        try:
+            rec = durable.recover()
+        except WALCorruptionError as e:
+            # REFUSE TO START: replaying past mid-WAL corruption
+            # silently drops every later acked write.  The operator
+            # restores the segment or accepts the loss explicitly.
+            log.critical("%s", e)
+            return 3
         cluster = rec.cluster
         if cluster is not None:
             log.info("recovered durable state from %s (%d nodes, %d "
@@ -136,7 +177,7 @@ def main(argv=None) -> int:
     httpd, state = serve(port=args.port, cluster=cluster,
                          tick_period=args.tick_period,
                          tls_cert=args.tls_cert, tls_key=args.tls_key,
-                         token=token, durable=durable)
+                         token=token, durable=durable, faults=plan)
     log.info("state server on %s://127.0.0.1:%d%s%s",
              "https" if args.tls_cert else "http",
              httpd.server_address[1],
@@ -151,10 +192,16 @@ def main(argv=None) -> int:
     state.tick_stop.set()   # no kubelet mutations during save
     httpd.shutdown()
     if durable is not None:
-        # final compaction so the next boot replays zero WAL
-        state.write_snapshot()
+        if durable.poisoned:
+            log.error("shutting down READ-ONLY (%s): skipping the "
+                      "final compaction — the last durable snapshot + "
+                      "WAL prefix is the recovery point",
+                      durable.poisoned)
+        else:
+            # final compaction so the next boot replays zero WAL
+            state.write_snapshot()
+            log.info("durable state compacted in %s", args.data_dir)
         durable.close()
-        log.info("durable state compacted in %s", args.data_dir)
     if args.state:
         # the graceful save routes through the same snapshot capture +
         # atomic writer the WAL compactor uses: the store/event locks

@@ -22,19 +22,52 @@ contract (docs/design/durability.md):
     and a delta resync across the restart is exact; a different BASE
     (fresh dir, legacy pickle boot) forces a full re-list.
 
-Record format — one JSON line per record, self-delimiting so a crash
-mid-append truncates to the last complete line:
+Record format — one line per record, self-delimiting and
+self-verifying:
 
-    {"rv": N, "k": kind, "o": <codec payload>}       store event
+    crc32hex {"q": seq, "rv": N, "k": kind, "o": <codec payload>}
+
+The 8-hex-char CRC32 covers the JSON body; ``q`` is a per-store
+monotonic sequence number.  Together they close the two gray-failure
+holes a bare JSON-lines journal has: a bit-flipped record that still
+PARSES as JSON (replayed silently before; now a CRC mismatch), and a
+duplicated or gapped record stream after an operator copy-restore
+(now detected by ``q``).  Replay policy (docs/design/chaos.md):
+
+  * torn FINAL record of the FINAL segment — a crash mid-append —
+    is dropped quietly, as before;
+  * corruption anywhere else (CRC mismatch, unparseable line,
+    sequence gap) REFUSES TO BOOT with ``WALCorruptionError`` — a
+    silent partial replay is how acked state quietly vanishes; the
+    operator accepts the loss explicitly with ``--wal-force-truncate``
+    which cuts the log at the corrupt record and discards the rest;
+  * duplicated records (``q`` already applied) are skipped idempotently
+    — a copy-restored segment replays to the same state.
+
+Record kinds besides store events (only those carry rv — they are the
+watch stream; private records replay in file order):
+
     {"k": "_lease", "o": {name, holder, expires_wall}} lease CAS
     {"k": "_drain", "o": {"target": key}}              command drain
     {"k": "_req",  "o": {"id":..,"code":..,"resp":..}} idempotency key
+    {"k": "_probe"}                                    heal probe
 
-Only store events carry rv (they are the watch stream); the private
-records replay in file order.  Leases persist wall-clock expiry and
-are rebased onto the monotonic clock at boot, so a restarted server
-refuses a second leader inside an old holder's TTL while a wall-clock
-jump can never mass-expire (or immortalize) live leases.
+Leases persist wall-clock expiry and are rebased onto the monotonic
+clock at boot, so a restarted server refuses a second leader inside an
+old holder's TTL while a wall-clock jump can never mass-expire (or
+immortalize) live leases.
+
+Gray-failure degrade (the fsyncgate lesson): an ENOSPC on append or
+an EIO from fsync POISONS the store for writes — fsync is never
+retried (a failed fsync may clear the kernel's dirty-page error bit,
+so a retry can falsely succeed over lost data).  The server degrades
+to READ-ONLY (writes 503 + Retry-After, reads and leases still
+served) instead of acking un-durable state, and heals by rotating to
+a fresh segment, probing it with a real fsync, and writing a full
+snapshot that recaptures the in-memory state wholesale — rv stays
+monotonic across the whole episode.  File ops route through a
+``faults.VFS`` seam so the chaos engine can inject exactly these
+failures deterministically.
 """
 
 from __future__ import annotations
@@ -46,6 +79,7 @@ import os
 import threading
 import time
 import uuid
+import zlib
 from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 log = logging.getLogger(__name__)
@@ -63,6 +97,32 @@ SNAPSHOT_EVERY_BYTES = 64 * 1024 * 1024
 # mutation whose first attempt committed before a crash must find its
 # recorded response, not double-apply
 REQ_CACHE = 2048
+
+
+class WALCorruptionError(RuntimeError):
+    """Mid-WAL corruption found at boot: the log cannot be replayed
+    without silently dropping acked state.  Refuse to start; the
+    operator accepts the loss explicitly with --wal-force-truncate."""
+
+    def __init__(self, path: str, lineno: int, reason: str):
+        super().__init__(
+            f"WAL {path} corrupt at record {lineno} ({reason}); "
+            "refusing to boot — a partial replay would silently drop "
+            "every later acked write.  Restore the segment from a "
+            "copy, or re-run with --wal-force-truncate to cut the log "
+            "here and accept the data loss.")
+        self.path = path
+        self.lineno = lineno
+        self.reason = reason
+
+
+class ReadOnlyError(RuntimeError):
+    """The store is poisoned for writes (failed fsync / full disk):
+    nothing can be made durable, so nothing may be acked."""
+
+    def __init__(self, reason: str):
+        super().__init__(f"store is read-only: {reason}")
+        self.reason = reason
 
 
 class Recovery(NamedTuple):
@@ -99,6 +159,40 @@ def atomic_write_json(path: str, doc: dict) -> None:
         os.fsync(f.fileno())
     os.replace(tmp, path)
     _fsync_dir(os.path.dirname(os.path.abspath(path)) or ".")
+
+
+def frame_record(rec: dict, seq: int) -> str:
+    """One WAL line: crc32hex SP json-body NL.  The CRC covers the
+    body bytes; the body carries the sequence number."""
+    body = json.dumps(dict(rec, q=seq), separators=(",", ":"))
+    return f"{zlib.crc32(body.encode('utf-8')) & 0xffffffff:08x} {body}\n"
+
+
+def parse_record(line: str) -> Tuple[Optional[dict], str]:
+    """(record, "") on success, (None, reason) on a bad line.
+    Legacy lines (bare JSON, pre-CRC vintage) still load — they just
+    can't prove their own integrity."""
+    line = line.strip()
+    if not line:
+        return None, "blank"
+    if line.startswith("{"):
+        try:
+            return json.loads(line), ""
+        except ValueError:
+            return None, "unparseable"
+    crc_hex, _, body = line.partition(" ")
+    if len(crc_hex) != 8 or not body:
+        return None, "unframed"
+    try:
+        want = int(crc_hex, 16)
+    except ValueError:
+        return None, "unframed"
+    if zlib.crc32(body.encode("utf-8")) & 0xffffffff != want:
+        return None, "crc-mismatch"
+    try:
+        return json.loads(body), ""
+    except ValueError:
+        return None, "unparseable"
 
 
 def decode_stores_into(cluster, stores: dict) -> None:
@@ -164,11 +258,15 @@ class DurableStore:
 
     def __init__(self, data_dir: str,
                  snapshot_every_records: int = SNAPSHOT_EVERY_RECORDS,
-                 snapshot_every_bytes: int = SNAPSHOT_EVERY_BYTES):
+                 snapshot_every_bytes: int = SNAPSHOT_EVERY_BYTES,
+                 vfs=None, force_truncate: bool = False):
+        from volcano_tpu import faults
         self.dir = os.path.abspath(data_dir)
         os.makedirs(self.dir, exist_ok=True)
         self.snapshot_every_records = snapshot_every_records
         self.snapshot_every_bytes = snapshot_every_bytes
+        self.vfs = vfs if vfs is not None else faults.VFS()
+        self.force_truncate = force_truncate
         self._lock = threading.Lock()     # file handle + counters
         # serializes whole snapshot() sequences: the background
         # compactor and the graceful-save path must never interleave
@@ -178,6 +276,7 @@ class DurableStore:
         self._snap_lock = threading.Lock()
         self._file: Optional[io.TextIOBase] = None
         self._seg_seq = 0
+        self._seq = 0                     # last record sequence written
         self._appended = 0                # records since last fsync mark
         self._synced_marker = 0
         self._tail_rv = 0                 # last store-event rv appended
@@ -190,6 +289,11 @@ class DurableStore:
         self.replay_records = 0
         self.replay_seconds = 0.0
         self.recovery: Optional[Recovery] = None
+        # read-only poison: set by the FIRST append/fsync failure,
+        # cleared only by a successful heal().  While set, appends are
+        # dropped (counted) and commit() raises ReadOnlyError — the
+        # server above must 503 writes instead of acking them.
+        self.poisoned = ""
 
     # -- boot ----------------------------------------------------------
 
@@ -222,10 +326,63 @@ class DurableStore:
         atomic_write_json(path, {"base": base, "boot": boot})
         return f"{base}.{boot}"
 
+    @staticmethod
+    def _scan_segment(path: str) -> List[Tuple[int, int, bytes, bool]]:
+        """[(lineno, byte_offset, raw_line, ended_with_newline)] —
+        offsets let force-truncate cut the file at the exact corrupt
+        record."""
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            log.exception("WAL segment %s unreadable", path)
+            return []
+        out = []
+        off = 0
+        lineno = 0
+        for chunk in raw.split(b"\n"):
+            complete = off + len(chunk) < len(raw)   # had its newline
+            if chunk.strip():
+                lineno += 1
+                out.append((lineno, off, chunk, complete))
+            off += len(chunk) + 1
+        return out
+
+    def _handle_corruption(self, path: str, lineno: int, offset: int,
+                           reason: str) -> None:
+        """Mid-WAL corruption: refuse to boot, or — with the explicit
+        operator override — truncate the log at the corrupt record
+        (and drop every later segment) so the surviving prefix is the
+        whole story."""
+        from volcano_tpu import metrics
+        if not self.force_truncate:
+            raise WALCorruptionError(path, lineno, reason)
+        dropped_bytes = os.path.getsize(path) - offset
+        with open(path, "r+b") as f:
+            f.truncate(offset)
+        later = [s for s in self._segments() if s > path]
+        for seg in later:
+            try:
+                os.remove(seg)
+            except OSError:
+                log.warning("could not remove post-corruption WAL %s",
+                            seg)
+        metrics.inc("server_wal_dropped_records_total",
+                    reason="force-truncate")
+        log.error("WAL %s corrupt at record %d (%s): "
+                  "--wal-force-truncate cut %d bytes here and dropped "
+                  "%d later segment(s) — ACKED STATE MAY BE LOST",
+                  path, lineno, reason, dropped_bytes, len(later))
+
     def recover(self, event_ring: int = 100_000) -> Recovery:
         """Snapshot + WAL-tail replay; opens a fresh live segment.
         Returns cluster=None when the dir held no durable state (the
-        caller seeds it and writes the initial snapshot)."""
+        caller seeds it and writes the initial snapshot).
+
+        Raises WALCorruptionError on mid-WAL corruption (CRC mismatch,
+        unparseable record, sequence gap) unless force_truncate was
+        set; only a torn final record of the final segment — the
+        crash-mid-append shape — is dropped quietly."""
         from volcano_tpu import metrics
         from volcano_tpu.cache.fake_cluster import FakeCluster
 
@@ -244,12 +401,14 @@ class DurableStore:
 
         cluster = None
         rv = 0
+        last_seq = 0
         leases: Dict[str, Tuple[str, float]] = {}
         req_cache: Dict[str, Tuple[int, object]] = {}
         if doc is not None:
             cluster = FakeCluster()
             decode_stores_into(cluster, doc.get("stores", {}))
             rv = int(doc.get("rv", 0))
+            last_seq = int(doc.get("wal_seq", 0))
             for name, rec in (doc.get("leases") or {}).items():
                 leases[name] = (rec["holder"], float(rec["expires_wall"]))
             for rec in (doc.get("req_cache") or []):
@@ -259,13 +418,62 @@ class DurableStore:
         import collections
         tail: collections.deque = collections.deque(maxlen=event_ring)
         replayed = 0
+        duplicates = 0
         drained_cids: set = set()
         if segments and cluster is None:
             cluster = FakeCluster()
-        for i, seg in enumerate(segments):
-            last = i == len(segments) - 1
-            for rec in self._read_segment(seg, tolerate_tail=last):
+        stop_replay = False
+        for si, seg in enumerate(segments):
+            if stop_replay:
+                break
+            last_segment = si == len(segments) - 1
+            entries = self._scan_segment(seg)
+            for ei, (lineno, offset, raw, complete) in enumerate(entries):
+                rec, bad = parse_record(
+                    raw.decode("utf-8", errors="replace"))
+                if rec is None:
+                    if bad == "blank":
+                        continue
+                    if last_segment and ei == len(entries) - 1 \
+                            and not complete:
+                        # the ONE tolerated shape: the final record of
+                        # the final segment, missing its newline — a
+                        # crash tore the append mid-write, so nothing
+                        # after it was acked.  A final record WITH its
+                        # newline was a complete append: a bad CRC
+                        # there is bit rot on (possibly acked) state,
+                        # which must refuse like any other corruption.
+                        log.info("WAL %s torn tail at record %d "
+                                 "(crash mid-append, %s); dropped",
+                                 seg, lineno, bad)
+                        break
+                    self._handle_corruption(seg, lineno, offset, bad)
+                    stop_replay = True
+                    break
+                seq = rec.get("q")
+                if seq is not None:
+                    seq = int(seq)
+                    if seq <= last_seq:
+                        # copy-restored / rotated-then-snapshotted
+                        # duplicate: replay is idempotent by skipping
+                        duplicates += 1
+                        continue
+                    if seq > last_seq + 1 and (last_seq or seq > 1):
+                        # records are MISSING mid-stream — replaying
+                        # past the hole would apply later state onto
+                        # a base that never existed.  last_seq == 0
+                        # with a first record past q=1 is the same
+                        # hole (a lost first segment / deleted
+                        # snapshot), not a fresh history.
+                        self._handle_corruption(
+                            seg, lineno, offset,
+                            f"sequence gap {last_seq}->{seq}")
+                        stop_replay = True
+                        break
+                    last_seq = seq
                 kind = rec.get("k")
+                if kind == "_probe":
+                    continue            # heal liveness marker, no state
                 if kind == "_lease":
                     o = rec["o"]
                     if o.get("holder"):
@@ -293,6 +501,11 @@ class DurableStore:
                     rv = max(rv, erv)
                     tail.append((erv, kind, rec["o"]))
                 replayed += 1
+        if duplicates:
+            metrics.inc("server_wal_dropped_records_total",
+                        value=float(duplicates), reason="duplicate-seq")
+            log.warning("WAL replay skipped %d duplicate record(s) "
+                        "(copy-restored segment?)", duplicates)
         if drained_cids:
             cluster.commands = [
                 c for c in cluster.commands
@@ -304,6 +517,7 @@ class DurableStore:
         leases = {n: (h, exp) for n, (h, exp) in leases.items()
                   if exp > now}
 
+        self._seq = last_seq
         self.replay_records = replayed
         self.replay_seconds = time.perf_counter() - t0
         if had_state:
@@ -318,32 +532,6 @@ class DurableStore:
                                  req_cache, epoch, replayed,
                                  self.replay_seconds)
         return self.recovery
-
-    @staticmethod
-    def _read_segment(path: str, tolerate_tail: bool):
-        """Yield records; a torn/corrupt line ends the segment — only
-        tolerated silently on the LIVE segment's tail (crash mid-
-        append), logged loudly anywhere else (real corruption: the
-        replay still applies the consistent prefix)."""
-        try:
-            with open(path, encoding="utf-8", errors="replace") as f:
-                for lineno, line in enumerate(f, 1):
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        yield json.loads(line)
-                    except ValueError:
-                        if not tolerate_tail:
-                            log.error("WAL %s corrupt at line %d; "
-                                      "replay stops there", path, lineno)
-                        else:
-                            log.info("WAL %s torn tail at line %d "
-                                     "(crash mid-append); dropped",
-                                     path, lineno)
-                        return
-        except OSError:
-            log.exception("WAL segment %s unreadable", path)
 
     def _open_new_segment(self) -> None:
         with self._lock:
@@ -363,16 +551,46 @@ class DurableStore:
                 pass
         path = os.path.join(self.dir,
                             f"{WAL_PREFIX}{self._seg_seq:08d}.log")
-        self._file = open(path, "a", encoding="utf-8")
+        self._file = self.vfs.open_append(path)
 
     # -- hot path ------------------------------------------------------
 
+    def _poison(self, reason: str) -> None:
+        from volcano_tpu import metrics
+        if not self.poisoned:
+            self.poisoned = reason
+            metrics.set_gauge("server_readonly", 1.0)
+            log.error("store POISONED for writes (%s): degrading to "
+                      "read-only — writes 503 until heal() succeeds; "
+                      "the failed fsync/append is NOT retried "
+                      "(fsyncgate: a retried fsync can falsely "
+                      "succeed over lost data)", reason)
+
     def append(self, rec: dict) -> None:
         """Buffer one record onto the live segment (no fsync here —
-        commit() is the durability barrier the ack path calls)."""
-        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        commit() is the durability barrier the ack path calls).
+
+        Never raises: a write failure (ENOSPC, injected torn write)
+        poisons the store instead — the caller's commit() then fails
+        the ack.  Poisoned appends are dropped and counted; the heal
+        snapshot recaptures the in-memory state wholesale, so nothing
+        acked is ever built on a dropped record."""
+        from volcano_tpu import metrics
         with self._lock:
-            self._file.write(line)
+            if self.poisoned:
+                metrics.inc("server_wal_dropped_records_total",
+                            reason="readonly")
+                return
+            seq = self._seq + 1
+            line = frame_record(rec, seq)
+            try:
+                self.vfs.write(self._file, line)
+            except OSError as e:
+                self._poison(f"append:{getattr(e, 'strerror', e)}")
+                metrics.inc("server_wal_dropped_records_total",
+                            reason="append-error")
+                return
+            self._seq = seq
             self._appended += 1
             self.wal_records += 1
             self.wal_bytes += len(line)
@@ -386,15 +604,25 @@ class DurableStore:
         """Make every appended record durable; returns the new synced
         rv horizon.  Group commit: the fsync that one thread pays
         covers every record appended before it, so concurrent callers
-        mostly return on the marker check without syncing again."""
+        mostly return on the marker check without syncing again.
+
+        Raises ReadOnlyError when the store is (or just became)
+        poisoned: a failed fsync is NEVER retried — the records it
+        covered are in an unknown state, and only heal()'s fresh
+        segment + full snapshot restores durability."""
         from volcano_tpu import metrics
         with self._lock:
+            if self.poisoned:
+                raise ReadOnlyError(self.poisoned)
             target = self._appended
             if self._synced_marker >= target:
                 return self.synced_rv
             t0 = time.perf_counter()
-            self._file.flush()
-            os.fsync(self._file.fileno())
+            try:
+                self.vfs.fsync(self._file)
+            except OSError as e:
+                self._poison(f"fsync:{getattr(e, 'strerror', e)}")
+                raise ReadOnlyError(self.poisoned) from None
             # marker/tail re-read under the SAME lock hold: anything
             # appended while we blocked in fsync hit the file before
             # this flush? no — but it will be covered by ITS caller's
@@ -409,6 +637,88 @@ class DurableStore:
         with self._lock:
             return (self.wal_records >= self.snapshot_every_records or
                     self.wal_bytes >= self.snapshot_every_bytes)
+
+    # -- read-only degrade + heal --------------------------------------
+
+    def heal(self, capture: Callable[[], dict]) -> bool:
+        """Attempt to leave read-only mode.  Protocol:
+
+          1. rotate to a FRESH segment (the poisoned file's contents
+             are presumed lost — never fsync it again);
+          2. probe the new segment with a real append + fsync through
+             the same VFS seam (a still-sick disk fails here and we
+             stay read-only);
+          3. capture() + atomically write a FULL snapshot — the
+             in-memory state (including mutations whose journal
+             records were dropped while poisoned; none were acked)
+             becomes durable wholesale;
+          4. delete the frozen segments, clear the poison.
+
+        Returns True when writable again; rv is untouched throughout,
+        so the counter stays monotonic across the whole episode."""
+        from volcano_tpu import metrics
+        with self._snap_lock:
+            if not self.poisoned:
+                return True
+            with self._lock:
+                try:
+                    self._open_segment_locked()
+                    seq = self._seq + 1
+                    self.vfs.write(self._file, frame_record(
+                        {"k": "_probe"}, seq))
+                    self.vfs.fsync(self._file)
+                    self._seq = seq
+                except OSError as e:
+                    log.info("heal probe failed (%s); staying "
+                             "read-only", e)
+                    return False
+                frozen = [s for s in self._segments()
+                          if s != self._file.name]
+                self._appended = self._synced_marker = 0
+                self.wal_records = 0
+                self.wal_bytes = 0
+                # while poisoned, appends drop without consuming seq,
+                # so the probe's is the horizon (same freeze-time rule
+                # as snapshot()).  Stamp the snapshot one BELOW it:
+                # the probe record itself stays in the live segment,
+                # and a wal_seq equal to its q would make the next
+                # boot flag it as a copy-restored duplicate — false
+                # corruption noise on exactly the post-incident
+                # forensics path.  At wal_seq = probe_seq - 1 the
+                # probe replays in-sequence and is skipped by kind.
+                probe_seq = self._seq
+            try:
+                doc = capture()
+                doc["format"] = SNAPSHOT_FORMAT
+                doc["saved_at"] = time.time()
+                doc["wal_seq"] = probe_seq - 1
+                atomic_write_json(os.path.join(self.dir, SNAPSHOT_FILE),
+                                  doc)
+            except OSError as e:
+                log.info("heal snapshot failed (%s); staying "
+                         "read-only", e)
+                return False
+            with self._lock:
+                self.snapshot_rv = int(doc.get("rv", 0))
+                self.snapshot_at = doc["saved_at"]
+                # the snapshot covers every event up to its rv: the
+                # durable horizon jumps there, releasing the events
+                # that were stuck behind the poisoned WAL
+                self._tail_rv = max(self._tail_rv, self.snapshot_rv)
+                self.synced_rv = self._tail_rv
+                was = self.poisoned
+                self.poisoned = ""
+            for seg in frozen:
+                try:
+                    os.remove(seg)
+                except OSError:
+                    log.warning("could not remove poisoned WAL %s", seg)
+            metrics.set_gauge("server_readonly", 0.0)
+            metrics.inc("server_snapshot_total")
+            log.warning("store HEALED (was read-only: %s): fresh "
+                        "segment probed, full snapshot at rv %d, "
+                        "writable again", was, self.snapshot_rv)
+            return True
 
     # -- compaction ----------------------------------------------------
 
@@ -435,18 +745,35 @@ class DurableStore:
         with self._snap_lock:
             t0 = time.perf_counter()
             with self._lock:
-                self._file.flush()
-                os.fsync(self._file.fileno())
+                if self.poisoned:
+                    # no compaction while read-only: heal() owns the
+                    # recovery snapshot (fsyncing the poisoned file
+                    # here would be exactly the forbidden retry)
+                    raise ReadOnlyError(self.poisoned)
+                try:
+                    self.vfs.fsync(self._file)
+                except OSError as e:
+                    self._poison(f"fsync:{getattr(e, 'strerror', e)}")
+                    raise ReadOnlyError(self.poisoned) from None
                 self.synced_rv = self._tail_rv
                 frozen = self._segments()
                 self._open_segment_locked()
                 self._appended = self._synced_marker = 0
                 self.wal_records = 0
                 self.wal_bytes = 0
+                # seq horizon AT THE FREEZE, under the same lock hold:
+                # everything <= frozen_seq is in the frozen segments
+                # the capture() below covers.  Reading self._seq after
+                # capture would fold in records appended to the NEW
+                # live segment in the meantime — recovery would then
+                # skip them as "covered" while the snapshot lacks
+                # them: a silently lost acked write.
+                frozen_seq = self._seq
 
             doc = capture()
             doc["format"] = SNAPSHOT_FORMAT
             doc["saved_at"] = time.time()
+            doc["wal_seq"] = frozen_seq
             atomic_write_json(os.path.join(self.dir, SNAPSHOT_FILE),
                               doc)
             with self._lock:
@@ -473,6 +800,7 @@ class DurableStore:
                 "dir": self.dir,
                 "wal_records": self.wal_records,
                 "wal_bytes": self.wal_bytes,
+                "wal_seq": self._seq,
                 "synced_rv": self.synced_rv,
                 "snapshot_rv": self.snapshot_rv,
                 "snapshot_age_s": (round(time.time() - self.snapshot_at, 3)
@@ -480,6 +808,7 @@ class DurableStore:
                 "last_fsync_s": round(self.last_fsync_s, 6),
                 "replay_records": self.replay_records,
                 "replay_seconds": round(self.replay_seconds, 4),
+                "readonly": self.poisoned,
             }
         metrics.set_gauge("server_wal_records", st["wal_records"])
         metrics.set_gauge("server_wal_bytes", st["wal_bytes"])
@@ -488,7 +817,11 @@ class DurableStore:
     def close(self) -> None:
         with self._lock:
             if self._file is not None:
-                self._file.flush()
-                os.fsync(self._file.fileno())
+                if not self.poisoned:
+                    try:
+                        self.vfs.fsync(self._file)
+                    except OSError as e:
+                        self._poison(
+                            f"fsync:{getattr(e, 'strerror', e)}")
                 self._file.close()
                 self._file = None

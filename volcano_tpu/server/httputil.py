@@ -27,9 +27,16 @@ def read_json_body(resp):
     return json.loads(body)
 
 
-def json_response(handler, code: int, payload) -> None:
+def json_response(handler, code: int, payload, headers=None,
+                  trickle_ms: float = 0.0) -> None:
     """Write a JSON response; a client that went away mid-response
     (killed scheduler, cancelled watch) is routine, not an error.
+
+    headers: extra response headers (e.g. Retry-After on the read-only
+    degrade 503s).  trickle_ms > 0 is the injected slow-loris fault:
+    the body dribbles out in tiny chunks with that gap between them —
+    a complete but pathologically slow response, the gray-failure
+    shape timeouts exist for.
 
     Large SUCCESS bodies are gzip-compressed when the client
     advertised `Accept-Encoding: gzip` — snapshot/watch payloads are
@@ -52,8 +59,21 @@ def json_response(handler, code: int, payload) -> None:
         if encoding:
             handler.send_header("Content-Encoding", encoding)
         handler.send_header("Content-Length", str(len(body)))
+        for name, value in (headers or {}).items():
+            handler.send_header(name, str(value))
         handler.end_headers()
-        handler.wfile.write(body)
+        if trickle_ms > 0:
+            import time as _time
+            # first ~1KB in 64-byte sips, the rest in one gulp: slow
+            # enough to exercise client timeouts, bounded enough that
+            # a patient client still completes
+            for i in range(0, min(len(body), 1024), 64):
+                handler.wfile.write(body[i:i + 64])
+                handler.wfile.flush()
+                _time.sleep(trickle_ms / 1000.0)
+            handler.wfile.write(body[min(len(body), 1024):])
+        else:
+            handler.wfile.write(body)
     except (BrokenPipeError, ConnectionResetError):
         handler.close_connection = True
 

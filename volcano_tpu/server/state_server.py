@@ -48,13 +48,26 @@ TRACE_RING = 512         # kept scheduler session traces (GET /traces)
 def _error_code(e: Exception) -> int:
     """Exception -> wire status, the same mapping do_POST applies to
     whole-request failures (reused for per-item /bind_batch verdicts):
-    missing object 404, admission veto 422, conflict 409, else 500."""
+    missing object 404, admission veto 422, conflict 409, read-only
+    degrade 503, else 500."""
+    from volcano_tpu.server.durability import ReadOnlyError
+    if isinstance(e, ReadOnlyError):
+        return 503
     if isinstance(e, KeyError):
         return 404
     if isinstance(e, ValueError):
         from volcano_tpu.webhooks.admission import AdmissionError
         return 422 if isinstance(e, AdmissionError) else 409
     return 500
+
+
+# what a read-only (degraded) server still answers on POST: leases
+# (leader election must keep working through a full disk — in-memory,
+# journaling resumes at heal) and traces (never durable anyway).
+# Everything else mutates the store and CANNOT be made durable, so it
+# gets 503 + Retry-After instead of an un-durable ack.
+READONLY_OK_POSTS = frozenset({"/lease", "/trace"})
+RETRY_AFTER_S = 1
 
 
 class Lease:
@@ -139,6 +152,20 @@ class StateServer:
         self._audit: collections.deque = collections.deque(maxlen=AUDIT_RING)
         self._audit_idx = 0
         self._audit_enabled = False
+        # chip-overcommit guard (found by tools/chaos_conductor.py:
+        # under sustained ack-lost faults a scheduler whose bind acks
+        # died un-assumes the gang, and its stale mirror re-allocates
+        # chips the server already committed to another gang — the
+        # trusted-scheduler design needs an apiserver-side backstop).
+        # The maps are event-sourced: _on_store_event keeps them in
+        # O(1) per pod/node event, so validation never scans the
+        # store; _bind_mutex makes check-and-bind atomic across
+        # concurrent handler threads.
+        self._bind_mutex = threading.Lock()
+        self._pod_chips: Dict[str, tuple] = {}   # pod key -> (node, chips)
+        self._chips_used: Dict[str, float] = {}  # node -> bound chips
+        self._node_chip_cap: Dict[str, float] = {}
+        self._rebuild_chip_maps()
         # scheduler session traces (trace.py docs): in-memory ring,
         # deliberately NOT journaled — across a crash it resets
         # cleanly with the new epoch (clients see the epoch change and
@@ -156,6 +183,96 @@ class StateServer:
 
     # -- event log -----------------------------------------------------
 
+    def _rebuild_chip_maps(self) -> None:
+        from volcano_tpu.api.resource import TPU
+        from volcano_tpu.api.types import TaskStatus
+        self._pod_chips.clear()
+        self._chips_used.clear()
+        self._node_chip_cap.clear()
+        for name, node in self.cluster.nodes.items():
+            cap = float((getattr(node, "allocatable", None) or {})
+                        .get(TPU, 0) or 0)
+            if cap > 0:
+                self._node_chip_cap[name] = cap
+        for key, pod in self.cluster.pods.items():
+            if pod.node_name and pod.phase in (TaskStatus.BOUND,
+                                               TaskStatus.RUNNING):
+                chips = float(pod.resource_requests().get(TPU) or 0)
+                if chips > 0:
+                    self._pod_chips[key] = (pod.node_name, chips)
+                    self._chips_used[pod.node_name] = \
+                        self._chips_used.get(pod.node_name, 0.0) + chips
+
+    def _track_chips(self, kind: str, obj) -> None:
+        """O(1) per-event maintenance of the overcommit-guard maps
+        (caller holds the event lock)."""
+        from volcano_tpu.api.resource import TPU
+        from volcano_tpu.api.types import TaskStatus
+        if kind == "node":
+            cap = float((getattr(obj, "allocatable", None) or {})
+                        .get(TPU, 0) or 0)
+            if cap > 0:
+                self._node_chip_cap[obj.name] = cap
+            else:
+                self._node_chip_cap.pop(obj.name, None)
+            return
+        if kind == "node_deleted":
+            self._node_chip_cap.pop(obj.name, None)
+            return
+        if kind not in ("pod", "pod_deleted"):
+            # a podgroup/vcjob shares the ns/name key space: letting
+            # its events touch the pod map would silently disarm the
+            # guard on a key collision
+            return
+        key = getattr(obj, "key", None)
+        if key is None:
+            return
+        old = self._pod_chips.pop(key, None)
+        if old is not None:
+            node, chips = old
+            left = self._chips_used.get(node, 0.0) - chips
+            if left > 1e-9:
+                self._chips_used[node] = left
+            else:
+                self._chips_used.pop(node, None)
+        if kind == "pod" and obj.node_name and \
+                obj.phase in (TaskStatus.BOUND, TaskStatus.RUNNING):
+            chips = float(obj.resource_requests().get(TPU) or 0)
+            if chips > 0:
+                self._pod_chips[key] = (obj.node_name, chips)
+                self._chips_used[obj.node_name] = \
+                    self._chips_used.get(obj.node_name, 0.0) + chips
+
+    def check_bind_capacity(self, namespace: str, name: str,
+                            node_name: str) -> Optional[str]:
+        """The apiserver-side overcommit backstop: would binding this
+        pod exceed the node's chip allocatable?  Returns the refusal
+        message, or None when the bind is safe (re-binding a pod to
+        the node it already occupies stays idempotent).  Callers hold
+        _bind_mutex so check-and-bind is atomic."""
+        from volcano_tpu.api.resource import TPU
+        key = f"{namespace}/{name}"
+        pod = self.cluster.pods.get(key)
+        if pod is None:
+            return None           # bind_pod will 404 with the details
+        chips = float(pod.resource_requests().get(TPU) or 0)
+        if chips <= 0:
+            return None           # cpu-only pods are not chip-guarded
+        with self._lock:
+            cap = self._node_chip_cap.get(node_name)
+            if cap is None:
+                return None       # no chips on the node to guard
+            prev = self._pod_chips.get(key)
+            if prev is not None and prev[0] == node_name:
+                return None       # idempotent re-bind, already counted
+            used = self._chips_used.get(node_name, 0.0)
+            if used + chips > cap + 1e-9:
+                return (f"bind overcommit: node {node_name} has "
+                        f"{used:g}/{cap:g} chips bound; refusing "
+                        f"+{chips:g} for {key} (stale scheduler "
+                        "view?)")
+        return None
+
     def _on_store_event(self, kind: str, obj) -> None:
         try:
             payload = codec.encode(obj)
@@ -163,6 +280,7 @@ class StateServer:
             log.exception("unencodable %s event dropped", kind)
             return
         with self._event_cv:
+            self._track_chips(kind, obj)
             self._rv += 1
             self._events.append((self._rv, kind, payload))
             if self.durable is not None:
@@ -190,12 +308,35 @@ class StateServer:
     def commit(self) -> None:
         """Durability barrier before an ack: fsync everything appended
         so far (group commit — one fsync covers concurrent handlers),
-        then wake watchers gated on the synced horizon."""
+        then wake watchers gated on the synced horizon.
+
+        Raises durability.ReadOnlyError when the store is poisoned
+        (failed fsync / full disk): the caller must 503 instead of
+        acking state that cannot be made durable."""
         if self.durable is None:
             return
         self.durable.commit()
         with self._event_cv:
             self._event_cv.notify_all()
+
+    @property
+    def readonly_reason(self) -> str:
+        """Non-empty while the store is degraded to read-only."""
+        if self.durable is None:
+            return ""
+        return self.durable.poisoned
+
+    def try_heal(self) -> bool:
+        """One heal attempt (fresh WAL segment + probe fsync + full
+        snapshot); wakes watchers on success — the durable horizon
+        jumped, releasing events stuck behind the poisoned WAL."""
+        if self.durable is None or not self.durable.poisoned:
+            return True
+        if not self.durable.heal(self.disk_snapshot_doc):
+            return False
+        with self._event_cv:
+            self._event_cv.notify_all()
+        return True
 
     def disk_snapshot_doc(self) -> dict:
         """The on-disk snapshot: /snapshot payload + leases (wall-
@@ -398,10 +539,69 @@ class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     state: StateServer = None          # injected by serve()
     token: str = ""                    # bearer token, all data routes
+    faults = None                      # faults.FaultPlan or None
 
     # quiet the default stderr access log
     def log_message(self, fmt, *args):  # noqa: N802
         log.debug("http: " + fmt, *args)
+
+    # -- fault injection (volcano_tpu/faults.py, site="server") -------
+
+    def _wire_fault(self, allowed=None):
+        """Consult the fault plan once per request.  Pre-response
+        kinds are applied HERE (delay/reorder park, 503, reset,
+        drop_request); kinds that act at response time (duplicate,
+        drop_response, trickle) return the rule for the route methods
+        to honour.  allowed narrows to the kinds THIS method can
+        express (GET cannot meaningfully duplicate) so a rule's
+        injection budget is never burned on a request that can't
+        apply it — the fault_injected_total counts stay honest."""
+        plan = self.faults
+        if plan is None:
+            return None
+        rule = plan.decide("server", urlparse(self.path).path,
+                           kinds=allowed)
+        if rule is None:
+            return None
+        kind = rule.kind
+        if kind == "delay":
+            time.sleep((rule.ms or 50.0) / 1000.0)
+            return None
+        if kind == "reorder":
+            plan.reorder_park((rule.ms or 150.0) / 1000.0)
+            return None
+        if kind == "http_503":
+            self._json(503, {"error": "injected fault: 503"},
+                       headers={"Retry-After": RETRY_AFTER_S})
+            return "handled"
+        if kind in ("reset", "drop_request"):
+            if kind == "drop_request":
+                # drain the body first: the request is READ then
+                # dropped on the floor (never processed) — distinct
+                # from reset, which cuts the connection mid-send
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    self.rfile.read(length)
+            else:
+                try:
+                    import socket as _socket
+                    import struct
+                    # RST instead of FIN on close
+                    self.connection.setsockopt(
+                        _socket.SOL_SOCKET, _socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+                except OSError:
+                    pass
+            self.close_connection = True
+            return "handled"
+        return rule        # drop_response / duplicate / trickle
+
+    def _readonly_503(self, reason: str):
+        return self._json(503, {
+            "error": f"store is read-only ({reason}); the server "
+                     "degrades instead of acking un-durable state",
+            "readonly": True},
+            headers={"Retry-After": RETRY_AFTER_S})
 
     def _authorized(self) -> bool:
         """Every data route — reads included — requires the cluster
@@ -417,9 +617,11 @@ class _Handler(BaseHTTPRequestHandler):
         self._json(401, {"error": "missing or invalid bearer token"})
         return False
 
-    def _json(self, code: int, payload) -> None:
+    def _json(self, code: int, payload, headers=None,
+              trickle_ms: float = 0.0) -> None:
         from volcano_tpu.server.httputil import json_response
-        json_response(self, code, payload)
+        json_response(self, code, payload, headers=headers,
+                      trickle_ms=trickle_ms)
 
     def _body(self) -> dict:
         length = int(self.headers.get("Content-Length") or 0)
@@ -437,15 +639,46 @@ class _Handler(BaseHTTPRequestHandler):
         if url.path == "/metrics":
             from volcano_tpu import metrics
             return metrics.write_exposition(self)
+        fault = self._wire_fault(allowed=(
+            "drop_request", "drop_response", "delay", "reorder",
+            "http_503", "reset", "trickle"))
+        if fault == "handled":
+            return None
+        if fault is not None and fault.kind == "drop_response":
+            # a read has no side effects to commit: its lost response
+            # is indistinguishable from a dropped request — cut now
+            self.close_connection = True
+            return None
+        trickle = fault.ms or 20.0 if fault is not None \
+            and fault.kind == "trickle" else 0.0
         if not self._authorized():
             return None
         if url.path == "/snapshot":
+            from volcano_tpu.server.durability import ReadOnlyError
+            if st.readonly_reason:
+                # the full dump would embed events the poisoned WAL
+                # never made durable — a mirror bootstrapping from it
+                # would hold state a crash un-happens.  Watch/delta
+                # reads stay up (they gate on the synced horizon);
+                # LISTs wait out the degrade.
+                return self._readonly_503(st.readonly_reason)
             payload = st.snapshot_payload()
             # fsync-before-serve: the captured state embeds events up
             # to payload["rv"]; committing them first means no mirror
             # ever bootstraps from state a crash could un-happen
-            st.commit()
-            return self._json(200, payload)
+            try:
+                st.commit()
+            except ReadOnlyError as e:
+                return self._readonly_503(e.reason)
+            return self._json(200, payload, trickle_ms=trickle)
+        if url.path == "/faults":
+            # the chaos engine's own observability: which rules have
+            # fired how often, and the seed that replays the run
+            if self.faults is None:
+                return self._json(200, {"active": False})
+            return self._json(200, {
+                "active": True, "seed": self.faults.seed,
+                "rules": self.faults.status()})
         if url.path == "/durability":
             return self._json(200, st.durability_status())
         if url.path == "/leases":
@@ -469,7 +702,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(200, {
                 "rv": rv, "resync": resync, "epoch": st.epoch,
                 "events": [{"rv": r, "kind": k, "obj": o}
-                           for r, k, o in events]})
+                           for r, k, o in events]},
+                trickle_ms=trickle)
         if url.path == "/bandwidth":
             # per-node DCN accounting reports (api/netusage.py), the
             # GET-route view of what the agents measured; ?node=
@@ -506,6 +740,9 @@ class _Handler(BaseHTTPRequestHandler):
     # -- POST ----------------------------------------------------------
 
     def do_POST(self):  # noqa: N802
+        fault = self._wire_fault()
+        if fault == "handled":
+            return None
         if not self._authorized():
             return None
         url = urlparse(self.path)
@@ -514,6 +751,67 @@ class _Handler(BaseHTTPRequestHandler):
             body = self._body()
         except (ValueError, json.JSONDecodeError) as e:
             return self._json(400, {"error": str(e)})
+        # read-only degrade gate: while the WAL is poisoned nothing
+        # can be made durable, so mutation routes are refused UP FRONT
+        # (503 + Retry-After) before they touch the in-memory store —
+        # memory and disk must not drift apart under a full disk.
+        # Leases and traces stay served (READONLY_OK_POSTS).
+        if st.readonly_reason and url.path not in READONLY_OK_POSTS:
+            return self._readonly_503(st.readonly_reason)
+        if fault is not None and fault.kind == "duplicate":
+            # the in-network duplicated request: the same body is
+            # delivered twice, back to back.  The first delivery runs
+            # the full pipeline (its ack is discarded — the network
+            # "kept" the duplicate); the answer below comes from the
+            # second.  Idempotency keys make the pair collapse to one
+            # application; unkeyed mutations must be state-compare
+            # safe — exactly what this fault exists to prove.
+            import copy
+            self._process_post(url.path, copy.deepcopy(body), st)
+        code, payload, _req_id = self._process_post(url.path, body, st)
+        # durability barrier BEFORE the ack: every event this request
+        # caused (and its idempotency record) is fsync'd in the WAL —
+        # the journals-before-acking contract the reference gets from
+        # etcd
+        from volcano_tpu.server.durability import ReadOnlyError
+        try:
+            st.commit()
+        except ReadOnlyError as e:
+            if url.path in READONLY_OK_POSTS:
+                # leases/traces keep serving from memory through the
+                # degrade: their journal records are dropped (state
+                # re-captured wholesale at heal), and leader election
+                # must not stall on a full disk
+                pass
+            else:
+                # the mutation applied in memory but cannot be made
+                # durable YET: 503, never ack.  The recorded
+                # idempotency verdict is deliberately KEPT — it and
+                # the in-memory state share fate exactly: heal()'s
+                # full snapshot persists both together (the retry
+                # then replays the verdict for state that IS
+                # durable), while a crash before heal loses both
+                # together (the retry re-applies for real).
+                # Forgetting the verdict here would double-apply
+                # non-idempotent mutations after a heal: the command
+                # the 503'd attempt left in memory becomes durable,
+                # and the retry — finding no recorded verdict —
+                # appends a second one.
+                return self._readonly_503(e.reason)
+        if fault is not None and fault.kind == "drop_response":
+            # the ack-lost case: committed, durable, and the client
+            # will never know — its retry (idempotency key or
+            # state-compare) must converge, not double-apply
+            self.close_connection = True
+            return None
+        trickle = fault.ms or 20.0 if fault is not None \
+            and fault.kind == "trickle" else 0.0
+        return self._json(code, payload, trickle_ms=trickle)
+
+    def _process_post(self, path: str, body, st) -> tuple:
+        """Route one POST body: idempotency replay, dispatch, verdict
+        recording.  Returns (code, payload, req_id) — commit/ack is
+        the caller's job."""
         # idempotency key: a retried mutation whose first attempt
         # committed (crash/partition between commit and ack) must get
         # the recorded verdict back, never double-apply — the replay-
@@ -525,9 +823,9 @@ class _Handler(BaseHTTPRequestHandler):
         if req_id:
             hit = st.replay_response(req_id)
             if hit is not None:
-                return self._json(hit[0], hit[1])
+                return hit[0], hit[1], None
         try:
-            code, payload = self._route_post(url.path, body, st)
+            code, payload = self._route_post(path, body, st)
         except KeyError as e:
             code, payload = 404, {"error": str(e)}
         except ValueError as e:
@@ -535,19 +833,14 @@ class _Handler(BaseHTTPRequestHandler):
             # _error_code): admission veto 422, conflict 409
             code, payload = _error_code(e), {"error": str(e)}
         except Exception as e:  # noqa: BLE001 — surface, don't kill thread
-            log.exception("POST %s failed", url.path)
+            log.exception("POST %s failed", path)
             code, payload = 500, {"error": str(e)}
         if req_id and code < 500:
             # 4xx verdicts are deterministic state-compare outcomes:
             # recording them keeps a retry's answer stable; 5xx is a
             # server fault the retry should re-attempt for real
             st.record_response(req_id, code, payload)
-        # durability barrier BEFORE the ack: every event this request
-        # caused (and its idempotency record) is fsync'd in the WAL —
-        # the journals-before-acking contract the reference gets from
-        # etcd
-        st.commit()
-        return self._json(code, payload)
+        return code, payload, req_id
 
     def _route_post(self, path: str, body: dict, st) -> tuple:
         cl = st.cluster
@@ -560,9 +853,14 @@ class _Handler(BaseHTTPRequestHandler):
             stored = cl.put_object(kind, obj, key=key)
             return 200, {"obj": codec.encode(stored)}
         if path == "/bind":
-            cl.bind_pod(body["namespace"], body["name"],
-                        body["node_name"],
-                        ts_alloc=body.get("ts_alloc"))
+            with st._bind_mutex:
+                err = st.check_bind_capacity(
+                    body["namespace"], body["name"], body["node_name"])
+                if err:
+                    raise ValueError(err)       # -> 409
+                cl.bind_pod(body["namespace"], body["name"],
+                            body["node_name"],
+                            ts_alloc=body.get("ts_alloc"))
             return 200, {"ok": True}
         if path == "/bind_batch":
             # a gang's binds as ONE request (the wire fast lane's
@@ -575,17 +873,22 @@ class _Handler(BaseHTTPRequestHandler):
             # 409.
             results = []
             bound = 0
-            for b in body.get("binds", []):
-                try:
-                    cl.bind_pod(b["namespace"], b["name"],
-                                b["node_name"],
-                                ts_alloc=b.get("ts_alloc"))
-                    results.append({"ok": True})
-                    bound += 1
-                except Exception as e:  # noqa: BLE001 — per-item
-                    results.append({
-                        "ok": False, "code": _error_code(e),
-                        "error": str(e) or type(e).__name__})
+            with st._bind_mutex:
+                for b in body.get("binds", []):
+                    try:
+                        err = st.check_bind_capacity(
+                            b["namespace"], b["name"], b["node_name"])
+                        if err:
+                            raise ValueError(err)   # -> 409 per-item
+                        cl.bind_pod(b["namespace"], b["name"],
+                                    b["node_name"],
+                                    ts_alloc=b.get("ts_alloc"))
+                        results.append({"ok": True})
+                        bound += 1
+                    except Exception as e:  # noqa: BLE001 — per-item
+                        results.append({
+                            "ok": False, "code": _error_code(e),
+                            "error": str(e) or type(e).__name__})
             return 200, {"bound": bound, "results": results}
         if path == "/evict":
             cl.evict_pod(body["namespace"], body["name"],
@@ -641,9 +944,16 @@ class _Handler(BaseHTTPRequestHandler):
     # -- DELETE --------------------------------------------------------
 
     def do_DELETE(self):  # noqa: N802
+        fault = self._wire_fault(allowed=(
+            "drop_request", "drop_response", "delay", "reorder",
+            "http_503", "reset"))
+        if fault == "handled":
+            return None
         if not self._authorized():
             return None
         url = urlparse(self.path)
+        if self.state.readonly_reason:
+            return self._readonly_503(self.state.readonly_reason)
         if not url.path.startswith("/objects/"):
             return self._json(404, {"error": f"no route {url.path}"})
         kind = url.path[len("/objects/"):]
@@ -653,14 +963,24 @@ class _Handler(BaseHTTPRequestHandler):
         if not key:
             return self._json(400, {"error": "missing key"})
         self.state.cluster.delete_object(kind, key)
-        self.state.commit()
+        from volcano_tpu.server.durability import ReadOnlyError
+        try:
+            self.state.commit()
+        except ReadOnlyError as e:
+            return self._readonly_503(e.reason)
+        if fault is not None and fault.kind == "drop_response":
+            # the ack-lost delete: committed, never told — a retried
+            # DELETE of a gone key is a no-op, so it must converge
+            self.close_connection = True
+            return None
         return self._json(200, {"ok": True})
 
 
 def serve(port: int = 0, cluster: Optional[FakeCluster] = None,
           tick_period: float = 0.0, tls_cert: str = "",
           tls_key: str = "", token: str = "", data_dir: str = "",
-          durable=None) -> Tuple[ThreadingHTTPServer, StateServer]:
+          durable=None, faults=None, wal_force_truncate: bool = False
+          ) -> Tuple[ThreadingHTTPServer, StateServer]:
     """Start the server on 127.0.0.1:port (0 = ephemeral); returns
     (http_server, state).  Caller runs http_server.serve_forever()
     or uses the background thread started here.  tls_cert/tls_key
@@ -668,13 +988,24 @@ def serve(port: int = 0, cluster: Optional[FakeCluster] = None,
     /healthz and /metrics.  data_dir (or a pre-built DurableStore via
     durable=) turns on the WAL + snapshot crash-safety layer: every
     mutation is journaled and fsync'd before its ack, and boot replays
-    snapshot-then-WAL."""
+    snapshot-then-WAL.  faults (a faults.FaultPlan) arms the chaos
+    engine: per-route wire faults at this handler, disk faults on the
+    WAL via a FaultyVFS, clock skew installed by the caller.
+    wal_force_truncate is the explicit operator override for mid-WAL
+    corruption (otherwise boot refuses with WALCorruptionError)."""
+    from volcano_tpu import faults as faults_mod
     from volcano_tpu.server.httputil import serve_threaded
     if durable is None and data_dir:
         from volcano_tpu.server.durability import DurableStore
-        durable = DurableStore(data_dir)
+        vfs = None
+        if faults is not None and any(r.site == "disk"
+                                      for r in faults.rules):
+            vfs = faults_mod.FaultyVFS(faults)
+        durable = DurableStore(data_dir, vfs=vfs,
+                               force_truncate=wal_force_truncate)
     state = StateServer(cluster, durable=durable)
-    httpd = serve_threaded(_Handler, {"state": state, "token": token},
+    httpd = serve_threaded(_Handler, {"state": state, "token": token,
+                                      "faults": faults},
                            port, "state-server",
                            tls_cert=tls_cert, tls_key=tls_key)
     state.tick_stop = threading.Event()
@@ -682,6 +1013,12 @@ def serve(port: int = 0, cluster: Optional[FakeCluster] = None,
         def tick_loop():
             while not state.tick_stop.wait(tick_period):
                 try:
+                    if state.readonly_reason:
+                        # no kubelet mutations while read-only: their
+                        # journal records would be dropped, and memory
+                        # must not drift from what heal() can capture
+                        # consistently
+                        continue
                     state.cluster.tick()
                     # tick mutations have no ack path; commit here so
                     # they become watch-visible (and durable) promptly
@@ -695,7 +1032,12 @@ def serve(port: int = 0, cluster: Optional[FakeCluster] = None,
             while not state.tick_stop.wait(0.5):
                 try:
                     durable.status()    # refreshes the WAL gauges
-                    if durable.should_snapshot():
+                    if durable.poisoned:
+                        # read-only degrade: keep probing for heal —
+                        # Retry-After tells clients to check back on
+                        # roughly this cadence
+                        state.try_heal()
+                    elif durable.should_snapshot():
                         state.write_snapshot()
                 except Exception:  # noqa: BLE001
                     log.exception("snapshot compaction failed")
