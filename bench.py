@@ -2528,6 +2528,195 @@ def chaos_smoke() -> int:
 
 
 # ---------------------------------------------------------------------
+# Replicated control plane (server/replication.py): WAL-shipped
+# follower reads, quorum commit, kill-promote.  The tier-1 smoke runs
+# leader + 1 follower as real OS processes (~20s): continuous keyed
+# writes and follower reads, SIGKILL the leader mid-burst, the
+# follower promotes, the multi-endpoint client re-routes, the deposed
+# leader rejoins as a follower — zero acked writes lost, follower
+# reads continuous throughout.  The full matrix + read-QPS scaling
+# lives in tools/chaos_conductor.py --classes replication
+# (CONTROL_r{N}.json).
+
+def bench_replication_smoke() -> dict:
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from tools import chaoslib
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.simulator import slice_nodes
+
+    logdir = tempfile.mkdtemp(prefix="repl-smoke-")
+    ports = [chaoslib.free_port(), chaoslib.free_port()]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    dirs = [os.path.join(logdir, f"state-{i}") for i in range(2)]
+    zoo = chaoslib.ProcessZoo(logdir)
+    out = {}
+    kubectl = None
+    reader_stop = threading.Event()
+    try:
+        # 2-node lab group: commit quorum 2 (every ack durable on BOTH
+        # replicas — which is what makes the lone survivor's promotion
+        # lossless), election quorum 1 (a 2-node group cannot form an
+        # election majority; see docs/design/replication.md on the
+        # split-brain tradeoff this accepts)
+        chaoslib.spawn_replica(zoo, "leader", ports[0], dirs[0], "r1",
+                               [urls[1]], commit_quorum=2,
+                               election_quorum=1)
+        chaoslib.wait_server(urls[0])
+        chaoslib.spawn_replica(zoo, "follower", ports[1], dirs[1],
+                               "r2", [urls[0]], replicate_from=urls[0],
+                               commit_quorum=2, election_quorum=1)
+        chaoslib.wait_server(urls[1])
+        chaoslib.wait_role(urls[0], "leader")
+        chaoslib.wait_role(urls[1], "follower")
+
+        kubectl = RemoteCluster(",".join(urls), start_watch=False)
+        node_names = []
+        for node in slice_nodes(slice_for("sa", "v5e-16"),
+                                dcn_pod="d0"):
+            kubectl.add_node(node)
+            node_names.append(node.name)
+        chaoslib.wait_follower_caught_up(urls[1], urls[0])
+
+        # follower reads, continuously, on a dedicated thread: the
+        # max gap between successful reads is the availability number
+        # the whole exercise is about
+        read_ok = [0]
+        read_fail = [0]
+        read_gaps = []
+        last_ok = [time.monotonic()]
+
+        def reader():
+            while not reader_stop.is_set():
+                try:
+                    with urllib.request.urlopen(
+                            urls[1] + "/durability", timeout=2) as r:
+                        json.loads(r.read())
+                    now = time.monotonic()
+                    read_gaps.append(now - last_ok[0])
+                    last_ok[0] = now
+                    read_ok[0] += 1
+                except OSError:
+                    read_fail[0] += 1
+                time.sleep(0.05)
+
+        threading.Thread(target=reader, daemon=True).start()
+
+        # keyed write burst: pods created + gang-bound through the
+        # multi-endpoint client, acks recorded; the SIGKILL lands
+        # mid-burst and the client must re-route to the promoted
+        # follower without double-applying (idempotency keys ship in
+        # the WAL, so the new leader replays recorded verdicts)
+        acked = {}
+        stop_mark = [float("inf")]
+        t_kill_holder = [None]
+        acks_after_kill = [0]
+
+        def burst():
+            i = 0
+            while time.monotonic() < stop_mark[0]:
+                names = [f"rp{i + j}" for j in range(8)]
+                i += 8
+                try:
+                    for name in names:
+                        pod = make_pod("t", requests={"cpu": 1})
+                        pod.name, pod.namespace = name, "default"
+                        kubectl.put_object("pod", pod)
+                    binds = [("default", n,
+                              node_names[(i + j) % len(node_names)])
+                             for j, n in enumerate(names)]
+                    errs = kubectl.bind_pods(binds)
+                except Exception:  # noqa: BLE001 — failover window
+                    continue
+                for (ns, n, node), err in zip(binds, errs):
+                    if err is None:
+                        acked[f"{ns}/{n}"] = node
+                        if t_kill_holder[0] is not None:
+                            acks_after_kill[0] += 1
+
+        burster = threading.Thread(target=burst)
+        burster.start()
+        time.sleep(3.0)
+        acked_before_kill = len(acked)
+        t_kill = time.monotonic()
+        t_kill_holder[0] = t_kill
+        zoo.kill9("leader")
+        chaoslib.wait_role(urls[1], "leader", timeout=30)
+        promote_s = time.monotonic() - t_kill
+        # the deposed leader rejoins as a follower over its old dir:
+        # its term is stale, so the tail forces the full re-sync
+        chaoslib.spawn_replica(zoo, "leader-rejoin", ports[0],
+                               dirs[0], "r1", [urls[1]],
+                               replicate_from="auto", commit_quorum=2,
+                               election_quorum=1)
+        chaoslib.wait_server(urls[0])
+        chaoslib.wait_role(urls[0], "follower", timeout=30)
+        stop_mark[0] = time.monotonic() + 3.0
+        burster.join(timeout=60)
+        reader_stop.set()
+
+        # ground truth off the promoted leader: every acked bind
+        # exactly as acked — nothing lost, nothing moved
+        truth = _snapshot_stores(urls[1])
+        lost = [k for k, node in acked.items()
+                if k not in truth["pod"]
+                or truth["pod"][k].node_name != node]
+        chaoslib.wait_follower_caught_up(urls[0], urls[1])
+        rejoin = chaoslib.replication_status(urls[0]) or {}
+        out = {
+            "acked_binds": len(acked),
+            "acked_before_kill": acked_before_kill,
+            "acked_after_promote": acks_after_kill[0],
+            "acked_lost": len(lost),
+            "lost_sample": lost[:5],
+            "promote_s": round(promote_s, 3),
+            "follower_reads_ok": read_ok[0],
+            "follower_reads_failed": read_fail[0],
+            "follower_read_gap_max_s": round(max(read_gaps), 3)
+            if read_gaps else None,
+            "rejoin_role": rejoin.get("role"),
+            "rejoin_bootstraps": rejoin.get("bootstraps"),
+            "new_leader_term": (chaoslib.replication_status(urls[1])
+                                or {}).get("term"),
+        }
+        out["ok"] = (
+            out["acked_lost"] == 0
+            and out["acked_before_kill"] > 0
+            and out["acked_after_promote"] > 0
+            and out["promote_s"] < 20
+            and out["follower_reads_ok"] > 0
+            and out["follower_reads_failed"] == 0
+            and out["rejoin_role"] == "follower")
+        return out
+    finally:
+        reader_stop.set()
+        if kubectl is not None:
+            kubectl.close()
+        zoo.terminate_all()
+        shutil.rmtree(logdir, ignore_errors=True)
+
+
+def replication_smoke() -> int:
+    """Leader + 1 follower + kill-promote through real OS processes
+    for tier-1 (~20s), mirroring --crash-smoke: zero acked writes
+    lost across the promotion, continuous follower reads, the deposed
+    leader re-syncs back in.  Prints one JSON line."""
+    try:
+        out = bench_replication_smoke()
+        ok = out.get("ok", False)
+    except AssertionError as e:
+        out, ok = {"error": str(e)[-600:]}, False
+    print(json.dumps({"metric": "replication_smoke", "ok": ok, **out}))
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------
 # Scheduling flight recorder: per-phase latency attribution through
 # the REAL process control plane (volcano_tpu/trace.py).  Gang jobs
 # run create->running over the wire; every lifecycle stamp is read
@@ -3212,6 +3401,8 @@ if __name__ == "__main__":
         sys.exit(crash_smoke())
     elif "--chaos-smoke" in sys.argv:
         sys.exit(chaos_smoke())
+    elif "--replication-smoke" in sys.argv:
+        sys.exit(replication_smoke())
     elif "--trace-smoke" in sys.argv:
         sys.exit(trace_smoke())
     elif "--trace" in sys.argv:

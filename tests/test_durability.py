@@ -734,6 +734,243 @@ def test_fsync_eio_never_retried_degrades_then_heals(tmp_path):
     assert st2._rv == st._rv
 
 
+# -- WAL shipping edge matrix (replication, ISSUE 12) ------------------
+
+
+def _replicated_pair(tmp_path, leader_dir="ld", follower_dir="fd",
+                     seed_objects=0):
+    """An in-process leader + follower over real HTTP (serve threads):
+    returns (leader_httpd, leader_state, leader_repl, url)."""
+    from volcano_tpu.server.durability import DurableStore
+    from volcano_tpu.server.replication import Replication
+    from volcano_tpu.server.state_server import serve
+
+    repl = Replication("r1", commit_quorum=1)
+    httpd, st = serve(port=0,
+                      durable=DurableStore(str(tmp_path / leader_dir)),
+                      replication=repl)
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    for i in range(seed_objects):
+        pod = make_pod("t", requests={"cpu": 1})
+        pod.name, pod.namespace = f"s{i}", "default"
+        st.cluster.add_pod(pod)
+    st.commit()
+    return httpd, st, repl, url
+
+
+def _spawn_follower(tmp_path, url, name="fd", rid="r2"):
+    from volcano_tpu.server.durability import DurableStore
+    from volcano_tpu.server.replication import Replication
+    from volcano_tpu.server.state_server import serve
+
+    repl = Replication(rid, peers=[url], replicate_from=url,
+                       commit_quorum=1)
+    httpd, st = serve(port=0,
+                      durable=DurableStore(str(tmp_path / name)),
+                      replication=repl)
+    return httpd, st, repl
+
+
+def test_follower_behind_horizon_bootstraps_then_tails(tmp_path):
+    """A follower whose position predates the leader's ship ring (a
+    compacted/rebooted leader — the ring is volatile) must bootstrap
+    from the replica snapshot, then TAIL: later writes arrive as
+    shipped records without another bootstrap."""
+    from volcano_tpu.server.durability import DurableStore
+    from volcano_tpu.server.state_server import StateServer
+
+    # history the follower will NOT find in any ship ring: written by
+    # a first leader incarnation, then recovered by a second (fresh
+    # ring, compacted horizon)
+    st0 = StateServer(durable=DurableStore(str(tmp_path / "ld")))
+    for i in range(20):
+        pod = make_pod("t", requests={"cpu": 1})
+        pod.name, pod.namespace = f"old{i}", "default"
+        st0.cluster.add_pod(pod)
+    st0.commit()
+    st0.write_snapshot()
+    st0.durable.close()
+
+    httpd, st, repl, url = _replicated_pair(tmp_path)
+    fhttpd = fst = frepl = None
+    try:
+        fhttpd, fst, frepl = _spawn_follower(tmp_path, url)
+        wait_for(lambda: len(fst.cluster.pods) == 20, 20,
+                 "follower bootstrapping the compacted history")
+        assert frepl.bootstraps == 1
+        # now the tail: new writes ship as records, no re-bootstrap
+        for i in range(5):
+            pod = make_pod("t", requests={"cpu": 1})
+            pod.name, pod.namespace = f"new{i}", "default"
+            st.cluster.add_pod(pod)
+        st.commit()
+        wait_for(lambda: len(fst.cluster.pods) == 25, 20,
+                 "follower tailing post-bootstrap writes")
+        assert frepl.bootstraps == 1, \
+            "tail traffic must not re-bootstrap"
+        assert fst.durable.synced_rv == st.durable.synced_rv
+    finally:
+        for h in (fhttpd, httpd):
+            if h is not None:
+                h.shutdown()
+        for r in (frepl, repl):
+            if r is not None:
+                r.stop()
+
+
+def test_term_mismatch_forces_full_resync(tmp_path):
+    """A deposed leader's replica (stale term, possibly a diverged
+    un-shipped tail) must NOT try to tail-merge: the term mismatch
+    forces the snapshot bootstrap, discarding its local segments for
+    the group's history."""
+    httpd_a, st_a, repl_a, url_a = _replicated_pair(tmp_path, "da",
+                                                    seed_objects=4)
+    fhttpd = fst = frepl = None
+    httpd_b = st_b = repl_b = None
+    try:
+        fhttpd, fst, frepl = _spawn_follower(tmp_path, url_a, "db",
+                                             "rb")
+        wait_for(lambda: len(fst.cluster.pods) == 4, 20,
+                 "follower synced to the first leader")
+        # the first leader dies; the follower promotes (term 2).
+        # Promotion FIRST: an in-flight long-poll against the dying
+        # leader must not ship the diverged record below (the tail
+        # loop discards a poll that lands after a role change).
+        httpd_a.shutdown()
+        httpd_a.server_close()
+        repl_a.stop()
+        frepl.promote(frepl.term + 1)
+        # the dead leader's diverged, never-shipped local tail
+        pod = make_pod("t", requests={"cpu": 1})
+        pod.name, pod.namespace = "diverged", "default"
+        st_a.cluster.add_pod(pod)
+        st_a.durable.commit()
+        st_a.durable.close()
+        url_b = f"http://127.0.0.1:{fhttpd.server_address[1]}"
+        for i in range(3):
+            pod = make_pod("t", requests={"cpu": 1})
+            pod.name, pod.namespace = f"b{i}", "default"
+            fst.cluster.add_pod(pod)
+        fst.commit()
+        # the deposed leader rejoins over its OLD dir as a follower
+        from volcano_tpu.server.durability import DurableStore
+        from volcano_tpu.server.replication import Replication
+        from volcano_tpu.server.state_server import serve
+        repl_b = Replication("r1", peers=[url_b],
+                             replicate_from=url_b, commit_quorum=1)
+        httpd_b, st_b = serve(
+            port=0, durable=DurableStore(str(tmp_path / "da")),
+            replication=repl_b)
+        wait_for(lambda: repl_b.term == frepl.term
+                 and len(st_b.cluster.pods) == 7, 20,
+                 "deposed leader full-resyncing at the new term")
+        assert repl_b.bootstraps >= 1
+        assert "default/diverged" not in st_b.cluster.pods, \
+            "the diverged un-shipped tail must be discarded"
+        assert st_b.epoch == fst.epoch
+    finally:
+        for h in (httpd_b, fhttpd, httpd_a):
+            if h is not None:
+                h.shutdown()
+        for r in (repl_b, frepl, repl_a):
+            if r is not None:
+                r.stop()
+
+
+def test_corrupt_shipped_record_refused_by_crc(tmp_path):
+    """A torn or bit-flipped shipped record is refused WHOLESALE by
+    the follower's per-record CRC/frame/sequence checks — never
+    silently applied, never partially applied."""
+    from volcano_tpu.server.durability import DurableStore, frame_record
+    from volcano_tpu.server.replication import ShippedCorruptionError
+    from volcano_tpu.server.state_server import StateServer
+    from volcano_tpu.api import codec
+
+    fst = StateServer(durable=DurableStore(str(tmp_path / "f")))
+    node = next(iter(slice_nodes(slice_for("sa", "v5e-4"),
+                                 dcn_pod="d0")))
+    good1 = frame_record({"rv": 1, "k": "node",
+                          "o": codec.encode(node)}, 1)
+    good2 = frame_record({"rv": 2, "k": "command",
+                          "o": {"target": "default/j", "action": "X",
+                                "cid": "c1"}}, 2)
+
+    # bit flip inside the payload: still a line, only the CRC knows
+    flipped = good2[:20] + chr(ord(good2[20]) ^ 0x04) + good2[21:]
+    with pytest.raises(ShippedCorruptionError):
+        fst.apply_shipped([good1, flipped])
+    assert not fst.cluster.nodes, "partial apply of a corrupt batch"
+    assert fst.durable.synced_seq == 0
+
+    # torn record (truncated mid-frame)
+    with pytest.raises(ShippedCorruptionError):
+        fst.apply_shipped([good1, good2[:len(good2) // 2]])
+    assert fst.durable.synced_seq == 0
+
+    # sequence gap (a record missing mid-batch)
+    good3 = frame_record({"rv": 3, "k": "command",
+                          "o": {"target": "default/j", "action": "Y",
+                                "cid": "c2"}}, 3)
+    with pytest.raises(ShippedCorruptionError):
+        fst.apply_shipped([good1, good3])
+    assert fst.durable.synced_seq == 0
+
+    # the clean re-request applies — and replays idempotently
+    fst.apply_shipped([good1, good2, good3])
+    assert "sa-w0" in fst.cluster.nodes
+    assert len(fst.cluster.commands) == 2
+    fst.apply_shipped([good1, good2, good3])    # overlap re-ship
+    assert len(fst.cluster.commands) == 2, "re-ship double-applied"
+    assert fst.durable.synced_seq == 3
+    assert fst._visible_rv() == 3
+
+
+def test_corrupt_shipped_record_refused_over_the_wire(tmp_path):
+    """End-to-end: a corrupt_ship fault on the leader's /wal lane
+    flips a byte inside one shipped record; the follower must refuse
+    the batch (counted), re-request, and converge to the exact leader
+    state once the injection budget is spent."""
+    from volcano_tpu import faults
+    from volcano_tpu.server.durability import DurableStore
+    from volcano_tpu.server.replication import Replication
+    from volcano_tpu.server.state_server import serve
+
+    plan = faults.FaultPlan(3, [faults.FaultRule(
+        "server", "corrupt_ship", route="/wal", max_injections=1)])
+    repl = Replication("r1", commit_quorum=1)
+    httpd, st = serve(port=0,
+                      durable=DurableStore(str(tmp_path / "l")),
+                      replication=repl, faults=plan)
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    fhttpd = fst = frepl = None
+    try:
+        # the follower joins FIRST (its initial contact is a
+        # snapshot bootstrap, which carries no framed records); the
+        # corruption must land on real TAIL traffic
+        fhttpd, fst, frepl = _spawn_follower(tmp_path, url, "f", "r2")
+        wait_for(lambda: frepl.bootstraps == 1, 20,
+                 "follower joined")
+        for i in range(6):
+            pod = make_pod("t", requests={"cpu": 1})
+            pod.name, pod.namespace = f"p{i}", "default"
+            st.cluster.add_pod(pod)
+        st.commit()
+        # gate on the DURABLE horizon, not the in-memory apply: the
+        # batch applies to memory a beat before its fsync lands
+        wait_for(lambda: len(fst.cluster.pods) == 6
+                 and fst.durable.synced_rv == st.durable.synced_rv,
+                 20, "follower converging after the corrupt batch")
+        assert frepl.refused_batches >= 1, \
+            "the corrupt shipped batch was silently applied"
+    finally:
+        for h in (fhttpd, httpd):
+            if h is not None:
+                h.shutdown()
+        for r in (frepl, repl):
+            if r is not None:
+                r.stop()
+
+
 def test_bench_crash_smoke_mode():
     """`bench.py --crash-smoke` SIGKILLs a real server mid-burst and
     asserts recovery invariants — the crash drill guarded on every

@@ -32,6 +32,22 @@ The invariants, checked while the faults fly and audited at the end:
     crc_refusal       a mid-WAL bit flip is detected by CRC at the
                       next boot and REFUSED (exit 3), not silently
                       replayed; restoring the byte boots cleanly
+    bounded_staleness (``replication`` class) no follower ever SERVES
+                      an rv it has not durably applied, its visible
+                      horizon never regresses, and its advertised lag
+                      is truthful — audited from outside against
+                      /watch + /durability
+
+The ``replication`` class runs the plane against a 3-replica state
+server (server/replication.py): the fault-armed leader plus two
+WAL-shipping followers, one behind a partition-able proxy.  Scheduled
+faults: a leader<->follower shipping partition, a shipping-lag window
+(delay on /wal), low-probability shipped-record corruption (refused
+by the follower's per-record CRC), and a late leader SIGKILL — a
+follower must promote without losing an acked write, the multi-
+endpoint client must re-route, and the deposed leader must rejoin by
+full re-sync.  The matrix run appends the read-QPS scaling row
+(leader-only vs follower reads under write churn).
 
 ``--matrix N`` runs seeds 1..N and writes the committed artifact
 (CHAOS_r{NN}.json shape): per-fault-class recovery latencies and the
@@ -112,8 +128,33 @@ def build_plan(seed: int, duration: float, classes) -> dict:
     slice_kill_at = None
     if "slice" in classes:
         slice_kill_at = round(duration * rng.uniform(0.3, 0.45), 2)
+    repl = None
+    if "replication" in classes:
+        # the replication fault schedule (drawn AFTER the classic
+        # classes so their plans stay byte-identical across versions):
+        # a leader<->follower partition, a shipping-lag window, low-
+        # probability shipped-record corruption all run, and a leader
+        # SIGKILL late enough that the classic disk/clock windows (on
+        # the original leader) complete first
+        p0 = round(duration * rng.uniform(0.26, 0.32), 2)
+        p1 = round(p0 + duration * rng.uniform(0.10, 0.14), 2)
+        l0 = round(duration * rng.uniform(0.52, 0.58), 2)
+        l1 = round(l0 + duration * rng.uniform(0.08, 0.12), 2)
+        rules.append({"site": "server", "kind": "delay",
+                      "route": "/wal", "prob": 1.0,
+                      "ms": round(rng.uniform(150, 400), 1),
+                      "after_s": l0, "until_s": l1})
+        rules.append({"site": "server", "kind": "corrupt_ship",
+                      "route": "/wal",
+                      "prob": round(rng.uniform(0.02, 0.05), 3),
+                      "max_injections": 3, "until_s": duration})
+        repl = {"partition": (p0, p1),
+                "kill_leader_at": round(duration *
+                                        rng.uniform(0.78, 0.85), 2)}
+        windows["repl_partition"] = repl["partition"]
+        windows["repl_lag"] = (l0, l1)
     return {"seed": seed, "rules": rules, "windows": windows,
-            "slice_kill_at": slice_kill_at}
+            "slice_kill_at": slice_kill_at, "replication": repl}
 
 
 def _iann(ann, key, default=0):
@@ -127,10 +168,15 @@ class InvariantTracker:
     """Continuous safety checks over the conductor's live mirror +
     the server's /durability endpoint."""
 
-    def __init__(self, cluster, url: str, floor_key: str):
+    def __init__(self, cluster, url: str, floor_key: str,
+                 repl: dict = None):
         self.c = cluster
         self.url = url
         self.floor_key = floor_key
+        # replication topology, kept current by the conductor as roles
+        # change: {"leader": url, "followers": [urls]}.  None = the
+        # classic single-server plane.
+        self.repl = repl
         self.violations = []
         self.max_rv = 0
         self.max_resume = 0
@@ -138,6 +184,10 @@ class InvariantTracker:
         self.resume_seen = False
         self.goodput_seen = False
         self._pod_nodes = {}
+        self._max_visible = {}          # replica url -> max visible_rv
+        self._prev_leader_visible = 0
+        self.follower_lag_max = {}      # replica url -> max lag_s seen
+        self.staleness_checks = 0
 
     def note(self, inv: str, detail: str):
         if any(v["invariant"] == inv and v["detail"] == detail
@@ -153,7 +203,68 @@ class InvariantTracker:
             f"owner={getattr(p, 'owner', '')[:8]}"
             for p in self.c.pods.values() if p.node_name == node)
 
+    def poll_replication(self):
+        """The tenth invariant — bounded staleness: no follower ever
+        SERVES an rv it has not durably applied (what /watch returns
+        is audited against what /durability admits), a replica's
+        visible horizon never regresses, and the advertised lag is
+        truthful (a follower claiming to be caught up must hold at
+        least what the leader had visible a poll ago)."""
+        if not self.repl:
+            return
+        for furl in self.repl.get("followers", []):
+            since = self._max_visible.get(furl, 0)
+            w = chaoslib.http_json(
+                furl + f"/watch?since={since}&timeout=0", timeout=2)
+            d = chaoslib.http_json(furl + "/durability", timeout=2)
+            if not d:
+                continue
+            self.staleness_checks += 1
+            vis = int(d.get("visible_rv") or 0)
+            synced = int(d.get("synced_rv") or 0)
+            rep = d.get("replication") or {}
+            applied = int(rep.get("applied_rv") or 0)
+            if w is not None and not w.get("resync") and \
+                    int(w.get("rv") or 0) > max(applied, vis):
+                # the durability doc was read AFTER the watch: a
+                # served rv past the admitted applied horizon means
+                # the follower served state it cannot prove durable
+                self.note("bounded_staleness",
+                          f"{furl} served rv {w.get('rv')} beyond "
+                          f"durably applied {applied}")
+            if vis > synced:
+                self.note("bounded_staleness",
+                          f"{furl} visible_rv {vis} beyond fsync "
+                          f"horizon {synced}")
+            prev = self._max_visible.get(furl, 0)
+            if vis < prev:
+                self.note("bounded_staleness",
+                          f"{furl} visible_rv regressed {prev} -> "
+                          f"{vis}")
+            self._max_visible[furl] = max(prev, vis)
+            lag = float(rep.get("lag_s") or 0.0)
+            if lag < 0:
+                self.note("bounded_staleness",
+                          f"{furl} advertised negative lag {lag}")
+            self.follower_lag_max[furl] = max(
+                self.follower_lag_max.get(furl, 0.0), lag)
+            if lag < 0.25 and self._prev_leader_visible and \
+                    applied < self._prev_leader_visible:
+                self.note("bounded_staleness",
+                          f"{furl} claims lag {lag}s but applied rv "
+                          f"{applied} trails the leader's horizon "
+                          f"{self._prev_leader_visible} from the "
+                          "previous poll — the advertised lag lies")
+        leader_url = self.repl.get("leader")
+        if leader_url:
+            d = chaoslib.http_json(leader_url + "/durability",
+                                   timeout=2)
+            if d:
+                self._prev_leader_visible = int(
+                    d.get("visible_rv") or 0)
+
     def poll(self):
+        self.poll_replication()
         dur = chaoslib.http_json(self.url + "/durability", timeout=2)
         if dur:
             rv = int(dur.get("visible_rv") or 0)
@@ -227,9 +338,11 @@ class InvariantTracker:
             "passed": {inv: inv not in failed for inv in (
                 "acked_durable", "rv_monotonic", "no_overcommit",
                 "no_double_bind", "resume_floor", "goodput_monotonic",
-                "mirror_converged", "crc_refusal", "clock_lease")},
+                "mirror_converged", "crc_refusal", "clock_lease",
+                "bounded_staleness")},
             "resume_floor_exercised": self.resume_seen,
             "goodput_ledger_exercised": self.goodput_seen,
+            "staleness_checks": self.staleness_checks,
         }
 
 
@@ -264,15 +377,51 @@ def run_conductor(seed: int, duration: float,
               "classes": sorted(classes),
               "windows": sched["windows"]}
     c = None
+    proxy = None
+    replication = sched.get("replication")
+    repl_topology = None
+    f_urls = []
+    f_dirs = []
+    plane_url = url
     try:
-        zoo.spawn_server(port, *server_faulted)
-        chaoslib.wait_server(url)
+        if replication:
+            # 3-replica group: the fault-armed leader plus two
+            # followers; f1 ships THROUGH a ChaosProxy (the
+            # partition-able link), f2 direct.  Campaign/peer traffic
+            # stays on the direct URLs, so a shipping partition is a
+            # partition, not a total disappearance.
+            f_ports = [chaoslib.free_port(), chaoslib.free_port()]
+            f_urls = [f"http://127.0.0.1:{p}" for p in f_ports]
+            f_dirs = [os.path.join(logdir, f"state-f{i + 1}")
+                      for i in range(2)]
+            proxy = chaoslib.ChaosProxy(port)
+            proxy.start()
+            proxy_url = f"http://127.0.0.1:{proxy.port}"
+            zoo.spawn_server(port, *server_faulted, "--replica-id",
+                             "r1", "--peers", ",".join(f_urls),
+                             "--repl-ttl", "1.5")
+            chaoslib.wait_server(url)
+            chaoslib.spawn_replica(
+                zoo, "f1", f_ports[0], f_dirs[0], "r2",
+                [url, f_urls[1]], replicate_from=proxy_url)
+            chaoslib.spawn_replica(
+                zoo, "f2", f_ports[1], f_dirs[1], "r3",
+                [url, f_urls[0]], replicate_from=url)
+            for u in f_urls:
+                chaoslib.wait_server(u)
+            chaoslib.wait_role(url, "leader")
+            repl_topology = {"leader": url, "followers": list(f_urls)}
+            plane_url = ",".join([url] + f_urls)
+        else:
+            zoo.spawn_server(port, *server_faulted)
+            chaoslib.wait_server(url)
         t_plan0 = time.monotonic()     # ~ the server plan's t0
         # leader-elected scheduler: the clock-jump invariant is about
         # the LEASE surviving a wall step — there must be a lease
-        zoo.spawn_plane("sched", url, "scheduler", "--leader-elect",
-                        "--holder", "s1", "--lease-ttl", "1.5")
-        zoo.spawn_plane("ctrl", url, "controllers")
+        zoo.spawn_plane("sched", plane_url, "scheduler",
+                        "--leader-elect", "--holder", "s1",
+                        "--lease-ttl", "1.5")
+        zoo.spawn_plane("ctrl", plane_url, "controllers")
 
         # high-rate sampler: the main loop slows down under injected
         # faults (that is the point), so the degrade/heal windows and
@@ -280,7 +429,14 @@ def run_conductor(seed: int, duration: float,
         import threading
         samples = []            # (t_rel, readonly_reason, visible_rv)
         leader_track = []       # (t_rel, holder)
+        repl_reads = []         # (t_rel, ok) — follower read liveness
+        inv = None              # InvariantTracker, created below
         sampler_stop = threading.Event()
+        # with replication on, the lease/rv sampling moves to f2 (a
+        # replica that lives through the whole run): leases are
+        # WAL-shipped, so the follower's view IS the group's, and its
+        # 10Hz answers double as the continuous-follower-reads proof
+        sample_url = f_urls[1] if replication else url
 
         def sampler():
             while not sampler_stop.wait(0.1):
@@ -290,7 +446,24 @@ def run_conductor(seed: int, duration: float,
                 if dur:
                     samples.append((t_rel, dur.get("readonly") or "",
                                     int(dur.get("visible_rv") or 0)))
-                leader_track.append((t_rel, chaoslib.leader(url)))
+                leader_track.append((t_rel,
+                                     chaoslib.leader(sample_url)))
+                if replication:
+                    repl_reads.append(
+                        (t_rel, chaoslib.http_json(
+                            sample_url + "/durability", timeout=2)
+                         is not None))
+                    # the partitioned follower's advertised lag, at
+                    # 10Hz: the churn loop can stall for seconds on a
+                    # faulted submit and miss a whole lag window
+                    d = chaoslib.http_json(
+                        f_urls[0] + "/durability", timeout=2)
+                    if d and inv is not None:
+                        lag = float((d.get("replication") or {})
+                                    .get("lag_s") or 0.0)
+                        inv.follower_lag_max[f_urls[0]] = max(
+                            inv.follower_lag_max.get(f_urls[0], 0.0),
+                            lag)
 
         threading.Thread(target=sampler, daemon=True).start()
 
@@ -302,7 +475,10 @@ def run_conductor(seed: int, duration: float,
         from volcano_tpu.api.vcjob import TaskSpec, VCJob
         from volcano_tpu.cache.remote_cluster import RemoteCluster
 
-        c = RemoteCluster(url)          # watches THROUGH every fault
+        # watches THROUGH every fault; with replication the client is
+        # multi-endpoint — writes follow the leader across the kill,
+        # reads stick to one replica
+        c = RemoteCluster(plane_url)
         chaoslib.seed_slices(c, ("sa", "sb", "sc"))
         acked_jobs = set()
 
@@ -373,13 +549,109 @@ def run_conductor(seed: int, duration: float,
                 except Exception as e:  # noqa: BLE001 — chaos is on
                     print("goodput agent sync failed:", e, flush=True)
 
-        inv = InvariantTracker(c, url, elastic_key)
+        inv = InvariantTracker(c, url, elastic_key,
+                               repl=repl_topology)
         import random as _random
         churn_rng = _random.Random(seed * 7919 + 13)
         submit_latencies = []
         submit_failures = 0
         submitted = 1    # the elastic gang
         killed_host = None
+        # replication event state
+        partitioned = False
+        leader_killed_at = None
+        leader_respawned = False
+        promote_s = None
+        new_leader_url = None
+        faults_before_kill = None
+        repl_state = {"partitioned": partitioned,
+                      "killed_at": leader_killed_at,
+                      "respawned": leader_respawned,
+                      "promote_s": promote_s,
+                      "new_leader": new_leader_url,
+                      "faults_before_kill": faults_before_kill}
+        # serializes the one-shot kill/respawn steps: the tick thread
+        # and the post-settle direct call may otherwise interleave
+        repl_tick_lock = threading.Lock()
+
+        def replication_tick(now_s: float) -> None:
+            """Drive the replication fault schedule (called from the
+            tick thread AND once after settle — the kill lands late,
+            so the promotion/rejoin tail often completes during
+            settle).  Serialized: the one-shot steps are guarded by
+            plain flags."""
+            if not replication or not repl_tick_lock.acquire(
+                    timeout=10.0):
+                return
+            try:
+                _replication_tick_locked(now_s)
+            finally:
+                repl_tick_lock.release()
+
+        def _replication_tick_locked(now_s: float) -> None:
+            p0, p1 = replication["partition"]
+            if not repl_state["partitioned"] and p0 <= now_s < p1:
+                repl_state["partitioned"] = True
+                proxy.set_mode("blackhole")
+                print(f"replication fault: f1<->leader shipping "
+                      f"PARTITIONED at t={now_s:.1f}s", flush=True)
+            elif repl_state["partitioned"] and now_s >= p1:
+                repl_state["partitioned"] = False
+                proxy.set_mode("pass")
+                print(f"replication fault: partition healed at "
+                      f"t={now_s:.1f}s", flush=True)
+            if repl_state["killed_at"] is None and \
+                    now_s >= replication["kill_leader_at"]:
+                repl_state["faults_before_kill"] = chaoslib.http_json(
+                    url + "/faults") or {}
+                zoo.kill9("server")
+                repl_state["killed_at"] = time.monotonic()
+                print(f"replication fault: leader SIGKILLed at "
+                      f"t={now_s:.1f}s", flush=True)
+            if repl_state["killed_at"] is not None and \
+                    repl_state["new_leader"] is None:
+                for u in f_urls:
+                    st_r = chaoslib.replication_status(u)
+                    if st_r and st_r.get("role") == "leader":
+                        repl_state["new_leader"] = u
+                        repl_state["promote_s"] = \
+                            time.monotonic() - repl_state["killed_at"]
+                        inv.repl["leader"] = u
+                        inv.repl["followers"] = [
+                            x for x in f_urls if x != u]
+                        inv.url = u
+                        print(f"replication: {u} PROMOTED "
+                              f"{repl_state['promote_s']:.2f}s after "
+                              f"the kill (term {st_r.get('term')})",
+                              flush=True)
+                        break
+            if repl_state["new_leader"] is not None and \
+                    not repl_state["respawned"]:
+                # the deposed leader rejoins over its old dir: its
+                # stale term forces the full re-sync
+                chaoslib.spawn_replica(
+                    zoo, "server-rejoin", port, data_dir, "r1",
+                    f_urls, replicate_from="auto")
+                repl_state["respawned"] = True
+                inv.repl["followers"].append(url)
+
+        # the replication fault schedule runs on its own 100ms thread:
+        # the churn loop can block for seconds inside a submit retry
+        # (that is the point of the wire faults), and a partition that
+        # starts late because a submit was stuck would smear the
+        # windows the recovery audit measures
+        repl_tick_stop = threading.Event()
+        if replication:
+            def repl_tick_loop():
+                while not repl_tick_stop.wait(0.1):
+                    try:
+                        replication_tick(time.monotonic() - t_plan0)
+                    except Exception as e:  # noqa: BLE001
+                        print("replication tick failed:", e,
+                              flush=True)
+            threading.Thread(target=repl_tick_loop,
+                             daemon=True).start()
+
         i = 0
         t_end = time.monotonic() + duration
         while time.monotonic() < t_end:
@@ -421,9 +693,25 @@ def run_conductor(seed: int, duration: float,
             done = sum(1 for j in c.vcjobs.values()
                        if getattr(j.phase, "value", j.phase)
                        == "Completed")
-            if done >= submitted - 1:   # all short gangs
-                break
+            if done >= submitted - 1 and (
+                    not replication or
+                    repl_state["new_leader"] is not None):
+                break               # all short gangs (+ promotion)
             time.sleep(0.5)
+        if replication:
+            # the promotion tail must complete before the audits:
+            # new leader elected, deposed leader re-synced back in
+            chaoslib.wait_for(
+                lambda: repl_state["new_leader"] is not None,
+                60, "a follower promoting after the leader kill")
+            repl_tick_stop.set()
+            replication_tick(time.monotonic() - t_plan0)
+            truth_url = repl_state["new_leader"]
+            chaoslib.wait_role(url, "follower", timeout=60)
+            chaoslib.wait_follower_caught_up(url, truth_url,
+                                             timeout=60)
+        else:
+            truth_url = url
 
         # -- end-of-run audits ---------------------------------------
         sampler_stop.set()
@@ -431,7 +719,7 @@ def run_conductor(seed: int, duration: float,
         c.resync()
         inv.poll()
         phases = chaoslib.phase_counts(c)
-        truth = chaoslib.snapshot_stores(url)
+        truth = chaoslib.snapshot_stores(truth_url)
         missing = [k for k in acked_jobs if k not in truth["vcjob"]]
         if missing:
             inv.note("acked_durable",
@@ -441,8 +729,8 @@ def run_conductor(seed: int, duration: float,
         # The plane is still live (ticks, status flushes), so compare
         # snapshot-vs-mirror repeatedly until a quiescent pair
         # matches — only a divergence that never settles is real.
-        final_rv = int((chaoslib.http_json(url + "/durability") or {})
-                       .get("visible_rv") or 0)
+        final_rv = int((chaoslib.http_json(truth_url + "/durability")
+                        or {}).get("visible_rv") or 0)
         try:
             chaoslib.wait_for(lambda: c._rv >= final_rv, 20,
                               "mirror caught up after heal")
@@ -450,7 +738,7 @@ def run_conductor(seed: int, duration: float,
             inv.note("mirror_converged", str(e))
         div = None
         for _ in range(8):
-            truth = chaoslib.snapshot_stores(url)
+            truth = chaoslib.snapshot_stores(truth_url)
             div = chaoslib.mirror_divergence(c, truth)
             if div == 0:
                 break
@@ -458,13 +746,69 @@ def run_conductor(seed: int, duration: float,
         if div:
             inv.note("mirror_converged", f"{div} diverged entries "
                      "(stable across 8 compares)")
-        faults_fired = chaoslib.http_json(url + "/faults") or {}
+        faults_fired = repl_state["faults_before_kill"] if replication \
+            else chaoslib.http_json(url + "/faults") or {}
 
         # -- CRC bit-rot drill: kill -9, flip one bit mid-WAL, boot
         # must REFUSE (exit 3); restore the byte, boot must recover —
         # then every acked job must still be there
         crc = {"checked": False}
-        if "disk" in classes or "wire" in classes:
+        if replication:
+            # replication flavor of the drill: a FOLLOWER's local WAL
+            # must be a complete recovery point — kill a current
+            # follower, flip one bit mid-WAL, a standalone boot over
+            # its dir must REFUSE (per-record CRC); restore the byte
+            # and every acked job must be in ITS recovered store.
+            drill_url = [u for u in f_urls
+                         if u != repl_state["new_leader"]][0]
+            fi = f_urls.index(drill_url)
+            drill_name, drill_dir = f"f{fi + 1}", f_dirs[fi]
+            drill_port = int(drill_url.rsplit(":", 1)[1])
+            rv_before = inv.max_rv
+            chaoslib.wait_follower_caught_up(drill_url, truth_url,
+                                             timeout=60)
+            zoo.kill9(drill_name)
+            seg, idx = _flippable_record(drill_dir)
+            if seg is not None:
+                from volcano_tpu import faults as faults_mod
+                off = faults_mod.flip_record_bit(seg, idx)
+                crc.update({"checked": True, "replica": drill_name,
+                            "segment": os.path.basename(seg),
+                            "record": idx})
+                zoo.spawn(f"{drill_name}-crc", "-m",
+                          "volcano_tpu.server", "--port",
+                          str(drill_port), "--data-dir", drill_dir)
+                code = zoo.wait_exit(f"{drill_name}-crc", timeout=30)
+                refused = code == 3 and bool(zoo.scrape(
+                    f"{drill_name}-crc", "refusing to boot"))
+                crc["refused"] = refused
+                if not refused:
+                    inv.note("crc_refusal",
+                             f"corrupt follower WAL boot exit={code},"
+                             " no refusal banner")
+                faults_mod.flip_bit(seg, off)
+                zoo.spawn(f"{drill_name}-crc2", "-m",
+                          "volcano_tpu.server", "--port",
+                          str(drill_port), "--data-dir", drill_dir)
+                chaoslib.wait_server(drill_url)
+                dur = chaoslib.http_json(drill_url + "/durability") \
+                    or {}
+                crc["recovered_rv"] = int(dur.get("rv") or 0)
+                if crc["recovered_rv"] < rv_before:
+                    inv.note("rv_monotonic",
+                             f"follower post-restore rv "
+                             f"{crc['recovered_rv']} < {rv_before}")
+                truth2 = chaoslib.snapshot_stores(drill_url)
+                missing2 = [k for k in acked_jobs
+                            if k not in truth2["vcjob"]]
+                if missing2:
+                    inv.note("acked_durable",
+                             f"{len(missing2)} acked vcjobs missing "
+                             "from the follower's own recovery")
+            else:
+                crc["skipped"] = "no follower WAL segment with >=3 " \
+                                 "records"
+        elif "disk" in classes or "wire" in classes:
             rv_before = inv.max_rv
             zoo.kill9("server")
             seg, idx = _flippable_record(data_dir)
@@ -520,8 +864,8 @@ def run_conductor(seed: int, duration: float,
         summary = inv.summary()
         recovery = {}
         for wname, (w0, w1) in sched["windows"].items():
-            if wname == "clock_jump":
-                continue
+            if wname == "clock_jump" or wname.startswith("repl_"):
+                continue    # not disk-degrade windows
             # 10Hz readonly trace: degrade must have been observable
             # inside the window (+heal slack), and the first writable
             # sample after the last readonly one dates the recovery
@@ -558,6 +902,34 @@ def run_conductor(seed: int, duration: float,
                 "submit_p95_s": round(
                     sl[min(len(sl) - 1, int(0.95 * len(sl)))], 4),
                 "submit_failures": submit_failures}
+        if replication:
+            # follower-read liveness at 10Hz across partition, lag
+            # window, leader kill and promotion: the max gap between
+            # consecutive successful /durability answers from f2
+            gaps, last_ok = [], None
+            for t_rel, ok in repl_reads:
+                if ok:
+                    if last_ok is not None:
+                        gaps.append(t_rel - last_ok)
+                    last_ok = t_rel
+            p0, p1 = replication["partition"]
+            f1_lag = inv.follower_lag_max.get(f_urls[0], 0.0)
+            recovery["replication"] = {
+                "kill_leader_at": replication["kill_leader_at"],
+                "promote_s": round(repl_state["promote_s"], 3)
+                if repl_state["promote_s"] is not None else None,
+                "new_leader": repl_state["new_leader"],
+                "deposed_leader_rejoined": repl_state["respawned"],
+                "partition_window": [p0, p1],
+                "partitioned_follower_lag_max_s": round(f1_lag, 3),
+                "partition_lag_observed": f1_lag >=
+                (p1 - p0) * 0.5,
+                "follower_reads_total": sum(
+                    1 for _t, ok in repl_reads if ok),
+                "follower_read_gap_max_s": round(max(gaps), 3)
+                if gaps else None,
+                "staleness_checks": inv.staleness_checks,
+            }
 
         result.update({
             "submitted": submitted,
@@ -579,6 +951,8 @@ def run_conductor(seed: int, duration: float,
     finally:
         if c is not None:
             c.close()
+        if proxy is not None:
+            proxy.close()
         zoo.terminate_all()
 
 
@@ -597,6 +971,136 @@ def _flippable_record(data_dir: str):
         if n >= 3:
             return path, n // 2
     return None, None
+
+
+_READ_WORKER = r'''
+import sys, time, urllib.request
+url, dur = sys.argv[1], float(sys.argv[2])
+t_end = time.monotonic() + dur
+n = 0
+paths = ["/durability", "/leases", "/watch?since=0&timeout=0"]
+i = 0
+while time.monotonic() < t_end:
+    try:
+        with urllib.request.urlopen(url + paths[i % 3],
+                                    timeout=3) as r:
+            r.read()
+        n += 1
+    except OSError:
+        pass
+    i += 1
+print(n)
+'''
+
+
+def read_qps_scaling(n_readers: int = 6, measure_s: float = 4.0,
+                     logdir: str = "") -> dict:
+    """The read-capacity row: aggregate read QPS against a leader
+    under sustained keyed write churn, vs the same reads spread over
+    its followers — real OS processes end to end (server replicas AND
+    reader workers; a single threaded client would GIL-cap the very
+    number being measured).  This is the deployment the whole feature
+    exists for: dashboards/vtpctl/watch mirrors polling while the
+    single writer is busy."""
+    import shutil
+    import subprocess
+    import tempfile
+    import threading
+
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.simulator import slice_nodes
+
+    logdir = logdir or tempfile.mkdtemp(prefix="repl-qps-")
+    ports = [chaoslib.free_port() for _ in range(3)]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    dirs = [os.path.join(logdir, f"qps-s{i}") for i in range(3)]
+    zoo = chaoslib.ProcessZoo(logdir)
+    stop = [False]
+    writers = []
+    try:
+        chaoslib.spawn_replica(zoo, "qps-leader", ports[0], dirs[0],
+                               "r1", urls[1:], tick_period=0.2)
+        chaoslib.wait_server(urls[0])
+        for i in (1, 2):
+            chaoslib.spawn_replica(
+                zoo, f"qps-f{i}", ports[i], dirs[i], f"r{i + 1}",
+                [urls[0], urls[3 - i]], replicate_from=urls[0],
+                tick_period=0.0)
+            chaoslib.wait_server(urls[i])
+        chaoslib.wait_role(urls[0], "leader")
+        seed_c = RemoteCluster(urls[0], start_watch=False)
+        node_names = []
+        for node in slice_nodes(slice_for("qa", "v5e-16"),
+                                dcn_pod="d0"):
+            seed_c.put_object("node", node)
+            node_names.append(node.name)
+        seed_c.close()
+
+        def writer(tid: int):
+            cw = RemoteCluster(urls[0], start_watch=False)
+            i = 0
+            while not stop[0]:
+                try:
+                    p = make_pod("t", requests={"cpu": 1})
+                    p.name = f"qw{tid}-{i}"
+                    p.namespace = "default"
+                    cw.put_object("pod", p)
+                    cw.bind_pods([("default", p.name,
+                                   node_names[i % len(node_names)])])
+                except Exception:  # noqa: BLE001 — churn is load
+                    pass
+                i += 1
+            cw.close()
+
+        for t in range(3):
+            th = threading.Thread(target=writer, args=(t,),
+                                  daemon=True)
+            th.start()
+            writers.append(th)
+        time.sleep(1.0)
+
+        def measure_once(endpoints) -> float:
+            procs = [subprocess.Popen(
+                [sys.executable, "-c", _READ_WORKER,
+                 endpoints[w % len(endpoints)], str(measure_s)],
+                stdout=subprocess.PIPE, text=True,
+                env=chaoslib.repo_env())
+                for w in range(n_readers)]
+            total = sum(int(p.communicate()[0].strip() or 0)
+                        for p in procs)
+            return round(total / measure_s, 1)
+
+        def measure(endpoints) -> float:
+            # median of 3 windows: a single window on a busy box
+            # (this runs right after five chaos seeds) is noisy
+            runs = sorted(measure_once(endpoints) for _ in range(3))
+            return runs[1]
+
+        leader_only = measure([urls[0]])
+        one_follower = measure([urls[1]])
+        two_followers = measure([urls[1], urls[2]])
+        return {
+            "readers": n_readers, "measure_s": measure_s,
+            "windows_per_config": 3, "statistic": "median",
+            "write_load": "3 writer threads, keyed put+bind churn "
+                          "at the leader throughout",
+            "read_mix": "/durability + /leases + /watch delta",
+            "leader_only_qps": leader_only,
+            "one_follower_qps": one_follower,
+            "two_followers_qps": two_followers,
+            "scaling_1f": round(one_follower / leader_only, 2)
+            if leader_only else None,
+            "scaling_2f": round(two_followers / leader_only, 2)
+            if leader_only else None,
+        }
+    finally:
+        stop[0] = True
+        for th in writers:
+            th.join(timeout=5)
+        zoo.terminate_all()
+        shutil.rmtree(logdir, ignore_errors=True)
 
 
 def run_matrix(seeds, duration: float, classes: str,
@@ -650,6 +1154,42 @@ def run_matrix(seeds, duration: float, classes: str,
             r["invariants"]["goodput_ledger_exercised"] for r in rows),
         "per_seed": rows,
     }
+    if "replication" in rows[0]["classes"]:
+        promotes = sorted(
+            r["recovery"]["replication"]["promote_s"]
+            for r in rows
+            if r["recovery"].get("replication", {}).get("promote_s")
+            is not None)
+        doc["replication"] = {
+            "replicas": 3,
+            "promotions": len(promotes),
+            "promote_p50_s": promotes[len(promotes) // 2]
+            if promotes else None,
+            "promote_max_s": promotes[-1] if promotes else None,
+            "acked_writes_lost_across_promotions": 0 if all(
+                r["invariants"]["passed"]["acked_durable"]
+                for r in rows) else "SEE per_seed",
+            "deposed_leader_rejoined_all": all(
+                r["recovery"].get("replication", {}).get(
+                    "deposed_leader_rejoined") for r in rows),
+            "partition_lag_observed_all": all(
+                r["recovery"].get("replication", {}).get(
+                    "partition_lag_observed") for r in rows),
+            "follower_read_gap_max_s": max(
+                (r["recovery"].get("replication", {}).get(
+                    "follower_read_gap_max_s") or 0) for r in rows),
+            "staleness_checks_total": sum(
+                r["invariants"].get("staleness_checks", 0)
+                for r in rows),
+            "corrupt_ship_injected_total": sum(
+                sum(rule.get("injected", 0)
+                    for rule in (r.get("faults_injected") or [])
+                    if rule.get("kind") == "corrupt_ship")
+                for r in rows),
+        }
+        print("measuring read-QPS scaling row "
+              "(leader+2 followers, write churn)...", flush=True)
+        doc["read_qps_scaling"] = read_qps_scaling()
     if out:
         with open(out, "w", encoding="utf-8") as f:
             json.dump(doc, f, indent=1)
@@ -662,7 +1202,8 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--duration", type=float, default=30.0)
     ap.add_argument("--classes", default=DEFAULT_CLASSES,
-                    help="comma set of wire,disk,clock,slice")
+                    help="comma set of wire,disk,clock,slice,"
+                         "replication")
     ap.add_argument("--logdir", default="")
     ap.add_argument("--matrix", type=int, default=0,
                     help="run seeds 1..N and aggregate the "

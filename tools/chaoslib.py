@@ -162,6 +162,55 @@ def leader(url: str, lease: str = "scheduler") -> Optional[str]:
     return (doc.get(lease) or {}).get("holder")
 
 
+# -- replicated control plane (server/replication.py) ------------------
+
+def spawn_replica(zoo: "ProcessZoo", name: str, port: int,
+                  data_dir: str, replica_id: str, peers,
+                  replicate_from: str = "", commit_quorum: int = 0,
+                  election_quorum: int = 0, ttl: float = 1.5,
+                  tick_period: float = 0.2, *extra: str):
+    """One replica of a state-server group as a real OS process.  The
+    seed leader passes no replicate_from; followers point at the
+    leader (or 'auto' to discover among the peers — how a deposed
+    leader rejoins after its SIGKILL).  Give followers the SAME
+    tick_period as the leader: the server's tick loop is gated on
+    leadership, so it lies dormant until a promotion — a promoted
+    follower spawned without it never advances the kubelet sim and
+    every post-failover pod sticks at Bound."""
+    args = ["-m", "volcano_tpu.server", "--port", str(port),
+            "--data-dir", data_dir, "--replica-id", replica_id,
+            "--peers", ",".join(peers), "--repl-ttl", str(ttl)]
+    if commit_quorum:
+        args += ["--commit-quorum", str(commit_quorum)]
+    if election_quorum:
+        args += ["--election-quorum", str(election_quorum)]
+    if replicate_from:
+        args += ["--replicate-from", replicate_from]
+    if tick_period:
+        args += ["--tick-period", str(tick_period)]
+    return zoo.spawn(name, *args, *extra)
+
+
+def replication_status(url: str) -> Optional[dict]:
+    return http_json(url + "/replication", timeout=2)
+
+
+def wait_role(url: str, role: str, timeout: float = 30.0) -> None:
+    wait_for(lambda: (replication_status(url) or {}).get("role")
+             == role, timeout, f"{url} reaching role {role}")
+
+
+def wait_follower_caught_up(url: str, leader_url: str,
+                            timeout: float = 30.0) -> None:
+    def caught():
+        f = replication_status(url)
+        l = http_json(leader_url + "/durability", timeout=2)
+        return bool(f and l and
+                    f.get("applied_rv", -1) >= int(
+                        l.get("visible_rv") or 0))
+    wait_for(caught, timeout, f"{url} catching up to {leader_url}")
+
+
 # -- TCP proxy with switchable fault modes ----------------------------
 
 class ChaosProxy(threading.Thread):

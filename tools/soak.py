@@ -13,6 +13,7 @@ reference semantics), not a leak.
 
 Usage:  python tools/soak.py [seconds] [--kill-slice]
                              [--kill-server[=EVERY_S]]
+                             [--kill-leader[=EVERY_S]]
         # default 600s; logs /tmp/soak/; --kill-slice injects a slice
         # failure (simulator.fail_host through the wire) ~40% in and
         # requires the failover loop to quarantine the slice and keep
@@ -27,7 +28,16 @@ Usage:  python tools/soak.py [seconds] [--kill-slice]
         # write, the scheduler/controller processes must stand by
         # through each outage (client retry layer + leader lease),
         # and jobs must keep completing — the control-plane crash
-        # drill for docs/design/durability.md
+        # drill for docs/design/durability.md.  --kill-leader runs a
+        # REPLICATED control plane (two state-server replicas,
+        # server/replication.py: commit quorum 2, so every ack is
+        # durable on both) and SIGKILLs whichever replica currently
+        # LEADS every EVERY_S seconds (default 25), respawning it as
+        # a follower of the promoted survivor: zero acked-write loss
+        # across every promotion, reads served continuously from the
+        # surviving replica, scheduler/controllers riding the
+        # multi-endpoint client across each failover — the drill for
+        # docs/design/replication.md
 """
 import json, os, random, signal, socket, subprocess, sys, time, urllib.request
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -47,26 +57,80 @@ def spawn(name, *argv):
         stdout=open(f"/tmp/soak/{name}.log", "a"), stderr=subprocess.STDOUT)
 
 kill_server_every = None
+kill_leader_every = None
 for a in sys.argv[1:]:
     if a == "--kill-server":
         kill_server_every = 20.0
     elif a.startswith("--kill-server="):
         kill_server_every = float(a.split("=", 1)[1])
+    elif a == "--kill-leader":
+        kill_leader_every = 25.0
+    elif a.startswith("--kill-leader="):
+        kill_leader_every = float(a.split("=", 1)[1])
 
-server_args = ["-m", "volcano_tpu.server", "--port", str(port),
-               "--tick-period", "0.2"]
-if kill_server_every:
-    # durable mode: the whole point is recovering from SIGKILL.
-    # Fresh dir per soak — replaying last week's run would skew the
-    # completion accounting.
-    import shutil
-    shutil.rmtree("/tmp/soak/state", ignore_errors=True)
-    server_args += ["--data-dir", "/tmp/soak/state"]
-spawn("server", *server_args)
-time.sleep(2)
-spawn("plane", "-m", "volcano_tpu", "--cluster-url",
-      f"http://127.0.0.1:{port}", "--components", "scheduler,controllers",
-      "--period", "0.2")
+import shutil
+import urllib.error
+
+if kill_leader_every:
+    # replicated control plane: two replicas, commit quorum 2 (every
+    # ack durable on BOTH before the client sees it — what makes a
+    # lone survivor's promotion lossless), election quorum 1 (2-node
+    # lab; docs/design/replication.md on the split-brain tradeoff)
+    port2 = free_port()
+    repl_urls = [f"http://127.0.0.1:{port}",
+                 f"http://127.0.0.1:{port2}"]
+    repl_ports = {repl_urls[0]: port, repl_urls[1]: port2}
+    repl_names = {repl_urls[0]: "r1", repl_urls[1]: "r2"}
+    repl_dirs = {repl_urls[0]: "/tmp/soak/state-r1",
+                 repl_urls[1]: "/tmp/soak/state-r2"}
+    for d in repl_dirs.values():
+        shutil.rmtree(d, ignore_errors=True)
+
+    def replica_args(url, follow=""):
+        args = ["-m", "volcano_tpu.server", "--port",
+                str(repl_ports[url]), "--data-dir", repl_dirs[url],
+                "--replica-id", repl_names[url], "--peers",
+                [u for u in repl_urls if u != url][0],
+                "--commit-quorum", "2", "--election-quorum", "1",
+                "--repl-ttl", "1.5", "--tick-period", "0.2"]
+        if follow:
+            args += ["--replicate-from", follow]
+        return args
+    spawn("r1", *replica_args(repl_urls[0]))
+    time.sleep(2)
+    spawn("r2", *replica_args(repl_urls[1], follow=repl_urls[0]))
+    time.sleep(2)
+    cluster_url = ",".join(repl_urls)
+else:
+    server_args = ["-m", "volcano_tpu.server", "--port", str(port),
+                   "--tick-period", "0.2"]
+    if kill_server_every:
+        # durable mode: the whole point is recovering from SIGKILL.
+        # Fresh dir per soak — replaying last week's run would skew
+        # the completion accounting.
+        shutil.rmtree("/tmp/soak/state", ignore_errors=True)
+        server_args += ["--data-dir", "/tmp/soak/state"]
+    spawn("server", *server_args)
+    time.sleep(2)
+    cluster_url = f"http://127.0.0.1:{port}"
+spawn("plane", "-m", "volcano_tpu", "--cluster-url", cluster_url,
+      "--components", "scheduler,controllers", "--period", "0.2")
+
+
+# shared drill plumbing (free ports, http_json, replication status)
+from tools import chaoslib
+
+
+def http_json(url, timeout=2.0):
+    return chaoslib.http_json(url, timeout=timeout)
+
+
+def current_leader():
+    for u in repl_urls:
+        doc = chaoslib.replication_status(u)
+        if doc and doc.get("role") == "leader":
+            return u
+    return None
 
 from volcano_tpu.cache.remote_cluster import RemoteCluster
 from volcano_tpu.api.devices.tpu.topology import slice_for
@@ -76,7 +140,7 @@ from volcano_tpu.api.pod import make_pod
 from volcano_tpu.api.resource import TPU
 from volcano_tpu.api.types import RUN_TICKS_ANNOTATION
 
-c = RemoteCluster(f"http://127.0.0.1:{port}")
+c = RemoteCluster(cluster_url)
 for sname in ("sa", "sb", "sc"):
     for node in slice_nodes(slice_for(sname, "v5e-16"), dcn_pod="d0"):
         c.put_object("node", node)
@@ -85,7 +149,7 @@ rng = random.Random(42)
 submitted = completed_seen = 0
 elastic_key = None
 kill_slice_mode = "--kill-slice" in sys.argv[1:]
-if kill_slice_mode or kill_server_every:
+if kill_slice_mode or kill_server_every or kill_leader_every:
     # one long-running elastic gang in the mix: grows into idle,
     # shrinks under churn pressure, and must survive the slice kill
     # AND every server kill -9.  Its goodput stream (progress files ->
@@ -197,17 +261,62 @@ killed = None
 server_kills = 0
 next_server_kill = (t_start + kill_server_every
                     if kill_server_every else None)
+leader_kills = 0
+acked_job_keys = set()
+next_leader_kill = (t_start + kill_leader_every
+                    if kill_leader_every else None)
+follower_read_fails = 0
+follower_reads = 0
+if kill_leader_every:
+    # continuous follower reads on a side thread: at every beat SOME
+    # replica must answer /durability — through every kill-promote
+    import threading
+    read_stop = threading.Event()
+
+    def read_sampler():
+        global follower_reads, follower_read_fails
+        while not read_stop.wait(0.25):
+            if any(http_json(u + "/durability") is not None
+                   for u in repl_urls):
+                follower_reads += 1
+            else:
+                follower_read_fails += 1
+    threading.Thread(target=read_sampler, daemon=True).start()
 i = 0
 rss_samples = []
 def server_rss():
     try:
-        with open(f"/proc/{procs['server'].pid}/status") as f:
+        name = "server" if "server" in procs else "r1"
+        with open(f"/proc/{procs[name].pid}/status") as f:
             for ln in f:
                 if ln.startswith("VmRSS"):
                     return int(ln.split()[1])
     except OSError:
         return -1
 while time.time() < t_end:
+    if next_leader_kill is not None and time.time() >= next_leader_kill:
+        # SIGKILL whichever replica currently LEADS; the survivor
+        # must promote (it holds every acked write: commit quorum 2)
+        # and the deposed one rejoins as its follower via full
+        # re-sync (--replicate-from auto + stale term)
+        lu = current_leader()
+        if lu is not None:
+            name = repl_names[lu]
+            os.kill(procs[name].pid, signal.SIGKILL)
+            procs[name].wait()
+            t0 = time.time()
+            survivor = [u for u in repl_urls if u != lu][0]
+            while time.time() - t0 < 30:
+                st_s = http_json(survivor + "/replication")
+                if st_s and st_s.get("role") == "leader":
+                    break
+                time.sleep(0.2)
+            spawn(name, *replica_args(lu, follow="auto"))
+            leader_kills += 1
+            print(f"kill -9 leader {name} (#{leader_kills}); "
+                  f"{survivor} promoted in {time.time() - t0:.1f}s; "
+                  f"{name} respawned as follower", flush=True)
+        next_leader_kill = time.time() + kill_leader_every
     if next_server_kill is not None and time.time() >= next_server_kill:
         # kill -9 and respawn in place: WAL replay + mirror delta
         # resync must carry every live component across the outage
@@ -237,6 +346,7 @@ while time.time() < t_end:
     try:
         c.add_vcjob(job)
         submitted += 1
+        acked_job_keys.add(job.key)
     except Exception as e:
         print("submit failed:", e, flush=True)
     i += 1
@@ -283,6 +393,22 @@ out = {"submitted": submitted, "phases": phases,
        "dead_processes": dead,
        "rss_first": rss_samples[0] if rss_samples else None,
        "rss_last": rss_samples[-1] if rss_samples else None}
+if kill_leader_every:
+    read_stop.set()
+    # zero acked-write loss across every promotion: every job whose
+    # create was ACKED must exist in the final (resynced) state
+    lost_jobs = [k for k in acked_job_keys if k not in c.vcjobs]
+    out["leader_kills"] = leader_kills
+    out["acked_jobs"] = len(acked_job_keys)
+    out["acked_jobs_lost"] = len(lost_jobs)
+    out["lost_sample"] = lost_jobs[:5]
+    out["follower_reads"] = follower_reads
+    out["follower_read_fails"] = follower_read_fails
+    out["final_leader"] = current_leader()
+    out["kill_leader_ok"] = (
+        leader_kills > 0 and not lost_jobs and not dead
+        and follower_read_fails == 0
+        and phases.get("Completed", 0) > 0)
 if kill_server_every:
     out["server_kills"] = server_kills
     out["kill_server_ok"] = (server_kills > 0 and not dead

@@ -99,6 +99,21 @@ FAMILIES: Dict[str, str] = {
     "server_snapshot_rv": "gauge",
     "server_replay_seconds": "histogram",
     "server_replay_records": "gauge",
+    # replicated control plane (server/replication.py): shipping
+    # volume, follower lag, promotions, role — labels bounded (the
+    # role enum and the operator-configured replica ids, never
+    # job/pod/node keys)
+    "server_replication_lag_seconds": "gauge",
+    "server_replication_applied_rv": "gauge",
+    "server_replication_last_shipped_rv": "gauge",
+    "server_replication_follower_lag_rv": "gauge",
+    "server_replication_shipped_records_total": "counter",
+    "server_replication_shipped_bytes_total": "counter",
+    "server_replication_promotions_total": "counter",
+    "server_replication_bootstraps_total": "counter",
+    "server_replication_refused_batches_total": "counter",
+    "server_replication_role": "gauge",
+    "server_replication_term": "gauge",
     # client wire resilience: every transient retry the unified
     # backoff policy performs, labeled by route
     "client_retries_total": "counter",
@@ -263,6 +278,23 @@ def scheduler_dashboard() -> dict:
                 "sum by (generation) (frag_largest_block_chips)",
                 "max by (queue) (starvation_age_seconds)",
                 "sum by (queue) (starvation_pending_gangs)"], 0, 64),
+        # replicated control plane: who leads at what term, how far
+        # each replica trails (the divergence an operator must see
+        # before it pages them), shipping volume, and the
+        # promotion/bootstrap/refusal event counters
+        _panel(18, "Control-plane replication: role / term / lag",
+               ["sum by (role) (server_replication_role)",
+                "server_replication_term",
+                "server_replication_lag_seconds",
+                "max by (follower) "
+                "(server_replication_follower_lag_rv)"], 12, 64),
+        _panel(19, "WAL shipping + promotions",
+               ["rate(server_replication_shipped_records_total[5m])",
+                "rate(server_replication_shipped_bytes_total[5m])",
+                "rate(server_replication_promotions_total[5m])",
+                "rate(server_replication_bootstraps_total[5m])",
+                "rate(server_replication_refused_batches_total[5m])"],
+               0, 72),
     ]
     return {
         "title": "volcano-tpu / scheduler", "uid": "vtp-scheduler",

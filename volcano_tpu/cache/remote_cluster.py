@@ -73,15 +73,26 @@ def _transient(e: Exception) -> bool:
         (isinstance(e, RemoteError) and e.code >= 500)
 
 
+class StaleReplicaError(RuntimeError):
+    """A same-lineage full re-list came back OLDER than the mirror
+    (lagging read replica): the rewind was refused and the sticky
+    read endpoint rotated.  resync() swallows this (the mirror just
+    stays put for a beat); it exists as a type so the refusal is
+    never mistaken for a wire failure."""
+
+
 class RemoteError(RuntimeError):
     def __init__(self, code: int, message: str,
-                 retry_after: float = 0.0):
+                 retry_after: float = 0.0, leader: str = ""):
         super().__init__(message)
         self.code = code
         # parsed from the Retry-After header (seconds); 0 = none.
         # The read-only degrade's 503s carry it so clients pace their
         # retries to the server's heal cadence.
         self.retry_after = retry_after
+        # a follower's 503 carries the current leader's URL: the
+        # retry re-routes the write instead of hammering the replica
+        self.leader = leader
 
 
 class RemoteCluster(Cluster):
@@ -95,8 +106,27 @@ class RemoteCluster(Cluster):
         resync-on-reconnect self-heals once the server returns (the
         hub's member-cluster clients must survive a member outage).
         retry_deadline: overall per-call budget for the shared
-        transient-retry policy (backoff + jitter)."""
-        self.base_url = base_url.rstrip("/")
+        transient-retry policy (backoff + jitter).
+
+        base_url may name a replica GROUP — a comma-separated URL
+        list (or a list/tuple).  Writes route to the leader (tracked
+        via the follower 503s' leader hints + /replication
+        discovery, re-routing in-flight retries across a failover);
+        reads stick to ONE randomly-chosen replica — sticky, so the
+        watch revision stays on one rv timeline — rotating to the
+        next replica on failure.  A fleet of mirrors thereby spreads
+        its read load across the followers while every write still
+        funnels through the single elected writer."""
+        if isinstance(base_url, str):
+            endpoints = [u for u in base_url.split(",") if u.strip()]
+        else:
+            endpoints = list(base_url)
+        self.endpoints = [u.strip().rstrip("/") for u in endpoints]
+        self.base_url = self.endpoints[0]      # current WRITE target
+        # sticky read replica (random: a fleet self-spreads); single-
+        # endpoint configs keep the exact legacy behavior
+        self._read_idx = random.randrange(len(self.endpoints)) \
+            if len(self.endpoints) > 1 else 0
         self.timeout = timeout
         self.token = token
         self._retry_deadline = retry_deadline
@@ -153,18 +183,31 @@ class RemoteCluster(Cluster):
         without a key are replay-safe by state-compare (re-bind to the
         same node, overwrite-put, repeated evict/delete)."""
         if idempotency_key and payload is not None:
+            # stable across this call's retries AND across a leader
+            # failover re-route: the new leader replayed the shipped
+            # _req records, so a retried write that already committed
+            # gets its recorded verdict, never a double-apply
             payload = dict(payload, _req_id=uuid.uuid4().hex)
         budget = self._retry_deadline if deadline is None else deadline
         t_end = time.monotonic() + budget
         delay = RETRY_BASE_S
+        is_read = method == "GET"
         while True:
+            base = self.endpoints[self._read_idx] if is_read \
+                else self.base_url
             try:
-                return self._request_once(method, path, payload, timeout)
+                return self._request_once(method, path, payload,
+                                          timeout, base=base)
             except Exception as e:  # noqa: BLE001 — classified
                 remain = t_end - time.monotonic()
                 if not retries or not _transient(e) or remain <= 0 \
                         or self._stop.is_set():
+                    # budget spent: surface the failure NOW — leader
+                    # discovery probes would overshoot the caller's
+                    # deadline by seconds
                     raise
+                if len(self.endpoints) > 1:
+                    self._reroute(is_read, e)
                 from volcano_tpu import metrics
                 metrics.inc("client_retries_total",
                             route=path.partition("?")[0])
@@ -173,8 +216,43 @@ class RemoteCluster(Cluster):
                 time.sleep(_retry_sleep(delay, e, remain))
                 delay = min(delay * 2, RETRY_CAP_S)
 
+    def _reroute(self, is_read: bool, e: Exception) -> None:
+        """Failover routing on a transient error in a replica group:
+        reads rotate to the next sticky replica; writes follow the
+        follower 503's leader hint when one came, else re-discover
+        the leader via GET /replication across the group."""
+        if is_read:
+            self._read_idx = (self._read_idx + 1) % len(self.endpoints)
+            return
+        hint = getattr(e, "leader", "")
+        if hint and hint.rstrip("/") != self.base_url:
+            self.base_url = hint.rstrip("/")
+            log.debug("write path re-routed to hinted leader %s",
+                      self.base_url)
+            return
+        self._discover_leader()
+
+    def _discover_leader(self) -> None:
+        best, best_term = "", -1
+        for url in self.endpoints:
+            try:
+                doc = self._request_once("GET", "/replication",
+                                         timeout=2.0, base=url)
+            except Exception:  # noqa: BLE001 — candidate down
+                continue
+            term = int(doc.get("term", 0) or 0)
+            if doc.get("role") == "leader" and term > best_term:
+                best, best_term = url, term
+            elif doc.get("leader") and term > best_term:
+                best, best_term = doc["leader"].rstrip("/"), term
+        if best and best != self.base_url:
+            self.base_url = best
+            log.info("write path re-routed to discovered leader %s",
+                     best)
+
     def _request_once(self, method: str, path: str, payload=None,
-                      timeout: Optional[float] = None):
+                      timeout: Optional[float] = None,
+                      base: Optional[str] = None):
         data = None
         if payload is not None:
             data = json.dumps(payload, separators=(",", ":")).encode()
@@ -185,7 +263,7 @@ class RemoteCluster(Cluster):
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
         req = urllib.request.Request(
-            self.base_url + path, data=data, method=method,
+            (base or self.base_url) + path, data=data, method=method,
             headers=headers)
         try:
             with urllib.request.urlopen(
@@ -194,8 +272,11 @@ class RemoteCluster(Cluster):
                 from volcano_tpu.server.httputil import read_json_body
                 return read_json_body(resp)
         except urllib.error.HTTPError as e:
+            leader = ""
             try:
-                msg = json.loads(e.read()).get("error", str(e))
+                doc = json.loads(e.read())
+                msg = doc.get("error", str(e))
+                leader = doc.get("leader") or ""
             except Exception:  # noqa: BLE001
                 msg = str(e)
             if e.code == 422:
@@ -209,8 +290,8 @@ class RemoteCluster(Cluster):
                 retry_after = float(e.headers.get("Retry-After") or 0.0)
             except (TypeError, ValueError):
                 retry_after = 0.0
-            raise RemoteError(e.code, msg,
-                              retry_after=retry_after) from None
+            raise RemoteError(e.code, msg, retry_after=retry_after,
+                              leader=leader) from None
 
     # -- mirror maintenance --------------------------------------------
 
@@ -271,13 +352,41 @@ class RemoteCluster(Cluster):
                     self._rv = max(self._rv, payload["rv"])
                     self._epoch = epoch or self._epoch
                 return
-        self._full_resync(_deadline=_deadline)
+        try:
+            self._full_resync(_deadline=_deadline)
+        except StaleReplicaError as e:
+            # the sticky replica lags the mirror: keep the mirror as
+            # is (it is AHEAD — nothing stale about it), let the
+            # rotated endpoint or the replica's catch-up win the next
+            # round.  Swallowed here so bare resync() callers (tools,
+            # tests) never crash on a routine failover transient.
+            log.debug("full resync skipped: %s", e)
+            time.sleep(0.2)
 
     def _full_resync(self, _deadline: Optional[float] = None) -> None:
         """Full LIST: replace the mirror (bootstrap + ring fall-off +
-        server restart)."""
+        server restart).  A snapshot from the SAME history lineage
+        (epoch BASE) that is OLDER than the mirror is refused — with
+        sticky reads rotating across replicas on failure, a re-list
+        could otherwise land on a lagging follower and REWIND the
+        mirror (deleted objects resurrected, phases rolled back);
+        refusing makes the caller back off and retry, by which time
+        the rotation found a caught-up replica or this one caught
+        up.  A different BASE really is a new history: accepted."""
         from volcano_tpu import metrics
         payload = self._request("GET", "/snapshot", deadline=_deadline)
+        epoch = payload.get("epoch", "")
+        if self._epoch and epoch and \
+                self._epoch_base(epoch) == self._epoch_base(self._epoch) \
+                and payload["rv"] < self._rv:
+            metrics.inc("mirror_resync_total", mode="stale-refused")
+            if len(self.endpoints) > 1:
+                self._read_idx = (self._read_idx + 1) % \
+                    len(self.endpoints)
+            raise StaleReplicaError(
+                f"replica snapshot rv {payload['rv']} is behind "
+                f"the mirror's rv {self._rv} (lagging replica); "
+                "refusing the rewind")
         metrics.inc("mirror_resync_total", mode="full")
         with self._mlock:
             self._rv = payload["rv"]
@@ -532,10 +641,14 @@ class RemoteCluster(Cluster):
         if not binds:
             return []
         try:
+            # keyed: a batch whose ack died with the old leader must
+            # replay its recorded per-item verdicts on the promoted
+            # one (exactly-once commit across a failover), not re-run
+            # the capacity checks against a half-applied world
             resp = self._request("POST", "/bind_batch", {"binds": [
                 dict({"namespace": ns, "name": n, "node_name": node},
                      **({"ts_alloc": ts} if ts is not None else {}))
-                for ns, n, node, ts in binds]})
+                for ns, n, node, ts in binds]}, idempotency_key=True)
             results = resp["results"]
             if len(results) != len(binds):
                 raise RemoteError(500, "bind_batch result count "

@@ -972,6 +972,30 @@ def cmd_server(cluster, args):
             ["boot-replay", f"{dur.get('replay_records')} records in "
              f"{dur.get('replay_seconds')}s"],
         ]
+    rep = dur.get("replication")
+    if rep:
+        # the divergence an operator must see BEFORE it pages them:
+        # who leads at what term, how far each replica trails, and
+        # whether the commit quorum is holding
+        rows += [
+            ["repl-role", f"{rep.get('role')} (term {rep.get('term')},"
+             f" id {rep.get('replica_id')})"],
+            ["repl-leader", rep.get("leader") or "-"],
+            ["repl-applied", f"rv {rep.get('applied_rv')} / seq "
+             f"{rep.get('applied_seq')}"],
+            ["repl-lag", f"{rep.get('lag_s', 0):.3f}s"],
+            ["repl-quorum", f"commit={rep.get('commit_quorum')} "
+             + ("ok" if rep.get("quorum_ok", True) else
+                "LOST (writes 503)")],
+            ["repl-promotions", rep.get("promotions")],
+        ]
+        if rep.get("role") == "leader":
+            rows.append(["last-shipped", f"rv {rep.get('last_shipped_rv')}"])
+            for fid, f in sorted((rep.get("followers") or {}).items()):
+                rows.append(
+                    [f"follower/{fid}",
+                     f"applied rv {f.get('applied_rv')} "
+                     f"(acked {f.get('ack_age_s', 0):.1f}s ago)"])
     print(_table([[k, str(v)] for k, v in rows], ["FIELD", "VALUE"]))
     leases = cluster._request("GET", "/leases")
     if leases:
@@ -1216,9 +1240,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="how many kept session traces to render")
     p.set_defaults(fn=cmd_trace)
 
-    p = sub.add_parser("server", help="state-server durability + "
-                       "lease status (WAL/snapshot/replay; needs "
-                       "--server)")
+    p = sub.add_parser("server", help="state-server durability, "
+                       "replication + lease status (WAL/snapshot/"
+                       "replay, role/term/lag; needs --server)")
     p.set_defaults(fn=cmd_server)
 
     p = sub.add_parser("tick",

@@ -56,7 +56,12 @@ SITES = ("server", "proxy", "disk", "clock")
 # bounded kind enum — these label fault_injected_total, so the set is
 # closed (a cardinality test pins it, like the sched_*/elastic_* rule)
 WIRE_KINDS = ("drop_request", "drop_response", "delay", "duplicate",
-              "reorder", "http_503", "reset", "trickle")
+              "reorder", "http_503", "reset", "trickle",
+              # shipped-segment corruption: a byte flipped inside one
+              # framed WAL record on the /wal shipping lane (the JSON
+              # envelope stays valid; only the follower's per-record
+              # CRC can tell) — applied by the /wal route itself
+              "corrupt_ship")
 PROXY_KINDS = ("blackhole", "latency", "reset", "trickle")
 DISK_KINDS = ("enospc_append", "eio_fsync", "torn_write")
 CLOCK_KINDS = ("wall_jump", "wall_skew")

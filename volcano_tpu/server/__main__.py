@@ -65,6 +65,36 @@ def main(argv=None) -> int:
                              "this process; also read from the "
                              "VTP_FAULT_PLAN env var.  Never use in "
                              "production")
+    parser.add_argument("--replica-id", default="",
+                        help="join a replica group under this id "
+                             "(requires --data-dir); without "
+                             "--replicate-from this process is the "
+                             "seed leader")
+    parser.add_argument("--peers", default="",
+                        help="comma-separated URLs of the OTHER "
+                             "replicas (quorums are majorities of "
+                             "the full group, this replica included)")
+    parser.add_argument("--replicate-from", default="",
+                        help="start as a FOLLOWER of this leader URL "
+                             "('auto' discovers the leader among "
+                             "--peers): ship + replay its WAL, serve "
+                             "reads at advertised staleness, refuse "
+                             "writes with a leader hint, campaign on "
+                             "leader death")
+    parser.add_argument("--commit-quorum", type=int, default=0,
+                        help="replicas (leader included) that must "
+                             "hold a write durably before its ack "
+                             "(default: group majority; 1 = async "
+                             "shipping)")
+    parser.add_argument("--election-quorum", type=int, default=0,
+                        help="votes (candidate included) needed to "
+                             "promote (default: group majority; a "
+                             "2-node lab needs the explicit 1 — see "
+                             "docs/design/replication.md on split "
+                             "brain)")
+    parser.add_argument("--repl-ttl", type=float, default=3.0,
+                        help="leader-silence window before followers "
+                             "campaign")
     parser.add_argument("--wal-force-truncate", action="store_true",
                         help="explicit operator override for mid-WAL "
                              "corruption: truncate the log at the "
@@ -174,10 +204,32 @@ def main(argv=None) -> int:
                  "reverting to the embedded chain (no --webhook-url)")
         cluster.admission = default_admission()
 
+    replication = None
+    if args.replica_id or args.replicate_from:
+        if durable is None:
+            parser.error("replication requires --data-dir (followers "
+                         "journal the shipped WAL before serving it)")
+        from volcano_tpu.server.replication import Replication
+        replication = Replication(
+            replica_id=args.replica_id or f"replica-{args.port}",
+            peers=[p for p in args.peers.split(",") if p],
+            replicate_from=args.replicate_from,
+            commit_quorum=args.commit_quorum,
+            election_quorum=args.election_quorum,
+            ttl=args.repl_ttl, token=token)
+
     httpd, state = serve(port=args.port, cluster=cluster,
                          tick_period=args.tick_period,
                          tls_cert=args.tls_cert, tls_key=args.tls_key,
-                         token=token, durable=durable, faults=plan)
+                         token=token, durable=durable, faults=plan,
+                         replication=replication)
+    if replication is not None:
+        log.info("replication: id=%s role=%s term=%d peers=%s "
+                 "commit-quorum=%d election-quorum=%d",
+                 replication.replica_id, replication.role,
+                 replication.term, replication.peers,
+                 replication.commit_quorum,
+                 replication.election_quorum)
     log.info("state server on %s://127.0.0.1:%d%s%s",
              "https" if args.tls_cert else "http",
              httpd.server_address[1],
@@ -190,6 +242,8 @@ def main(argv=None) -> int:
     stop.wait()
 
     state.tick_stop.set()   # no kubelet mutations during save
+    if replication is not None:
+        replication.stop()
     httpd.shutdown()
     if durable is not None:
         if durable.poisoned:

@@ -97,6 +97,11 @@ SNAPSHOT_EVERY_BYTES = 64 * 1024 * 1024
 # mutation whose first attempt committed before a crash must find its
 # recorded response, not double-apply
 REQ_CACHE = 2048
+# fsync'd records kept in memory for WAL shipping (server/replication
+# .py): a follower inside the ring tails in O(new records); one that
+# fell off (or a fresh boot — the ring is volatile) bootstraps from
+# the replica snapshot instead
+SHIP_RING = 50_000
 
 
 class WALCorruptionError(RuntimeError):
@@ -217,8 +222,14 @@ def apply_event(cluster, kind: str, payload) -> None:
     (it already ran before the event was logged), no watchers (none
     are attached at boot)."""
     from volcano_tpu.api import codec
+    apply_event_obj(cluster, kind, codec.decode(payload))
+
+
+def apply_event_obj(cluster, kind: str, obj) -> None:
+    """apply_event with the payload already decoded — the follower
+    apply path decodes once and shares the object with its own
+    bookkeeping (chip maps, watch ring)."""
     from volcano_tpu.cache.kinds import KINDS
-    obj = codec.decode(payload)
     deleted = kind.endswith("_deleted")
     base = kind[:-len("_deleted")] if deleted else kind
     spec = KINDS.get(base)
@@ -281,6 +292,12 @@ class DurableStore:
         self._synced_marker = 0
         self._tail_rv = 0                 # last store-event rv appended
         self.synced_rv = 0                # last store-event rv fsync'd
+        self.synced_seq = 0               # last record seq fsync'd
+        # shipping ring: (seq, line) of recent records, served to
+        # follower replicas up to the fsync horizon (ship_since)
+        import collections
+        self._ship: "collections.deque" = collections.deque(
+            maxlen=SHIP_RING)
         self.wal_records = 0              # records in live segments
         self.wal_bytes = 0
         self.snapshot_rv = 0
@@ -518,6 +535,9 @@ class DurableStore:
                   if exp > now}
 
         self._seq = last_seq
+        # everything replayed is durable; the ship ring starts empty
+        # (a follower past this seq tails, an older one bootstraps)
+        self.synced_seq = last_seq
         self.replay_records = replayed
         self.replay_seconds = time.perf_counter() - t0
         if had_state:
@@ -594,11 +614,111 @@ class DurableStore:
             self._appended += 1
             self.wal_records += 1
             self.wal_bytes += len(line)
+            self._ship.append((seq, line))
             if "rv" in rec:
                 self._tail_rv = max(self._tail_rv, rec["rv"])
 
     def append_event(self, rv: int, kind: str, payload) -> None:
         self.append({"rv": rv, "k": kind, "o": payload})
+
+    def append_shipped(self, line: str, seq: int, rv: int) -> None:
+        """Append one leader-framed WAL line verbatim (follower path):
+        the record keeps the LEADER's sequence number, so a promoted
+        follower's log is seq-continuous with the group history and
+        its own recover()/shipping work unchanged.  The caller
+        (StateServer.apply_shipped) has already CRC-verified the line
+        and checked seq continuity against synced_seq.
+
+        Raises ReadOnlyError when this replica's own disk is poisoned:
+        a follower that cannot durably apply must NOT advance its
+        position — its advertised lag grows truthfully instead."""
+        if not line.endswith("\n"):
+            line += "\n"
+        with self._lock:
+            if self.poisoned:
+                raise ReadOnlyError(self.poisoned)
+            try:
+                self.vfs.write(self._file, line)
+            except OSError as e:
+                self._poison(f"append:{getattr(e, 'strerror', e)}")
+                raise ReadOnlyError(self.poisoned) from None
+            self._seq = seq
+            self._appended += 1
+            self.wal_records += 1
+            self.wal_bytes += len(line)
+            self._ship.append((seq, line))
+            if rv:
+                self._tail_rv = max(self._tail_rv, rv)
+
+    def ship_since(self, since_seq: int, limit: int = 2048) -> dict:
+        """Framed records with since_seq < seq <= synced_seq for a
+        follower long-poll.  resync=True when the follower's position
+        fell off the (volatile) ship ring or is ahead of this store's
+        history — only a replica-snapshot bootstrap recovers."""
+        import itertools
+        with self._lock:
+            synced = self.synced_seq
+            if since_seq > synced:
+                return {"records": [], "last_seq": synced,
+                        "resync": True}
+            earliest = self._ship[0][0] if self._ship else synced + 1
+            if since_seq + 1 < earliest:
+                return {"records": [], "last_seq": synced,
+                        "resync": True}
+            # ring seqs are contiguous: the suffix starts at a known
+            # offset — never scan the whole (up to 50k) ring per poll
+            start = max(0, since_seq - earliest + 1)
+            records = []
+            for seq, line in itertools.islice(self._ship, start,
+                                              start + limit):
+                if seq > synced:
+                    break
+                records.append(line)
+            return {"records": records, "last_seq": synced,
+                    "resync": False}
+
+    def reset_from_snapshot(self, doc: dict, epoch: str) -> dict:
+        """Install a replica snapshot wholesale (follower bootstrap /
+        epoch-term-mismatch full re-sync): local WAL segments are
+        DISCARDED (the leader's history supersedes them), the doc
+        lands as the local snapshot atomically, and the seq/rv
+        counters jump to the leader's horizon.  Returns the doc."""
+        with self._snap_lock:
+            with self._lock:
+                if self._file is not None:
+                    self._file.close()
+                    self._file = None
+                for seg in self._segments():
+                    try:
+                        os.remove(seg)
+                    except OSError:
+                        log.warning("could not remove superseded WAL "
+                                    "%s", seg)
+                doc = dict(doc)
+                doc["format"] = SNAPSHOT_FORMAT
+                doc["saved_at"] = time.time()
+                atomic_write_json(
+                    os.path.join(self.dir, SNAPSHOT_FILE), doc)
+                base, _, boot = epoch.rpartition(".")
+                try:
+                    boot_n = int(boot)
+                except ValueError:
+                    base, boot_n = epoch, 0
+                atomic_write_json(os.path.join(self.dir, EPOCH_FILE),
+                                  {"base": base or epoch,
+                                   "boot": boot_n})
+                self._seq = self.synced_seq = int(doc.get("wal_seq", 0))
+                rv = int(doc.get("rv", 0))
+                self._tail_rv = self.synced_rv = rv
+                self.snapshot_rv = rv
+                self.snapshot_at = doc["saved_at"]
+                self._appended = self._synced_marker = 0
+                self.wal_records = 0
+                self.wal_bytes = 0
+                self._ship.clear()
+                self.poisoned = ""
+                self._open_segment_locked()
+            return doc
 
     def commit(self) -> int:
         """Make every appended record durable; returns the new synced
@@ -629,6 +749,7 @@ class DurableStore:
             # commit; only what was appended at flush time is synced
             self._synced_marker = target
             self.synced_rv = self._tail_rv
+            self.synced_seq = self._seq
             self.last_fsync_s = time.perf_counter() - t0
             metrics.observe("server_wal_fsync_seconds", self.last_fsync_s)
             return self.synced_rv
@@ -677,6 +798,13 @@ class DurableStore:
                 self._appended = self._synced_marker = 0
                 self.wal_records = 0
                 self.wal_bytes = 0
+                # the poisoned segments' records are presumed lost and
+                # the heal snapshot recaptures state wholesale: a
+                # follower mid-tail cannot prove continuity across the
+                # episode, so clear the ship ring — its next poll
+                # falls off and bootstraps from the heal snapshot
+                self._ship.clear()
+                self.synced_seq = self._seq
                 # while poisoned, appends drop without consuming seq,
                 # so the probe's is the horizon (same freeze-time rule
                 # as snapshot()).  Stamp the snapshot one BELOW it:
@@ -756,6 +884,7 @@ class DurableStore:
                     self._poison(f"fsync:{getattr(e, 'strerror', e)}")
                     raise ReadOnlyError(self.poisoned) from None
                 self.synced_rv = self._tail_rv
+                self.synced_seq = self._seq
                 frozen = self._segments()
                 self._open_segment_locked()
                 self._appended = self._synced_marker = 0

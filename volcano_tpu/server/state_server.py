@@ -86,8 +86,9 @@ class StateServer:
     """Owns the authoritative store + event log + leases."""
 
     def __init__(self, cluster: Optional[FakeCluster] = None,
-                 durable=None):
+                 durable=None, replication=None):
         self.durable = durable                 # DurableStore or None
+        self.repl = replication                # replication.Replication
         recovery = None
         if durable is not None:
             recovery = getattr(durable, "recovery", None)
@@ -175,6 +176,11 @@ class StateServer:
         self._traces: collections.deque = collections.deque(
             maxlen=TRACE_RING)
         cluster.watch(self._on_store_event)
+        if self.repl is not None:
+            if durable is None:
+                raise ValueError("replication requires a durable "
+                                 "store (--data-dir)")
+            self.repl.attach(self)
         if durable is not None and recovery.cluster is None:
             # first boot of this data dir (possibly seeded from a
             # legacy --state file): the baseline must be durable
@@ -300,22 +306,36 @@ class StateServer:
         """Events are released to watchers/snapshots only once their
         WAL records are fsync'd: a mirror can then never hold an event
         a crash un-happens, which is what makes a delta resync across
-        a restart exact (docs/design/durability.md)."""
+        a restart exact (docs/design/durability.md).  Leading a
+        replica group tightens the gate to the QUORUM horizon: an
+        event only a doomed leader holds must never reach a mirror,
+        or a promotion would un-happen state a mirror already saw."""
         if self.durable is None:
             return self._rv
-        return min(self._rv, self.durable.synced_rv)
+        vis = min(self._rv, self.durable.synced_rv)
+        if self.repl is not None and self.repl.is_leader:
+            vis = min(vis, self.repl.quorum_rv())
+        return vis
 
     def commit(self) -> None:
         """Durability barrier before an ack: fsync everything appended
         so far (group commit — one fsync covers concurrent handlers),
-        then wake watchers gated on the synced horizon.
+        then wake watchers gated on the synced horizon.  Leading a
+        replica group, the barrier extends to the commit quorum: the
+        ack waits until a majority holds the records durably — the
+        wait doubling as the fence that stops a partitioned leader
+        acking writes a promotion would lose.
 
         Raises durability.ReadOnlyError when the store is poisoned
-        (failed fsync / full disk): the caller must 503 instead of
-        acking state that cannot be made durable."""
+        (failed fsync / full disk) or the replication quorum is lost:
+        the caller must 503 instead of acking state that cannot be
+        made durable."""
         if self.durable is None:
             return
         self.durable.commit()
+        if self.repl is not None:
+            self.repl.notify_durable()      # wake /wal long-polls
+            self.repl.wait_quorum()         # leader only; may raise
         with self._event_cv:
             self._event_cv.notify_all()
 
@@ -381,7 +401,152 @@ class StateServer:
                "visible_rv": self._visible_rv()}
         if self.durable is not None:
             out.update(self.durable.status())
+        if self.repl is not None:
+            out["replication"] = self.repl.status()
         return out
+
+    # -- replication (server/replication.py) ---------------------------
+
+    def replica_snapshot_doc(self) -> dict:
+        """The follower-bootstrap payload: the full disk snapshot doc
+        (stores + leases + req cache) plus the WAL seq horizon, term
+        and epoch the tail resumes from.  The seq is read BEFORE the
+        capture, so records appended during the capture overlap the
+        doc — the follower skips store events at rv <= the doc's rv
+        (the same rotated-then-snapshotted rule recovery applies) and
+        the private record kinds replay idempotently.
+
+        Only LOCAL durability is required here — never the commit
+        quorum: a joining follower calls this to BECOME part of that
+        quorum (waiting for it would deadlock the join)."""
+        self.durable.commit()
+        if self.repl is not None:
+            self.repl.notify_durable()
+        seq0 = self.durable.synced_seq
+        doc = self.disk_snapshot_doc()
+        doc["wal_seq"] = seq0
+        doc["epoch"] = self.epoch
+        if self.repl is not None:
+            doc["term"] = self.repl.term
+        return doc
+
+    def install_replica_snapshot(self, doc: dict) -> None:
+        """Follower full re-sync: replace store, event ring, leases,
+        req cache and the local WAL wholesale with the leader's
+        replica snapshot (reset_from_snapshot discards the local
+        segments — the leader's history supersedes them)."""
+        from volcano_tpu.server.durability import decode_stores_into
+        from volcano_tpu.webhooks import default_admission
+        epoch = doc.get("epoch") or self.epoch
+        cluster = FakeCluster()
+        decode_stores_into(cluster, doc.get("stores", {}))
+        # keep THIS replica's configured admission (e.g. a
+        # --webhook-url RemoteAdmission): a bootstrap replaces the
+        # data, never the policy chain a promotion will enforce
+        cluster.admission = getattr(self.cluster, "admission", None) \
+            or default_admission()
+        now_m, now_w = time.monotonic(), time.time()
+        with self._event_cv:
+            self.durable.reset_from_snapshot(doc, epoch)
+            cluster.watch(self._on_store_event)
+            self.cluster = cluster
+            self.epoch = epoch
+            self._rv = int(doc.get("rv", 0))
+            self._events.clear()
+            self._leases.clear()
+            for name, rec in (doc.get("leases") or {}).items():
+                exp_wall = float(rec["expires_wall"])
+                if exp_wall > now_w:
+                    self._leases[name] = Lease(
+                        rec["holder"], now_m + (exp_wall - now_w))
+            self._req_cache.clear()
+            for rec in (doc.get("req_cache") or []):
+                self._req_cache[rec["id"]] = (int(rec["code"]),
+                                              rec["resp"])
+            self._rebuild_chip_maps()
+            self._event_cv.notify_all()
+
+    def apply_shipped(self, lines) -> None:
+        """Fold one shipped batch into this follower: verify EVERY
+        record's CRC + sequence first (a corrupt or torn shipped
+        record refuses the whole batch — never a partial apply), then
+        journal the leader-framed lines verbatim, apply them to the
+        store/leases/req-cache, and fsync before the new rv becomes
+        visible — the bounded-staleness contract: a follower never
+        serves an rv it has not durably applied."""
+        from volcano_tpu.server.durability import (apply_event_obj,
+                                                   parse_record)
+        from volcano_tpu.server.replication import \
+            ShippedCorruptionError
+        parsed = []
+        seq = self.durable.synced_seq
+        for line in lines:
+            rec, bad = parse_record(line.rstrip("\n"))
+            if rec is None:
+                raise ShippedCorruptionError(
+                    f"record after seq {seq}: {bad}")
+            q = int(rec.get("q", 0))
+            if q <= seq:
+                continue                    # overlap re-ship: skip
+            if q != seq + 1:
+                raise ShippedCorruptionError(
+                    f"sequence gap {seq}->{q}")
+            seq = q
+            parsed.append((line, q, rec))
+        if not parsed:
+            return
+        with self._event_cv:
+            snap_rv = self.durable.snapshot_rv
+            for line, q, rec in parsed:
+                kind = rec.get("k")
+                erv = int(rec.get("rv", 0))
+                self.durable.append_shipped(line, q, erv)
+                if kind == "_probe":
+                    continue
+                if kind == "_lease":
+                    o = rec["o"]
+                    if o.get("holder"):
+                        self._leases[o["name"]] = Lease(
+                            o["holder"], time.monotonic() +
+                            (float(o["expires_wall"]) - time.time()))
+                    else:
+                        self._leases.pop(o["name"], None)
+                elif kind == "_req":
+                    o = rec["o"]
+                    self._req_cache[o["id"]] = (int(o["code"]),
+                                                o["resp"])
+                    from volcano_tpu.server.durability import REQ_CACHE
+                    while len(self._req_cache) > REQ_CACHE:
+                        self._req_cache.popitem(last=False)
+                elif kind == "_drain":
+                    drained = set(rec["o"].get("cids") or [])
+                    if drained:
+                        self.cluster.commands = [
+                            c for c in self.cluster.commands
+                            if not (isinstance(c, dict)
+                                    and c.get("cid") in drained)]
+                else:
+                    if erv <= snap_rv:
+                        continue    # already in the bootstrap snapshot
+                    obj = codec.decode(rec["o"])
+                    apply_event_obj(self.cluster, kind, obj)
+                    self._track_chips(kind, obj)
+                    self._rv = max(self._rv, erv)
+                    self._events.append((erv, kind, rec["o"]))
+        # durability BEFORE visibility: the fsync advances synced_rv,
+        # which is what _visible_rv releases to this replica's readers
+        self.durable.commit()
+        with self._event_cv:
+            self._event_cv.notify_all()
+
+    def on_promote(self) -> None:
+        """Follower -> leader: bump the BOOT half of the epoch (same
+        BASE: the rv history is WAL-continuous, mirrors delta-resync
+        across the promotion) and wake everything gated on roles."""
+        new_epoch = self.durable._bump_epoch(continuous=True)
+        with self._event_cv:
+            self.epoch = new_epoch
+            self._event_cv.notify_all()
 
     @staticmethod
     def _audit_record(idx: int, kind: str, obj) -> dict:
@@ -603,6 +768,24 @@ class _Handler(BaseHTTPRequestHandler):
             "readonly": True},
             headers={"Retry-After": RETRY_AFTER_S})
 
+    def _follower_503(self, unproven: bool = False):
+        """A mutation hit a replica whose write path is dead — or a
+        READ hit a replica that has not yet re-proven continuity with
+        the group (a rebooting deposed leader must not serve its
+        possibly-diverged local tail) — the read-only degrade shape
+        (503 + Retry-After) with the leader hint the client
+        re-routes on."""
+        repl = self.state.repl
+        what = ("has not re-synced with the group yet; reads come "
+                "back after its bootstrap" if unproven else
+                "refuses writes; they go to the leader")
+        return self._json(503, {
+            "error": f"replica {repl.replica_id} "
+                     f"({repl.role}, term {repl.term}) {what}",
+            "readonly": True, "follower": True,
+            "leader": repl.leader_hint()},
+            headers={"Retry-After": RETRY_AFTER_S})
+
     def _authorized(self) -> bool:
         """Every data route — reads included — requires the cluster
         bearer token when one is configured (VERDICT r4 weak #4: an
@@ -653,6 +836,14 @@ class _Handler(BaseHTTPRequestHandler):
             and fault.kind == "trickle" else 0.0
         if not self._authorized():
             return None
+        if st.repl is not None and not st.repl.proven and \
+                url.path not in ("/replication", "/faults"):
+            # an unproven follower (rebooting deposed leader, pending
+            # bootstrap) serves NO store reads: its local tail may
+            # hold records the group's quorum never acked, which the
+            # imminent re-sync will discard — state a mirror must
+            # never have seen
+            return self._follower_503(unproven=True)
         if url.path == "/snapshot":
             from volcano_tpu.server.durability import ReadOnlyError
             if st.readonly_reason:
@@ -681,6 +872,70 @@ class _Handler(BaseHTTPRequestHandler):
                 "rules": self.faults.status()})
         if url.path == "/durability":
             return self._json(200, st.durability_status())
+        if url.path == "/replication":
+            if st.repl is None:
+                return self._json(200, {"enabled": False})
+            return self._json(200, dict(st.repl.status(),
+                                        enabled=True,
+                                        epoch=st.epoch))
+        if url.path == "/wal":
+            # WAL shipping lane (leader): framed records past the
+            # follower's seq, long-polled; the request doubles as the
+            # follower's durability ack (applied_seq/applied_rv feed
+            # the commit quorum)
+            if st.repl is None:
+                return self._json(404, {"error": "not replicated"})
+            q = parse_qs(url.query)
+
+            def qi(name, default=0):
+                try:
+                    return int(q.get(name, [default])[0])
+                except (TypeError, ValueError):
+                    return default
+            try:
+                timeout = min(float(q.get("timeout", ["5"])[0]), 30.0)
+            except (TypeError, ValueError):
+                timeout = 5.0
+            resp = st.repl.ship(
+                since_seq=qi("since_seq"),
+                follower=q.get("follower", ["?"])[0],
+                applied_seq=qi("applied_seq"),
+                applied_rv=qi("applied_rv"),
+                term=qi("term"),
+                timeout=timeout)
+            if self.faults is not None and resp.get("records"):
+                rule = self.faults.decide("server", "/wal",
+                                          kinds=("corrupt_ship",))
+                if rule is not None:
+                    # shipped-segment corruption: flip a byte INSIDE
+                    # one framed record (the JSON envelope stays
+                    # valid; only the follower's per-record CRC can
+                    # tell) — the follower must refuse, never apply
+                    recs = list(resp["records"])
+                    mid = recs[len(recs) // 2]
+                    pos = min(len(mid) - 2, max(12, len(mid) // 2))
+                    flipped = chr(ord(mid[pos]) ^ 0x08)
+                    recs[len(recs) // 2] = (mid[:pos] + flipped +
+                                            mid[pos + 1:])
+                    resp = dict(resp, records=recs)
+            return self._json(200, resp, trickle_ms=trickle)
+        if url.path == "/replica_snapshot":
+            # follower bootstrap: the FULL disk doc (stores + leases +
+            # req cache) plus seq/term/epoch.  Leader-only — a
+            # follower's own horizon could be stale — and refused
+            # while read-only, like /snapshot.
+            from volcano_tpu.server.durability import ReadOnlyError
+            if st.durable is None:
+                return self._json(404, {"error": "not durable"})
+            if st.repl is not None and not st.repl.is_leader:
+                return self._follower_503()
+            if st.readonly_reason:
+                return self._readonly_503(st.readonly_reason)
+            try:
+                doc = st.replica_snapshot_doc()
+            except ReadOnlyError as e:
+                return self._readonly_503(e.reason)
+            return self._json(200, doc, trickle_ms=trickle)
         if url.path == "/leases":
             now = time.monotonic()
             with st._lock:
@@ -751,6 +1006,28 @@ class _Handler(BaseHTTPRequestHandler):
             body = self._body()
         except (ValueError, json.JSONDecodeError) as e:
             return self._json(400, {"error": str(e)})
+        if url.path in ("/campaign", "/promote"):
+            # replication control plane: votes and forced promotion
+            # bypass the write gates (they are ABOUT the gates)
+            if st.repl is None:
+                return self._json(404, {"error": "not replicated"})
+            if url.path == "/campaign":
+                return self._json(200, st.repl.handle_campaign(body))
+            if st.repl.is_leader:
+                return self._json(200, {"ok": True, "already": True,
+                                        "term": st.repl.term})
+            # promote() may ABANDON (term moved / vote granted to a
+            # concurrent candidate mid-call): report that truthfully
+            # — an operator forcing failover must not see a false ok
+            won = st.repl.promote(st.repl.term + 1)
+            return self._json(200, {"ok": won,
+                                    "role": st.repl.role,
+                                    "term": st.repl.term})
+        # follower gate: a replica whose write path is dead refuses
+        # every mutation with the read-only 503 shape + a leader hint
+        # (PR 8's degrade mode IS this role, minus the hint)
+        if st.repl is not None and not st.repl.may_write():
+            return self._follower_503()
         # read-only degrade gate: while the WAL is poisoned nothing
         # can be made durable, so mutation routes are refused UP FRONT
         # (503 + Retry-After) before they touch the in-memory store —
@@ -952,6 +1229,9 @@ class _Handler(BaseHTTPRequestHandler):
         if not self._authorized():
             return None
         url = urlparse(self.path)
+        if self.state.repl is not None and \
+                not self.state.repl.may_write():
+            return self._follower_503()
         if self.state.readonly_reason:
             return self._readonly_503(self.state.readonly_reason)
         if not url.path.startswith("/objects/"):
@@ -979,7 +1259,8 @@ class _Handler(BaseHTTPRequestHandler):
 def serve(port: int = 0, cluster: Optional[FakeCluster] = None,
           tick_period: float = 0.0, tls_cert: str = "",
           tls_key: str = "", token: str = "", data_dir: str = "",
-          durable=None, faults=None, wal_force_truncate: bool = False
+          durable=None, faults=None, wal_force_truncate: bool = False,
+          replication=None
           ) -> Tuple[ThreadingHTTPServer, StateServer]:
     """Start the server on 127.0.0.1:port (0 = ephemeral); returns
     (http_server, state).  Caller runs http_server.serve_forever()
@@ -1003,16 +1284,29 @@ def serve(port: int = 0, cluster: Optional[FakeCluster] = None,
             vfs = faults_mod.FaultyVFS(faults)
         durable = DurableStore(data_dir, vfs=vfs,
                                force_truncate=wal_force_truncate)
-    state = StateServer(cluster, durable=durable)
+    state = StateServer(cluster, durable=durable,
+                        replication=replication)
     httpd = serve_threaded(_Handler, {"state": state, "token": token,
                                       "faults": faults},
                            port, "state-server",
                            tls_cert=tls_cert, tls_key=tls_key)
+    if replication is not None:
+        # the listener is up: peers can reach us, the tail/watchdog
+        # threads may start
+        if not replication.self_url:
+            replication.self_url = \
+                f"http://127.0.0.1:{httpd.server_address[1]}"
+        replication.start()
     state.tick_stop = threading.Event()
     if tick_period > 0:
         def tick_loop():
             while not state.tick_stop.wait(tick_period):
                 try:
+                    if state.repl is not None and \
+                            not state.repl.may_write():
+                        # a follower's kubelet is the LEADER's tick,
+                        # shipped like any other mutation
+                        continue
                     if state.readonly_reason:
                         # no kubelet mutations while read-only: their
                         # journal records would be dropped, and memory
@@ -1023,8 +1317,15 @@ def serve(port: int = 0, cluster: Optional[FakeCluster] = None,
                     # tick mutations have no ack path; commit here so
                     # they become watch-visible (and durable) promptly
                     state.commit()
-                except Exception:  # noqa: BLE001
-                    log.exception("tick failed")
+                except Exception as e:  # noqa: BLE001
+                    from volcano_tpu.server.durability import \
+                        ReadOnlyError
+                    if isinstance(e, ReadOnlyError):
+                        # quorum not assembled yet / degrade window:
+                        # routine for a replicated boot, not an error
+                        log.debug("tick commit deferred: %s", e)
+                    else:
+                        log.exception("tick failed")
         threading.Thread(target=tick_loop, name="kubelet-tick",
                          daemon=True).start()
     if durable is not None:
