@@ -672,30 +672,10 @@ def test_vtpctl_explain_and_elastic_views(tmp_path, capsys):
         eapi.RESIZE_MIGRATE
 
 
-# -- metric-label cardinality (PR 5 rule extended) ---------------------
-
-def test_elastic_metric_labels_are_bounded():
-    """elastic_* families may carry ONLY the bounded resize-kind enum:
-    job keys and slice names never label them (a 10k-job fleet must
-    not mint 10k series)."""
-    cluster, mgr, sched = plane([("sa", "v5e-16"), ("sb", "v5e-16")])
-    cluster.add_vcjob(elastic_job())
-    drive(cluster, mgr, sched, 12)           # grow executes
-    cluster.add_vcjob(fixed_job())
-    drive(cluster, mgr, sched, 14)           # shrink executes
-    mgr.stop()
-    dumped = metrics.dump()
-    elastic_lines = [l for l in dumped.splitlines()
-                     if l.startswith("elastic_")]
-    assert elastic_lines                     # families are live
-    for line in elastic_lines:
-        assert "etrain" not in line, line
-        if "{" in line:
-            labels = line.split("{", 1)[1].split("}", 1)[0]
-            for pair in labels.split(","):
-                k, _, v = pair.partition("=")
-                assert k == "kind", line
-                assert v.strip('"') in eapi.RESIZE_KINDS, line
+# metric-label cardinality: the per-family copy of this test moved to
+# tests/test_lint.py::test_live_exposition_honours_label_schema — one
+# linter-driven check over the WHOLE exposition against
+# bundle.FAMILY_LABELS (the elastic_* kind enum included).
 
 
 # -- workload: dp-dimension resize is loss-continuous ------------------

@@ -473,45 +473,10 @@ def test_session_gauges_fragmentation_and_starvation():
     assert ages2["default"]["gangs"] == ages["default"]["gangs"]
 
 
-# -- metric-label cardinality (PR 5 rule extended) ---------------------
-
-def test_goodput_metric_labels_are_bounded():
-    """goodput_*/frag_*/starvation_* families may carry ONLY bounded
-    labels: generation (the GENERATIONS enum), decision
-    (allowed|declined), queue (operator config).  Job keys, pod and
-    node names never label them — a 10k-job fleet must not mint 10k
-    series."""
-    metrics.reset()
-    trace.reset()
-    cluster = make_tpu_cluster([("sa", "v5e-16")])
-    cluster.add_podgroup(PodGroup(name="etrain", namespace="default"))
-    cluster.put_object("goodputreport", gapi.GoodputReport(
-        node="sa-w0", ts=1.0, usages=[gapi.PodGoodput(
-            pod_key="default/p", uid="u1", job="default/etrain",
-            generation="v5e", step=10, steps_per_s=2.0,
-            allocated_s=1.0, productive_s=1.0)]))
-    sched = Scheduler(cluster, schedule_period=0)
-    sched.run_once()
-    metrics.inc("goodput_gated_grows_total", decision="declined")
-
-    allowed_keys = {"generation", "queue", "decision"}
-    lines = [l for l in metrics.dump().splitlines()
-             if l.startswith(("goodput_", "frag_", "starvation_"))]
-    assert lines                              # families are live
-    assert any(l.startswith("frag_index") for l in lines)
-    for line in lines:
-        assert "etrain" not in line, line     # no job keys
-        assert "sa-w0" not in line, line      # no node names
-        if "{" in line:
-            labels = line.split("{", 1)[1].split("}", 1)[0]
-            for pair in labels.split(","):
-                k, _, v = pair.partition("=")
-                assert k in allowed_keys, line
-                v = v.strip('"')
-                if k == "generation":
-                    assert v in gapi.GENERATIONS, line
-                elif k == "decision":
-                    assert v in gp.GATE_DECISIONS, line
+# metric-label cardinality: the per-family copy of this test moved to
+# tests/test_lint.py::test_live_exposition_honours_label_schema — one
+# linter-driven check over the WHOLE exposition against
+# bundle.FAMILY_LABELS (goodput_*/frag_*/starvation_* included).
 
 
 # -- the closed loop: goodput-gated elastic grow -----------------------

@@ -1,0 +1,454 @@
+"""vtplint + LockAudit: the invariant gate, in tier-1.
+
+Four layers, each pinned so the linter itself cannot rot:
+
+  1. the full tree lints clean — ``tools/vtplint.py --strict``
+     semantics in-process (AST rules + flakes + registry checks),
+     with ZERO unsuppressed findings and ZERO unexplained
+     suppressions;
+  2. per-rule broken fixtures — one minimal violating snippet per
+     shipped rule, asserted to be CAUGHT (a rule that silently stops
+     firing is worse than no rule);
+  3. the metric label schema over a LIVE exposition — one real
+     scheduling session covering the trace/elastic/goodput families,
+     validated wholesale against bundle.FAMILY_LABELS.  This is the
+     linter-driven replacement for the three per-PR label-cardinality
+     tests (test_trace/test_elastic/test_goodput) it deduplicated;
+  4. the runtime lock-order auditor — synthetic inversion/guard
+     fixtures plus a real in-process server+scheduler drive under
+     audit with an empty violation report (the chaos conductor's
+     ``--lock-audit`` runs the same audit across the process plane).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from volcano_tpu import metrics, trace
+from volcano_tpu.analysis import astlint, flakes, lockaudit, registry
+from volcano_tpu.analysis.schema import check_exposition
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT_PATHS = [os.path.join(REPO, "volcano_tpu"),
+              os.path.join(REPO, "tools")]
+
+
+@pytest.fixture(autouse=True)
+def _clean_registries():
+    metrics.reset()
+    trace.reset()
+    yield
+    metrics.reset()
+    trace.reset()
+
+
+# -- 1. the tree is clean ----------------------------------------------
+
+def test_vtplint_strict_tree_is_clean():
+    findings = astlint.lint_paths(LINT_PATHS)
+    active = [f for f in findings if f.suppressed is None]
+    assert not active, "\n".join(f.format() for f in active)
+
+
+def test_flakes_tree_is_clean():
+    findings = flakes.check_paths(LINT_PATHS)
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_registry_checks_pass():
+    findings = registry.check_all()
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_suppression_inventory_is_fully_explained():
+    findings = astlint.lint_paths(LINT_PATHS)
+    unexplained = [f for f in findings
+                   if f.rule == "unexplained-suppression"]
+    assert not unexplained, \
+        "\n".join(f.format() for f in unexplained)
+    # and the inventory itself is non-empty: the waivers ARE the
+    # documented exceptions to the rules (wire wall-expiry rebases,
+    # state-compare-safe POSTs, best-effort probes)
+    suppressed = [f for f in findings if f.suppressed]
+    assert suppressed
+
+
+def test_vtplint_cli_strict_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "vtplint.py"),
+         "--strict", "--json"],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["findings"] == 0
+    assert all(s["reason"] for s in doc["suppressions"])
+
+
+# -- 2. broken fixtures: every rule still fires ------------------------
+
+def _lint(src, path="volcano_tpu/server/state_server.py"):
+    return astlint.Linter().lint_source(src, path)
+
+
+def _rules(findings):
+    return {f.rule for f in findings if f.suppressed is None}
+
+
+def test_rule_req_id_fires():
+    src = ("class C:\n"
+           "    def create(self, body):\n"
+           "        return self._request('POST', '/objects/vcjob',"
+           " body)\n")
+    assert "req-id" in _rules(_lint(src, "volcano_tpu/cache/x.py"))
+
+
+def test_rule_req_id_satisfied_by_key():
+    src = ("class C:\n"
+           "    def create(self, body):\n"
+           "        return self._request('POST', '/objects/vcjob',"
+           " body, idempotency_key=True)\n")
+    assert "req-id" not in _rules(_lint(src, "volcano_tpu/cache/x.py"))
+
+
+def test_rule_wall_clock_fires_in_scoped_file():
+    src = "import time\ndeadline = time.time() + 5\n"
+    assert "wall-clock" in _rules(_lint(src))
+
+
+def test_rule_wall_clock_fires_in_lease_function_anywhere():
+    src = ("import time\n"
+           "def renew_lease():\n"
+           "    return time.time() + 15\n")
+    assert "wall-clock" in _rules(
+        _lint(src, "volcano_tpu/somewhere.py"))
+    # ...but ordinary timing code outside the scope is untouched
+    src2 = ("import time\n"
+            "def measure():\n"
+            "    return time.time()\n")
+    assert "wall-clock" not in _rules(
+        _lint(src2, "volcano_tpu/somewhere.py"))
+
+
+def test_rule_metric_family_fires():
+    src = ("from volcano_tpu import metrics\n"
+           "metrics.inc('totally_unregistered_total')\n")
+    assert "metric-family" in _rules(
+        _lint(src, "volcano_tpu/actions/x.py"))
+
+
+def test_rule_metric_labels_fires_on_undeclared_key():
+    src = ("from volcano_tpu import metrics\n"
+           "metrics.inc('elastic_decisions_total', job='ns/j')\n")
+    assert "metric-labels" in _rules(
+        _lint(src, "volcano_tpu/actions/x.py"))
+
+
+def test_rule_metric_labels_fires_on_out_of_enum_value():
+    src = ("from volcano_tpu import metrics\n"
+           "metrics.inc('elastic_decisions_total', kind='explode')\n")
+    assert "metric-labels" in _rules(
+        _lint(src, "volcano_tpu/actions/x.py"))
+    # a member of the bounded enum is fine
+    src2 = ("from volcano_tpu import metrics\n"
+            "metrics.inc('elastic_decisions_total', kind='grow')\n")
+    assert "metric-labels" not in _rules(
+        _lint(src2, "volcano_tpu/actions/x.py"))
+
+
+def test_rule_append_lock_fires():
+    src = ("class S:\n"
+           "    def record(self, rec):\n"
+           "        self.durable.append(rec)\n")
+    assert "append-lock" in _rules(_lint(src))
+    src2 = ("class S:\n"
+            "    def record(self, rec):\n"
+            "        with self._lock:\n"
+            "            self.durable.append(rec)\n")
+    assert "append-lock" not in _rules(_lint(src2))
+
+
+def test_rule_except_pass_fires():
+    src = ("def poke(path):\n"
+           "    try:\n"
+           "        open(path).read()\n"
+           "    except Exception:\n"
+           "        pass\n")
+    assert "except-pass" in _rules(_lint(src, "volcano_tpu/x.py"))
+    # a narrow, non-I/O or handled except is not flagged
+    src2 = ("def poke(d):\n"
+            "    try:\n"
+            "        return d['k']\n"
+            "    except KeyError:\n"
+            "        pass\n")
+    assert "except-pass" not in _rules(_lint(src2, "volcano_tpu/x.py"))
+
+
+def test_suppression_with_reason_waives_and_is_inventoried():
+    src = ("import time\n"
+           "# vtplint: disable=wall-clock (fixture: wire carries "
+           "wall time)\n"
+           "deadline = time.time() + 5\n")
+    findings = _lint(src)
+    assert "wall-clock" not in _rules(findings)
+    assert any(f.rule == "wall-clock" and f.suppressed
+               for f in findings)
+
+
+def test_unexplained_suppression_is_itself_a_finding():
+    src = ("import time\n"
+           "# vtplint: disable=wall-clock\n"
+           "deadline = time.time() + 5\n")
+    assert "unexplained-suppression" in _rules(_lint(src))
+
+
+def test_flakes_unused_import_fires():
+    findings = flakes.check_source("import os\nx = 1\n",
+                                   "volcano_tpu/x.py")
+    assert any(f.rule in ("unused-import", "pyflakes")
+               for f in findings)
+
+
+def test_flakes_skips_type_checking_and_try_imports():
+    src = ("from typing import TYPE_CHECKING\n"
+           "if TYPE_CHECKING:\n"
+           "    from volcano_tpu.framework.session import Session\n"
+           "try:\n"
+           "    import optional_dep\n"
+           "except ImportError:\n"
+           "    optional_dep = None\n")
+    findings = flakes.check_source(src, "volcano_tpu/x.py")
+    assert not [f for f in findings if f.rule == "unused-import"]
+
+
+def test_flakes_syntax_error_fires():
+    findings = flakes.check_source("def broken(:\n",
+                                   "volcano_tpu/x.py")
+    assert any(f.rule in ("syntax-error", "pyflakes")
+               for f in findings)
+
+
+def test_schema_checker_fixtures():
+    # undeclared family
+    assert check_exposition("bogus_family_total 1\n")
+    # undeclared label key on a declared family
+    assert check_exposition(
+        'elastic_decisions_total{job="ns/j"} 1\n')
+    # out-of-enum value on a bounded label
+    assert check_exposition(
+        'elastic_decisions_total{kind="explode"} 1\n')
+    # the happy path is silent
+    assert not check_exposition(
+        'elastic_decisions_total{kind="grow"} 1\n'
+        'frag_index{generation="v5e"} 0.25\n'
+        "goodput_jobs 3\n")
+
+
+# -- 3. live exposition vs the label schema (the deduped test) ---------
+
+def _elastic_job(name="etrain", slices=1, lo=1, hi=2):
+    from volcano_tpu.api import elastic as eapi
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.api.vcjob import TaskSpec, VCJob
+    return VCJob(
+        name=name, min_available=slices * 4,
+        annotations={
+            eapi.ELASTIC_MIN_SLICES_ANNOTATION: str(lo),
+            eapi.ELASTIC_MAX_SLICES_ANNOTATION: str(hi),
+            eapi.ELASTIC_SLICES_ANNOTATION: str(slices)},
+        plugins={"jax": []},
+        tasks=[TaskSpec(name="worker", replicas=slices * 4,
+                        template=make_pod(
+                            "t", requests={"cpu": 8, TPU: 4}))])
+
+
+def test_live_exposition_honours_label_schema():
+    """One compact control-plane drive lighting up the trace,
+    elastic, goodput, fairness and scheduler families — then the
+    WHOLE exposition is validated against bundle.FAMILY_LABELS.
+    Replaces the three per-PR cardinality tests (PR 5/6/7): any
+    family ANY subsystem emits with a job key, a free-text reason or
+    an out-of-enum label value fails here, without a per-subsystem
+    copy of the assertion."""
+    from volcano_tpu.api import goodput as gapi
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.api.vcjob import TaskSpec, VCJob
+    from volcano_tpu.controllers import ControllerManager
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.simulator import make_tpu_cluster
+    from volcano_tpu.uthelper import gang_job
+    from volcano_tpu.webhooks import default_admission
+
+    conf = {
+        "actions": "enqueue, allocate, elastic, backfill",
+        "tiers": [
+            {"plugins": [{"name": "priority"}, {"name": "gang"},
+                         {"name": "failover"}, {"name": "elastic"},
+                         {"name": "conformance"}]},
+            {"plugins": [{"name": "overcommit"}, {"name": "drf"},
+                         {"name": "predicates"},
+                         {"name": "proportion"},
+                         {"name": "nodeorder"}, {"name": "binpack"}]},
+        ],
+        "configurations": {"elastic": {"elastic.cooldownSeconds": 0}},
+    }
+    cluster = make_tpu_cluster([("sa", "v5e-16"), ("sb", "v5e-16")])
+    cluster.admission = default_admission()
+    # a stuck gang: unschedulable-reason + pending families
+    pg, pods = gang_job("stuck", replicas=2, requests={"cpu": 1})
+    for p in pods:
+        p.node_selector = {"zone": "nowhere"}
+    cluster.add_podgroup(pg)
+    for p in pods:
+        cluster.add_pod(p)
+    # an elastic gang that grows into the idle slice
+    cluster.add_vcjob(_elastic_job())
+    # a goodput report folding into podgroup annotations
+    cluster.put_object("goodputreport", gapi.GoodputReport(
+        node="sa-w0", ts=1.0, usages=[gapi.PodGoodput(
+            pod_key="default/p", uid="u1", job="default/etrain",
+            generation="v5e", step=10, steps_per_s=2.0,
+            allocated_s=1.0, productive_s=1.0)]))
+    mgr = ControllerManager(cluster, enabled=[
+        "job", "podgroup", "queue", "failover", "elastic"])
+    sched = Scheduler(cluster, conf=conf, schedule_period=0)
+    try:
+        for _ in range(12):
+            mgr.sync_all()
+            sched.run_once()
+            cluster.tick()
+    finally:
+        mgr.stop()
+    metrics.inc("goodput_gated_grows_total", decision="declined")
+
+    dumped = metrics.dump()
+    # the families this drive must have lit (guards against the test
+    # going quietly vacuous)
+    for prefix in ("sched_span_seconds", "sched_phase_seconds",
+                   "sched_unschedulable_reasons_total",
+                   "elastic_decisions_total", "frag_index",
+                   "action_latency_seconds", "queue_share"):
+        assert any(line.startswith(prefix)
+                   for line in dumped.splitlines()), prefix
+    violations = check_exposition(dumped)
+    assert not violations, "\n".join(violations)
+    # and the cardinality spot-checks the old tests pinned: job keys
+    # never label the bounded families
+    for line in dumped.splitlines():
+        if line.startswith(("sched_", "elastic_", "goodput_",
+                            "frag_", "starvation_")):
+            assert "etrain" not in line, line
+            assert "default/stuck" not in line, line
+            assert "sa-w0" not in line, line
+
+
+# -- 4. the runtime lock-order auditor ---------------------------------
+
+@pytest.fixture
+def audit():
+    lockaudit.install()
+    lockaudit.reset()
+    yield lockaudit
+    lockaudit.reset()
+    lockaudit.uninstall()
+
+
+def test_lockaudit_detects_inversion(audit):
+    a, b = audit.make_lock("A"), audit.make_lock("B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    rep = audit.report()
+    kinds = [v["kind"] for v in rep["violations"]]
+    assert "inversion" in kinds
+    assert ["A", "B"] in rep["cycles"]
+    inv = next(v for v in rep["violations"]
+               if v["kind"] == "inversion")
+    assert inv["stack_forward"] and inv["stack_reverse"]
+
+
+def test_lockaudit_consistent_order_is_clean(audit):
+    a, b = audit.make_lock("A"), audit.make_lock("B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    rep = audit.report()
+    assert not rep["violations"]
+    assert not rep["cycles"]
+    assert ["A", "B", 3] in rep["edges"]
+
+
+def test_lockaudit_condition_wait_keeps_bookkeeping(audit):
+    import threading
+    import time as _time
+    lk = audit.make_lock("CV")
+    cv = threading.Condition(lk)
+    woke = []
+
+    def waiter():
+        with cv:
+            cv.wait(timeout=1.0)
+            woke.append(True)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    _time.sleep(0.05)
+    with cv:
+        cv.notify_all()
+    t.join()
+    assert woke
+    assert not audit.report()["violations"]
+
+
+def test_lockaudit_guarded_store(audit):
+    lk = audit.make_lock("G")
+    store = audit.guard_store({}, lk, "test.store")
+    with lk:
+        store["ok"] = 1                  # under the lock: clean
+    assert not audit.report()["violations"]
+    store["bad"] = 2                     # without the lock: violation
+    viols = audit.report()["violations"]
+    assert any(v["kind"] == "unguarded-mutation"
+               and v["store"] == "test.store" for v in viols)
+
+
+def test_lockaudit_in_process_plane_is_clean(audit, tmp_path):
+    """The tier-1 half of the acceptance smoke: a real StateServer
+    (durable, snapshotting) + scheduler sessions + lease CAS churn
+    under the armed auditor — the acquisition graph must hold zero
+    inversions/cycles/self-deadlocks.  (The chaos conductor's
+    --lock-audit repeats this across the real process plane.)"""
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.server.durability import DurableStore
+    from volcano_tpu.server.state_server import StateServer
+    from volcano_tpu.simulator import make_tpu_cluster
+    from volcano_tpu.uthelper import gang_job
+
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    pg, pods = gang_job("demo", replicas=2, requests={"cpu": 1})
+    st = StateServer(cluster,
+                     durable=DurableStore(str(tmp_path / "state")))
+    cluster.add_podgroup(pg)
+    for p in pods:
+        cluster.add_pod(p)
+    sched = Scheduler(cluster, schedule_period=0)
+    for i in range(3):
+        sched.run_once()
+        cluster.tick()
+        st.lease("scheduler", f"holder-{i % 2}", ttl=0.01)
+        st.commit()
+    st.write_snapshot()
+    rep = audit.report()
+    assert rep["locks"], "the plane must actually exercise locks"
+    assert not rep["violations"], json.dumps(
+        rep["violations"], indent=1, default=str)[:4000]
+    assert not rep["cycles"], rep["cycles"]

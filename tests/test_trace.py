@@ -228,18 +228,14 @@ def test_session_trace_via_scheduler():
     plugin_spans = [d for d in flat if d["kind"] == "plugin"
                     and "calls" in d.get("labels", {})]
     assert plugin_spans
-    # and sched_span_seconds is live with BOUNDED labels
+    # and sched_span_seconds is live with BOUNDED labels.  (The full
+    # label-cardinality sweep — job keys never label the trace
+    # families, values stay in their enums — moved to tests/
+    # test_lint.py::test_live_exposition_honours_label_schema, the
+    # linter-driven check over the whole exposition.)
     dumped = metrics.dump()
     assert 'sched_span_seconds_count{action="allocate"}' in dumped
     assert re.search(r'sched_span_seconds_count\{plugin=', dumped)
-    # job keys never label the TRACE families (cardinality rule:
-    # span/phase/reason labels are bounded enums; job_share et al.
-    # are per-object gauges with their own deletion lifecycle)
-    for line in dumped.splitlines():
-        if line.startswith(("sched_span_", "sched_phase_",
-                            "sched_unschedulable_",
-                            "sched_traces_")):
-            assert "default/stuck" not in line, line
 
 
 def test_pending_reasons_published_and_cleared():

@@ -531,3 +531,33 @@ def test_gzip_on_large_bodies():
             c.close()
     finally:
         httpd.shutdown()
+
+
+def test_put_pod_overcommit_refused(wire):
+    """Whole-pod writes honour the same chip guard as /bind: a stale
+    mirror's pod object carrying node_name + Running must not
+    double-book chips the server already bound (the resurrection
+    hole the lock-audited chaos run exposed; vtplint PR)."""
+    from volcano_tpu.api.resource import TPU
+    a = wire.client()
+    a.add_node(Node(name="t0", allocatable={"cpu": "8",
+                                            TPU: "4"}))
+    a.add_pod(make_pod("w0", requests={"cpu": 1, TPU: 4}))
+    a.bind_pod("default", "w0", "t0")
+
+    # a resurrected pod write: Running on the full node -> 409
+    stale = make_pod("ghost", requests={"cpu": 1, TPU: 4})
+    stale.node_name = "t0"
+    stale.phase = TaskStatus.RUNNING
+    with pytest.raises(ValueError):
+        a.put_object("pod", stale, key=stale.key)
+
+    # replacing a pod's OWN booking on the same node is idempotent
+    mine = make_pod("w0", requests={"cpu": 1, TPU: 4})
+    mine.node_name = "t0"
+    mine.phase = TaskStatus.RUNNING
+    a.put_object("pod", mine, key=mine.key)
+
+    # and an unbound (Pending) write is never capacity-gated
+    pending = make_pod("ghost", requests={"cpu": 1, TPU: 4})
+    a.put_object("pod", pending, key=pending.key)

@@ -347,7 +347,8 @@ class InvariantTracker:
 
 
 def run_conductor(seed: int, duration: float,
-                  classes=DEFAULT_CLASSES, logdir: str = "") -> dict:
+                  classes=DEFAULT_CLASSES, logdir: str = "",
+                  lock_audit: bool = False) -> dict:
     classes = set(classes.split(",")) if isinstance(classes, str) \
         else set(classes)
     sched = build_plan(seed, duration, classes)
@@ -355,7 +356,21 @@ def run_conductor(seed: int, duration: float,
     logdir = logdir or f"/tmp/chaos_conductor/seed-{seed}"
     import shutil
     shutil.rmtree(logdir, ignore_errors=True)
-    zoo = chaoslib.ProcessZoo(logdir)
+    audit_dir = os.path.join(logdir, "lockaudit")
+    if lock_audit:
+        # arm the runtime lock-order auditor (analysis/lockaudit.py)
+        # in EVERY child process (server, replicas, scheduler,
+        # controllers, agents) and in this conductor too: each
+        # process flushes its acquisition graph + violations to
+        # audit_dir at 2Hz and at exit, so even a SIGKILL'd server
+        # incarnation leaves its last graph behind
+        os.makedirs(audit_dir, exist_ok=True)
+        from volcano_tpu.analysis import lockaudit
+        lockaudit.install()
+        zoo = chaoslib.ProcessZoo(logdir, env=chaoslib.repo_env(
+            VTP_LOCK_AUDIT="1", VTP_LOCK_AUDIT_OUT=audit_dir))
+    else:
+        zoo = chaoslib.ProcessZoo(logdir)
     data_dir = os.path.join(logdir, "state")
     progress_root = os.path.join(logdir, "progress")
     os.makedirs(progress_root, exist_ok=True)
@@ -942,10 +957,22 @@ def run_conductor(seed: int, duration: float,
             "crc_drill": crc,
             "ok": not summary["violations"],
         })
-        if summary["violations"]:
+        if lock_audit:
+            # terminate the plane BEFORE merging: SIGTERM triggers
+            # each child's lockaudit flush handler (atexit never runs
+            # under signals), so violations recorded after the last
+            # 2Hz flush — the shutdown window where ordering races
+            # live — still reach the merged report.  terminate_all is
+            # idempotent; the finally's call becomes a no-op.
+            zoo.terminate_all()
+            result["lock_audit"] = _collect_lock_audit(audit_dir)
+            result["ok"] = result["ok"] and not \
+                result["lock_audit"]["violations"]
+        if not result["ok"]:
+            flag = " --lock-audit" if lock_audit else ""
             print(f"\nREPRODUCE: python tools/chaos_conductor.py "
                   f"--seed {seed} --duration {duration} "
-                  f"--classes {','.join(sorted(classes))}",
+                  f"--classes {','.join(sorted(classes))}{flag}",
                   flush=True)
         return result
     finally:
@@ -954,6 +981,45 @@ def run_conductor(seed: int, duration: float,
         if proxy is not None:
             proxy.close()
         zoo.terminate_all()
+
+
+def _collect_lock_audit(audit_dir: str) -> dict:
+    """Merge every process's flushed lockaudit report (plus this
+    conductor's own, in-process) into one graph summary: unique lock
+    sites, merged edges, all violations, all cycles."""
+    import glob
+
+    from volcano_tpu.analysis import lockaudit
+    lockaudit.flush(audit_dir)          # the conductor's own report
+    locks, edges, violations, cycles = {}, {}, [], []
+    same_site = {}
+    reports = sorted(glob.glob(os.path.join(audit_dir, "*.json")))
+    for path in reports:
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            # vtplint: disable=except-pass (a report torn mid-flush by the 2Hz writer; the process's atexit flush supersedes it)
+            continue
+        for name, n in doc.get("locks", {}).items():
+            locks[name] = locks.get(name, 0) + n
+        for a, b, n in doc.get("edges", []):
+            edges[(a, b)] = edges.get((a, b), 0) + n
+        for name, n in doc.get("same_site_nestings", {}).items():
+            same_site[name] = same_site.get(name, 0) + n
+        violations.extend(doc.get("violations", []))
+        for cyc in doc.get("cycles", []):
+            if cyc not in cycles:
+                cycles.append(cyc)
+    return {
+        "processes_reporting": len(reports),
+        "lock_sites": len(locks),
+        "acquisitions_total": sum(locks.values()),
+        "edges": sorted([[a, b, n] for (a, b), n in edges.items()]),
+        "same_site_nestings": same_site,
+        "cycles": cycles,
+        "violations": violations,
+    }
 
 
 def _flippable_record(data_dir: str):
@@ -1213,6 +1279,10 @@ def main(argv=None) -> int:
     ap.add_argument("--print-schedule", action="store_true",
                     help="dump the derived fault plan for --seed and "
                          "exit (no processes; reproducibility check)")
+    ap.add_argument("--lock-audit", action="store_true",
+                    help="arm analysis/lockaudit.py in every process "
+                         "and fail the run on any lock-order/guarded-"
+                         "store violation (the vtplint runtime smoke)")
     args = ap.parse_args(argv)
     classes = args.classes
     if args.print_schedule:
@@ -1227,7 +1297,8 @@ def main(argv=None) -> int:
                           if k != "per_seed"}, indent=1))
         return 0 if doc["zero_violations"] else 1
     out = run_conductor(args.seed, args.duration, classes,
-                        logdir=args.logdir)
+                        logdir=args.logdir,
+                        lock_audit=args.lock_audit)
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 

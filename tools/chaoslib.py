@@ -300,7 +300,13 @@ class ChaosProxy(threading.Thread):
             socks = [client, upstream]
             peer = {client: upstream, upstream: client}
             while True:
-                r, _, _ = select.select(socks, [], [], 1.0)
+                try:
+                    r, _, _ = select.select(socks, [], [], 1.0)
+                except (ValueError, OSError):
+                    # proxy.close() raced us: a socket in the set was
+                    # closed (fd -1) mid-select — clean teardown, not
+                    # an error to leak into the pytest thread reaper
+                    return
                 if self.mode == "blackhole":
                     return      # partition started mid-flight: cut it
                 for s in r:
@@ -318,6 +324,7 @@ class ChaosProxy(threading.Thread):
                     else:
                         peer[s].sendall(data)
         except OSError:
+            # vtplint: disable=except-pass (chaos proxy data pump: a torn connection IS the injected fault; the finally closes both sides)
             pass
         finally:
             for s in (client, upstream):

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""vtplint — the project-native invariant linter (CLI).
+
+Runs three passes over the tree and prints one merged report:
+
+  rules      AST project rules (volcano_tpu/analysis/astlint.py):
+             req-id, wall-clock, metric-family, metric-labels,
+             append-lock, except-pass — plus unexplained-suppression
+             for any waiver without a reason.
+  flakes     pyflakes when installed, the conservative built-in
+             fallback otherwise (syntax errors, unused imports).
+  registry   runtime cross-checks: codec wire round-trips, store
+             kind registry, metric family/label-schema coverage.
+
+Usage:
+    python tools/vtplint.py [--strict] [--json] [--report OUT.json]
+                            [--no-flakes] [--no-registry] [paths...]
+
+--strict exits 1 on ANY unsuppressed finding (tier-1 runs this via
+tests/test_lint.py).  Suppressed findings are listed as the
+suppression inventory — an explained waiver is part of the contract,
+an unexplained one fails strict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DEFAULT_PATHS = ("volcano_tpu", "tools")
+
+
+def run(paths, flakes: bool = True, registry: bool = True):
+    """(active findings, suppressed findings) over the given paths."""
+    from volcano_tpu.analysis import astlint
+    from volcano_tpu.analysis import flakes as flakes_mod
+    from volcano_tpu.analysis import registry as registry_mod
+    findings = astlint.lint_paths(paths)
+    if flakes:
+        findings += flakes_mod.check_paths(paths)
+    if registry:
+        findings += registry_mod.check_all()
+    active = [f for f in findings if f.suppressed is None]
+    suppressed = [f for f in findings if f.suppressed is not None]
+    return active, suppressed
+
+
+def doc(active, suppressed) -> dict:
+    return {
+        "findings": len(active),
+        "rule_counts": dict(sorted(Counter(
+            f.rule for f in active).items())),
+        "suppressions": [
+            {"rule": f.rule, "site": f"{f.path}:{f.line}",
+             "reason": f.suppressed} for f in suppressed],
+        "details": [
+            {"rule": f.rule, "site": f"{f.path}:{f.line}",
+             "msg": f.msg} for f in active],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="vtplint", description=__doc__)
+    ap.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS))
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on any unsuppressed finding")
+    ap.add_argument("--json", action="store_true",
+                    help="print the JSON report instead of lines")
+    ap.add_argument("--report", default="",
+                    help="also write the JSON report to this path")
+    ap.add_argument("--no-flakes", action="store_true")
+    ap.add_argument("--no-registry", action="store_true")
+    args = ap.parse_args(argv)
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.chdir(repo)
+    active, suppressed = run(args.paths or list(DEFAULT_PATHS),
+                             flakes=not args.no_flakes,
+                             registry=not args.no_registry)
+    report = doc(active, suppressed)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=1)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        for f in active:
+            print(f.format())
+        if suppressed:
+            print(f"-- {len(suppressed)} suppressed "
+                  f"(explained waivers):")
+            for f in suppressed:
+                print(f"   {f.format()}")
+        print(f"vtplint: {len(active)} finding(s), "
+              f"{len(suppressed)} suppression(s)")
+    if args.strict and active:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

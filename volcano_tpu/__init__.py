@@ -29,3 +29,13 @@ Layer map (mirrors SURVEY.md §1 for the reference):
 """
 
 __version__ = "0.1.0"
+
+# Opt-in runtime lock-order auditing (analysis/lockaudit.py): arming
+# must happen here — before ANY repo module creates a lock — so the
+# chaos conductor's --lock-audit child processes and audited test
+# runs wrap every threading.Lock/RLock/Condition site in the package.
+import os as _os
+
+if _os.environ.get("VTP_LOCK_AUDIT"):
+    from volcano_tpu.analysis import lockaudit as _lockaudit
+    _lockaudit.install_from_env()

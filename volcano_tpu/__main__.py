@@ -34,7 +34,6 @@ import os
 import pickle
 import signal
 import sys
-import time
 
 
 def main(argv=None) -> int:

@@ -13,12 +13,12 @@ from __future__ import annotations
 import heapq
 import logging
 import time
-from typing import Dict, List, Optional
+from typing import Dict
 
 from volcano_tpu import metrics, trace
 from volcano_tpu.api.fit_error import (FitError, FitErrors,
                                        unschedulable)
-from volcano_tpu.api.job_info import JobInfo, TaskInfo
+from volcano_tpu.api.job_info import JobInfo
 from volcano_tpu.api.types import PodGroupPhase, TaskStatus
 from volcano_tpu.framework.plugins import Action, register_action
 from volcano_tpu.util import PriorityQueue

@@ -10,9 +10,9 @@ from __future__ import annotations
 
 from collections import defaultdict
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List
 
-from volcano_tpu.api.job_info import JobInfo, TaskInfo
+from volcano_tpu.api.job_info import TaskInfo
 from volcano_tpu.api.resource import Resource
 from volcano_tpu.actions.util import victim_sort_key
 

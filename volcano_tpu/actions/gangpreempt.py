@@ -16,7 +16,7 @@ nominatedNodeName — the NEXT allocate cycle takes the fast path
 from __future__ import annotations
 
 import logging
-from typing import List, Optional
+from typing import List
 
 from volcano_tpu.api.job_info import JobInfo, TaskInfo
 from volcano_tpu.api.types import PodGroupPhase, TaskStatus

@@ -10,7 +10,6 @@ intersection (gang floors, conformance, queue reclaimable flag).
 from __future__ import annotations
 
 import logging
-from typing import List
 
 from volcano_tpu.api.job_info import JobInfo, TaskInfo
 from volcano_tpu.api.types import PodGroupPhase, TaskStatus

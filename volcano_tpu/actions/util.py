@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Tuple
 
 from volcano_tpu.api.fit_error import FitError
-from volcano_tpu.api.job_info import JobInfo, TaskInfo
+from volcano_tpu.api.job_info import TaskInfo
 from volcano_tpu.api.node_info import NodeInfo
 
 

@@ -22,10 +22,10 @@ from __future__ import annotations
 import abc
 import logging
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
-from volcano_tpu.api.resource import TPU, Resource
+from volcano_tpu.api.resource import Resource
 from volcano_tpu.api.types import TaskStatus
 
 log = logging.getLogger(__name__)
@@ -51,7 +51,10 @@ DCN_ONLINE_GUARANTEE_ANNOTATION = \
 DCN_POD_LIMIT_ANNOTATION = "networkqos.volcano-tpu.io/pod-limit-mbps"
 DEFAULT_DCN_MBPS = 100_000  # 100 Gbps per host default
 
-from volcano_tpu.api.types import QOS_BEST_EFFORT, QOS_LEVEL_ANNOTATION
+# QOS_BEST_EFFORT is a RE-EXPORT: handlers.py imports it from
+# here (lazily, inside functions) to avoid a module cycle
+from volcano_tpu.api.types import (QOS_BEST_EFFORT,  # noqa: F401
+                                   QOS_LEVEL_ANNOTATION)
 
 # annotation marking pods the agent may evict under pressure
 PREEMPTABLE_QOS_ANNOTATION = QOS_LEVEL_ANNOTATION

@@ -154,6 +154,7 @@ class LocalProcCollector(Collector):
                 out["memory_fraction"] = max(0.0, min(1.0, 1.0 - (
                     info.get("MemAvailable", 0) / info["MemTotal"])))
         except (OSError, ValueError):
+            # vtplint: disable=except-pass (proc-file sampling: a missing/garbled /proc/meminfo just omits the optional gauge this round)
             pass
         return out
 

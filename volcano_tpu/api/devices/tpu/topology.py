@@ -16,7 +16,7 @@ e.g. tpu-v5-lite-podslice with topology "16x16" = v5e-256.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 # chips per host by accelerator family (GKE podslice machine shapes)
 CHIPS_PER_HOST: Dict[str, int] = {

@@ -9,11 +9,11 @@ from __future__ import annotations
 
 import time
 from collections import defaultdict
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional
 
 from volcano_tpu.api.fit_error import FitError, FitErrors
 from volcano_tpu.api.pod import Pod
-from volcano_tpu.api.podgroup import NetworkTopologySpec, PodGroup, SubGroupPolicy
+from volcano_tpu.api.podgroup import NetworkTopologySpec, PodGroup
 from volcano_tpu.api.resource import Resource
 from volcano_tpu.api.types import (
     ALIVE_TASK_STATUSES,

@@ -23,7 +23,7 @@ from volcano_tpu.api.types import (
 )
 
 if TYPE_CHECKING:
-    from volcano_tpu.api.job_info import TaskInfo
+    from volcano_tpu.api.job_info import TaskInfo  # noqa: F401
 
 
 @dataclass

@@ -11,7 +11,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-from volcano_tpu.api.pod import Container, Pod, Toleration, new_uid
+from volcano_tpu.api.pod import Container, Pod, new_uid
 from volcano_tpu.api.podgroup import NetworkTopologySpec
 from volcano_tpu.api.types import (
     DEFAULT_QUEUE,

@@ -58,19 +58,44 @@ FAMILIES: Dict[str, str] = {
     "pod_reclaim_total": "counter",
     "reclaim_commits_total": "counter",
     "shuffle_victims_total": "counter",
-    # fair share
+    # fair share — proportion exports deserved/allocated/request and
+    # capacity exports real_capacity/inqueue/capacity/overused, each
+    # resource vector as the three per-dimension gauges
+    # (metrics.resource_gauge_rows); every generated name is declared
+    # here or vtplint's family-coverage check fails the build
     "job_share": "gauge",
     "queue_share": "gauge",
     "queue_weight": "gauge",
+    "queue_overused": "gauge",
     "queue_allocated_milli_cpu": "gauge",
     "queue_allocated_memory_bytes": "gauge",
     "queue_allocated_scalar_resources": "gauge",
     "queue_deserved_milli_cpu": "gauge",
+    "queue_deserved_memory_bytes": "gauge",
+    "queue_deserved_scalar_resources": "gauge",
     "queue_request_milli_cpu": "gauge",
+    "queue_request_memory_bytes": "gauge",
+    "queue_request_scalar_resources": "gauge",
+    "queue_real_capacity_milli_cpu": "gauge",
+    "queue_real_capacity_memory_bytes": "gauge",
+    "queue_real_capacity_scalar_resources": "gauge",
+    "queue_inqueue_milli_cpu": "gauge",
+    "queue_inqueue_memory_bytes": "gauge",
+    "queue_inqueue_scalar_resources": "gauge",
+    "queue_capacity_milli_cpu": "gauge",
+    "queue_capacity_memory_bytes": "gauge",
+    "queue_capacity_scalar_resources": "gauge",
     # agent scheduler (fast path)
     "agent_pod_e2e_latency_seconds": "histogram",
     "agent_bind_conflicts_total": "counter",
     "agent_unschedulable_total": "counter",
+    # audit-derived latency exporter (server/audit_exporter.py): job
+    # submit -> terminal phase, the batchjob completion analogue
+    "batchjob_completion_latency_seconds": "histogram",
+    # client mirror resync paths (cache/remote_cluster.py): how a
+    # mirror recovered — delta catch-up, refused-stale re-route, or a
+    # full re-list (bounded mode enum)
+    "mirror_resync_total": "counter",
     # node-agent DCN bandwidth accounting (agent/handlers.py
     # netaccounting: measured per-pod rates + watermark violations)
     "pod_dcn_tx_mbps": "gauge",
@@ -163,6 +188,117 @@ FAMILIES: Dict[str, str] = {
     "frag_largest_block_chips": "gauge",
     "starvation_age_seconds": "gauge",
     "starvation_pending_gangs": "gauge",
+}
+
+# -- label schema (enforced by volcano_tpu/analysis + tests/test_lint) --
+#
+# Every family's ALLOWED label keys, and what may appear as a value:
+#   a tuple                  closed enum, values must be members
+#   "enum:<module>:<NAME>"   closed enum resolved lazily from code (the
+#                            single source of truth stays next to the
+#                            subsystem that owns it)
+#   CONFIG                   operator-bounded value (queue names, node
+#                            names, replica ids, wire routes, resource
+#                            dimensions): cardinality is capped by the
+#                            deployment's configuration, not by
+#                            workload churn
+#   OBJECT                   per-object key (job keys, pod keys).  Only
+#                            legal on families with a declared deletion
+#                            lifecycle (swap_gauge_families scope swap
+#                            or metrics.delete_labeled on object
+#                            removal) — anything else would mint one
+#                            immortal series per job forever.
+#
+# A family absent from this table carries NO labels.  The static half
+# (analysis/astlint.py metric-family/metric-labels rules) checks call
+# sites; the runtime half (analysis/schema.check_exposition) checks a
+# live exposition — together they subsume the three per-PR label-
+# cardinality tests this table replaced.
+CONFIG = "config"
+OBJECT = "object"
+
+FAMILY_LABELS: Dict[str, Dict[str, object]] = {
+    "task_scheduling_latency_seconds": {"action": CONFIG},
+    "action_latency_seconds": {"action": CONFIG},
+    "plugin_latency_seconds": {"plugin": CONFIG,
+                               "point": ("open", "close")},
+    "schedule_attempts_total": {"result": ("scheduled", "error")},
+    "job_retry_counts": {"job": OBJECT},
+    # fair share: job_share is the per-object gauge precedent — swapped
+    # wholesale each session and delete_labeled on GC (metrics/job.go)
+    "job_share": {"job": OBJECT},
+    "queue_share": {"queue": CONFIG},
+    "queue_weight": {"queue": CONFIG},
+    "queue_overused": {"queue": CONFIG},
+    **{f"queue_{m}{s}": ({"queue": CONFIG, "resource": CONFIG}
+                         if s == "_scalar_resources"
+                         else {"queue": CONFIG})
+       for m in ("allocated", "deserved", "request", "real_capacity",
+                 "inqueue", "capacity")
+       for s in ("_milli_cpu", "_memory_bytes", "_scalar_resources")},
+    # node-agent bandwidth accounting: per-pod gauges live inside a
+    # per-node scope swap (handlers.py), so pod keys have a deletion
+    # lifecycle; tier is the offline/online DCN split
+    "pod_dcn_tx_mbps": {"pod": OBJECT, "node": CONFIG,
+                        "tier": ("offline", "online")},
+    "pod_dcn_rx_mbps": {"pod": OBJECT, "node": CONFIG,
+                        "tier": ("offline", "online")},
+    "node_dcn_measured_mbps": {"node": CONFIG,
+                               "tier": ("offline", "online")},
+    "bandwidth_violating_pods": {"node": CONFIG},
+    "bandwidth_violations_total": {"pod": OBJECT, "node": CONFIG},
+    # failover: slice names are topology configuration
+    "slice_failovers_total": {"slice": CONFIG},
+    "failover_detect_seconds": {"slice": CONFIG},
+    "failover_drain_seconds": {"slice": CONFIG},
+    "failover_reschedule_seconds": {"slice": CONFIG},
+    "failover_resume_seconds": {"slice": CONFIG},
+    "failover_mttr_seconds": {"slice": CONFIG},
+    "failover_resume_step_gap": {"slice": CONFIG},
+    # durability / replication
+    "server_wal_dropped_records_total": {
+        "reason": ("readonly", "append-error", "duplicate-seq",
+                   "force-truncate")},
+    "server_replication_role": {
+        "role": ("leader", "follower", "candidate")},
+    "server_replication_follower_lag_rv": {"follower": CONFIG},
+    # client wire
+    "client_retries_total": {"route": CONFIG},
+    "mirror_resync_total": {"mode": ("delta", "stale-refused", "full")},
+    # chaos engine
+    "fault_injected_total": {"site": "enum:volcano_tpu.faults:SITES",
+                             "kind": "enum:volcano_tpu.faults:ALL_KINDS"},
+    # flight recorder: bounded enums only, free text never labels
+    "sched_phase_seconds": {
+        "phase": ("queue", "schedule", "bind", "admit", "start", "e2e")},
+    "sched_span_seconds": {"action": CONFIG, "plugin": CONFIG,
+                           "point": CONFIG},
+    "sched_traces_total": {
+        "kept": ("error", "unschedulable", "slow", "sampled")},
+    "sched_unschedulable_reasons_total": {
+        "reason": "enum:volcano_tpu.trace:REASON_ENUM"},
+    # elastic gangs: the bounded resize-kind enum, never job keys
+    "elastic_decisions_total": {
+        "kind": "enum:volcano_tpu.api.elastic:RESIZE_KINDS"},
+    "elastic_resizes_total": {
+        "kind": "enum:volcano_tpu.api.elastic:RESIZE_KINDS"},
+    "elastic_resize_seconds": {
+        "kind": "enum:volcano_tpu.api.elastic:RESIZE_KINDS"},
+    "elastic_drain_seconds": {
+        "kind": "enum:volcano_tpu.api.elastic:RESIZE_KINDS"},
+    # goodput observatory
+    "goodput_vector_updates_total": {
+        "generation": "enum:volcano_tpu.api.goodput:GENERATIONS"},
+    "goodput_gated_grows_total": {
+        "decision": "enum:volcano_tpu.goodput:GATE_DECISIONS"},
+    "frag_index": {"generation": "enum:volcano_tpu.api.goodput:"
+                                 "GENERATIONS"},
+    "frag_idle_chips": {"generation": "enum:volcano_tpu.api.goodput:"
+                                      "GENERATIONS"},
+    "frag_largest_block_chips": {
+        "generation": "enum:volcano_tpu.api.goodput:GENERATIONS"},
+    "starvation_age_seconds": {"queue": CONFIG},
+    "starvation_pending_gangs": {"queue": CONFIG},
 }
 
 

@@ -11,8 +11,6 @@ machines.  Thread-safe: the scheduler loop and controllers may share it.
 from __future__ import annotations
 
 import threading
-import time
-from collections import defaultdict
 from typing import Callable, Dict, List, Optional, Tuple
 
 from volcano_tpu.api.hypernode import HyperNode

@@ -25,7 +25,7 @@ import urllib.error
 import urllib.request
 import uuid
 from http.client import HTTPException
-from typing import Callable, Dict, List, Optional
+from typing import Callable, List, Optional
 
 from volcano_tpu.api import codec
 from volcano_tpu.api.types import TaskStatus
@@ -239,6 +239,7 @@ class RemoteCluster(Cluster):
                 doc = self._request_once("GET", "/replication",
                                          timeout=2.0, base=url)
             except Exception:  # noqa: BLE001 — candidate down
+                # vtplint: disable=except-pass (leader-discovery scan: a dark endpoint cannot be the leader; the loop keeps probing)
                 continue
             term = int(doc.get("term", 0) or 0)
             if doc.get("role") == "leader" and term > best_term:
@@ -621,6 +622,7 @@ class RemoteCluster(Cluster):
             # decision stamp for the `allocated` lifecycle phase;
             # servers that predate it ignore unknown body fields
             body["ts_alloc"] = ts_alloc
+        # vtplint: disable=req-id (replay-safe by state-compare: a re-bind to the same node re-verdicts as success, never double-applies)
         self._request("POST", "/bind", body)
         with self._mlock:
             pod = self.pods.get(f"{namespace}/{name}")
@@ -671,6 +673,7 @@ class RemoteCluster(Cluster):
         return errors
 
     def evict_pod(self, namespace: str, name: str, reason: str = "") -> None:
+        # vtplint: disable=req-id (replay-safe by state-compare: re-evicting a Releasing/gone pod converges)
         self._request("POST", "/evict", {
             "namespace": namespace, "name": name, "reason": reason})
         with self._mlock:
@@ -681,6 +684,7 @@ class RemoteCluster(Cluster):
 
     def nominate_pod(self, namespace: str, name: str,
                      node_name: str) -> None:
+        # vtplint: disable=req-id (replay-safe overwrite: nominating the same node twice is the same state)
         self._request("POST", "/nominate", {
             "namespace": namespace, "name": name, "node_name": node_name})
         with self._mlock:
@@ -689,6 +693,7 @@ class RemoteCluster(Cluster):
                 pod.nominated_node = node_name
 
     def update_podgroup_status(self, pg) -> None:
+        # vtplint: disable=req-id (replay-safe overwrite-put of the full status object)
         self._request("POST", "/podgroup_status",
                       {"obj": codec.encode(pg)})
         with self._mlock:
@@ -700,6 +705,7 @@ class RemoteCluster(Cluster):
         try:
             # best-effort AND often on failure paths: a short budget,
             # never the full retry deadline
+            # vtplint: disable=req-id (best-effort observability append; a rare duplicate event line is harmless)
             self._request("POST", "/record_event", {
                 "obj_key": obj_key, "reason": reason,
                 "message": message}, deadline=2.0)
@@ -730,10 +736,12 @@ class RemoteCluster(Cluster):
     # -- test / simulation surface -------------------------------------
 
     def tick(self) -> None:
+        # vtplint: disable=req-id (test/simulation surface: a duplicate kubelet tick only advances the simulated clock)
         self._request("POST", "/tick")
 
     def complete_pod(self, key: str, succeeded: bool = True,
                      exit_code=None) -> None:
+        # vtplint: disable=req-id (replay-safe by state-compare: completing a completed pod is a no-op)
         self._request("POST", "/complete_pod", {
             "key": key, "succeeded": succeeded, "exit_code": exit_code})
 
@@ -745,6 +753,7 @@ class RemoteCluster(Cluster):
         """deadline bounds the retry budget: a renewal must fail
         before the caller's next renewal slot, not block past the
         lease TTL and forfeit leadership to a slow wire."""
+        # vtplint: disable=req-id (lease CAS is idempotent for the same holder; a replayed acquire/renew returns the same verdict)
         return self._request("POST", "/lease", {
             "name": name, "holder": holder, "ttl": ttl,
             "release": release}, deadline=deadline)

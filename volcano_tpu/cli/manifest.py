@@ -35,7 +35,7 @@ mirrors batch/v1alpha1 with TPU-first fields:
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List
 
 import yaml
 

@@ -24,7 +24,7 @@ from typing import List, Optional
 from volcano_tpu.api.pod import Container, Pod
 from volcano_tpu.api.queue import Queue
 from volcano_tpu.api.resource import TPU
-from volcano_tpu.api.types import GROUP_NAME_ANNOTATION, JobPhase
+from volcano_tpu.api.types import GROUP_NAME_ANNOTATION
 from volcano_tpu.api.vcjob import TaskSpec, VCJob
 from volcano_tpu.framework.job_updater import SCHEDULING_REASON_ANNOTATION
 

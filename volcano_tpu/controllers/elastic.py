@@ -48,13 +48,7 @@ from typing import Dict, Optional
 
 from volcano_tpu.api import elastic as eapi
 from volcano_tpu.api.resource import TPU
-from volcano_tpu.api.types import (
-    GROUP_NAME_ANNOTATION,
-    JobAction,
-    JobPhase,
-    PodGroupPhase,
-    TaskStatus,
-)
+from volcano_tpu.api.types import GROUP_NAME_ANNOTATION, JobAction, JobPhase, TaskStatus
 from volcano_tpu.controllers.framework import Controller, register_controller
 
 log = logging.getLogger(__name__)

@@ -18,7 +18,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from volcano_tpu.api.pod import new_uid
-from volcano_tpu.api.types import FINISHED_JOB_PHASES, JobPhase
+from volcano_tpu.api.types import JobPhase
 from volcano_tpu.api.vcjob import VCJob
 from volcano_tpu.controllers.framework import Controller, register_controller
 

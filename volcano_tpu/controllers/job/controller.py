@@ -13,7 +13,7 @@ from __future__ import annotations
 import logging
 import time
 from collections import defaultdict
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 from volcano_tpu.api.pod import Pod
 from volcano_tpu.api.podgroup import (NetworkTopologySpec, PodGroup,

@@ -9,11 +9,7 @@ from __future__ import annotations
 
 from volcano_tpu.api.types import JOB_NAME_LABEL
 from volcano_tpu.controllers.job.plugins import JobPlugin, register_job_plugin
-from volcano_tpu.controllers.job.plugins.util import (
-    all_hostnames,
-    set_env,
-    task_hostnames,
-)
+from volcano_tpu.controllers.job.plugins.util import set_env, task_hostnames
 
 
 @register_job_plugin("svc")

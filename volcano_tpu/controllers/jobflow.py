@@ -12,7 +12,7 @@ import copy
 import logging
 from typing import Dict, Optional
 
-from volcano_tpu.api.jobflow import JobFlow, JobFlowPhase, JobTemplate
+from volcano_tpu.api.jobflow import JobFlow, JobFlowPhase
 from volcano_tpu.api.types import JobPhase
 from volcano_tpu.api.vcjob import VCJob
 from volcano_tpu.controllers.framework import Controller, register_controller

@@ -11,7 +11,6 @@ import json
 import logging
 import signal
 import time
-from typing import Optional
 
 log = logging.getLogger(__name__)
 

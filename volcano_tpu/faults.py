@@ -46,7 +46,7 @@ import os
 import random
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 log = logging.getLogger(__name__)
 

@@ -9,7 +9,7 @@ from __future__ import annotations
 from typing import Callable, Dict, Optional, TYPE_CHECKING
 
 if TYPE_CHECKING:
-    from volcano_tpu.framework.session import Session
+    from volcano_tpu.framework.session import Session  # noqa: F401
 
 
 class Plugin:

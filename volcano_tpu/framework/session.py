@@ -22,13 +22,12 @@ from collections import defaultdict
 from typing import Callable, Dict, List, Optional, Set
 
 from volcano_tpu import trace
-from volcano_tpu.api.fit_error import FitErrors, StatusCode
+from volcano_tpu.api.fit_error import StatusCode
 from volcano_tpu.api.job_info import JobInfo, TaskInfo
 from volcano_tpu.api.node_info import NodeInfo
 from volcano_tpu.api.queue_info import QueueInfo
 from volcano_tpu.api.types import PodGroupPhase, TaskStatus
 from volcano_tpu.conf import SchedulerConf, Tier
-from volcano_tpu.util import PriorityQueue
 
 # vote values for tiered voting points
 PERMIT = 1

@@ -34,6 +34,18 @@ def _key(name: str, labels: dict) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
 # bound (the exposition reports count/sum over the window).
 MAX_OBSERVATIONS = 16384
 
+# Lock-order audit opt-in (analysis/lockaudit.py): when armed, the
+# four registries are wrapped so any mutation without _lock held is
+# recorded as a violation — every writer in this module must stay
+# inside `with _lock`, and this makes the rule mechanical.
+import os as _os
+
+if _os.environ.get("VTP_LOCK_AUDIT"):
+    import sys as _sys
+
+    from volcano_tpu.analysis import lockaudit as _lockaudit
+    _lockaudit.maybe_guard_metrics(_sys.modules[__name__])
+
 
 def observe(name: str, value: float, **labels):
     with _lock:

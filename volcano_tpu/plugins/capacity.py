@@ -19,9 +19,7 @@ from volcano_tpu.api.queue_info import QueueInfo
 from volcano_tpu.api.resource import Resource
 from volcano_tpu.api.types import PodGroupPhase
 from volcano_tpu.framework.plugins import Plugin, register_plugin
-from volcano_tpu.framework.session import (
-    ABSTAIN, PERMIT, REJECT, EventHandler,
-)
+from volcano_tpu.framework.session import PERMIT, REJECT, EventHandler
 
 ROOT_QUEUE = "root"
 

@@ -14,7 +14,7 @@ claim mode makes it a predicate.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Set
+from typing import Dict, List
 
 from volcano_tpu.api.fit_error import unschedulable
 from volcano_tpu.api.job_info import TaskInfo

@@ -19,7 +19,7 @@ from volcano_tpu.api.fit_error import unschedulable
 from volcano_tpu.api.job_info import JobInfo, TaskInfo
 from volcano_tpu.api.node_info import NodeInfo
 from volcano_tpu.framework.plugins import Plugin, register_plugin
-from volcano_tpu.framework.session import ABSTAIN, PERMIT, REJECT
+from volcano_tpu.framework.session import ABSTAIN, REJECT
 
 log = logging.getLogger(__name__)
 

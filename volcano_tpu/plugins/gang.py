@@ -14,7 +14,7 @@ from typing import Dict, List
 
 from volcano_tpu.api.job_info import JobInfo, TaskInfo
 from volcano_tpu.framework.plugins import Plugin, register_plugin
-from volcano_tpu.framework.session import ABSTAIN, PERMIT, REJECT
+from volcano_tpu.framework.session import PERMIT, REJECT
 
 
 @register_plugin("gang")

@@ -9,7 +9,7 @@ from volcano_tpu.api.job_info import JobInfo
 from volcano_tpu.api.resource import Resource
 from volcano_tpu.api.types import PodGroupPhase
 from volcano_tpu.framework.plugins import Plugin, register_plugin
-from volcano_tpu.framework.session import ABSTAIN, PERMIT, REJECT
+from volcano_tpu.framework.session import PERMIT, REJECT
 
 DEFAULT_FACTOR = 1.2
 

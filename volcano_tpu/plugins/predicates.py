@@ -9,7 +9,7 @@ conflicts.
 
 from __future__ import annotations
 
-from volcano_tpu.api.fit_error import Status, StatusCode, unschedulable
+from volcano_tpu.api.fit_error import unschedulable
 from volcano_tpu.api.job_info import TaskInfo
 from volcano_tpu.api.node_info import NodeInfo
 from volcano_tpu.api.resource import PODS

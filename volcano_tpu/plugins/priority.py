@@ -6,7 +6,7 @@ from typing import List
 
 from volcano_tpu.api.job_info import JobInfo, TaskInfo
 from volcano_tpu.framework.plugins import Plugin, register_plugin
-from volcano_tpu.framework.session import ABSTAIN, PERMIT, REJECT
+from volcano_tpu.framework.session import ABSTAIN, REJECT
 
 
 @register_plugin("priority")

@@ -10,8 +10,7 @@ pairs land together).  Job (podgroup) annotations:
 
 from __future__ import annotations
 
-from collections import defaultdict
-from typing import Dict, List, Set, Tuple
+from typing import List, Set, Tuple
 
 from volcano_tpu.api.job_info import JobInfo, TaskInfo
 from volcano_tpu.api.node_info import NodeInfo

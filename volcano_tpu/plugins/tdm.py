@@ -14,11 +14,10 @@ import time
 from typing import List, Optional
 
 from volcano_tpu.api.fit_error import unschedulable
-from volcano_tpu.api.job_info import JobInfo, TaskInfo
+from volcano_tpu.api.job_info import TaskInfo
 from volcano_tpu.api.node_info import NodeInfo
 from volcano_tpu.api.types import REVOCABLE_ZONE_ANNOTATION
 from volcano_tpu.framework.plugins import Plugin, register_plugin
-from volcano_tpu.framework.session import ABSTAIN, PERMIT, REJECT
 
 REVOCABLE_ZONE_LABEL = "volcano-tpu.io/revocable-zone"
 MAX_SCORE = 100.0

@@ -20,7 +20,6 @@ so they ride the normal /metrics exposition.
 
 from __future__ import annotations
 
-import json
 import logging
 import urllib.request
 from typing import Dict, List

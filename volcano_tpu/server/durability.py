@@ -336,6 +336,7 @@ class DurableStore:
                 doc = json.load(f)
             base, boot = doc.get("base", ""), int(doc.get("boot", 0))
         except (OSError, ValueError):
+            # vtplint: disable=except-pass (first boot or corrupt epoch doc: fall through to a fresh base below)
             pass
         if not base or not continuous:
             base = uuid.uuid4().hex[:12]
@@ -530,6 +531,7 @@ class DurableStore:
                         and c.get("cid") in drained_cids)]
         # drop expired leases now so the boot doesn't resurrect stale
         # holders (live ones rebase onto the monotonic clock upstairs)
+        # vtplint: disable=wall-clock (the DISK carries wall expiries by contract; live ones rebase onto monotonic at boot)
         now = time.time()
         leases = {n: (h, exp) for n, (h, exp) in leases.items()
                   if exp > now}
@@ -677,48 +679,65 @@ class DurableStore:
             return {"records": records, "last_seq": synced,
                     "resync": False}
 
+    def snapshot_gate(self):
+        """The compaction lock as a context manager, for callers that
+        must pin the LOCK HIERARCHY from outside: _snap_lock is the
+        OUTERMOST lock (snapshot()/heal() hold it while capturing
+        state under the server lock), so any path that reaches this
+        store while already holding the server lock must take the
+        gate FIRST.  install_replica_snapshot is that path — taking
+        _snap_lock inside the server lock deadlocked against a
+        concurrent compaction (found by analysis/lockaudit.py: the
+        wal-compactor thread holds _snap_lock wanting the server
+        lock for capture while the follower tail thread holds the
+        server lock wanting _snap_lock)."""
+        return self._snap_lock
+
     def reset_from_snapshot(self, doc: dict, epoch: str) -> dict:
         """Install a replica snapshot wholesale (follower bootstrap /
         epoch-term-mismatch full re-sync): local WAL segments are
         DISCARDED (the leader's history supersedes them), the doc
         lands as the local snapshot atomically, and the seq/rv
-        counters jump to the leader's horizon.  Returns the doc."""
-        with self._snap_lock:
-            with self._lock:
-                if self._file is not None:
-                    self._file.close()
-                    self._file = None
-                for seg in self._segments():
-                    try:
-                        os.remove(seg)
-                    except OSError:
-                        log.warning("could not remove superseded WAL "
-                                    "%s", seg)
-                doc = dict(doc)
-                doc["format"] = SNAPSHOT_FORMAT
-                doc["saved_at"] = time.time()
-                atomic_write_json(
-                    os.path.join(self.dir, SNAPSHOT_FILE), doc)
-                base, _, boot = epoch.rpartition(".")
+        counters jump to the leader's horizon.  Returns the doc.
+
+        Caller MUST hold snapshot_gate() (the lock-hierarchy contract
+        above); only the store's inner lock is taken here."""
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            for seg in self._segments():
                 try:
-                    boot_n = int(boot)
-                except ValueError:
-                    base, boot_n = epoch, 0
-                atomic_write_json(os.path.join(self.dir, EPOCH_FILE),
-                                  {"base": base or epoch,
-                                   "boot": boot_n})
-                self._seq = self.synced_seq = int(doc.get("wal_seq", 0))
-                rv = int(doc.get("rv", 0))
-                self._tail_rv = self.synced_rv = rv
-                self.snapshot_rv = rv
-                self.snapshot_at = doc["saved_at"]
-                self._appended = self._synced_marker = 0
-                self.wal_records = 0
-                self.wal_bytes = 0
-                self._ship.clear()
-                self.poisoned = ""
-                self._open_segment_locked()
-            return doc
+                    os.remove(seg)
+                except OSError:
+                    log.warning("could not remove superseded WAL "
+                                "%s", seg)
+            doc = dict(doc)
+            doc["format"] = SNAPSHOT_FORMAT
+            # vtplint: disable=wall-clock (operator-facing snapshot stamp, never a deadline)
+            doc["saved_at"] = time.time()
+            atomic_write_json(
+                os.path.join(self.dir, SNAPSHOT_FILE), doc)
+            base, _, boot = epoch.rpartition(".")
+            try:
+                boot_n = int(boot)
+            except ValueError:
+                base, boot_n = epoch, 0
+            atomic_write_json(os.path.join(self.dir, EPOCH_FILE),
+                              {"base": base or epoch,
+                               "boot": boot_n})
+            self._seq = self.synced_seq = int(doc.get("wal_seq", 0))
+            rv = int(doc.get("rv", 0))
+            self._tail_rv = self.synced_rv = rv
+            self.snapshot_rv = rv
+            self.snapshot_at = doc["saved_at"]
+            self._appended = self._synced_marker = 0
+            self.wal_records = 0
+            self.wal_bytes = 0
+            self._ship.clear()
+            self.poisoned = ""
+            self._open_segment_locked()
+        return doc
 
     def commit(self) -> int:
         """Make every appended record durable; returns the new synced
@@ -818,6 +837,7 @@ class DurableStore:
             try:
                 doc = capture()
                 doc["format"] = SNAPSHOT_FORMAT
+                # vtplint: disable=wall-clock (operator-facing snapshot stamp, never a deadline)
                 doc["saved_at"] = time.time()
                 doc["wal_seq"] = probe_seq - 1
                 atomic_write_json(os.path.join(self.dir, SNAPSHOT_FILE),
@@ -901,6 +921,7 @@ class DurableStore:
 
             doc = capture()
             doc["format"] = SNAPSHOT_FORMAT
+            # vtplint: disable=wall-clock (operator-facing snapshot stamp, never a deadline)
             doc["saved_at"] = time.time()
             doc["wal_seq"] = frozen_seq
             atomic_write_json(os.path.join(self.dir, SNAPSHOT_FILE),
@@ -932,6 +953,7 @@ class DurableStore:
                 "wal_seq": self._seq,
                 "synced_rv": self.synced_rv,
                 "snapshot_rv": self.snapshot_rv,
+                # vtplint: disable=wall-clock (status display only; snapshot_at is a wall stamp)
                 "snapshot_age_s": (round(time.time() - self.snapshot_at, 3)
                                    if self.snapshot_at else None),
                 "last_fsync_s": round(self.last_fsync_s, 6),

@@ -242,6 +242,7 @@ class Replication:
                                                             0)))
             self.voted_for = doc.get("voted_for", "")
         except (OSError, ValueError):
+            # vtplint: disable=except-pass (first boot: no term file yet, term 0 is correct)
             pass
 
     def _persist_term(self) -> None:
@@ -477,6 +478,7 @@ class Replication:
                                  timeout=max(1.0, self.ttl / 2),
                                  token=self.token)
             except (OSError, ValueError):
+                # vtplint: disable=except-pass (an unreachable peer is a NO vote; the quorum count below is the signal)
                 continue
             if resp.get("granted"):
                 votes += 1
@@ -584,6 +586,7 @@ class Replication:
                     doc = http_json("GET", peer + "/replication",
                                     timeout=2.0, token=self.token)
                 except (OSError, ValueError):
+                    # vtplint: disable=except-pass (watchdog probe: a dark peer proves nothing, the next tick re-probes)
                     continue
                 if int(doc.get("term", 0)) > self.term:
                     hint = doc.get("leader") or (
@@ -603,6 +606,7 @@ class Replication:
                 doc = http_json("GET", peer + "/replication",
                                 timeout=2.0, token=self.token)
             except (OSError, ValueError):
+                # vtplint: disable=except-pass (discovery scan: a dark peer simply cannot be the leader we adopt)
                 continue
             term = int(doc.get("term", 0))
             if doc.get("role") == "leader" and term > best_term:

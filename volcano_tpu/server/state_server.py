@@ -135,6 +135,7 @@ class StateServer:
         if recovery is not None:
             self._rv = recovery.rv
             self._events.extend(recovery.events)
+            # vtplint: disable=wall-clock (disk carries wall expiries; rebased onto monotonic here)
             now_m, now_w = time.monotonic(), time.time()
             for name, (holder, exp_wall) in recovery.leases.items():
                 # rebase the persisted wall expiry onto THIS boot's
@@ -175,6 +176,14 @@ class StateServer:
         # ring never serves half a tree
         self._traces: collections.deque = collections.deque(
             maxlen=TRACE_RING)
+        # lock-order audit opt-in: wrap the _lock-owned maps so any
+        # mutation without the lock held is recorded (the guard is
+        # installed AFTER init — the single-threaded construction
+        # above is exempt by construction)
+        import os
+        if os.environ.get("VTP_LOCK_AUDIT"):
+            from volcano_tpu.analysis import lockaudit
+            lockaudit.maybe_guard_server(self)
         cluster.watch(self._on_store_event)
         if self.repl is not None:
             if durable is None:
@@ -264,20 +273,54 @@ class StateServer:
         chips = float(pod.resource_requests().get(TPU) or 0)
         if chips <= 0:
             return None           # cpu-only pods are not chip-guarded
+        return self._check_chip_capacity(
+            key, node_name, chips, verb="bind",
+            hint="stale scheduler view?")
+
+    def _check_chip_capacity(self, key: str, node: str, chips: float,
+                             verb: str, hint: str) -> Optional[str]:
+        """The one chip-accounting core both guards share (/bind and
+        the pod-PUT route must never diverge on the rule): replacing
+        a pod's own booking on the same node is idempotent, anything
+        else must fit under the node's allocatable.  Callers hold
+        _bind_mutex; the map reads take _lock here."""
         with self._lock:
-            cap = self._node_chip_cap.get(node_name)
+            cap = self._node_chip_cap.get(node)
             if cap is None:
                 return None       # no chips on the node to guard
+            used = self._chips_used.get(node, 0.0)
             prev = self._pod_chips.get(key)
-            if prev is not None and prev[0] == node_name:
-                return None       # idempotent re-bind, already counted
-            used = self._chips_used.get(node_name, 0.0)
+            if prev is not None and prev[0] == node:
+                used -= prev[1]   # replacing its own booking
             if used + chips > cap + 1e-9:
-                return (f"bind overcommit: node {node_name} has "
+                return (f"{verb} overcommit: node {node} has "
                         f"{used:g}/{cap:g} chips bound; refusing "
-                        f"+{chips:g} for {key} (stale scheduler "
-                        "view?)")
+                        f"+{chips:g} for {key} ({hint})")
         return None
+
+    def check_put_capacity(self, obj) -> Optional[str]:
+        """The overcommit backstop for WHOLE-POD writes: /bind and
+        /bind_batch are capacity-guarded, but a pod object PUT via
+        /objects/pod carrying node_name + Bound/Running used to land
+        unchecked — so a stale mirror's delayed or reordered pod
+        write could resurrect a drained pod onto chips the server
+        had already re-bound (observed as a confirmed double-booking
+        under the chaos conductor's reorder/duplicate faults with
+        lock-audit timing).  Same shape as check_bind_capacity, but
+        against the INCOMING object; replacing a pod's own booking on
+        the same node stays idempotent.  Callers hold _bind_mutex."""
+        from volcano_tpu.api.resource import TPU
+        from volcano_tpu.api.types import TaskStatus
+        node = getattr(obj, "node_name", None)
+        if not node or getattr(obj, "phase", None) not in (
+                TaskStatus.BOUND, TaskStatus.RUNNING):
+            return None
+        chips = float(obj.resource_requests().get(TPU) or 0)
+        if chips <= 0:
+            return None
+        return self._check_chip_capacity(
+            obj.key, node, chips, verb="put",
+            hint=f"written as {obj.phase.value}; stale mirror write?")
 
     def _on_store_event(self, kind: str, obj) -> None:
         try:
@@ -363,6 +406,7 @@ class StateServer:
         rebased) + the idempotency-key cache, so compaction of the WAL
         never drops what only the WAL knew."""
         doc = self.snapshot_payload()
+        # vtplint: disable=wall-clock (the snapshot persists wall expiries by contract; monotonic deadlines rebased here)
         now_m, now_w = time.monotonic(), time.time()
         with self._lock:
             doc["leases"] = {
@@ -392,6 +436,7 @@ class StateServer:
             while len(self._req_cache) > REQ_CACHE:
                 self._req_cache.popitem(last=False)
         if self.durable is not None:
+            # vtplint: disable=append-lock (_req records are keyed by unique id and replay idempotently: journal order does not matter, so the append deliberately runs outside _lock)
             self.durable.append({"k": "_req", "o": {
                 "id": req_id, "code": code, "resp": payload}})
 
@@ -445,26 +490,34 @@ class StateServer:
         # data, never the policy chain a promotion will enforce
         cluster.admission = getattr(self.cluster, "admission", None) \
             or default_admission()
+        # vtplint: disable=wall-clock (bootstrap doc carries wall expiries; rebased onto monotonic here)
         now_m, now_w = time.monotonic(), time.time()
-        with self._event_cv:
-            self.durable.reset_from_snapshot(doc, epoch)
-            cluster.watch(self._on_store_event)
-            self.cluster = cluster
-            self.epoch = epoch
-            self._rv = int(doc.get("rv", 0))
-            self._events.clear()
-            self._leases.clear()
-            for name, rec in (doc.get("leases") or {}).items():
-                exp_wall = float(rec["expires_wall"])
-                if exp_wall > now_w:
-                    self._leases[name] = Lease(
-                        rec["holder"], now_m + (exp_wall - now_w))
-            self._req_cache.clear()
-            for rec in (doc.get("req_cache") or []):
-                self._req_cache[rec["id"]] = (int(rec["code"]),
-                                              rec["resp"])
-            self._rebuild_chip_maps()
-            self._event_cv.notify_all()
+        # lock hierarchy: the compaction gate (_snap_lock) is the
+        # OUTERMOST lock — snapshot()/heal() hold it while capturing
+        # under the server lock, so taking it the other way around
+        # here deadlocked a follower's tail thread against its own
+        # wal-compactor (found by analysis/lockaudit.py; the gate is
+        # acquired before the event lock precisely for this)
+        with self.durable.snapshot_gate():
+            with self._event_cv:
+                self.durable.reset_from_snapshot(doc, epoch)
+                cluster.watch(self._on_store_event)
+                self.cluster = cluster
+                self.epoch = epoch
+                self._rv = int(doc.get("rv", 0))
+                self._events.clear()
+                self._leases.clear()
+                for name, rec in (doc.get("leases") or {}).items():
+                    exp_wall = float(rec["expires_wall"])
+                    if exp_wall > now_w:
+                        self._leases[name] = Lease(
+                            rec["holder"], now_m + (exp_wall - now_w))
+                self._req_cache.clear()
+                for rec in (doc.get("req_cache") or []):
+                    self._req_cache[rec["id"]] = (int(rec["code"]),
+                                                  rec["resp"])
+                self._rebuild_chip_maps()
+                self._event_cv.notify_all()
 
     def apply_shipped(self, lines) -> None:
         """Fold one shipped batch into this follower: verify EVERY
@@ -506,8 +559,10 @@ class StateServer:
                 if kind == "_lease":
                     o = rec["o"]
                     if o.get("holder"):
+                        # vtplint: disable=wall-clock (shipped record carries a wall expiry; rebased onto monotonic here)
                         self._leases[o["name"]] = Lease(
                             o["holder"], time.monotonic() +
+                            # vtplint: disable=wall-clock (shipped wall expiry rebased)
                             (float(o["expires_wall"]) - time.time()))
                     else:
                         self._leases.pop(o["name"], None)
@@ -550,6 +605,7 @@ class StateServer:
 
     @staticmethod
     def _audit_record(idx: int, kind: str, obj) -> dict:
+        # vtplint: disable=wall-clock (audit stamps are operator-facing wall time, never deadlines)
         rec = {"i": idx, "ts": time.time(), "kind": kind,
                "key": getattr(obj, "key", None) or
                (obj.get("key") if isinstance(obj, dict) else None)}
@@ -673,6 +729,7 @@ class StateServer:
         boot: a restarted server honours the remaining TTL and cannot
         elect a second leader inside an old holder's term."""
         if self.durable is not None:
+            # vtplint: disable=append-lock (every caller holds _lock — lease() acquires it around the CAS; the lexical rule cannot see through the call)
             self.durable.append({"k": "_lease", "o": {
                 "name": name, "holder": holder,
                 "expires_wall": expires_wall}})
@@ -690,11 +747,16 @@ class StateServer:
                         "expires_in": 0}
             if cur is None or cur.expires < now or cur.holder == holder:
                 self._leases[name] = Lease(holder, now + ttl)
+                # vtplint: disable=wall-clock (the wire/journal carry wall expiries by contract; the live deadline above is monotonic)
                 self._wal_lease(name, holder, time.time() + ttl)
+                # vtplint: disable=wall-clock (wire expiry; expires_in is the authoritative TTL)
                 return {"acquired": True, "holder": holder,
+                        # vtplint: disable=wall-clock (wire expiry by contract)
                         "expires": time.time() + ttl,
                         "expires_in": round(ttl, 3)}
+            # vtplint: disable=wall-clock (wire expiry; expires_in is the authoritative TTL)
             return {"acquired": False, "holder": cur.holder,
+                    # vtplint: disable=wall-clock (wire expiry by contract)
                     "expires": time.time() + (cur.expires - now),
                     "expires_in": round(cur.expires - now, 3)}
 
@@ -756,6 +818,7 @@ class _Handler(BaseHTTPRequestHandler):
                         _socket.SOL_SOCKET, _socket.SO_LINGER,
                         struct.pack("ii", 1, 0))
                 except OSError:
+                    # vtplint: disable=except-pass (best-effort RST styling on an injected reset; the close itself still happens)
                     pass
             self.close_connection = True
             return "handled"
@@ -1127,7 +1190,17 @@ class _Handler(BaseHTTPRequestHandler):
                 return 404, {"error": f"unknown kind {kind}"}
             obj = codec.decode(body["obj"])
             key = body.get("key")
-            stored = cl.put_object(kind, obj, key=key)
+            if kind == "pod":
+                # whole-pod writes go through the same chip-guard as
+                # /bind: check-and-put is atomic under _bind_mutex so
+                # a concurrent bind cannot slip between them
+                with st._bind_mutex:
+                    err = st.check_put_capacity(obj)
+                    if err:
+                        raise ValueError(err)       # -> 409
+                    stored = cl.put_object(kind, obj, key=key)
+            else:
+                stored = cl.put_object(kind, obj, key=key)
             return 200, {"obj": codec.encode(stored)}
         if path == "/bind":
             with st._bind_mutex:
@@ -1195,6 +1268,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # record can land on either side of this one in the
                 # file, so replay removes the exact consumed set
                 # regardless of record order
+                # vtplint: disable=append-lock (journaled by cid: replay removes the exact consumed set regardless of record order — see the comment above)
                 st.durable.append({"k": "_drain", "o": {
                     "target": body["target"],
                     "cids": [c.get("cid") for c in cmds

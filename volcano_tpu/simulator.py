@@ -13,7 +13,6 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from volcano_tpu.api.node_info import Node
-from volcano_tpu.api.pod import Taint
 from volcano_tpu.api.resource import TPU
 from volcano_tpu.api.types import (
     TPU_COORDS_LABEL,

@@ -36,7 +36,7 @@ from __future__ import annotations
 import json
 import threading
 import time
-from collections import Counter, deque
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from volcano_tpu import metrics

@@ -9,9 +9,8 @@ create — a rejection raises AdmissionError before anything persists.
 from __future__ import annotations
 
 import re
-from typing import Optional
 
-from volcano_tpu.api.types import DEFAULT_QUEUE, JobEvent
+from volcano_tpu.api.types import DEFAULT_QUEUE
 from volcano_tpu.api.vcjob import VCJob
 
 DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")

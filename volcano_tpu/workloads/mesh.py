@@ -19,7 +19,7 @@ tier 1 = pod over DCN; SURVEY §5 long-context analogue).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional
 
 import jax
 from jax.sharding import Mesh

@@ -22,7 +22,7 @@ embedded batch never materializes on every device.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp

@@ -68,8 +68,10 @@ class ProgressReporter:
             os.replace(tmp, self.path)   # atomic: never a torn read
             return True
         except OSError:
+            # vtplint: disable=except-pass (progress publishing is best-effort by contract: the return False IS the classification, and the tmp unlink is cleanup of a write that already failed)
             try:
                 os.unlink(tmp)
             except OSError:
+                # vtplint: disable=except-pass (cleanup of a failed tmp write; nothing to report beyond the False below)
                 pass
             return False

@@ -16,10 +16,7 @@ Used inside shard_map with specs like
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

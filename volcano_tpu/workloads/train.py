@@ -9,7 +9,7 @@ collectives (psum over dp/fsdp riding ICI, DCN for multi-slice).
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
