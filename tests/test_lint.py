@@ -28,7 +28,8 @@ import sys
 import pytest
 
 from volcano_tpu import metrics, trace
-from volcano_tpu.analysis import astlint, flakes, lockaudit, registry
+from volcano_tpu.analysis import (astlint, flakes, freezeaudit,
+                                  lockaudit, racecheck, registry)
 from volcano_tpu.analysis.schema import check_exposition
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -45,12 +46,68 @@ def _clean_registries():
     trace.reset()
 
 
+# The full-tree passes are pure functions of the working tree: run
+# each ONCE per pytest session and let every assertion share the
+# result — the growing rule set must not grow the gate's wall time
+# (the CLI run below additionally exercises the on-disk
+# .vtplint_cache/ increment).
+@pytest.fixture(scope="module")
+def tree_findings():
+    return astlint.lint_paths(LINT_PATHS)
+
+
+@pytest.fixture(scope="module")
+def race_pass():
+    prog = racecheck.build_program(LINT_PATHS)
+    return prog, prog.analyze()
+
+
+@pytest.fixture(scope="module")
+def race_findings(race_pass):
+    return race_pass[1]
+
+
 # -- 1. the tree is clean ----------------------------------------------
 
-def test_vtplint_strict_tree_is_clean():
-    findings = astlint.lint_paths(LINT_PATHS)
-    active = [f for f in findings if f.suppressed is None]
+def test_vtplint_strict_tree_is_clean(tree_findings):
+    active = [f for f in tree_findings if f.suppressed is None]
     assert not active, "\n".join(f.format() for f in active)
+
+
+def test_racecheck_tree_is_clean(race_findings):
+    active = [f for f in race_findings if f.suppressed is None]
+    assert not active, "\n".join(f.format() for f in active)
+
+
+def test_racecheck_classifies_the_reader_trees(race_pass):
+    """The ownership pass must actually see the sweep: the predicate/
+    score plugin callbacks and the sweep machinery classify as
+    snapshot-readers (an empty reader set would make rule silence
+    vacuous)."""
+    prog, _ = race_pass
+    readers = set(prog.readers())
+    for needle in (
+            "volcano_tpu/actions/util.py:fit_class",
+            "volcano_tpu/actions/util.py:predicate_nodes",
+            "volcano_tpu/actions/sweep.py:sweep_shard",
+            "volcano_tpu/plugins/predicates.py:"
+            "PredicatesPlugin._predicate",
+            "volcano_tpu/plugins/nodeorder.py:"
+            "NodeOrderPlugin._score",
+            "volcano_tpu/framework/session.py:"
+            "Session._run_predicates"):
+        assert any(r.endswith(needle) for r in readers), needle
+    # ...and the mutation seams are NOT readers
+    assert not any(r.endswith("Session.allocate") for r in readers)
+    assert not any(r.endswith("SpecCache.invalidate")
+                   for r in readers)
+
+
+def test_racecheck_waivers_name_their_reason(race_findings):
+    waived = [f for f in race_findings if f.suppressed is not None]
+    assert waived, "the burn-down inventory must be non-empty"
+    for f in waived:
+        assert f.suppressed, f.format()
 
 
 def test_flakes_tree_is_clean():
@@ -63,8 +120,8 @@ def test_registry_checks_pass():
     assert not findings, "\n".join(f.format() for f in findings)
 
 
-def test_suppression_inventory_is_fully_explained():
-    findings = astlint.lint_paths(LINT_PATHS)
+def test_suppression_inventory_is_fully_explained(tree_findings):
+    findings = tree_findings
     unexplained = [f for f in findings
                    if f.rule == "unexplained-suppression"]
     assert not unexplained, \
@@ -245,6 +302,287 @@ def test_schema_checker_fixtures():
         'elastic_decisions_total{kind="grow"} 1\n'
         'frag_index{generation="v5e"} 0.25\n'
         "goodput_jobs 3\n")
+
+
+# -- 2b. racecheck broken fixtures: the ownership rules still fire -----
+
+FAKE_PLUGIN_PATH = "volcano_tpu/plugins/fixture_plugin.py"
+
+
+def _race(src, path=FAKE_PLUGIN_PATH):
+    findings = racecheck.check_sources({path: src})
+    return {f.rule for f in findings if f.suppressed is None}
+
+
+def test_rule_snapshot_write_fires_on_attribute_write():
+    src = ("class P:\n"
+           "    def on_session_open(self, ssn):\n"
+           "        ssn.add_predicate_fn('p', self._predicate)\n"
+           "    def _predicate(self, task, node):\n"
+           "        task.node_name = node.name\n"
+           "        return None\n")
+    assert "snapshot-write" in _race(src)
+
+
+def test_rule_snapshot_write_fires_on_mutator_call():
+    src = ("class P:\n"
+           "    def on_session_open(self, ssn):\n"
+           "        ssn.add_predicate_fn('p', self._predicate)\n"
+           "    def _predicate(self, task, node):\n"
+           "        node.idle.sub(task.resreq)\n"
+           "        return None\n")
+    assert "snapshot-write" in _race(src)
+
+
+def test_rule_snapshot_write_fires_on_item_write_via_taint():
+    src = ("class P:\n"
+           "    def on_session_open(self, ssn):\n"
+           "        ssn.add_node_order_fn('p', self._score)\n"
+           "    def _score(self, task, node):\n"
+           "        owner = node.tasks.get(task.uid)\n"
+           "        node.tasks[task.uid] = task\n"
+           "        return 0.0\n")
+    assert "snapshot-write" in _race(src)
+
+
+def test_rule_snapshot_write_clean_reader_is_silent():
+    src = ("class P:\n"
+           "    def on_session_open(self, ssn):\n"
+           "        ssn.add_predicate_fn('p', self._predicate)\n"
+           "    def _predicate(self, task, node):\n"
+           "        fresh = node.idle.clone()\n"
+           "        fresh.sub(task.resreq)\n"
+           "        counts = {}\n"
+           "        counts[node.name] = 1\n"
+           "        return None\n")
+    assert not _race(src)
+
+
+def test_rule_shared_cache_unkeyed_fires():
+    src = ("class P:\n"
+           "    def on_session_open(self, ssn):\n"
+           "        ssn.add_predicate_fn('p', self._predicate)\n"
+           "    def _predicate(self, task, node):\n"
+           "        self._memo[task.uid] = node.name\n"
+           "        return None\n")
+    assert "shared-cache-unkeyed" in _race(src)
+
+
+def test_rule_shared_cache_waiver_is_honoured_and_inventoried():
+    src = ("class P:\n"
+           "    def on_session_open(self, ssn):\n"
+           "        ssn.add_predicate_fn('p', self._predicate)\n"
+           "    def _predicate(self, task, node):\n"
+           "        # vtplint: disable=shared-cache-unkeyed "
+           "(idempotent memo under plugin lock)\n"
+           "        self._memo[task.uid] = node.name\n"
+           "        return None\n")
+    findings = racecheck.check_sources({FAKE_PLUGIN_PATH: src})
+    assert not [f for f in findings if f.suppressed is None]
+    assert any(f.rule == "shared-cache-unkeyed" and f.suppressed
+               for f in findings)
+
+
+def test_racecheck_reachability_propagates_through_helpers():
+    src = ("class P:\n"
+           "    def on_session_open(self, ssn):\n"
+           "        ssn.add_predicate_fn('p', self._predicate)\n"
+           "    def _predicate(self, task, node):\n"
+           "        return self._helper(task, node)\n"
+           "    def _helper(self, task, node):\n"
+           "        node.bind_generation = 0\n"
+           "        return None\n")
+    assert "snapshot-write" in _race(src)
+
+
+# -- 2c. runtime freeze/race broken fixtures ---------------------------
+
+@pytest.fixture
+def race_runtime():
+    freezeaudit.install()
+    freezeaudit.reset()
+    yield freezeaudit
+    freezeaudit.reset()
+    freezeaudit.uninstall()
+
+
+def _frozen_session(race_runtime, tmp_scenario=None):
+    from volcano_tpu.framework.framework import open_session
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.simulator import make_tpu_cluster
+    from volcano_tpu.uthelper import gang_job
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    pg, pods = gang_job("frozen", replicas=2, requests={"cpu": 1})
+    cluster.add_podgroup(pg)
+    for p in pods:
+        cluster.add_pod(p)
+    sched = Scheduler(cluster, schedule_period=0)
+    return open_session(sched.cache, sched.conf)
+
+
+def test_runtime_freeze_violation_fires(race_runtime):
+    """A bare attribute write to a frozen snapshot object before the
+    first commit is recorded (the write the static pass would flag,
+    caught live)."""
+    ssn = _frozen_session(race_runtime)
+    node = next(iter(ssn.nodes.values()))
+    node.bind_generation = 99          # not in any seam
+    viols = race_runtime.report()["violations"]
+    assert any(v["kind"] == "frozen-write"
+               and "bind_generation" in v["target"] for v in viols)
+
+
+def test_runtime_freeze_seam_writes_are_clean(race_runtime):
+    """The same mutation through the designated seams (Statement ->
+    Session primitives) records nothing."""
+    from volcano_tpu.api.types import TaskStatus
+    ssn = _frozen_session(race_runtime)
+    task = next(t for j in ssn.jobs.values()
+                for t in j.tasks_in_status(TaskStatus.PENDING))
+    node = next(iter(ssn.nodes.values()))
+    stmt = ssn.statement()
+    stmt.allocate(task, node)
+    stmt.commit()
+    assert not race_runtime.report()["violations"]
+
+
+def test_runtime_freeze_window_closes_at_first_commit(race_runtime):
+    from volcano_tpu.api.types import TaskStatus
+    ssn = _frozen_session(race_runtime)
+    task = next(t for j in ssn.jobs.values()
+                for t in j.tasks_in_status(TaskStatus.PENDING))
+    node = next(iter(ssn.nodes.values()))
+    stmt = ssn.statement()
+    stmt.allocate(task, node)
+    stmt.commit()
+    # post-commit owner-thread writes are the mutation phase
+    node.bind_generation += 1
+    assert not race_runtime.report()["violations"]
+
+
+def test_runtime_fanout_write_fires_even_after_commit(race_runtime):
+    from volcano_tpu.api.types import TaskStatus
+    ssn = _frozen_session(race_runtime)
+    task = next(t for j in ssn.jobs.values()
+                for t in j.tasks_in_status(TaskStatus.PENDING))
+    node = next(iter(ssn.nodes.values()))
+    stmt = ssn.statement()
+    stmt.allocate(task, node)
+    stmt.commit()
+    race_runtime.fanout_begin()
+    try:
+        node.bind_generation += 1
+    finally:
+        race_runtime.fanout_end()
+    viols = race_runtime.report()["violations"]
+    assert any(v["kind"] == "frozen-write" and
+               "parallel sweep" in v["reason"] for v in viols)
+
+
+def test_runtime_seam_in_fanout_fires(race_runtime):
+    """Entering a mutation seam while workers are in flight is a
+    violation even though seams are otherwise sanctioned."""
+    from volcano_tpu.api.types import TaskStatus
+    ssn = _frozen_session(race_runtime)
+    task = next(t for j in ssn.jobs.values()
+                for t in j.tasks_in_status(TaskStatus.PENDING))
+    node = next(iter(ssn.nodes.values()))
+    race_runtime.fanout_begin()
+    try:
+        ssn.allocate(task, node)
+    finally:
+        race_runtime.fanout_end()
+    viols = race_runtime.report()["violations"]
+    assert any(v["kind"] == "seam-in-fanout" for v in viols)
+
+
+def test_runtime_cross_thread_unsync_pair_fires(race_runtime):
+    """A tracked store written by one thread and read by another with
+    no common lock held -> unsync-pair (ThreadSanitizer-lite)."""
+    import threading
+    store = race_runtime.track({}, "test.shared")
+
+    def writer():
+        store["k"] = 1
+
+    t = threading.Thread(target=writer)
+    t.start()
+    t.join()
+    _ = store.get("k")
+    viols = race_runtime.report()["violations"]
+    assert any(v["kind"] == "unsync-pair"
+               and v["store"] == "test.shared" for v in viols)
+
+
+def test_runtime_locked_cross_thread_access_is_clean(race_runtime):
+    """The same pattern under ONE common audited lock is ordered:
+    held-sets intersect, no pair."""
+    import threading
+    lockaudit.install()
+    lockaudit.reset()
+    try:
+        lk = lockaudit.make_lock("SHARED")
+        store = race_runtime.track({}, "test.locked")
+
+        def writer():
+            with lk:
+                store["k"] = 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        t.join()
+        with lk:
+            _ = store.get("k")
+        viols = race_runtime.report()["violations"]
+        assert not [v for v in viols
+                    if v.get("store") == "test.locked"], viols
+    finally:
+        lockaudit.reset()
+        lockaudit.uninstall()
+
+
+# -- 2d. the incremental cache -----------------------------------------
+
+def test_lintcache_roundtrip_and_invalidation(tmp_path):
+    from volcano_tpu.analysis.lintcache import LintCache
+    import time as _time
+    src = tmp_path / "volcano_tpu"
+    src.mkdir()
+    f = src / "mod.py"
+    f.write_text("import os\nx = 1\n")
+    # mirror the toolchain files the version digest stats
+    cache = LintCache(REPO, cache_dir=str(tmp_path / ".vtplint_cache"))
+    findings = flakes.check_source(f.read_text(), str(f))
+    assert findings                      # the unused import
+    cache.put_file("flakes", str(f), findings)
+    cache.save()
+
+    reloaded = LintCache(REPO,
+                         cache_dir=str(tmp_path / ".vtplint_cache"))
+    hit = reloaded.get_file("flakes", str(f))
+    assert hit is not None
+    assert [(x.rule, x.line) for x in hit] == \
+        [(x.rule, x.line) for x in findings]
+    # an edit invalidates: new mtime/size => miss
+    _time.sleep(0.01)
+    f.write_text("import os\nimport sys\nx = 1\n")
+    assert reloaded.get_file("flakes", str(f)) is None
+
+
+def test_lintcache_tree_sig_tracks_any_file(tmp_path):
+    from volcano_tpu.analysis.lintcache import LintCache
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text("x = 1\n")
+    b.write_text("y = 2\n")
+    cache = LintCache(REPO, cache_dir=str(tmp_path / ".c"))
+    sig = cache.tree_sig([str(a), str(b)])
+    cache.put_tree("race", sig, [])
+    assert cache.get_tree("race", sig) == []
+    import time as _time
+    _time.sleep(0.01)
+    b.write_text("y = 3\n")
+    assert cache.tree_sig([str(a), str(b)]) != sig
 
 
 # -- 3. live exposition vs the label schema (the deduped test) ---------

@@ -1,0 +1,329 @@
+"""The parallel predicate sweep pilot (actions/sweep.py) + the
+runtime freeze auditor wired through it.
+
+Four layers:
+
+  1. prepared-form equivalence — every plugin that registers a
+     PreFilter/PreScore prepared twin must stay verdict/score-
+     identical to its plain callback over a diverse node set (a
+     prepared form that drifts is a silent scheduling change);
+  2. parallel == serial — the fanned-out build_entry returns a
+     bit-identical entry (fits, scores, heap metadata) to the legacy
+     dispatch path, topology or not;
+  3. end-to-end — a gang schedules to the same placements with
+     parallelPredicates on and off, under the ARMED freeze auditor
+     with zero violations;
+  4. the SpecCache invalidate fast path — entries whose candidate
+     set never contained the placed node are skipped (no predicate
+     re-runs), pinned by call counting.
+"""
+
+import pytest
+
+from volcano_tpu.actions.sweep import SpecCache, parallel_conf
+from volcano_tpu.analysis import freezeaudit
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.framework.framework import open_session
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.simulator import make_tpu_cluster
+from volcano_tpu.uthelper import gang_job
+
+CONF = {
+    "actions": "enqueue, allocate, backfill",
+    "tiers": [
+        {"plugins": [{"name": "priority"}, {"name": "gang"},
+                     {"name": "failover"}, {"name": "conformance"}]},
+        {"plugins": [{"name": "overcommit"}, {"name": "drf"},
+                     {"name": "predicates"},
+                     {"name": "volumebinding"},
+                     {"name": "deviceshare"},
+                     {"name": "proportion"},
+                     {"name": "nodeorder"}, {"name": "binpack"}]},
+    ],
+}
+
+
+def _scenario(n_slices=8, replicas=8, requests=None):
+    cluster = make_tpu_cluster(
+        [(f"s{i}", "v5e-16") for i in range(n_slices)])
+    pg, pods = gang_job(
+        "sweepjob", replicas=replicas,
+        requests=requests or {"cpu": 2, "google.com/tpu": 4})
+    cluster.add_podgroup(pg)
+    for p in pods:
+        cluster.add_pod(p)
+    return cluster
+
+
+def _open(cluster, parallel=False, workers=4, conf=None):
+    import copy
+    c = copy.deepcopy(conf or CONF)
+    if parallel:
+        c.setdefault("configurations", {})["allocate"] = {
+            "parallelPredicates": True,
+            "parallelPredicates.workers": workers}
+    sched = Scheduler(cluster, conf=c, schedule_period=0)
+    return sched, open_session(sched.cache, sched.conf)
+
+
+def _pending_task(ssn):
+    return next(t for j in ssn.jobs.values()
+                for t in j.tasks_in_status(TaskStatus.PENDING))
+
+
+# -- 1. prepared-form equivalence --------------------------------------
+
+def _diverse_nodes(ssn):
+    """Mutate a few nodes so every prepared branch sees both sides."""
+    nodes = list(ssn.nodes.values())
+    nodes[1].node.unschedulable = True             # not ready
+    nodes[2].node.labels["zone"] = "elsewhere"
+    from volcano_tpu.api.netusage import NODE_SATURATED_ANNOTATION
+    nodes[3].node.annotations[NODE_SATURATED_ANNOTATION] = "true"
+    from volcano_tpu.api.node_info import Taint
+    nodes[4].node.taints.append(
+        Taint(key="maint", value="true", effect="NoSchedule"))
+    nodes[5].node.taints.append(
+        Taint(key="soft", value="true", effect="PreferNoSchedule"))
+    return nodes
+
+
+def test_prepared_forms_match_plain_callbacks():
+    cluster = _scenario()
+    _, ssn = _open(cluster)
+    task = _pending_task(ssn)
+    nodes = _diverse_nodes(ssn)
+
+    preds = dict(ssn.resolved_named_fns("predicate"))
+    for name, prep in ssn.resolved_named_fns("predicatePrepare"):
+        assert name in preds, f"{name}: prepare without predicate"
+        prepared = prep(task)
+        for node in nodes:
+            plain = preds[name](task, node)
+            got = prepared(node)
+            assert (plain is None) == (got is None), \
+                f"{name} on {node.name}: {plain} != {got}"
+            if plain is not None:
+                assert plain.reason == got.reason
+                assert plain.code == got.code
+
+    scores = dict(ssn.resolved_named_fns("nodeOrder"))
+    for name, prep in ssn.resolved_named_fns("nodeOrderPrepare"):
+        assert name in scores, f"{name}: prepare without nodeOrder"
+        prepared = prep(task)
+        for node in nodes:
+            assert scores[name](task, node) == \
+                pytest.approx(prepared(node)), \
+                f"{name} on {node.name}"
+
+
+def test_prepared_spread_and_volume_fast_paths_fire():
+    """The claimless/spreadless fast paths return constant lambdas
+    (not wrappers) — the batched sweep's common case."""
+    cluster = _scenario()
+    _, ssn = _open(cluster)
+    task = _pending_task(ssn)
+    for name, prep in ssn.resolved_named_fns("predicatePrepare"):
+        if name in ("pod-topology-spread", "volumebinding"):
+            assert prep(task)(list(ssn.nodes.values())[0]) is None
+
+
+# -- 2. parallel == serial build_entry ---------------------------------
+
+def _entries_equal(a, b):
+    return (a["fits"].keys() == b["fits"].keys()
+            and a["scores"] == b["scores"]
+            and a["meta"] == b["meta"]
+            and a["candidates"] == b["candidates"])
+
+
+@pytest.mark.parametrize("workers", [1, 3, 8])
+def test_parallel_build_entry_identical_to_serial(workers):
+    cluster = _scenario()
+    _, ssn = _open(cluster)
+    task = _pending_task(ssn)
+    nodes = list(ssn.nodes.values())
+    serial = SpecCache(ssn, nodes, record_errors=False)
+    base = serial.build_entry(task)
+
+    _, pssn = _open(_scenario(), parallel=True, workers=workers)
+    ptask = _pending_task(pssn)
+    pnodes = list(pssn.nodes.values())
+    par = SpecCache(pssn, pnodes, record_errors=False)
+    assert par.workers == workers
+    entry = par.build_entry(ptask)
+    assert _entries_equal(entry, base)
+
+
+def test_parallel_sweep_records_same_fit_errors():
+    """Fit errors deferred to the post-barrier merge must equal the
+    serial path's immediate recording (same nodes, same reasons)."""
+    cluster = _scenario()
+    # an impossible selector: every node fails the predicate
+    for pod in cluster.pods.values():
+        pod.node_selector = {"zone": "nowhere"}
+
+    def errors(parallel):
+        _, ssn = _open(_scenario() if False else cluster,
+                       parallel=parallel)
+        task = _pending_task(ssn)
+        cache = SpecCache(ssn, list(ssn.nodes.values()),
+                          record_errors=True)
+        cache.build_entry(task)
+        job = ssn.jobs[task.job]
+        fe = job.fit_errors.get(task.uid)
+        return {n: s.statuses[0].reason
+                for n, s in fe.nodes.items()} if fe else {}
+
+    serial = errors(False)
+    parallel = errors(True)
+    assert serial and serial == parallel
+
+
+def test_shard_unit_is_leaf_group():
+    from volcano_tpu.actions.sweep import shard_nodes
+    cluster = _scenario(n_slices=8)
+    _, ssn = _open(cluster)
+    nodes = list(ssn.nodes.values())
+    shards = shard_nodes(ssn, nodes, workers=2)
+    assert sum(len(s) for s in shards) == len(nodes)
+    # leaf groups are never split across shards (the item-3 unit)
+    seen = {}
+    for i, shard in enumerate(shards):
+        for n in shard:
+            group = ssn.node_group(n.name)
+            assert seen.setdefault(group, i) == i, \
+                f"leaf {group} split across shards"
+
+
+# -- 3. end-to-end under the armed auditor -----------------------------
+
+@pytest.fixture
+def audit():
+    freezeaudit.install()
+    freezeaudit.reset()
+    yield freezeaudit
+    freezeaudit.reset()
+    freezeaudit.uninstall()
+
+
+def _run_cycles(cluster, parallel, cycles=3):
+    sched, _ = None, None
+    import copy
+    conf = copy.deepcopy(CONF)
+    if parallel:
+        conf["configurations"] = {"allocate": {
+            "parallelPredicates": True,
+            "parallelPredicates.workers": 4}}
+    sched = Scheduler(cluster, conf=conf, schedule_period=0)
+    for _ in range(cycles):
+        sched.run_once()
+        cluster.tick()
+    return {p.name: p.node_name for p in cluster.pods.values()}
+
+
+def test_end_to_end_parallel_matches_serial_under_audit(audit):
+    placed_serial = _run_cycles(_scenario(), parallel=False)
+    placed_parallel = _run_cycles(_scenario(), parallel=True)
+    assert placed_serial == placed_parallel
+    assert any(placed_serial.values()), "gang must actually place"
+    rep = audit.report()
+    assert rep["sessions_frozen"] > 0
+    assert rep["fanout_regions"] > 0
+    assert not rep["violations"], rep["violations"]
+
+
+def test_confined_stores_tracked_and_leak_detected(audit):
+    """The TSan-lite half is ARMED on the production stores whose
+    race waivers claim owner-thread confinement (SpecCache.entries,
+    the Session dispatch memos) — and a pool-worker-style cross-thread
+    access on one of them fires an unsync-pair."""
+    cluster = _scenario()
+    _, ssn = _open(cluster, parallel=True)
+    task = _pending_task(ssn)
+    cache = SpecCache(ssn, list(ssn.nodes.values()),
+                      record_errors=False)
+    cache.build_entry(task)
+    rep = audit.report()
+    assert "sweep.SpecCache.entries" in rep["tracked_stores"]
+    assert "session._raw_cache" in rep["tracked_stores"]
+    assert not rep["violations"], rep["violations"]
+
+    # a worker holding a reference to the owner-confined table is
+    # exactly the leak the unsync-pair detector exists to catch
+    import threading
+    t = threading.Thread(target=lambda: cache.entries.get("leak"))
+    t.start()
+    t.join()
+    rep = audit.report()
+    assert any(v["kind"] == "unsync-pair"
+               and v["store"] == "sweep.SpecCache.entries"
+               for v in rep["violations"]), rep["violations"]
+
+
+def test_parallel_conf_parsing():
+    cluster = _scenario()
+    _, ssn = _open(cluster)
+    assert parallel_conf(ssn) == (False, 0)
+    ssn.conf.configurations["allocate"] = {"parallelPredicates": True}
+    enabled, workers = parallel_conf(ssn)
+    assert enabled and workers >= 1
+    ssn.conf.configurations["allocate"] = {
+        "parallelPredicates": "off"}
+    assert parallel_conf(ssn) == (False, 0)
+
+
+# -- 4. invalidate skips never-candidate entries -----------------------
+
+def test_invalidate_skips_entries_without_the_node():
+    """A placement on a node OUTSIDE an entry's candidate set cannot
+    change that entry's cached verdicts: invalidate must not re-run
+    ssn.predicate for it (satellite fix, pinned by call counting)."""
+    cluster = _scenario(n_slices=4)
+    _, ssn = _open(cluster)
+    task = _pending_task(ssn)
+    nodes = list(ssn.nodes.values())
+    inside, outside = nodes[:8], nodes[8:]
+    assert outside, "scenario must leave out-of-set nodes"
+    cache = SpecCache(ssn, inside, record_errors=False)
+    cache.build_entry(task)
+
+    calls = []
+    real = ssn.predicate
+    ssn.predicate = lambda t, n: (calls.append(n.name),
+                                  real(t, n))[1]
+    try:
+        cache.invalidate(outside[0])
+        assert calls == [], \
+            "predicate re-ran for a never-candidate node"
+        cache.invalidate(inside[0])
+        assert calls == [inside[0].name]
+    finally:
+        ssn.predicate = real
+
+
+def test_invalidate_refreshes_candidate_nodes():
+    """The legacy behavior is unchanged for real candidates: a
+    placement consumes the node and the entry's verdict flips."""
+    cluster = _scenario(n_slices=2, replicas=2,
+                        requests={"cpu": 2, "google.com/tpu": 4})
+    _, ssn = _open(cluster)
+    task = _pending_task(ssn)
+    nodes = list(ssn.nodes.values())
+    cache = SpecCache(ssn, nodes, record_errors=False)
+    entry = cache.build_entry(task)
+    victim = entry["fits"][sorted(entry["fits"])[0]]
+    # consume the node's chips in-session, then invalidate
+    job = ssn.jobs[task.job]
+    tasks = [t for t in job.tasks_in_status(TaskStatus.PENDING)]
+    ssn.allocate(tasks[0], victim)
+    cache.invalidate(victim)
+    assert victim.name not in entry["fits"] or \
+        entry["meta"][victim.name][1] != "idle"
+
+
+def test_sweep_metric_family_declared():
+    from volcano_tpu.bundle import FAMILIES, FAMILY_LABELS
+    assert FAMILIES.get("predicate_sweep_seconds") == "histogram"
+    assert set(FAMILY_LABELS["predicate_sweep_seconds"]["mode"]) == \
+        {"serial", "parallel"}

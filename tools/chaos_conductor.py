@@ -348,7 +348,8 @@ class InvariantTracker:
 
 def run_conductor(seed: int, duration: float,
                   classes=DEFAULT_CLASSES, logdir: str = "",
-                  lock_audit: bool = False) -> dict:
+                  lock_audit: bool = False,
+                  race_audit: bool = False) -> dict:
     classes = set(classes.split(",")) if isinstance(classes, str) \
         else set(classes)
     sched = build_plan(seed, duration, classes)
@@ -357,6 +358,8 @@ def run_conductor(seed: int, duration: float,
     import shutil
     shutil.rmtree(logdir, ignore_errors=True)
     audit_dir = os.path.join(logdir, "lockaudit")
+    race_dir = os.path.join(logdir, "raceaudit")
+    audit_env = {}
     if lock_audit:
         # arm the runtime lock-order auditor (analysis/lockaudit.py)
         # in EVERY child process (server, replicas, scheduler,
@@ -367,8 +370,23 @@ def run_conductor(seed: int, duration: float,
         os.makedirs(audit_dir, exist_ok=True)
         from volcano_tpu.analysis import lockaudit
         lockaudit.install()
+        audit_env.update(VTP_LOCK_AUDIT="1",
+                         VTP_LOCK_AUDIT_OUT=audit_dir)
+    if race_audit:
+        # arm the snapshot-freeze/data-race auditor the same way
+        # (analysis/freezeaudit.py): every scheduler session in the
+        # plane deep-freezes its snapshot, and the scheduler child
+        # additionally runs the PARALLEL predicate sweep so the
+        # fan-out is certified against real chaos traffic, not just
+        # tier-1 fixtures
+        os.makedirs(race_dir, exist_ok=True)
+        from volcano_tpu.analysis import freezeaudit
+        freezeaudit.install()
+        audit_env.update(VTP_RACE_AUDIT="1",
+                         VTP_RACE_AUDIT_OUT=race_dir)
+    if audit_env:
         zoo = chaoslib.ProcessZoo(logdir, env=chaoslib.repo_env(
-            VTP_LOCK_AUDIT="1", VTP_LOCK_AUDIT_OUT=audit_dir))
+            **audit_env))
     else:
         zoo = chaoslib.ProcessZoo(logdir)
     data_dir = os.path.join(logdir, "state")
@@ -433,9 +451,23 @@ def run_conductor(seed: int, duration: float,
         t_plan0 = time.monotonic()     # ~ the server plan's t0
         # leader-elected scheduler: the clock-jump invariant is about
         # the LEASE surviving a wall step — there must be a lease
+        sched_extra = []
+        if race_audit:
+            # the pilot under certification: default conf + the
+            # parallel leaf-shard predicate sweep
+            conf_path = os.path.join(logdir, "sched_conf.yaml")
+            import yaml
+            from volcano_tpu.conf import DEFAULT_SCHEDULER_CONF
+            conf_doc = dict(DEFAULT_SCHEDULER_CONF)
+            conf_doc["configurations"] = {
+                "allocate": {"parallelPredicates": True,
+                             "parallelPredicates.workers": 8}}
+            with open(conf_path, "w", encoding="utf-8") as f:
+                yaml.safe_dump(conf_doc, f)
+            sched_extra = ["--conf", conf_path]
         zoo.spawn_plane("sched", plane_url, "scheduler",
                         "--leader-elect", "--holder", "s1",
-                        "--lease-ttl", "1.5")
+                        "--lease-ttl", "1.5", *sched_extra)
         zoo.spawn_plane("ctrl", plane_url, "controllers")
 
         # high-rate sampler: the main loop slows down under injected
@@ -957,19 +989,25 @@ def run_conductor(seed: int, duration: float,
             "crc_drill": crc,
             "ok": not summary["violations"],
         })
-        if lock_audit:
+        if lock_audit or race_audit:
             # terminate the plane BEFORE merging: SIGTERM triggers
-            # each child's lockaudit flush handler (atexit never runs
+            # each child's audit flush handlers (atexit never runs
             # under signals), so violations recorded after the last
             # 2Hz flush — the shutdown window where ordering races
             # live — still reach the merged report.  terminate_all is
             # idempotent; the finally's call becomes a no-op.
             zoo.terminate_all()
+        if lock_audit:
             result["lock_audit"] = _collect_lock_audit(audit_dir)
             result["ok"] = result["ok"] and not \
                 result["lock_audit"]["violations"]
+        if race_audit:
+            result["race_audit"] = _collect_race_audit(race_dir)
+            result["ok"] = result["ok"] and not \
+                result["race_audit"]["violations"]
         if not result["ok"]:
-            flag = " --lock-audit" if lock_audit else ""
+            flag = (" --lock-audit" if lock_audit else "") + \
+                (" --race-audit" if race_audit else "")
             print(f"\nREPRODUCE: python tools/chaos_conductor.py "
                   f"--seed {seed} --duration {duration} "
                   f"--classes {','.join(sorted(classes))}{flag}",
@@ -1018,6 +1056,41 @@ def _collect_lock_audit(audit_dir: str) -> dict:
         "edges": sorted([[a, b, n] for (a, b), n in edges.items()]),
         "same_site_nestings": same_site,
         "cycles": cycles,
+        "violations": violations,
+    }
+
+
+def _collect_race_audit(race_dir: str) -> dict:
+    """Merge every process's flushed freeze-audit report (plus this
+    conductor's own, in-process) into one summary: frozen sessions,
+    fan-out regions, tracked stores, all violations."""
+    import glob
+
+    from volcano_tpu.analysis import freezeaudit
+    freezeaudit.flush(race_dir)         # the conductor's own report
+    sessions = objects = fanouts = 0
+    tracked = {}
+    violations = []
+    reports = sorted(glob.glob(os.path.join(race_dir, "*.json")))
+    for path in reports:
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            # vtplint: disable=except-pass (a report torn mid-flush by the 2Hz writer; the process's atexit flush supersedes it)
+            continue
+        sessions += doc.get("sessions_frozen", 0)
+        objects += doc.get("objects_frozen", 0)
+        fanouts += doc.get("fanout_regions", 0)
+        for name, n in doc.get("tracked_stores", {}).items():
+            tracked[name] = tracked.get(name, 0) + n
+        violations.extend(doc.get("violations", []))
+    return {
+        "processes_reporting": len(reports),
+        "sessions_frozen": sessions,
+        "objects_frozen": objects,
+        "fanout_regions": fanouts,
+        "tracked_stores": tracked,
         "violations": violations,
     }
 
@@ -1170,10 +1243,13 @@ def read_qps_scaling(n_readers: int = 6, measure_s: float = 4.0,
 
 
 def run_matrix(seeds, duration: float, classes: str,
-               out: str = "") -> dict:
+               out: str = "", lock_audit: bool = False,
+               race_audit: bool = False) -> dict:
     rows = []
     for seed in seeds:
-        rows.append(run_conductor(seed, duration, classes))
+        rows.append(run_conductor(seed, duration, classes,
+                                  lock_audit=lock_audit,
+                                  race_audit=race_audit))
         print(json.dumps({"seed": seed, "ok": rows[-1]["ok"]}),
               flush=True)
     invariant_names = sorted(rows[0]["invariants"]["passed"])
@@ -1283,6 +1359,12 @@ def main(argv=None) -> int:
                     help="arm analysis/lockaudit.py in every process "
                          "and fail the run on any lock-order/guarded-"
                          "store violation (the vtplint runtime smoke)")
+    ap.add_argument("--race-audit", action="store_true",
+                    help="arm analysis/freezeaudit.py in every "
+                         "process (snapshot deep-freeze + unsync-pair "
+                         "tracking), run the scheduler with the "
+                         "parallel predicate sweep, and fail the run "
+                         "on any race/freeze violation")
     args = ap.parse_args(argv)
     classes = args.classes
     if args.print_schedule:
@@ -1292,13 +1374,16 @@ def main(argv=None) -> int:
         return 0
     if args.matrix:
         doc = run_matrix(range(1, args.matrix + 1), args.duration,
-                         classes, out=args.out)
+                         classes, out=args.out,
+                         lock_audit=args.lock_audit,
+                         race_audit=args.race_audit)
         print(json.dumps({k: v for k, v in doc.items()
                           if k != "per_seed"}, indent=1))
         return 0 if doc["zero_violations"] else 1
     out = run_conductor(args.seed, args.duration, classes,
                         logdir=args.logdir,
-                        lock_audit=args.lock_audit)
+                        lock_audit=args.lock_audit,
+                        race_audit=args.race_audit)
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 

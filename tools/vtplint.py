@@ -1,20 +1,33 @@
 #!/usr/bin/env python
 """vtplint — the project-native invariant linter (CLI).
 
-Runs three passes over the tree and prints one merged report:
+Runs four passes over the tree and prints one merged report:
 
   rules      AST project rules (volcano_tpu/analysis/astlint.py):
              req-id, wall-clock, metric-family, metric-labels,
              append-lock, except-pass — plus unexplained-suppression
              for any waiver without a reason.
+  race       the snapshot-ownership pass (analysis/racecheck.py):
+             functions reachable from the predicate/nodeOrder/
+             batchNodeOrder/fit_class call trees are classified
+             snapshot-readers; snapshot-write and
+             shared-cache-unkeyed flag mutations that would race the
+             parallel sweep.
   flakes     pyflakes when installed, the conservative built-in
              fallback otherwise (syntax errors, unused imports).
   registry   runtime cross-checks: codec wire round-trips, store
              kind registry, metric family/label-schema coverage.
 
+Results are cached in .vtplint_cache/ keyed by file mtime+size and
+the toolchain's own sources (analysis/lintcache.py), so the growing
+rule set keeps the tier-1 gate's wall time flat: an unchanged tree
+replays instantly, an edit re-lints just that file (plus the
+whole-program race pass).
+
 Usage:
     python tools/vtplint.py [--strict] [--json] [--report OUT.json]
-                            [--no-flakes] [--no-registry] [paths...]
+                            [--no-flakes] [--no-registry] [--no-race]
+                            [--no-cache] [paths...]
 
 --strict exits 1 on ANY unsuppressed finding (tier-1 runs this via
 tests/test_lint.py).  Suppressed findings are listed as the
@@ -36,16 +49,64 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 DEFAULT_PATHS = ("volcano_tpu", "tools")
 
 
-def run(paths, flakes: bool = True, registry: bool = True):
+def _py_files(paths):
+    files = []
+    for path in paths:
+        if os.path.isfile(path):
+            files.append(path)
+            continue
+        for root, dirs, fnames in os.walk(path):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            files.extend(os.path.join(root, f) for f in sorted(fnames)
+                         if f.endswith(".py"))
+    return files
+
+
+def run(paths, flakes: bool = True, registry: bool = True,
+        race: bool = True, cache=None):
     """(active findings, suppressed findings) over the given paths."""
     from volcano_tpu.analysis import astlint
     from volcano_tpu.analysis import flakes as flakes_mod
+    from volcano_tpu.analysis import racecheck
     from volcano_tpu.analysis import registry as registry_mod
-    findings = astlint.lint_paths(paths)
-    if flakes:
-        findings += flakes_mod.check_paths(paths)
+
+    files = _py_files(paths)
+    findings = []
+    linter = astlint.Linter()
+    for fpath in files:
+        per_file = None
+        if cache is not None:
+            per_file = cache.get_file("rules", fpath)
+        if per_file is None:
+            per_file = linter.lint_file(fpath)
+            if cache is not None:
+                cache.put_file("rules", fpath, per_file)
+        findings.extend(per_file)
+        if flakes:
+            fl = cache.get_file("flakes", fpath) \
+                if cache is not None else None
+            if fl is None:
+                with open(fpath, encoding="utf-8") as f:
+                    fl = flakes_mod.check_source(f.read(), fpath)
+                if cache is not None:
+                    cache.put_file("flakes", fpath, fl)
+            findings.extend(fl)
+    if race:
+        domain = [f for f in files if racecheck.in_domain(f)]
+        rf = None
+        sig = ""
+        if cache is not None:
+            sig = cache.tree_sig(domain)
+            rf = cache.get_tree("race", sig)
+        if rf is None:
+            rf = racecheck.check_paths(paths)
+            if cache is not None:
+                cache.put_tree("race", sig, rf)
+        findings.extend(rf)
     if registry:
         findings += registry_mod.check_all()
+    if cache is not None:
+        cache.save()
     active = [f for f in findings if f.suppressed is None]
     suppressed = [f for f in findings if f.suppressed is not None]
     return active, suppressed
@@ -76,13 +137,22 @@ def main(argv=None) -> int:
                     help="also write the JSON report to this path")
     ap.add_argument("--no-flakes", action="store_true")
     ap.add_argument("--no-registry", action="store_true")
+    ap.add_argument("--no-race", action="store_true")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass .vtplint_cache/ (cold full run)")
     args = ap.parse_args(argv)
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     os.chdir(repo)
+    cache = None
+    if not args.no_cache:
+        from volcano_tpu.analysis.lintcache import LintCache
+        cache = LintCache(repo)
     active, suppressed = run(args.paths or list(DEFAULT_PATHS),
                              flakes=not args.no_flakes,
-                             registry=not args.no_registry)
+                             registry=not args.no_registry,
+                             race=not args.no_race,
+                             cache=cache)
     report = doc(active, suppressed)
     if args.report:
         with open(args.report, "w", encoding="utf-8") as f:

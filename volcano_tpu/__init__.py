@@ -39,3 +39,11 @@ import os as _os
 if _os.environ.get("VTP_LOCK_AUDIT"):
     from volcano_tpu.analysis import lockaudit as _lockaudit
     _lockaudit.install_from_env()
+
+# Opt-in runtime snapshot-freeze/data-race auditing (the `-race`
+# analog, analysis/freezeaudit.py): armed here so every process in a
+# chaos conductor --race-audit plane freezes its scheduler sessions
+# and reports to VTP_RACE_AUDIT_OUT.
+if _os.environ.get("VTP_RACE_AUDIT"):
+    from volcano_tpu.analysis import freezeaudit as _freezeaudit
+    _freezeaudit.install_from_env()

@@ -10,7 +10,6 @@ best-scoring domain is recovered (allocate.go:370-463).
 
 from __future__ import annotations
 
-import heapq
 import logging
 import time
 from typing import Dict
@@ -23,8 +22,8 @@ from volcano_tpu.api.types import PodGroupPhase, TaskStatus
 from volcano_tpu.framework.plugins import Action, register_action
 from volcano_tpu.util import PriorityQueue
 
+from volcano_tpu.actions.sweep import SpecCache, heap_best
 from volcano_tpu.actions.util import (
-    fit_class,
     predicate_nodes,
     prioritize_nodes,
     split_by_fit,
@@ -166,7 +165,7 @@ class AllocateAction(Action):
             if job.fit_errors:
                 errs = FitErrors()
                 errs.set_error(job.fit_error())
-                job.job_fit_errors = errs
+                job.set_job_fit_errors(errs)
             ssn.set_job_pending_reason(
                 job, "Unschedulable",
                 job.fit_error() or
@@ -199,149 +198,15 @@ class AllocateAction(Action):
             # so only named (controller-stamped, identical) specs cache
             return cache_enabled and bool(task.task_spec)
         # Per-spec predicate/score/fit-class cache with single-node
-        # invalidation: a gang's tasks are identical, and a placement
-        # only changes the state of the ONE node it landed on — so
-        # feasibility, per-node scores AND idle/future classification
-        # are recomputed just for that node instead of sweeping all
-        # nodes per task (the reference parallelizes this sweep; we
-        # make it incremental).  Task-dependent scores (BatchNodeOrder,
-        # e.g. topology pull) are still per task — when any
-        # BatchNodeOrder plugin is enabled the selection falls back to
-        # the linear scan; otherwise a lazy max-heap over the cached
-        # scores makes each pick O(log n) instead of O(nodes), which
-        # is what takes a 1024-host gang over 5k hosts from ~9s to
-        # well under a second.
-        spec_cache: Dict[str, dict] = {}
+        # invalidation + optional parallel leaf-shard sweep — the
+        # machinery lives in actions/sweep.py (SpecCache) so the
+        # static race pass can name the reader call tree and the
+        # thread-pool pilot can fan it out over the frozen snapshot.
+        cache = SpecCache(ssn, candidate_nodes, record_errors)
+        use_heap = cache.use_heap
+        has_grouped = cache.has_grouped
         insufficient_memo: Dict[str, list] = {}
         spec_error_rep: Dict[str, str] = {}   # failed spec -> task uid
-        # Heap fast path is exact when every enabled BatchNodeOrder
-        # plugin also provides the leaf-grouped form (scores constant
-        # within a node group): the per-group heaps stay ordered by the
-        # cached NodeOrder score and the group offset is added at pick
-        # time.  Any ungrouped batch scorer (extender) forces the
-        # linear scan.
-        batch_names = ssn.fn_plugin_names("batchNodeOrder")
-        grouped_names = ssn.fn_plugin_names("groupedBatchNodeOrder")
-        use_heap = not (batch_names - grouped_names)
-        has_grouped = bool(grouped_names)
-
-        def build_entry(task):
-            fit_nodes = predicate_nodes(ssn, task, candidate_nodes,
-                                        record_errors)
-            entry = {
-                "proto": task,
-                "fits": {},     # name -> node (predicate-passing)
-                "scores": {},   # name -> cached NodeOrder score
-                # name -> (gen, cls, score): heap validity in ONE
-                # lookup — heap_peek runs ~60x per task on a 10k-host
-                # gang, and three separate dict.gets per peek were a
-                # measurable slice of the cycle
-                "meta": {},
-                "group": {},    # name -> node group (leaf hypernode)
-                # cls -> group -> heap of (-score, name, gen)
-                "heaps": {"idle": {}, "future": {}},
-                # cls -> {group: valid heap top (score, name)|None}.
-                # Only a placement/invalidate can change a group's
-                # top, so heap_best reads this cache instead of
-                # re-peeking every group for every task (at 20k hosts
-                # that was ~126 peeks x 4096 tasks per gang cycle);
-                # per-class dicts let it iterate items() instead of
-                # hashing a (cls, group) tuple per group per task
-                "top": {"idle": {}, "future": {}},
-            }
-            for n in fit_nodes:
-                entry["fits"][n.name] = n
-                score = ssn.node_order(task, n)
-                entry["scores"][n.name] = score
-                if use_heap:
-                    group = ssn.node_group(n.name) if has_grouped else None
-                    entry["group"][n.name] = group
-                    cls = fit_class(task, n)
-                    entry["meta"][n.name] = (0, cls, score)
-                    if cls is not None:
-                        entry["heaps"][cls].setdefault(group, []).append(
-                            (-score, n.name, 0))
-            if use_heap:
-                for cls, groups in entry["heaps"].items():
-                    tops = entry["top"][cls]
-                    for group, heap in groups.items():
-                        heapq.heapify(heap)
-                        tops[group] = heap_peek(entry, cls, group)
-            spec_cache[task.task_spec] = entry
-            return entry
-
-        def invalidate(node):
-            for entry in spec_cache.values():
-                proto = entry["proto"]
-                old = entry["meta"].get(node.name) if use_heap else None
-                gen = (old[0] + 1) if old else 1
-                if ssn.predicate(proto, node) is None:
-                    entry["fits"][node.name] = node
-                    score = ssn.node_order(proto, node)
-                    entry["scores"][node.name] = score
-                    if use_heap:
-                        cls = fit_class(proto, node)
-                        entry["meta"][node.name] = (gen, cls, score)
-                        if cls is not None:
-                            group = entry["group"].get(node.name)
-                            heapq.heappush(
-                                entry["heaps"][cls].setdefault(group, []),
-                                (-score, node.name, gen))
-                else:
-                    entry["fits"].pop(node.name, None)
-                    entry["scores"].pop(node.name, None)
-                    if use_heap:
-                        entry["meta"][node.name] = (gen, None, None)
-                if use_heap:
-                    # this node's group is the only one whose top can
-                    # have changed (either class: a node may have
-                    # moved idle <-> future) — refresh just those two
-                    # cache slots
-                    group = entry["group"].get(node.name)
-                    for cls in ("idle", "future"):
-                        if group in entry["heaps"][cls]:
-                            entry["top"][cls][group] = heap_peek(
-                                entry, cls, group)
-
-        def heap_peek(entry, cls, group):
-            """Valid top of one group heap (lazy-discarding stale)."""
-            heap = entry["heaps"][cls].get(group)
-            if not heap:
-                return None
-            meta = entry["meta"]
-            while heap:
-                neg_score, name, gen = heap[0]
-                m = meta.get(name)
-                if m is not None and m[0] == gen and m[1] == cls \
-                        and m[2] == -neg_score:
-                    return -neg_score, name
-                heapq.heappop(heap)
-            return None
-
-        def heap_best(entry, cls, group_scores):
-            """Highest (cached score + group offset) node of *cls*;
-            ties broken by smallest name, exactly like the linear
-            scan.  Group tops come from the entry's top cache
-            (maintained by build/invalidate), so scoring a task is
-            one arithmetic pass over groups, not a heap walk."""
-            best = None          # (total, name)
-            if group_scores:
-                get_offset = group_scores.get
-                for group, top in entry["top"][cls].items():
-                    if top is None:
-                        continue
-                    total = top[0] + get_offset(group, 0.0)
-                    if best is None or total > best[0] or \
-                            (total == best[0] and top[1] < best[1]):
-                        best = (total, top[1])
-            else:
-                for top in entry["top"][cls].values():
-                    if top is None:
-                        continue
-                    if best is None or top[0] > best[0] or \
-                            (top[0] == best[0] and top[1] < best[1]):
-                        best = top
-            return entry["fits"][best[1]] if best else None
 
         for task in tasks:
             t_task = time.perf_counter()
@@ -382,7 +247,8 @@ class AllocateAction(Action):
                 continue
 
             if task_cacheable(task):
-                entry = spec_cache.get(task.task_spec) or build_entry(task)
+                entry = cache.get(task.task_spec) or \
+                    cache.build_entry(task)
                 if use_heap:
                     # O(groups log n) pick straight off the cached heaps
                     group_scores = (ssn.grouped_batch_node_order(task)
@@ -422,8 +288,7 @@ class AllocateAction(Action):
                 metrics.observe("task_scheduling_latency_seconds",
                                 time.perf_counter() - t_task,
                                 action="allocate")
-                if spec_cache:
-                    invalidate(node)
+                cache.invalidate(node)
                 continue
 
             if record_errors:

@@ -44,6 +44,7 @@ def predicate_nodes(ssn, task: TaskInfo, nodes: List[NodeInfo],
         if status is None:
             fits.append(node)
         elif record_errors and job is not None:
+            # vtplint: disable=snapshot-write (serial sweep only, single-threaded on the session owner thread; the parallel path defers fit-error rows to sweep._build_parallel's post-barrier merge)
             job.record_fit_error(task, node.name,
                                  FitError(task, node, statuses=[status]))
     return fits
@@ -60,14 +61,23 @@ def fit_class(task: TaskInfo, node: NodeInfo) -> Optional[str]:
     is_be = task.pod.annotations.get(QOS_LEVEL_ANNOTATION) == \
         QOS_BEST_EFFORT
     idle = node.idle
-    future = node.future_idle()
     if is_be and not node.oversubscription.is_empty():
         slack = node.oversub_remaining()
         idle = idle.clone().add(slack)
-        future = future.add(slack)
+        future = node.future_idle().add(slack)
+        if task.init_resreq.less_equal(idle):
+            return "idle"
+        if task.init_resreq.less_equal(future):
+            return "future"
+        return None
     if task.init_resreq.less_equal(idle):
         return "idle"
-    if task.init_resreq.less_equal(future):
+    # nothing releasing and nothing pipelined => future_idle == idle:
+    # skip the clone+add+sub (the sweep calls this once per fit node,
+    # and on a mostly-settled cluster the slow path was pure waste)
+    if node.releasing.is_empty() and node.pipelined.is_empty():
+        return None
+    if task.init_resreq.less_equal(node.future_idle()):
         return "future"
     return None
 

@@ -143,6 +143,37 @@ class _Enums:
         return None        # CONFIG / OBJECT: not statically checkable
 
 
+def match_waivers(findings, src: str, path: str) -> List[Finding]:
+    """Match raw findings against *src*'s inline suppressions (shared
+    by the AST rules and the racecheck ownership pass).
+
+    The waiver may sit on the finding's line or the line above;
+    except-pass alone also honours the first handler-body line (the
+    comment rides next to the ``pass`` it explains).  The window stays
+    this tight on purpose: a wider one would let a NEW violation
+    written adjacent to an existing waiver inherit that waiver's
+    reason.  Every candidate is checked for the rule (a neighboring
+    waiver for a different rule never shadows a match)."""
+    sup = _suppressions(src)
+    out: List[Finding] = []
+    for f in findings:
+        lines = [f.line, f.line - 1]
+        if f.rule == "except-pass":
+            lines.append(f.line + 1)
+        waiver = next(
+            (w for w in (sup.get(ln) for ln in lines)
+             if w and (f.rule in w[0] or "*" in w[0])), None)
+        if waiver:
+            f.suppressed = waiver[1] or None
+            if not waiver[1]:
+                out.append(Finding(
+                    "unexplained-suppression", path, f.line,
+                    f"suppression of [{f.rule}] carries no "
+                    f"(reason) — every waiver must say why"))
+        out.append(f)
+    return out
+
+
 class Linter:
     """One AST pass over one file; yields Findings (already matched
     against the file's inline suppressions)."""
@@ -167,32 +198,7 @@ class Linter:
         except SyntaxError as e:
             return [Finding("syntax-error", path, e.lineno or 0,
                             f"cannot parse: {e.msg}")]
-        sup = _suppressions(src)
-        findings: List[Finding] = []
-        for f in self._walk(tree, rel):
-            # the waiver may sit on the finding's line or the line
-            # above; except-pass alone also honours the first
-            # handler-body line (the comment rides next to the `pass`
-            # it explains).  The window stays this tight on purpose:
-            # a wider one would let a NEW violation written adjacent
-            # to an existing waiver inherit that waiver's reason.
-            # Every candidate is checked for the rule (a neighboring
-            # waiver for a different rule never shadows a match).
-            lines = [f.line, f.line - 1]
-            if f.rule == "except-pass":
-                lines.append(f.line + 1)
-            waiver = next(
-                (w for w in (sup.get(ln) for ln in lines)
-                 if w and (f.rule in w[0] or "*" in w[0])), None)
-            if waiver:
-                f.suppressed = waiver[1] or None
-                if not waiver[1]:
-                    findings.append(Finding(
-                        "unexplained-suppression", path, f.line,
-                        f"suppression of [{f.rule}] carries no "
-                        f"(reason) — every waiver must say why"))
-            findings.append(f)
-        return findings
+        return match_waivers(self._walk(tree, rel), src, path)
 
     def lint_file(self, path: str) -> List[Finding]:
         with open(path, encoding="utf-8") as f:

@@ -68,6 +68,13 @@ class TPUDevices(Devices):
     def has_device_request(self, task) -> bool:
         return task.resreq.get(TPU) > 0
 
+    @staticmethod
+    def task_requests_device(task) -> bool:
+        """Class-level twin of has_device_request (the request is
+        task-only for TPUs): lets deviceshare's prepared sweep skip
+        the per-node device walk for chipless tasks."""
+        return task.resreq.get(TPU) > 0
+
     def filter_node(self, task) -> Optional[Status]:
         req = task.resreq.get(TPU)
         if req <= 0:

@@ -245,6 +245,7 @@ class HyperNodesInfo:
         if cached is None:
             lca = self.lca(la, lb)
             cached = self.members[lca].tier if lca else root_tier
+            # vtplint: disable=snapshot-write (idempotent memo: the tier is pure in the immutable member tree, so a racing GIL-atomic store publishes an equal value; a lost update only recomputes)
             self._lca_tier_cache[key] = cached
         return cached
 

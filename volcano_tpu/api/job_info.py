@@ -429,6 +429,14 @@ class JobInfo:
             self.fit_errors[task.uid] = errs
         errs.set_node_error(node_name, fe)
 
+    def set_job_fit_errors(self, errs) -> None:
+        """Publish the job-level fit-error summary.  A designated
+        reporting seam (like record_fit_error): the freeze auditor
+        and the static race pass admit snapshot writes only through
+        these, so allocate's _finish does not poke the attribute
+        directly."""
+        self.job_fit_errors = errs
+
     def task_has_fit_errors(self, task: TaskInfo) -> bool:
         """Fit-error memoization: a pending task whose identical spec
         already failed everywhere need not be retried this session

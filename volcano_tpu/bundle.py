@@ -44,6 +44,7 @@ FAMILIES: Dict[str, str] = {
     "e2e_scheduling_latency_seconds": "histogram",
     "pod_scheduling_latency_seconds": "histogram",
     "task_scheduling_latency_seconds": "histogram",
+    "predicate_sweep_seconds": "histogram",
     "action_latency_seconds": "histogram",
     "plugin_latency_seconds": "histogram",
     "open_session_duration_seconds": "histogram",
@@ -219,6 +220,7 @@ OBJECT = "object"
 
 FAMILY_LABELS: Dict[str, Dict[str, object]] = {
     "task_scheduling_latency_seconds": {"action": CONFIG},
+    "predicate_sweep_seconds": {"mode": ("serial", "parallel")},
     "action_latency_seconds": {"action": CONFIG},
     "plugin_latency_seconds": {"plugin": CONFIG,
                                "point": ("open", "close")},

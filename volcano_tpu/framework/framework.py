@@ -6,6 +6,7 @@ import logging
 import time
 
 from volcano_tpu import metrics, trace
+from volcano_tpu.analysis import freezeaudit
 from volcano_tpu.conf import SchedulerConf
 from volcano_tpu.framework import job_updater
 from volcano_tpu.framework.plugins import get_plugin_builder
@@ -34,10 +35,17 @@ def open_session(cache, conf: SchedulerConf) -> Session:
                             plugin=opt.name, point="open")
     metrics.observe("open_session_duration_seconds",
                     time.perf_counter() - t0)
+    # plugins have finished their session setup: under VTP_RACE_AUDIT
+    # the snapshot deep-freezes here, and stays frozen until the
+    # session's first Statement commit (analysis/freezeaudit.py)
+    freezeaudit.maybe_freeze_session(ssn)
     return ssn
 
 
 def close_session(ssn: Session) -> None:
+    # lift the snapshot freeze first: plugin close hooks, the job
+    # updater and the cache's post-session bookkeeping mutate freely
+    freezeaudit.thaw_session(ssn)
     for name, plugin in reversed(list(ssn.plugins.items())):
         tp = time.perf_counter()
         with trace.span(name, kind="plugin", point="close"):

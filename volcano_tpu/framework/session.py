@@ -75,6 +75,7 @@ class Session:
         # extension-point registries: point -> plugin name -> fn
         self._fns: Dict[str, Dict[str, Callable]] = defaultdict(dict)
         self._enabled_cache: Dict[str, list] = {}
+        self._raw_cache: Dict[str, list] = {}
         self.event_handlers: List[EventHandler] = []
         # Plugins whose predicate verdicts depend on TASK IDENTITY or
         # cross-node external state (not just task spec + node state)
@@ -123,6 +124,7 @@ class Session:
     def add_fn(self, point: str, plugin: str, fn: Callable):
         self._fns[point][plugin] = fn
         self._enabled_cache.pop(point, None)
+        self._raw_cache.pop(point, None)
 
     def add_event_handler(self, handler: EventHandler):
         self.event_handlers.append(handler)
@@ -143,7 +145,21 @@ class Session:
     def add_job_starving_fn(self, p, fn):     self.add_fn("jobStarving", p, fn)
     def add_pre_predicate_fn(self, p, fn):    self.add_fn("prePredicate", p, fn)
     def add_predicate_fn(self, p, fn):        self.add_fn("predicate", p, fn)
+    def add_predicate_prepare_fn(self, p, fn):
+        """Optional batched twin of a predicate fn (the k8s PreFilter
+        idiom): ``fn(task)`` returns a per-node callable EXACTLY
+        equivalent to ``predicate(task, node)`` with every task-side
+        constant hoisted out of the per-node loop.  The batch sweep
+        (actions/sweep.py) uses the prepared form when the same
+        plugin registered both; the tiered serial dispatch never
+        calls it, so plugins without one lose nothing."""
+        self.add_fn("predicatePrepare", p, fn)
     def add_node_order_fn(self, p, fn):       self.add_fn("nodeOrder", p, fn)
+    def add_node_order_prepare_fn(self, p, fn):
+        """Optional batched twin of a nodeOrder fn (PreScore): same
+        contract as add_predicate_prepare_fn, returning a per-node
+        scorer."""
+        self.add_fn("nodeOrderPrepare", p, fn)
     def add_batch_node_order_fn(self, p, fn): self.add_fn("batchNodeOrder", p, fn)
     def add_grouped_batch_node_order_fn(self, p, fn):
         """Optional leaf-grouped twin of a BatchNodeOrder fn: fn(task)
@@ -211,6 +227,7 @@ class Session:
                             (opt, self._timed(point, opt.name, fn)))
                 if tier_fns:
                     result.append(tier_fns)
+        # vtplint: disable=shared-cache-unkeyed (idempotent dispatch memo resolved on the session owner thread before any fan-out — SpecCache pre-resolves via resolved_fns; a racing GIL-atomic store publishes an equal table)
         self._enabled_cache[point] = result
         return result
 
@@ -397,6 +414,35 @@ class Session:
         """Names of plugins with enabled registrations at *point*."""
         return {opt.name for tier in self._enabled_fns(point)
                 for opt, _ in tier}
+
+    def resolved_fns(self, point: str) -> list:
+        """The RAW enabled callbacks at *point*, flattened in tier
+        order — the batch-sweep fast path (actions/sweep.py) calls
+        these directly so the per-node cost is the plugin body alone,
+        not the tier walk + trace-timing wrapper per call; the sweep
+        attributes its aggregate time as one lane instead.  Resolved
+        on the calling thread and memoized, so a parallel sweep's
+        workers never write the dispatch caches mid-flight."""
+        return [fn for _, fn in self.resolved_named_fns(point)]
+
+    def resolved_named_fns(self, point: str) -> list:
+        """(plugin name, raw fn) pairs at *point* in tier order (see
+        resolved_fns); the names let the sweep pair prepare fns with
+        the callbacks they accelerate."""
+        cached = self._raw_cache.get(point)
+        if cached is not None:
+            return cached
+        fns = self._fns.get(point)
+        result = []
+        if fns:
+            for tier in self.tiers:
+                for opt in tier.plugins:
+                    fn = fns.get(opt.name)
+                    if fn is not None and opt.is_enabled(point):
+                        result.append((opt.name, fn))
+        # vtplint: disable=shared-cache-unkeyed (idempotent dispatch memo resolved on the session owner thread before any fan-out; a racing GIL-atomic store publishes an equal table)
+        self._raw_cache[point] = result
+        return result
 
     def node_group(self, node_name: str):
         """Grouping key for grouped batch scoring: the node's leaf

@@ -37,6 +37,7 @@ class BinpackPlugin(Plugin):
 
     def on_session_open(self, ssn):
         ssn.add_node_order_fn(self.name, self._score)
+        ssn.add_node_order_prepare_fn(self.name, self._prepare)
 
     def _score(self, task: TaskInfo, node: NodeInfo) -> float:
         total, weight_sum = 0.0, 0.0
@@ -51,3 +52,28 @@ class BinpackPlugin(Plugin):
         if weight_sum == 0:
             return 0.0
         return self.weight * MAX_SCORE * total / weight_sum
+
+    def _prepare(self, task: TaskInfo):
+        """Batched _score (PreScore): the request rows and their
+        weights are fixed per task (equivalence pinned in
+        test_sweep.py)."""
+        rows = [(dim, req, self.dim_weights.get(dim, 1.0))
+                for dim, req in task.resreq.res.items()
+                if req >= MIN_RESOURCE]
+        factor = self.weight * MAX_SCORE
+
+        def score(node: NodeInfo) -> float:
+            total, weight_sum = 0.0, 0.0
+            alloc_get = node.allocatable.res.get
+            used_get = node.used.res.get
+            for dim, req, w in rows:
+                alloc = alloc_get(dim, 0.0)
+                if alloc < MIN_RESOURCE:
+                    continue
+                total += w * ((used_get(dim, 0.0) + req) / alloc)
+                weight_sum += w
+            if weight_sum == 0:
+                return 0.0
+            return factor * total / weight_sum
+
+        return score

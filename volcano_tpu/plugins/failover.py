@@ -55,6 +55,7 @@ class FailoverPlugin(Plugin):
             else set()
         ssn.add_job_order_fn(self.name, self._job_order)
         ssn.add_predicate_fn(self.name, self._predicate)
+        ssn.add_predicate_prepare_fn(self.name, self._prepare)
 
     # -- requeued gangs first ------------------------------------------
 
@@ -101,6 +102,29 @@ class FailoverPlugin(Plugin):
                 return unschedulable(
                     "node reserved as failover warm spare", self.name)
         return None
+
+    def _prepare(self, task: TaskInfo):
+        """Batched _predicate (PreFilter): the task's job + requeued
+        flag are resolved once per sweep instead of per node; the
+        quarantine annotation check stays per node, per call time
+        (equivalence pinned in test_sweep.py)."""
+        spares = self._spares
+        spare_applies = False
+        if spares:
+            job = self.ssn.jobs.get(task.job)
+            spare_applies = job is None or not self._is_requeued(job)
+
+        def check(node: NodeInfo):
+            if self._quarantined(node):
+                return unschedulable(
+                    "node's slice is quarantined after failure",
+                    self.name, resolvable=False)
+            if spare_applies and node.name in spares:
+                return unschedulable(
+                    "node reserved as failover warm spare", self.name)
+            return None
+
+        return check
 
     def _pick_spares(self, ssn) -> Set[str]:
         """The N least-loaded fully-idle slices per topology shape.

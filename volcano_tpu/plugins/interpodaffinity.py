@@ -210,6 +210,7 @@ class InterPodAffinityPlugin(Plugin):
                     continue
                 if term.matches(pod.labels):
                     pairs.append((holder_node, term.topology_key))
+        # vtplint: disable=shared-cache-unkeyed (idempotent version-stamped memo: the value is pure in task + _anti_version and published as one fully-built tuple; a racing store publishes an equal one)
         self._repel_cache[task.uid] = (self._anti_version, pairs)
         return pairs
 

@@ -116,6 +116,7 @@ class NumaAwarePlugin(Plugin):
                 n = len(cells)
                 if topo.capacity_res:
                     # reserved-adjusted ceilings for eviction credits
+                    # vtplint: disable=shared-cache-unkeyed (idempotent per-node memo, fully built before the GIL-atomic publish; mutation paths run inside Session seams on the owner thread)
                     self._cell_caps[node.name] = [
                         [max(0.0, topo.capacity_res.get("cpu", {})
                              .get(c, 0.0) - res_cpu / n),
@@ -133,6 +134,7 @@ class NumaAwarePlugin(Plugin):
 
     def _live_cells(self, node: NodeInfo) -> Optional[List[List[float]]]:
         if node.name not in self._cells:
+            # vtplint: disable=shared-cache-unkeyed (idempotent per-node memo: cells are pure in unchanged node state and published fully built; allocate-path invalidation runs inside Session seams)
             self._cells[node.name] = self._build_cells(node)
         return self._cells[node.name]
 
@@ -239,6 +241,7 @@ class NumaAwarePlugin(Plugin):
         combinatorial and _predicate + _score would otherwise compute
         it twice per (task, node); cell mutations (allocate /
         deallocate / credit) invalidate the node's entries."""
+        # vtplint: disable=shared-cache-unkeyed (idempotent per node+needs memo: a racing setdefault/store publishes an equal hint; invalidation runs inside Session seams on the owner thread)
         per_node = self._hint_cache.setdefault(node.name, {})
         hint = per_node.get(needs)
         if hint is None:

@@ -23,6 +23,7 @@ class PredicatesPlugin(Plugin):
     def on_session_open(self, ssn):
         ssn.add_pre_predicate_fn(self.name, self._pre_predicate)
         ssn.add_predicate_fn(self.name, self._predicate)
+        ssn.add_predicate_prepare_fn(self.name, self._prepare)
 
     @staticmethod
     def _pre_predicate(task: TaskInfo):
@@ -97,6 +98,60 @@ class PredicatesPlugin(Plugin):
         return (PredicatesPlugin._predicate_static(task, node)
                 or PredicatesPlugin._predicate_dynamic(task, node))
 
+    @staticmethod
+    def _prepare(task: TaskInfo):
+        """Batched form of _predicate (PreFilter): hoists the pod's
+        selector/affinity/tolerations/ports once per sweep and runs
+        the same checks in one closure per node.  MUST stay verdict-
+        identical to _predicate_static + _predicate_dynamic —
+        tests/test_sweep.py pins the equivalence."""
+        pod = task.pod
+        selector = tuple(pod.node_selector.items())
+        terms = pod.affinity_node_terms
+        tolerations = pod.tolerations
+        ports = [port for c in pod.containers for port in c.ports]
+
+        def check(node: NodeInfo):
+            if not node.ready:
+                return unschedulable("node is not ready", "predicates",
+                                     resolvable=False)
+            labels = node.labels
+            for k, v in selector:
+                if labels.get(k) != v:
+                    return unschedulable(
+                        "node(s) didn't match Pod's node selector",
+                        "predicates", resolvable=False)
+            if terms:
+                matched = any(
+                    all(labels.get(k) in vals
+                        for k, vals in term.items())
+                    for term in terms)
+                if not matched:
+                    return unschedulable(
+                        "node(s) didn't match Pod's node affinity",
+                        "predicates", resolvable=False)
+            for taint in node.taints:
+                if taint.effect == "PreferNoSchedule":
+                    continue
+                if not any(tol.tolerates(taint)
+                           for tol in tolerations):
+                    return unschedulable(
+                        f"node(s) had untolerated taint {{{taint.key}: "
+                        f"{taint.value}}}", "predicates",
+                        resolvable=False)
+            cap = node.capability.get(PODS)
+            if cap and len(node.tasks) >= cap:
+                return unschedulable("node(s) had too many pods",
+                                     "predicates")
+            occupied = node.occupied_ports
+            for port in ports:
+                if occupied.get(port):
+                    return unschedulable(
+                        "node(s) didn't have free ports", "predicates")
+            return None
+
+        return check
+
 
 # pod topology spread: pods opt in via annotations
 #   spread.volcano-tpu.io/topology-key: <node label, e.g. zone>
@@ -121,6 +176,7 @@ class PodTopologySpreadPlugin(Plugin):
     def on_session_open(self, ssn):
         self.ssn = ssn
         ssn.add_predicate_fn(self.name, self._predicate)
+        ssn.add_predicate_prepare_fn(self.name, self._prepare_spread)
         # MUST be a batch (per-task) scorer: the score depends on the
         # job's placements across the whole cluster, which allocate's
         # per-spec NodeOrder cache would go stale on (the cache only
@@ -167,6 +223,14 @@ class PodTopologySpreadPlugin(Plugin):
             scores[node.name] = self.weight * 100.0 * \
                 (worst - counts[my_value]) / worst
         return scores
+
+    def _prepare_spread(self, task: TaskInfo):
+        """Batched _predicate (PreFilter): the spread key is task-only
+        and almost always absent — the common case collapses to a
+        constant (equivalence pinned in test_sweep.py)."""
+        if not task.pod.annotations.get(SPREAD_KEY_ANNOTATION):
+            return lambda node: None
+        return lambda node: self._predicate(task, node)
 
     def _predicate(self, task: TaskInfo, node: NodeInfo):
         key = task.pod.annotations.get(SPREAD_KEY_ANNOTATION)

@@ -118,6 +118,7 @@ class NetworkTopologyAwarePlugin(Plugin):
             hns = self.ssn.hypernodes
             row = _vec([hns.lca_tier_of_leaves(other, leaf)
                         for other in self._leaf_names])
+            # vtplint: disable=shared-cache-unkeyed (idempotent memo: the row is pure in the session's immutable leaf set and published fully built; a lost GIL-atomic update only recomputes)
             self._tier_rows[leaf] = row
         return row
 
@@ -132,8 +133,10 @@ class NetworkTopologyAwarePlugin(Plugin):
             for t in job.tasks.values():
                 if t.node_name and t.occupies_resources():
                     leaf = hns.leaf_of_node(t.node_name)
+                    # vtplint: disable=shared-cache-unkeyed (building a FRESH local dict — the taint only sees the read-only .get alias above; published once complete on the line below)
                     state["added"][t.uid] = leaf
                     _vec_iadd(state["total"], self._tier_row(leaf))
+            # vtplint: disable=shared-cache-unkeyed (fully-built per-job state published once; event maintenance runs inside Session.allocate's seam on the owner thread)
             self._jobs_aff[job.uid] = state
         return state
 

@@ -65,7 +65,9 @@ class VolumeBindingPlugin(Plugin):
         # always register: a pod claiming an unknown PVC must be gated
         # even when the cluster has no PVCs at all
         ssn.add_predicate_fn(self.name, self._predicate)
+        ssn.add_predicate_prepare_fn(self.name, self._prepare_predicate)
         ssn.add_node_order_fn(self.name, self._score)
+        ssn.add_node_order_prepare_fn(self.name, self._prepare_score)
         from volcano_tpu.framework.session import EventHandler
         ssn.add_event_handler(EventHandler(
             allocate_fn=self._on_allocate,
@@ -189,6 +191,15 @@ class VolumeBindingPlugin(Plugin):
                 taken_here.add(pv)
         return None
 
+    def _prepare_predicate(self, task: TaskInfo):
+        """Batched _predicate (PreFilter): the claim list is parsed
+        from annotations once per sweep, and the claimless common
+        case skips everything (equivalence pinned in test_sweep.py)."""
+        claims = self._claims(task)
+        if not claims:
+            return lambda node: None
+        return lambda node: self._predicate(task, node)
+
     def _score(self, task: TaskInfo, node: NodeInfo) -> float:
         claims = self._claims(task)
         if not claims:
@@ -200,6 +211,12 @@ class VolumeBindingPlugin(Plugin):
             if pv is not None and pv is not PROVISION:
                 ok += 1   # existing data gravity only
         return MAX_SCORE * ok / len(claims)
+
+    def _prepare_score(self, task: TaskInfo):
+        """Batched _score (PreScore), claimless fast path."""
+        if not self._claims(task):
+            return lambda node: 0.0
+        return lambda node: self._score(task, node)
 
     def _on_allocate(self, event):
         """Assume PVs the moment a claiming task is placed, so later
