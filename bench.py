@@ -939,6 +939,355 @@ def bench_40k_host_scale() -> dict:
     return _scale_gang_probe(625, 8192)
 
 
+# -- process-parallel scheduler cycle (ROADMAP item 3) -----------------
+
+SWEEP_WORKER_STEPS = (1, 2, 4, 8)
+
+
+def _sweep_entry_bench(ssn, nodes, task, backend: str, workers: int,
+                       reps: int = 3):
+    """Best-of-reps build_entry wall time under the given sweep
+    backend ('' = the serial fallback path)."""
+    from volcano_tpu.actions.sweep import SpecCache
+    conf = ssn.conf.configurations.setdefault("allocate", {})
+    conf["parallelPredicates"] = backend if backend else False
+    conf["parallelPredicates.workers"] = workers or 1
+    best, entry = float("inf"), None
+    for _ in range(reps):
+        cache = SpecCache(ssn, nodes, record_errors=False)
+        t0 = time.perf_counter()
+        entry = cache.build_entry(task)
+        best = min(best, time.perf_counter() - t0)
+    return best, entry
+
+
+def _entries_identical(a, b) -> bool:
+    return (a["fits"].keys() == b["fits"].keys()
+            and a["scores"] == b["scores"]
+            and a["meta"] == b["meta"])
+
+
+def _span_waterfall(doc: Optional[dict]) -> dict:
+    """Flatten a kept session trace into {span_name: seconds} for the
+    parallel-cycle attribution spans (summed over occurrences), so the
+    SCALE artifact shows where a cycle's time went."""
+    names = ("snapshot_build", "open_session", "delta_ship",
+             "sweep_fanout", "sweep_merge", "allocate", "enqueue",
+             "backfill", "close_session")
+    out: dict = {}
+
+    def walk(s):
+        if s["name"] in names:
+            out[s["name"]] = round(
+                out.get(s["name"], 0.0) + s["dur"], 4)
+        for c in s.get("children", ()):
+            walk(c)
+
+    if doc and doc.get("root"):
+        walk(doc["root"])
+        out["session"] = round(doc["root"]["dur"], 4)
+    return out
+
+
+def _sweep_entry_matrix(ssn, nodes, task, reps: int) -> Tuple[list, bool]:
+    """Serial baseline + thread/process rows at every worker count;
+    returns (rows, all_identical)."""
+    serial_s, serial_entry = _sweep_entry_bench(ssn, nodes, task, "",
+                                                0, reps)
+    rows = [{"backend": "serial", "workers": 0,
+             "ms": round(serial_s * 1000, 2), "speedup_vs_serial": 1.0,
+             "entry_identical_to_serial": True}]
+    all_ok = True
+    for backend in ("thread", "process"):
+        for w in SWEEP_WORKER_STEPS:
+            if backend == "process":
+                # the process-wide pool grows and never shrinks — a
+                # fresh pool per step keeps the row at EXACTLY w
+                # workers (first rep pays the bootstrap, best-of-reps
+                # reports the synced steady state)
+                from volcano_tpu.actions import procpool
+                procpool.shutdown()
+            t, entry = _sweep_entry_bench(ssn, nodes, task, backend,
+                                          w, reps)
+            identical = _entries_identical(entry, serial_entry)
+            all_ok &= identical
+            rows.append({
+                "backend": backend, "workers": w,
+                "ms": round(t * 1000, 2),
+                "speedup_vs_serial": round(serial_s / t, 2),
+                "entry_identical_to_serial": identical})
+            print(f"  {backend}@{w}: {t*1000:.1f} ms "
+                  f"({serial_s/t:.2f}x, identical={identical})",
+                  flush=True)
+    return rows, all_ok
+
+
+def bench_scale_100k(n_slices: int = 1563, gang: int = 8192,
+                     include_40k: bool = True) -> dict:
+    """The SCALE100K artifact (ROADMAP item 3): a 100k-host cluster
+    (1563 x v5e-256 = 100,032 hosts, 60% pre-occupied) measured
+    through the incremental-snapshot cycle with all three sweep
+    backends.
+
+    Sections:
+      cycles        idle + 8192-gang cycle seconds per backend
+                    (serial / thread@8 / process@8), with the
+                    process cycle's flight-recorder waterfall
+                    (snapshot_build / delta_ship / sweep_fanout /
+                    sweep_merge / allocate) — where the time goes;
+      entry_rows    per-spec build_entry sweep at every worker count
+                    for both pools, bit-identity asserted against the
+                    serial entry (disarmed, then ARMED under the
+                    freeze auditor, mirroring tools/race_bench.py);
+      idle_40k      the acceptance row: incremental snapshot reuse
+                    must hold the 40k idle cycle at or under 0.1s
+                    (0.52s at the PR 2 seed).
+    """
+    import copy
+    import gc
+    import os as _os
+
+    from volcano_tpu import trace
+    from volcano_tpu.actions import procpool
+    from volcano_tpu.analysis import freezeaudit
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.api.types import TaskStatus
+    from volcano_tpu.framework.framework import (close_session,
+                                                 open_session)
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.uthelper import gang_job
+
+    t_build = time.perf_counter()
+    cluster = _build_scale_cluster(n_slices)
+    conf = copy.deepcopy(BENCH_CONF)
+    sched = Scheduler(cluster, conf=conf, schedule_period=0)
+    sched.run_once()                       # warm-up full snapshot
+    build_s = time.perf_counter() - t_build
+    print(f"built {len(cluster.nodes)} hosts in {build_s:.1f}s",
+          flush=True)
+    gc.collect()
+    gc.freeze()
+
+    backends = (("serial", False, 0), ("thread", "thread", 8),
+                ("process", "process", 8))
+    cycles = {}
+    waterfall = {}
+    try:
+        for label, raw, workers in backends:
+            sched.conf.configurations["allocate"] = {
+                "parallelPredicates": raw,
+                "parallelPredicates.workers": workers}
+            sched.run_once()               # absorb prior dirty state
+            if label == "process":
+                # pre-warm: production runs a PERSISTENT pool — the
+                # worker spawn + bootstrap full sync happens once per
+                # scheduler lifetime, not inside a measured cycle;
+                # the timed gang cycle below ships only the delta
+                ssn = open_session(sched.cache, sched.conf)
+                procpool.pool(workers).ensure_sync(ssn)
+                close_session(ssn)
+                gc.collect()   # bootstrap pickle garbage, not the
+                gc.freeze()    # timed cycles', pays the GC bill here
+            t0 = time.perf_counter()
+            sched.run_once()               # steady idle cycle
+            idle_s = time.perf_counter() - t0
+            pg, pods = gang_job(f"g-{label}", replicas=gang,
+                                min_available=gang,
+                                requests={"cpu": 8, TPU: 4})
+            cluster.add_podgroup(pg)
+            for p in pods:
+                cluster.add_pod(p)
+            trace.reset()                  # first session is kept
+            t0 = time.perf_counter()
+            sched.run_once()
+            gang_s = time.perf_counter() - t0
+            bound = sum(1 for k, _ in cluster.binds
+                        if k.startswith(f"default/g-{label}"))
+            assert bound == gang, \
+                f"{label}: gang bound {bound}/{gang}"
+            cycles[label] = {"idle_cycle_s": round(idle_s, 4),
+                             f"gang{gang}_cycle_s": round(gang_s, 4)}
+            kept = trace.recent_traces(limit=1)
+            if kept:
+                waterfall[label] = _span_waterfall(kept[-1])
+            print(f"  {label}: idle {idle_s:.4f}s "
+                  f"gang{gang} {gang_s:.3f}s", flush=True)
+            # advance the bound gang to Running so the next backend's
+            # idle row measures a STEADY fleet (a Bound gang keeps
+            # its job non-steady, which forces the incremental — not
+            # the reuse — path every cycle, by design)
+            cluster.tick()
+
+        # -- per-spec sweep rows: disarmed then armed ------------------
+        pg, pods = gang_job("probe", replicas=gang,
+                            min_available=gang,
+                            requests={"cpu": 8, TPU: 4})
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+        ssn = open_session(sched.cache, sched.conf)
+        task = next(t for j in ssn.jobs.values()
+                    for t in j.tasks_in_status(TaskStatus.PENDING))
+        nodes = list(ssn.nodes.values())
+        print("entry sweep (disarmed):", flush=True)
+        rows, ok_disarmed = _sweep_entry_matrix(ssn, nodes, task, 2)
+        close_session(ssn)
+
+        freezeaudit.install()
+        freezeaudit.reset()
+        ssn = open_session(sched.cache, sched.conf)
+        task = next(t for j in ssn.jobs.values()
+                    for t in j.tasks_in_status(TaskStatus.PENDING))
+        nodes = list(ssn.nodes.values())
+        print("entry sweep (ARMED):", flush=True)
+        armed_rows, ok_armed = _sweep_entry_matrix(ssn, nodes, task, 1)
+        close_session(ssn)
+        audit = freezeaudit.report()
+        freezeaudit.uninstall()
+    finally:
+        gc.unfreeze()
+        procpool.shutdown()
+
+    out = {
+        "hosts": len(cluster.nodes),
+        "host_cpus": _os.cpu_count(),
+        "gang": gang,
+        "cycles": cycles,
+        "waterfall_s": waterfall,
+        "entry_rows_disarmed": rows,
+        "entry_rows_armed": armed_rows,
+        "entries_identical_all_backends_all_worker_counts":
+            ok_disarmed and ok_armed,
+        "freeze_audit": {
+            "sessions_frozen": audit["sessions_frozen"],
+            "fanout_regions": audit["fanout_regions"],
+            "tracked_stores": audit["tracked_stores"],
+            "violations": audit["violations"],
+        },
+        "note": ("single-CPU host: process/thread rows measure the "
+                 "batched prepared-form sweep plus the mirror "
+                 "protocol's IPC overhead, serialized by one core — "
+                 "host_cpus recorded so a multi-core replay separates "
+                 "the batching win from hardware parallelism"),
+    }
+    if include_40k:
+        print("40k idle-cycle acceptance row:", flush=True)
+        s40 = bench_40k_host_scale()
+        s40["idle_le_0.1s"] = s40["idle_cycle_s"] <= 0.1
+        out["idle_40k"] = s40
+    out["ok"] = bool(
+        out["entries_identical_all_backends_all_worker_counts"]
+        and not audit["violations"]
+        and (not include_40k or out["idle_40k"]["idle_le_0.1s"]))
+    return out
+
+
+def bench_sweep_smoke() -> dict:
+    """Tier-1 smoke for the process-pool sweep: REAL worker OS
+    processes on a small cluster — entry bit-identity vs serial,
+    full-cycle placement identity vs serial, mirror full->delta sync
+    order, distinct worker pids."""
+    import copy
+    import os as _os
+
+    from volcano_tpu import metrics
+    from volcano_tpu.actions import procpool
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.api.types import TaskStatus
+    from volcano_tpu.framework.framework import (close_session,
+                                                 open_session)
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.simulator import make_tpu_cluster
+    from volcano_tpu.uthelper import gang_job
+
+    def decisions(cluster):
+        return sorted((k.rsplit("-", 1)[0], node)
+                      for k, node in cluster.binds)
+
+    def run(backend):
+        cluster = make_tpu_cluster(
+            [(f"s{i}", "v5e-16") for i in range(4)])
+        conf = copy.deepcopy(BENCH_CONF)
+        if backend:
+            conf["configurations"] = {"allocate": {
+                "parallelPredicates": backend,
+                "parallelPredicates.workers": 2}}
+        sched = Scheduler(cluster, conf=conf, schedule_period=0)
+        for g in range(2):
+            pg, pods = gang_job(f"g{g}", replicas=4, min_available=4,
+                                requests={"cpu": 2, TPU: 4})
+            cluster.add_podgroup(pg)
+            for p in pods:
+                cluster.add_pod(p)
+        sched.run_once()
+        cluster.tick()
+        pg, pods = gang_job("late", replicas=4, min_available=4,
+                            requests={"cpu": 2, TPU: 4})
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+        sched.run_once()               # second cycle: delta-synced
+        return cluster, sched, decisions(cluster)
+
+    try:
+        _c, _s, serial = run("")
+        cluster, sched, proc = run("process")
+        pool = procpool.pool(2)
+        pids = {pid for _w, pid, _g, _o in pool.ping()}
+        full = metrics._counters.get(
+            ("sweep_snapshot_delta_bytes_total",
+             (("kind", "full"),)), 0.0)
+        delta = metrics._counters.get(
+            ("sweep_snapshot_delta_bytes_total",
+             (("kind", "delta"),)), 0.0)
+
+        # entry-level bit identity on the live session
+        pg, pods = gang_job("probe", replicas=2, min_available=2,
+                            requests={"cpu": 2, TPU: 4})
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+        ssn = open_session(sched.cache, sched.conf)
+        task = next(t for j in ssn.jobs.values()
+                    for t in j.tasks_in_status(TaskStatus.PENDING))
+        nodes = list(ssn.nodes.values())
+        _, serial_entry = _sweep_entry_bench(ssn, nodes, task, "", 0,
+                                             reps=1)
+        _, proc_entry = _sweep_entry_bench(ssn, nodes, task,
+                                           "process", 2, reps=1)
+        close_session(ssn)
+        return {
+            "placements_identical": proc == serial,
+            "entry_identical": _entries_identical(proc_entry,
+                                                  serial_entry),
+            "real_worker_processes":
+                len(pids) == 2 and _os.getpid() not in pids,
+            "full_sync_bytes": int(full),
+            "delta_sync_bytes": int(delta),
+            "synced_full_then_delta": full > 0 and delta > 0,
+            "placements": len(serial),
+        }
+    finally:
+        procpool.shutdown()
+
+
+def sweep_smoke() -> int:
+    """CLI wrapper for tier-1 (tests/test_procpool.py), mirroring
+    --wire-smoke: prints one JSON line, exit 0 only when every check
+    holds."""
+    try:
+        out = bench_sweep_smoke()
+    except Exception as e:  # noqa: BLE001 - smoke must report, not die
+        print(json.dumps({"metric": "sweep_smoke", "ok": False,
+                          "error": repr(e)}))
+        return 1
+    ok = (out["placements_identical"] and out["entry_identical"]
+          and out["real_worker_processes"]
+          and out["synced_full_then_delta"])
+    print(json.dumps({"metric": "sweep_smoke", "ok": ok, **out}))
+    return 0 if ok else 1
+
+
 def bench_net_accounting_overhead(pods_per_host: int = 120,
                                   ticks: int = 20) -> dict:
     """Per-tick cost of the DCN accounting subsystem at 100+ pods on
@@ -3429,5 +3778,15 @@ if __name__ == "__main__":
         # without the full suite
         print(json.dumps({"metric": "scale_40k_hosts",
                           **bench_40k_host_scale()}))
+    elif "--sweep-smoke" in sys.argv:
+        sys.exit(sweep_smoke())
+    elif "--scale-100k" in sys.argv:
+        # the SCALE100K_r{N}.json artifact (ROADMAP item 3): 100k
+        # hosts, idle + 8192-gang cycles per sweep backend with
+        # flight-recorder waterfalls, per-worker-count entry rows
+        # bit-identical to serial (disarmed + armed), and the 40k
+        # idle-cycle acceptance row
+        print(json.dumps({"metric": "scale_100k_hosts",
+                          **bench_scale_100k()}))
     else:
         main()

@@ -228,6 +228,45 @@ def test_rule_append_lock_fires():
     assert "append-lock" not in _rules(_lint(src2))
 
 
+def test_rule_process_ship_purity_fires():
+    # a pipe send outside the ship seam, in a module touching
+    # multiprocessing, is a purity hole: whatever it pickles skips
+    # the callable-refusing pickler
+    src = ("import multiprocessing\n"
+           "def leak(conn, obj):\n"
+           "    conn.send(obj)\n")
+    assert "process-ship-purity" in _rules(
+        _lint(src, "volcano_tpu/actions/x.py"))
+    # the designated seams are the allowed senders
+    src2 = ("import multiprocessing\n"
+            "def post_bytes(conn, data):\n"
+            "    conn.send_bytes(data)\n")
+    assert "process-ship-purity" not in _rules(
+        _lint(src2, "volcano_tpu/actions/x.py"))
+    # modules that never touch multiprocessing are out of scope
+    # (send() on an arbitrary object is not a pipe)
+    src3 = ("def notify(ch, obj):\n"
+            "    ch.send(obj)\n")
+    assert "process-ship-purity" not in _rules(
+        _lint(src3, "volcano_tpu/actions/x.py"))
+
+
+def test_procpool_ship_refuses_callables():
+    # the runtime half of the purity contract: the seam's pickler
+    # refuses anything callable, however deeply nested
+    import pytest as _pytest
+
+    from volcano_tpu.actions import procpool
+    assert procpool.unship(procpool.ship({"n": 1}))["n"] == 1
+    with _pytest.raises(procpool.PicklePurityError):
+        procpool.ship(lambda x: x)
+    with _pytest.raises(procpool.PicklePurityError):
+        procpool.ship({"cb": [1, 2, (print,)]})
+    import functools
+    with _pytest.raises(procpool.PicklePurityError):
+        procpool.ship(functools.partial(int, "3"))
+
+
 def test_rule_except_pass_fires():
     src = ("def poke(path):\n"
            "    try:\n"

@@ -1,0 +1,483 @@
+"""The process-pool sweep backend (actions/procpool.py): snapshot
+mirrors in worker OS processes, delta/ops sync, staleness refusal,
+crash self-healing, and the process-boundary audits.
+
+Layers:
+
+  1. bit-identity — the process backend's entry (fits/scores/meta)
+     equals the serial path's at several worker counts, and full
+     scheduler cycles place identically across serial/thread/process;
+  2. the mirror protocol — first sync is full, following cycles ship
+     deltas (bytes counted per kind), mid-cycle ops replay through
+     the worker session's own primitives;
+  3. degradation — a SIGKILL'd worker's shards re-sweep serially with
+     identical placements, the pool self-heals and counts the
+     restart; a poisoned mirror answers stale, its rows are refused;
+  4. audits — the armed freeze auditor's mirror-divergence check
+     catches a tampered mirror; the thread pool's grow path drains
+     in-flight futures instead of abandoning them (the old
+     shutdown(wait=False) bug).
+"""
+
+import copy
+import os
+import signal
+import time
+
+import pytest
+
+from volcano_tpu import metrics
+from volcano_tpu.actions import procpool
+from volcano_tpu.actions.sweep import SpecCache
+from volcano_tpu.api.types import TaskStatus
+from volcano_tpu.framework.framework import (close_session,
+                                             open_session)
+from volcano_tpu.scheduler import Scheduler
+from volcano_tpu.simulator import make_tpu_cluster
+from volcano_tpu.uthelper import gang_job
+
+CONF = {
+    "actions": "enqueue, allocate, backfill",
+    "tiers": [
+        {"plugins": [{"name": "priority"}, {"name": "gang"},
+                     {"name": "conformance"}]},
+        {"plugins": [{"name": "overcommit"}, {"name": "drf"},
+                     {"name": "predicates"},
+                     {"name": "proportion"},
+                     {"name": "nodeorder"}, {"name": "binpack"},
+                     {"name": "deviceshare"},
+                     {"name": "network-topology-aware"}]},
+    ],
+}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_pool():
+    yield
+    procpool.shutdown()
+
+
+def _cluster(n_slices=8):
+    return make_tpu_cluster(
+        [(f"s{i:02d}", "v5e-16") for i in range(n_slices)])
+
+
+def _add_gang(cluster, name, replicas, requests=None):
+    pg, pods = gang_job(name, replicas=replicas,
+                        min_available=replicas,
+                        requests=requests or
+                        {"cpu": 2, "google.com/tpu": 4})
+    cluster.add_podgroup(pg)
+    for p in pods:
+        cluster.add_pod(p)
+
+
+def _sched(cluster, backend="", workers=2):
+    conf = copy.deepcopy(CONF)
+    if backend:
+        conf.setdefault("configurations", {})["allocate"] = {
+            "parallelPredicates": backend,
+            "parallelPredicates.workers": workers}
+    return Scheduler(cluster, conf=conf, schedule_period=0)
+
+
+def _pending_task(ssn):
+    return next(t for j in ssn.jobs.values()
+                for t in j.tasks_in_status(TaskStatus.PENDING))
+
+
+def _counter(name, **labels):
+    return metrics._counters.get(
+        (name, tuple(sorted(labels.items()))), 0.0)
+
+
+def _decisions(cluster):
+    """Scheduling decisions as (job, node) pairs: same-spec sibling
+    pods are interchangeable (their uid tie-break order depends on
+    the process-global uid counter, which differs between runs), so
+    WHO got a node is noise — WHICH nodes each job got is the
+    decision content."""
+    return sorted((key.rsplit("-", 1)[0], node)
+                  for key, node in cluster.binds)
+
+
+# -- 1. bit-identity ---------------------------------------------------
+
+def test_process_entry_bit_identical_to_serial():
+    cluster = _cluster()
+    _add_gang(cluster, "g0", 8)
+    sched = _sched(cluster)
+    ssn = open_session(sched.cache, sched.conf)
+    task = _pending_task(ssn)
+    nodes = list(ssn.nodes.values())
+    aconf = ssn.conf.configurations.setdefault("allocate", {})
+
+    aconf["parallelPredicates"] = False
+    serial = SpecCache(ssn, nodes, record_errors=False) \
+        .build_entry(task)
+    aconf["parallelPredicates"] = "process"
+    for workers in (1, 2):
+        aconf["parallelPredicates.workers"] = workers
+        entry = SpecCache(ssn, nodes, record_errors=False) \
+            .build_entry(task)
+        assert entry["fits"].keys() == serial["fits"].keys()
+        assert entry["scores"] == serial["scores"]
+        assert entry["meta"] == serial["meta"]
+    close_session(ssn)
+
+
+def test_cycles_place_identically_across_backends():
+    """Multi-gang, multi-cycle: the second wave's sweeps run against
+    mirrors that absorbed cycle deltas AND mid-cycle op replays."""
+    def run(backend):
+        cluster = _cluster()
+        sched = _sched(cluster, backend)
+        for g in range(3):
+            _add_gang(cluster, f"g{g}", 8)
+        sched.run_once()
+        for g in range(3, 6):
+            _add_gang(cluster, f"g{g}", 4)
+        sched.run_once()
+        cluster.tick()
+        sched.run_once()
+        return _decisions(cluster)
+
+    serial = run("")
+    assert run("thread") == serial
+    assert run("process") == serial
+    # 32 hosts total: wave one takes 24, wave two fits two of its
+    # three 4-pod gangs into the 8 that remain
+    assert len(serial) == 3 * 8 + 2 * 4
+
+
+# -- 2. the mirror protocol --------------------------------------------
+
+def test_sync_is_full_then_delta():
+    cluster = _cluster()
+    sched = _sched(cluster, "process")
+    _add_gang(cluster, "g0", 4)
+    sched.run_once()
+    full0 = _counter("sweep_snapshot_delta_bytes_total", kind="full")
+    delta0 = _counter("sweep_snapshot_delta_bytes_total",
+                      kind="delta")
+    assert full0 > 0            # first cycle: workers bootstrapped
+    cluster.tick()
+    _add_gang(cluster, "g1", 4)
+    sched.run_once()
+    full1 = _counter("sweep_snapshot_delta_bytes_total", kind="full")
+    delta1 = _counter("sweep_snapshot_delta_bytes_total",
+                      kind="delta")
+    assert full1 == full0       # ...and never full-synced again
+    assert delta1 > delta0      # the second cycle shipped a delta
+    # the delta is a fraction of the bootstrap (changed objects only)
+    assert (delta1 - delta0) < full0 / 2
+
+
+def test_ops_replay_keeps_midcycle_sweeps_exact():
+    """Two spec shapes in one cycle: the second build_entry runs
+    AFTER the first gang's placements, so the worker mirrors must
+    replay those ops to stay exact — pinned by comparing against the
+    serial run's placements AND zero stale refusals (the rows were
+    accepted, not refused-and-recomputed)."""
+    def run(backend):
+        cluster = _cluster()
+        sched = _sched(cluster, backend)
+        _add_gang(cluster, "a", 8)
+        _add_gang(cluster, "b", 4,
+                  requests={"cpu": 4, "google.com/tpu": 4})
+        sched.run_once()
+        return _decisions(cluster)
+
+    serial = run("")
+    stale0 = _counter("sweep_stale_refusals_total")
+    assert run("process") == serial
+    assert _counter("sweep_stale_refusals_total") == stale0
+    pool = procpool.pool(2)
+    pings = pool.ping()
+    assert len(pings) == 2
+    # every worker replayed the first gang's ops (8 allocs) before
+    # the second spec's sweep
+    assert all(p[3] >= 8 for p in pings)
+
+
+# -- 3. degradation ----------------------------------------------------
+
+def test_sigkill_worker_degrades_serially_and_heals():
+    serial_binds = None
+    cluster = _cluster()
+    sched = _sched(cluster, "")
+    _add_gang(cluster, "g0", 8)
+    sched.run_once()
+    cluster.tick()
+    _add_gang(cluster, "g1", 8)
+    sched.run_once()
+    serial_binds = _decisions(cluster)
+
+    cluster = _cluster()
+    sched = _sched(cluster, "process")
+    _add_gang(cluster, "g0", 8)
+    sched.run_once()
+    pool = procpool.pool(2)
+    restarts0 = pool.restarts
+    os.kill(pool.workers[0].proc.pid, signal.SIGKILL)
+    cluster.tick()
+    _add_gang(cluster, "g1", 8)
+    sched.run_once()
+    assert _decisions(cluster) == serial_binds
+    assert pool.restarts == restarts0 + 1
+    assert _counter("sweep_worker_restarts_total",
+                    reason="crash") >= 1
+    # self-healed: both workers alive, and the respawn full-syncs on
+    # the next fan-out's ensure_sync (driven directly — an idle cycle
+    # with nothing to sweep never fans out)
+    ssn = open_session(sched.cache, sched.conf)
+    pool.ensure_sync(ssn)
+    pings = pool.ping()
+    assert len(pings) == 2
+    assert all(gen >= 0 for _, _pid, gen, _ops in pings)
+    close_session(ssn)
+
+
+def test_stale_mirror_rows_are_refused():
+    cluster = _cluster()
+    sched = _sched(cluster, "process")
+    _add_gang(cluster, "g0", 4)
+    sched.run_once()
+    pool = procpool.pool(2)
+    # poison one worker's journal position: an ops message whose
+    # start index doesn't match marks the mirror stale worker-side
+    w = pool.workers[0]
+    procpool.post(w.conn, ("ops", w.gen, 9999,
+                           [("alloc", "x", "y", "z")]))
+    stale0 = pool.stale_refusals
+    cluster.tick()
+    _add_gang(cluster, "g1", 4)
+    sched.run_once()          # its reply is refused, shards re-sweep
+    assert pool.stale_refusals > stale0
+    assert _counter("sweep_stale_refusals_total") >= 1
+    # placements unaffected by the refusal (serial fallback covered)
+    assert sum(1 for k, _ in cluster.binds
+               if k.startswith("default/g1")) == 4
+    # the poisoned worker full-syncs on the next ensure_sync and
+    # serves again
+    ssn = open_session(sched.cache, sched.conf)
+    pool.ensure_sync(ssn)
+    assert all(gen >= 0 for _, _pid, gen, _ops in pool.ping())
+    close_session(ssn)
+
+
+# -- 4. audits + pool hygiene ------------------------------------------
+
+def test_mirror_divergence_audit_catches_tampering():
+    from volcano_tpu.analysis import freezeaudit
+    cluster = _cluster(2)
+    sched = _sched(cluster, "process")
+    _add_gang(cluster, "g0", 2)
+    sched.run_once()
+    ssn = open_session(sched.cache, sched.conf)
+    pool = procpool.pool(2)
+    pool.ensure_sync(ssn)
+    assert pool.audit_mirrors(ssn) is True   # honest mirrors match
+
+    # tamper: ship one worker a full payload whose node state lies,
+    # stamped with the CURRENT generation so staleness can't save us
+    payload = pool._full_payload(ssn)
+    tampered = procpool.unship(procpool.ship(payload))  # deep copy
+    ni = next(iter(tampered["nodes"].values()))
+    ni.idle.res["google.com/tpu"] = 9999.0
+    w = pool.workers[0]
+    procpool.post(w.conn, ("full", tampered))
+    w.ops = 0
+
+    freezeaudit.install()
+    freezeaudit.reset()
+    try:
+        assert pool.audit_mirrors(ssn) is False
+        rep = freezeaudit.report()
+        assert any(v["kind"] == "mirror-divergence"
+                   for v in rep["violations"]), rep["violations"]
+    finally:
+        freezeaudit.uninstall()
+    close_session(ssn)
+
+
+def test_delta_compose_recreated_job_ships_as_change():
+    """Regression: a job removed at gen N and re-created under the
+    SAME key at gen N+1 composed to a removal-only (the trailing
+    `changed -= removed` was order-blind), so a mirror catching up
+    across the gap silently dropped a live job while its staleness
+    stamp still matched.  Composition is per-key last-wins now."""
+    cluster = _cluster()
+    sched = _sched(cluster)
+    _add_gang(cluster, "gq", 4)
+    sched.cache.snapshot()
+    gen0 = sched.cache._gen
+    pgkey = next(k for k in cluster.podgroups if "gq" in k)
+    podkeys = [k for k in cluster.pods
+               if cluster.pods[k].annotations.get(
+                   "scheduling.k8s.io/group-name") ==
+               cluster.podgroups[pgkey].name or k.startswith(
+                   "default/gq")]
+    for k in list(podkeys):
+        cluster.delete_pod(k)
+    cluster.delete_podgroup(pgkey)
+    sched.cache.snapshot()                 # gen0+1: removal
+    _add_gang(cluster, "gq", 4)            # same key, new incarnation
+    sched.cache.snapshot()                 # gen0+2: re-creation
+    composed = sched.cache.delta_since(gen0)
+    assert composed is not None
+    _nodes, changed, removed, _hn = composed
+    recreated = {k for k in changed if "gq" in k}
+    assert recreated, (changed, removed)
+    assert not any("gq" in k for k in removed), (changed, removed)
+
+
+def test_midcycle_full_sync_carries_ops_base():
+    """Regression: a worker joining MID-cycle (respawn after a crash,
+    pool grow) gets a full sync built from LIVE already-mutated
+    session objects; the owner then reset its journal cursor to 0 and
+    replayed every op on top — double-applying allocations until
+    node.add_task raised and the worker crash-looped.  The full
+    payload now carries ops_base and the replay suffix is skipped."""
+    cluster = _cluster()
+    sched = _sched(cluster)
+    _add_gang(cluster, "a", 8)
+    _add_gang(cluster, "b", 4,
+              requests={"cpu": 4, "google.com/tpu": 4})
+    ssn = open_session(sched.cache, sched.conf)
+    pending = [t for j in ssn.jobs.values()
+               for t in j.tasks_in_status(TaskStatus.PENDING)
+               if t.job.endswith("a") or "a" in t.job]
+    a_tasks = [t for t in pending if "a" in str(t.job)][:8]
+    for t, name in zip(a_tasks, sorted(ssn.nodes)):
+        ssn.allocate(t, ssn.nodes[name])
+    assert len(ssn.mirror_log) == 8
+    aconf = ssn.conf.configurations.setdefault("allocate", {})
+    aconf["parallelPredicates"] = False
+    b_task = next(t for j in ssn.jobs.values()
+                  for t in j.tasks_in_status(TaskStatus.PENDING))
+    serial = SpecCache(ssn, list(ssn.nodes.values()),
+                       record_errors=False).build_entry(b_task)
+
+    pool = procpool.pool(2)     # fresh workers join mid-cycle
+    pool.ensure_sync(ssn)
+    pings = pool.ping()
+    assert len(pings) == 2
+    # the full sync stamped the journal position it embodies
+    assert all(ops == 8 for _w, _p, _g, ops in pings), pings
+    # and a sweep at the current stamp is ACCEPTED, not refused, with
+    # rows identical to the owner's serial walk over the same state
+    aconf["parallelPredicates"] = "process"
+    aconf["parallelPredicates.workers"] = 2
+    stale0 = pool.stale_refusals
+    entry = SpecCache(ssn, list(ssn.nodes.values()),
+                      record_errors=False).build_entry(b_task)
+    assert pool.stale_refusals == stale0
+    assert pool.restarts == 0
+    assert entry["fits"].keys() == serial["fits"].keys()
+    assert entry["scores"] == serial["scores"]
+    assert entry["meta"] == serial["meta"]
+    close_session(ssn)
+
+
+def test_frozen_payload_ships_thawed_no_worker_churn():
+    """Regression (caught live in the conductor): a frozen owner
+    session ships payloads holding FrozenDict-wrapped structures
+    (node.tasks of busy nodes); the default dict-subclass pickle
+    rebuilt them item-by-item through the armed __setitem__ barrier
+    on a half-constructed instance in the worker, killing it — every
+    armed fan-out silently degraded to serial behind constant worker
+    churn.  FrozenDict.__reduce__ now thaws shipped copies to plain
+    dicts."""
+    from volcano_tpu.analysis import freezeaudit
+
+    fd = freezeaudit.FrozenDict({"a": 1, "b": 2}, "t")
+    out = procpool.unship(procpool.ship(fd))
+    assert type(out) is dict and out == {"a": 1, "b": 2}
+
+    cluster = _cluster()
+    sched = _sched(cluster, "process")
+    _add_gang(cluster, "g0", 8)
+    sched.run_once()
+    cluster.tick()            # g0 running: busy nodes carry tasks
+    procpool.shutdown()       # next cycle bootstraps a fresh pool
+    _add_gang(cluster, "g1", 8)
+    freezeaudit.install()
+    freezeaudit.reset()
+    try:
+        sched.run_once()      # full sync ships FROZEN busy nodes
+        rep = freezeaudit.report()
+        assert rep["violations"] == [], rep["violations"]
+    finally:
+        freezeaudit.uninstall()
+    pool = procpool.pool(2)
+    # the sweep came back from real mirrors, not the serial fallback
+    assert pool.restarts == 0
+    assert pool.stale_refusals == 0
+    assert sum(1 for k, _ in cluster.binds
+               if k.startswith("default/g1")) == 8
+
+
+def test_thread_pool_grow_drains_inflight_futures():
+    """Regression (the old grow path called shutdown(wait=False) and
+    abandoned in-flight futures): a future submitted before a grow
+    must still complete."""
+    from volcano_tpu.actions import sweep as sweep_mod
+    sweep_mod._POOL = None
+    sweep_mod._POOL_WORKERS = 0
+    small = sweep_mod.sweep_pool(1)
+    started = []
+
+    def slow():
+        started.append(True)
+        time.sleep(0.4)
+        return "survived"
+
+    fut = small.submit(slow)
+    while not started:
+        time.sleep(0.01)
+    grown = sweep_mod.sweep_pool(4)
+    assert grown is not small
+    # the old pool was DRAINED, not abandoned: the future resolved
+    assert fut.result(timeout=1) == "survived"
+    sweep_mod._POOL = None
+    sweep_mod._POOL_WORKERS = 0
+
+
+def test_sweep_smoke_subprocess():
+    """bench.py --sweep-smoke through a real interpreter: process-
+    pool sweep on a small cluster, bit-identical entries and
+    placements vs serial, real OS worker processes (tier-1 wiring,
+    mirroring --wire-smoke)."""
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"),
+         "--sweep-smoke"],
+        capture_output=True, text=True, timeout=180, cwd=repo)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = proc.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["ok"] is True
+    assert out["placements_identical"] is True
+    assert out["entry_identical"] is True
+    assert out["real_worker_processes"] is True
+    assert out["synced_full_then_delta"] is True
+
+
+def test_process_pool_grow_keeps_existing_mirrors():
+    cluster = _cluster()
+    sched = _sched(cluster, "process")
+    _add_gang(cluster, "g0", 4)
+    sched.run_once()
+    pool2 = procpool.pool(2)
+    gens = {wid: gen for wid, _pid, gen, _ops in pool2.ping()}
+    pool4 = procpool.pool(4)
+    assert pool4 is pool2 and pool4.size() == 4
+    # the two original workers kept their synced mirrors
+    for wid, _pid, gen, _ops in pool4.ping():
+        if wid in gens:
+            assert gen == gens[wid] >= 0

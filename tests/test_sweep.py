@@ -264,13 +264,17 @@ def test_confined_stores_tracked_and_leak_detected(audit):
 def test_parallel_conf_parsing():
     cluster = _scenario()
     _, ssn = _open(cluster)
-    assert parallel_conf(ssn) == (False, 0)
+    assert parallel_conf(ssn) == ("", 0)
     ssn.conf.configurations["allocate"] = {"parallelPredicates": True}
-    enabled, workers = parallel_conf(ssn)
-    assert enabled and workers >= 1
+    backend, workers = parallel_conf(ssn)
+    assert backend == "thread" and workers >= 1
+    ssn.conf.configurations["allocate"] = {
+        "parallelPredicates": "process",
+        "parallelPredicates.workers": 3}
+    assert parallel_conf(ssn) == ("process", 3)
     ssn.conf.configurations["allocate"] = {
         "parallelPredicates": "off"}
-    assert parallel_conf(ssn) == (False, 0)
+    assert parallel_conf(ssn) == ("", 0)
 
 
 # -- 4. invalidate skips never-candidate entries -----------------------
@@ -326,4 +330,14 @@ def test_sweep_metric_family_declared():
     from volcano_tpu.bundle import FAMILIES, FAMILY_LABELS
     assert FAMILIES.get("predicate_sweep_seconds") == "histogram"
     assert set(FAMILY_LABELS["predicate_sweep_seconds"]["mode"]) == \
-        {"serial", "parallel"}
+        {"serial", "thread", "process"}
+    # the process backend's sync/heal/staleness families: bounded
+    # label enums only, like every sched_* family before them
+    assert FAMILIES.get("sweep_snapshot_delta_bytes_total") == \
+        "counter"
+    assert set(FAMILY_LABELS["sweep_snapshot_delta_bytes_total"]
+               ["kind"]) == {"full", "delta", "ops"}
+    assert FAMILIES.get("sweep_worker_restarts_total") == "counter"
+    assert set(FAMILY_LABELS["sweep_worker_restarts_total"]
+               ["reason"]) == {"crash", "timeout"}
+    assert FAMILIES.get("sweep_stale_refusals_total") == "counter"

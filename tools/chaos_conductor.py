@@ -349,7 +349,8 @@ class InvariantTracker:
 def run_conductor(seed: int, duration: float,
                   classes=DEFAULT_CLASSES, logdir: str = "",
                   lock_audit: bool = False,
-                  race_audit: bool = False) -> dict:
+                  race_audit: bool = False,
+                  sweep_backend: str = "thread") -> dict:
     classes = set(classes.split(",")) if isinstance(classes, str) \
         else set(classes)
     sched = build_plan(seed, duration, classes)
@@ -460,7 +461,7 @@ def run_conductor(seed: int, duration: float,
             from volcano_tpu.conf import DEFAULT_SCHEDULER_CONF
             conf_doc = dict(DEFAULT_SCHEDULER_CONF)
             conf_doc["configurations"] = {
-                "allocate": {"parallelPredicates": True,
+                "allocate": {"parallelPredicates": sweep_backend,
                              "parallelPredicates.workers": 8}}
             with open(conf_path, "w", encoding="utf-8") as f:
                 yaml.safe_dump(conf_doc, f)
@@ -1003,11 +1004,14 @@ def run_conductor(seed: int, duration: float,
                 result["lock_audit"]["violations"]
         if race_audit:
             result["race_audit"] = _collect_race_audit(race_dir)
+            result["race_audit"]["sweep_backend"] = sweep_backend
             result["ok"] = result["ok"] and not \
                 result["race_audit"]["violations"]
         if not result["ok"]:
             flag = (" --lock-audit" if lock_audit else "") + \
-                (" --race-audit" if race_audit else "")
+                (" --race-audit" if race_audit else "") + \
+                (f" --sweep-backend {sweep_backend}"
+                 if race_audit and sweep_backend != "thread" else "")
             print(f"\nREPRODUCE: python tools/chaos_conductor.py "
                   f"--seed {seed} --duration {duration} "
                   f"--classes {','.join(sorted(classes))}{flag}",
@@ -1244,12 +1248,14 @@ def read_qps_scaling(n_readers: int = 6, measure_s: float = 4.0,
 
 def run_matrix(seeds, duration: float, classes: str,
                out: str = "", lock_audit: bool = False,
-               race_audit: bool = False) -> dict:
+               race_audit: bool = False,
+               sweep_backend: str = "thread") -> dict:
     rows = []
     for seed in seeds:
         rows.append(run_conductor(seed, duration, classes,
                                   lock_audit=lock_audit,
-                                  race_audit=race_audit))
+                                  race_audit=race_audit,
+                                  sweep_backend=sweep_backend))
         print(json.dumps({"seed": seed, "ok": rows[-1]["ok"]}),
               flush=True)
     invariant_names = sorted(rows[0]["invariants"]["passed"])
@@ -1365,6 +1371,13 @@ def main(argv=None) -> int:
                          "tracking), run the scheduler with the "
                          "parallel predicate sweep, and fail the run "
                          "on any race/freeze violation")
+    ap.add_argument("--sweep-backend", default="thread",
+                    choices=("thread", "process"),
+                    help="which parallel sweep backend the "
+                         "--race-audit scheduler runs: the GIL-bound "
+                         "thread pool (PR 11's pilot) or the "
+                         "mirror-worker process pool "
+                         "(actions/procpool.py)")
     args = ap.parse_args(argv)
     classes = args.classes
     if args.print_schedule:
@@ -1376,14 +1389,16 @@ def main(argv=None) -> int:
         doc = run_matrix(range(1, args.matrix + 1), args.duration,
                          classes, out=args.out,
                          lock_audit=args.lock_audit,
-                         race_audit=args.race_audit)
+                         race_audit=args.race_audit,
+                         sweep_backend=args.sweep_backend)
         print(json.dumps({k: v for k, v in doc.items()
                           if k != "per_seed"}, indent=1))
         return 0 if doc["zero_violations"] else 1
     out = run_conductor(args.seed, args.duration, classes,
                         logdir=args.logdir,
                         lock_audit=args.lock_audit,
-                        race_audit=args.race_audit)
+                        race_audit=args.race_audit,
+                        sweep_backend=args.sweep_backend)
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 

@@ -61,12 +61,14 @@ def build_scenario(hosts: int):
     return Scheduler(cluster, schedule_period=0)
 
 
-def bench_entry(ssn, nodes, task, workers: int, reps: int = 9):
+def bench_entry(ssn, nodes, task, workers: int, reps: int = 9,
+                backend: str = "thread"):
     """Best-of-reps build_entry wall time at the given worker count
-    (0 = the serial fallback path)."""
+    (0 = the serial fallback path; backend selects the thread pool or
+    the mirror-worker process pool for workers > 0)."""
     from volcano_tpu.actions.sweep import SpecCache
     conf = ssn.conf.configurations.setdefault("allocate", {})
-    conf["parallelPredicates"] = bool(workers)
+    conf["parallelPredicates"] = backend if workers else False
     conf["parallelPredicates.workers"] = workers or 1
     best, entry = float("inf"), None
     for _ in range(reps):
@@ -88,8 +90,16 @@ def main(argv=None) -> int:
                                  description=__doc__)
     ap.add_argument("--hosts", type=int, default=1024)
     ap.add_argument("--reps", type=int, default=9)
+    ap.add_argument("--backends", default="thread",
+                    help="comma list of pool backends to row "
+                         "(thread, process); RACE_r15.json was "
+                         "thread-only, the mirror-worker process "
+                         "pool rows land in SCALE100K via bench.py "
+                         "--scale-100k")
     ap.add_argument("--out", default="")
     args = ap.parse_args(argv)
+    backends = [b.strip() for b in args.backends.split(",")
+                if b.strip()]
 
     from volcano_tpu.analysis import freezeaudit, racecheck
     from volcano_tpu.api.types import TaskStatus
@@ -108,15 +118,18 @@ def main(argv=None) -> int:
     serial_s, serial_entry = bench_entry(ssn, nodes, task, 0,
                                          args.reps)
     rows = []
-    for w in WORKER_STEPS:
-        t, entry = bench_entry(ssn, nodes, task, w, args.reps)
-        identical = entries_identical(entry, serial_entry)
-        rows.append({"workers": w, "ms": round(t * 1000, 2),
-                     "speedup_vs_serial": round(serial_s / t, 2),
-                     "entry_identical_to_serial": identical})
-        print(f"  w={w}: {t*1000:.2f} ms "
-              f"({serial_s/t:.2f}x, identical={identical})",
-              flush=True)
+    for backend in backends:
+        for w in WORKER_STEPS:
+            t, entry = bench_entry(ssn, nodes, task, w, args.reps,
+                                   backend=backend)
+            identical = entries_identical(entry, serial_entry)
+            rows.append({"backend": backend, "workers": w,
+                         "ms": round(t * 1000, 2),
+                         "speedup_vs_serial": round(serial_s / t, 2),
+                         "entry_identical_to_serial": identical})
+            print(f"  {backend} w={w}: {t*1000:.2f} ms "
+                  f"({serial_s/t:.2f}x, identical={identical})",
+                  flush=True)
     close_session(ssn)
 
     # -- phase 2: certify (auditor armed) -----------------------------
@@ -128,9 +141,11 @@ def main(argv=None) -> int:
     cnodes = list(ssn.nodes.values())
     _, armed_serial = bench_entry(ssn, cnodes, ctask, 0, reps=1)
     armed_identical = True
-    for w in WORKER_STEPS:
-        _, entry = bench_entry(ssn, cnodes, ctask, w, reps=2)
-        armed_identical &= entries_identical(entry, armed_serial)
+    for backend in backends:
+        for w in WORKER_STEPS:
+            _, entry = bench_entry(ssn, cnodes, ctask, w, reps=2,
+                                   backend=backend)
+            armed_identical &= entries_identical(entry, armed_serial)
     close_session(ssn)
     # ...and three full scheduler cycles with the parallel sweep on,
     # so the freeze window sees real Statement commits
@@ -167,7 +182,7 @@ def main(argv=None) -> int:
         "parallel": rows,
         "speedup_at_8_workers": next(
             r["speedup_vs_serial"] for r in rows
-            if r["workers"] == 8),
+            if r["workers"] == 8 and r["backend"] == backends[0]),
         "note": ("single-CPU host: the measured speedup is the "
                  "batched prepared-sweep form the fan-out "
                  "architecture enables (task-side hoisting, no "
@@ -194,6 +209,9 @@ def main(argv=None) -> int:
                and armed_identical
                and all(r["entry_identical_to_serial"] for r in rows)),
     }
+    if "process" in backends:
+        from volcano_tpu.actions import procpool
+        procpool.shutdown()
     out = args.out or "RACE_r15.json"
     with open(out, "w", encoding="utf-8") as f:
         json.dump(doc, f, indent=1, default=str)

@@ -22,13 +22,19 @@ class BackfillAction(Action):
 
     def execute(self, ssn) -> None:
         for job in ssn.jobs.values():
+            # cheap emptiness probe FIRST: on a steady fleet the
+            # per-job gang-validity walk below cost more than every
+            # other action combined, for jobs with nothing to backfill
+            pending = job.task_status_index.get(TaskStatus.PENDING)
+            if not pending:
+                continue
             if job.podgroup is not None and \
                     job.podgroup.phase is PodGroupPhase.PENDING and \
                     "enqueue" in ssn.conf.actions:
                 continue
             if ssn.job_valid(job) is not None:
                 continue
-            for task in job.tasks_in_status(TaskStatus.PENDING):
+            for task in list(pending.values()):
                 if not task.best_effort:
                     continue
                 nodes = predicate_nodes(ssn, task,

@@ -4,17 +4,17 @@ thread pool over the frozen snapshot (ROADMAP item 3's first measured
 step) and (b) named by the static race pass (analysis/racecheck.py)
 as the reader call tree it certifies.
 
-Two sweep paths build the same entry:
+Three sweep backends build the same entry:
 
   serial    the legacy path: ``ssn.predicate``/``ssn.node_order``
             dispatch per node, with per-plugin trace attribution.
             Always correct, always available — the fallback.
-  parallel  (``parallelPredicates`` under the allocate action's
-            configurations) the per-spec sweep is sharded by LEAF
-            HYPERNODE GROUP and fanned out across a shared thread
-            pool.  Workers run the RAW resolved plugin callbacks
-            (session.resolved_fns) over a read-only snapshot and
-            return plain result rows; every mutation — entry
+  thread    (``parallelPredicates: true`` / ``thread`` under the
+            allocate action's configurations) the per-spec sweep is
+            sharded by LEAF HYPERNODE GROUP and fanned out across a
+            shared thread pool.  Workers run the prepared
+            PreFilter/PreScore plugin forms over a read-only snapshot
+            and return plain result rows; every mutation — entry
             assembly, heap builds, fit-error recording — happens on
             the calling thread after the barrier.  The freeze auditor
             (analysis/freezeaudit.py) brackets the fan-out so any
@@ -22,7 +22,16 @@ Two sweep paths build the same entry:
             recorded violation, and the batched form (no tier walk,
             no trace-timing wrapper, no Session dispatch per node) is
             what the measured sweep speedup in RACE_r15.json comes
-            from.
+            from.  GIL-bound: real hardware parallelism needs the
+            process backend.
+  process   (``parallelPredicates: process``) the same leaf shards
+            fan across a pool of worker OS PROCESSES holding
+            long-lived snapshot mirrors kept current by per-cycle
+            deltas plus a within-cycle op journal; rows come back
+            stamped with the (generation, ops) they were computed
+            against, and anything stale re-sweeps serially.  See
+            actions/procpool.py for the mirror/staleness protocol and
+            docs/design/parallel-cycle.md for the contract.
 
 The entry shape, the heap fast path and the single-node invalidation
 contract are unchanged from allocate.py's original closures; see
@@ -63,28 +72,40 @@ def sweep_pool(workers: int):
                 max_workers=workers, thread_name_prefix="vtp-sweep")
             _POOL_WORKERS = workers
             if old is not None:
-                old.shutdown(wait=False)
+                # DRAIN the old pool: shutdown(wait=False) abandoned
+                # any fan-out another session had in flight — its
+                # futures died unresolved and the barrier hung.  The
+                # grower's own futures are not submitted yet (pools
+                # resize at fan-out start), so waiting here can only
+                # block on OTHER threads' already-running shards,
+                # which complete without us.
+                old.shutdown(wait=True)
         return _POOL
 
 
 def parallel_conf(ssn):
-    """(enabled, workers) from the allocate action's configurations:
+    """(backend, workers) from the allocate action's configurations:
 
         configurations:
           allocate:
-            parallelPredicates: true
+            parallelPredicates: thread      # or: process / true / off
             parallelPredicates.workers: 8
-    """
+
+    ``true`` keeps meaning the thread backend (the PR 11 pilot's
+    spelling); ``process`` selects the mirror-worker process pool
+    (actions/procpool.py).  Backend is "" when disabled."""
     conf = ssn.conf.configurations.get("allocate", {})
     raw = conf.get("parallelPredicates", False)
-    if not raw or str(raw).lower() in ("false", "0", "none", "off"):
-        return False, 0
+    val = str(raw).lower()
+    if not raw or val in ("false", "0", "none", "off"):
+        return "", 0
+    backend = "process" if val == "process" else "thread"
     try:
         workers = int(conf.get("parallelPredicates.workers",
                                DEFAULT_WORKERS))
     except (TypeError, ValueError):
         workers = DEFAULT_WORKERS
-    return True, max(1, workers)
+    return backend, max(1, workers)
 
 
 # -- the per-shard worker (runs on pool threads: READS ONLY) ---------
@@ -206,13 +227,15 @@ class SpecCache:
         grouped_names = ssn.fn_plugin_names("groupedBatchNodeOrder")
         self.use_heap = not (batch_names - grouped_names)
         self.has_grouped = bool(grouped_names)
-        enabled, workers = parallel_conf(ssn)
-        self.workers = workers if enabled else 0
-        if enabled:
+        backend, workers = parallel_conf(ssn)
+        self.backend = backend
+        self.workers = workers if backend else 0
+        if backend:
             # resolve the raw callback tables ONCE, on this thread,
             # before any fan-out: resolution populates the session's
             # dispatch memo (_raw_cache) so no worker ever writes it
-            # mid-sweep
+            # mid-sweep (process workers resolve their OWN tables, but
+            # the serial-fallback path still reads these)
             ssn.resolved_named_fns("predicate")
             ssn.resolved_named_fns("predicatePrepare")
             ssn.resolved_named_fns("nodeOrder")
@@ -230,14 +253,15 @@ class SpecCache:
         under its spec.  The parallel path shards by leaf group; the
         serial path is the legacy per-node dispatch."""
         t0 = time.perf_counter()
-        if self.workers:
+        if self.backend == "process":
+            entry = self._build_process(task)
+        elif self.backend == "thread":
             entry = self._build_parallel(task)
-            mode = "parallel"
         else:
             entry = self._build_serial(task)
-            mode = "serial"
         metrics.observe("predicate_sweep_seconds",
-                        time.perf_counter() - t0, mode=mode)
+                        time.perf_counter() - t0,
+                        mode=self.backend or "serial")
         # vtplint: disable=shared-cache-unkeyed (SpecCache is confined to the allocate loop thread; pool workers only ever see sweep_shard's arguments)
         self.entries[task.task_spec] = entry
         return entry
@@ -319,6 +343,71 @@ class SpecCache:
                         task, node.name,
                         FitError(task, node, statuses=[st]))
         self._seal(entry)
+        return entry
+
+    def _build_process(self, task) -> dict:
+        """Fan the sweep across the mirror-worker process pool
+        (actions/procpool.py).  Workers hold long-lived snapshot
+        mirrors and resolve the prepared plugin forms themselves —
+        only the task, shard names and compact (name, score, class)
+        rows cross the boundary.  Stale/crashed shards degrade to the
+        serial prepared-form sweep on this thread; the merge below is
+        owner-thread-only, exactly like the thread backend."""
+        from volcano_tpu.actions import procpool
+        ssn = self.ssn
+        entry = self._new_entry(task)
+        pool = procpool.pool(self.workers)
+        need_class = self.use_heap
+        t0 = time.perf_counter()
+        freezeaudit.fanout_begin()
+        try:
+            per_shard, leftover = pool.sweep(
+                ssn, task, self._shards, need_class)
+            if freezeaudit.enabled():
+                pool.audit_mirrors(ssn, self.candidate_names)
+        finally:
+            freezeaudit.fanout_end()
+        if leftover:
+            # refused/stale/crashed shards re-sweep serially with the
+            # owner's own prepared forms and merge at their GLOBAL
+            # shard index — a degraded cycle's entry order stays
+            # byte-identical to a healthy one's
+            pred_fns = prepared_fns(ssn, "predicate",
+                                    "predicatePrepare", task)
+            score_fns = prepared_fns(ssn, "nodeOrder",
+                                     "nodeOrderPrepare", task)
+            for idx, shard in leftover:
+                f, e = sweep_shard(task, shard, pred_fns, score_fns,
+                                   need_class)
+                per_shard[idx] = (
+                    [(n.name, score, cls) for n, score, cls in f],
+                    [(n.name, st) for n, st in e])
+        fit_rows: list = []
+        fail_rows: list = []
+        for idx in sorted(per_shard):
+            f, e = per_shard[idx]
+            fit_rows.extend(f)
+            fail_rows.extend(e)
+        trace.add_plugin_time("predicate", "_process_sweep",
+                              time.perf_counter() - t0)
+        with trace.span("sweep_merge", kind="action"):
+            job = ssn.jobs.get(task.job)
+            by_name = ssn.nodes
+            for name, score, cls in fit_rows:
+                node = by_name.get(name)
+                if node is not None:
+                    self._admit(entry, task, node, score, cls)
+            if self.record_errors and job is not None:
+                from volcano_tpu.api.fit_error import FitError
+                for name, st in fail_rows:
+                    node = by_name.get(name)
+                    if node is None:
+                        continue
+                    # vtplint: disable=shared-cache-unkeyed (post-barrier merge on the session owner thread; record_fit_error is a designated mutation seam)
+                    job.record_fit_error(
+                        task, name,
+                        FitError(task, node, statuses=[st]))
+            self._seal(entry)
         return entry
 
     def _admit(self, entry, task, node, score, cls):

@@ -32,6 +32,15 @@ catalog with provenance lives in docs/design/static-analysis.md):
                  (pass/continue-only body) around wire/disk I/O —
                  gray failures must be counted or classified, never
                  eaten.
+  process-ship-purity
+                 in any module touching multiprocessing, a pipe
+                 ``.send(...)``/``.send_bytes(...)`` may only happen
+                 inside the designated ship seam
+                 (actions/procpool.post/post_bytes), whose pickler
+                 REFUSES callables — the pickled-callback purity
+                 contract of the process-pool sweep: worker behavior
+                 comes from worker-side resolution, never from
+                 shipped code.
 
 Suppressions: ``# vtplint: disable=<rule>[,<rule>] (<reason>)`` on the
 finding's line or the line above.  A suppression WITHOUT a
@@ -48,7 +57,7 @@ import re
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 RULES = ("req-id", "wall-clock", "metric-family", "metric-labels",
-         "append-lock", "except-pass")
+         "append-lock", "except-pass", "process-ship-purity")
 
 SUPPRESS_RE = re.compile(
     r"#\s*vtplint:\s*disable=([a-z0-9*,_-]+)(?:\s*\(([^)]+)\))?")
@@ -64,6 +73,12 @@ WALL_CLOCK_FN = re.compile(r"lease|election|campaign|promote|_wal",
 # DurableStore implementation takes its own internal lock)
 APPEND_LOCK_FILES = ("server/state_server.py", "server/replication.py")
 APPEND_METHODS = frozenset({"append", "append_event", "append_shipped"})
+
+# process-ship-purity: the only functions allowed to call a pipe send
+# (both live in actions/procpool.py and route through the pure
+# pickler that refuses callables)
+SHIP_SEAMS = frozenset({"post", "post_bytes"})
+SHIP_SENDS = frozenset({"send", "send_bytes"})
 
 EMIT_METHODS = frozenset({"inc", "observe", "set_gauge"})
 READ_METHODS = frozenset({"get_gauge", "get_counter",
@@ -210,6 +225,13 @@ class Linter:
         in_scope_file = rel.endswith(WALL_CLOCK_FILES)
         append_scope = rel.endswith(APPEND_LOCK_FILES)
         is_metrics_impl = rel.endswith("volcano_tpu/metrics.py")
+        ship_scope = rel.endswith("actions/procpool.py") or any(
+            (isinstance(n, ast.Import)
+             and any(a.name.split(".")[0] == "multiprocessing"
+                     for a in n.names))
+            or (isinstance(n, ast.ImportFrom) and n.module
+                and n.module.split(".")[0] == "multiprocessing")
+            for n in ast.walk(tree))
         # ancestor context maintained by an explicit stack walk
         fn_stack: List[str] = []
         lock_depth = [0]        # with-a-lock nesting count
@@ -284,6 +306,17 @@ class Linter:
                         f"{chain}(...) outside a lock-holding `with` "
                         f"block: journal order may drift from the "
                         f"order the lock assigned")
+
+            # process-ship-purity -------------------------------------
+            if ship_scope and attr in SHIP_SENDS and \
+                    isinstance(node.func, ast.Attribute):
+                if not (fn_stack and fn_stack[-1] in SHIP_SEAMS):
+                    yield Finding(
+                        "process-ship-purity", rel, node.lineno,
+                        f"{chain}(...) outside the ship seam "
+                        f"(procpool.post/post_bytes): every cross-"
+                        f"process payload must go through the pure "
+                        f"pickler that refuses callables")
 
             # metric-family / metric-labels ---------------------------
             if not is_metrics_impl and chain.startswith("metrics."):

@@ -105,6 +105,17 @@ def _violation(kind: str, key, **fields) -> None:
         _VIOLATIONS.append(doc)
 
 
+def record_boundary_violation(kind: str, key, **fields) -> None:
+    """Public entry for audits that live OUTSIDE this module but
+    report through it — the process-pool's per-worker mirror
+    divergence check (actions/procpool.py) records here so conductor
+    runs and race_bench fail on exactly the same report surface as
+    in-process freeze violations.  No-op when disarmed."""
+    if not _ACTIVE:
+        return
+    _violation(kind, key, **fields)
+
+
 def _held_names() -> frozenset:
     """The acquiring thread's held-lock names from the lock auditor
     (empty when it is not armed — pairs then need no common lock to
@@ -216,6 +227,16 @@ class FrozenDict(dict):
     def __init__(self, data, name: str):
         super().__init__(data)
         self._vtp_name = name
+
+    def __reduce__(self):
+        # a pickled copy THAWS to a plain dict: the barrier guards
+        # THIS process's snapshot objects, and the default dict-
+        # subclass protocol would rebuild item-by-item through the
+        # armed __setitem__ barrier on a half-constructed instance
+        # (no _vtp_name yet) — which killed every process-pool mirror
+        # worker that received a frozen owner's shipped payload.  The
+        # worker freezes its OWN mirror session when armed.
+        return (dict, (dict(self),))
 
     def _bar(self, op):
         _check_write(self, op, f"{self._vtp_name}[{op}]")

@@ -45,6 +45,11 @@ FAMILIES: Dict[str, str] = {
     "pod_scheduling_latency_seconds": "histogram",
     "task_scheduling_latency_seconds": "histogram",
     "predicate_sweep_seconds": "histogram",
+    # process-pool sweep backend (actions/procpool.py): mirror sync
+    # traffic, pool self-healing and the staleness-refusal contract
+    "sweep_snapshot_delta_bytes_total": "counter",
+    "sweep_worker_restarts_total": "counter",
+    "sweep_stale_refusals_total": "counter",
     "action_latency_seconds": "histogram",
     "plugin_latency_seconds": "histogram",
     "open_session_duration_seconds": "histogram",
@@ -220,7 +225,13 @@ OBJECT = "object"
 
 FAMILY_LABELS: Dict[str, Dict[str, object]] = {
     "task_scheduling_latency_seconds": {"action": CONFIG},
-    "predicate_sweep_seconds": {"mode": ("serial", "parallel")},
+    "predicate_sweep_seconds": {"mode": ("serial", "thread",
+                                         "process")},
+    "sweep_snapshot_delta_bytes_total": {
+        "kind": ("full", "delta", "ops")},
+    "sweep_worker_restarts_total": {
+        "reason": ("crash", "timeout")},
+    "sweep_stale_refusals_total": {},
     "action_latency_seconds": {"action": CONFIG},
     "plugin_latency_seconds": {"plugin": CONFIG,
                                "point": ("open", "close")},
@@ -433,6 +444,20 @@ def scheduler_dashboard() -> dict:
                 "rate(server_replication_bootstraps_total[5m])",
                 "rate(server_replication_refused_batches_total[5m])"],
                0, 72),
+        # parallel scheduler cycle: sweep latency by backend, mirror
+        # sync traffic by kind, and the pool's self-healing/staleness
+        # counters — the waterfall an operator reads when a cycle's
+        # fan-out stops paying for itself
+        _panel(20, "Predicate sweep: latency by mode / mirror sync",
+               ["sum by (mode) "
+                "(rate(predicate_sweep_seconds_sum[5m])) / sum by "
+                "(mode) (clamp_min("
+                "rate(predicate_sweep_seconds_count[5m]), 1e-9))",
+                "sum by (kind) "
+                "(rate(sweep_snapshot_delta_bytes_total[5m]))",
+                "sum by (reason) "
+                "(rate(sweep_worker_restarts_total[5m]))",
+                "rate(sweep_stale_refusals_total[5m])"], 12, 72),
     ]
     return {
         "title": "volcano-tpu / scheduler", "uid": "vtp-scheduler",

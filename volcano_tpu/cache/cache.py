@@ -16,6 +16,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
+from volcano_tpu import trace
 from volcano_tpu.api.hypernode import HyperNodesInfo
 from volcano_tpu.api.job_info import JobInfo, TaskInfo
 from volcano_tpu.api.node_info import NodeInfo
@@ -63,8 +64,21 @@ class Snapshot:
         # per-(job, generation) step-rate vectors, exposed to plugins
         # and actions as session.goodput
         self.goodput = None
+        # monotonic snapshot generation stamped by the cache: the
+        # staleness token of the process-mirror protocol (every sweep
+        # row a pool worker returns carries the generation it was
+        # computed against; actions/procpool.py)
+        self.gen = 0
+        # fleet capacity carried incrementally by the cache (a reused
+        # node's contribution never changes); None on bare snapshots
+        self._total = None
 
     def total_resource(self):
+        if self._total is not None:
+            # callers own the result (plugins fold shares into it):
+            # hand out a clone, never the cached instance that now
+            # survives across sessions
+            return self._total.clone()
         from volcano_tpu.api.resource import Resource
         total = Resource()
         for n in self.nodes.values():
@@ -75,6 +89,29 @@ class Snapshot:
                 # best-effort-QoS tasks (actions/util.split_by_fit)
                 total.add(n.oversubscription)
         return total
+
+
+class SnapshotDelta:
+    """What changed between two consecutive snapshots — the unit the
+    process-pool mirror protocol ships (actions/procpool.py) and the
+    goodput fragmentation memo consumes.  ``full=True`` marks a
+    rebuild-everything snapshot (mirrors must full-resync; memos must
+    recompute)."""
+
+    __slots__ = ("gen", "full", "changed_nodes", "removed_nodes",
+                 "changed_jobs", "removed_jobs", "hypernodes_changed")
+
+    def __init__(self, gen: int, full: bool = False,
+                 changed_nodes=frozenset(), removed_nodes=frozenset(),
+                 changed_jobs=frozenset(), removed_jobs=frozenset(),
+                 hypernodes_changed: bool = False):
+        self.gen = gen
+        self.full = full
+        self.changed_nodes = changed_nodes
+        self.removed_nodes = removed_nodes
+        self.changed_jobs = changed_jobs
+        self.removed_jobs = removed_jobs
+        self.hypernodes_changed = hypernodes_changed
 
 
 class BindContext:
@@ -120,6 +157,19 @@ class SchedulerCache:
         self._dirty_nodes: set = set()
         self._dirty_jobs: set = set()
         self._needs_full = True
+        self._hn_dirty = False
+        # snapshot generation + delta ring: last_delta describes how
+        # the NEWEST snapshot differs from its predecessor; the ring
+        # lets a process-mirror several generations behind catch up
+        # with one composed delta instead of a full re-sync
+        self._gen = 0
+        self.last_delta: Optional[SnapshotDelta] = None
+        self._deltas: deque = deque(maxlen=16)
+        # job keys with in-flight scheduling state in the CURRENT base
+        # (anything non-steady rebuilds every cycle, so the steady
+        # fast path below only fires when this is empty)
+        self._unsteady_jobs: set = set()
+        self._base_counts: tuple = ()
         # pods whose lifecycle-phase segments were already fed to
         # sched_phase_seconds (once per pod, bounded window)
         self._phase_seen: set = set()
@@ -165,9 +215,12 @@ class SchedulerCache:
                 # routine job churn and their cascaded pod/podgroup
                 # deletions already dirty the right objects.
                 self._needs_full = True
-            # hypernode/numatopology/vcjob/command/...: not part of
-            # the reused model (hypernodes rebuild every snapshot;
-            # the rest is controller-side state)
+            elif kind in ("hypernode", "hypernode_deleted"):
+                # topology CRs changed: the (otherwise reused)
+                # HyperNodesInfo must rebuild next snapshot
+                self._hn_dirty = True
+            # numatopology/vcjob/command/...: controller-side state,
+            # not part of the reused model
         if kind == "pod":
             # outside the dirty lock: phase-metric derivation reads
             # the podgroup store and feeds the metrics registry
@@ -236,26 +289,120 @@ class SchedulerCache:
     def _consume_dirty(self):
         with self._dirty_lock:
             dirty = (self._needs_full, self._dirty_nodes,
-                     self._dirty_jobs)
+                     self._dirty_jobs, self._hn_dirty)
             self._needs_full = False
             self._dirty_nodes = set()
             self._dirty_jobs = set()
+            self._hn_dirty = False
             return dirty
 
     # -- snapshot ------------------------------------------------------
 
+    # exact incremental totals drift at most an ulp per non-integral
+    # capacity change; a periodic full recompute bounds even that
+    _TOTAL_REFRESH_EVERY = 512
+
     def snapshot(self) -> Snapshot:
         from volcano_tpu import features
-        needs_full, dirty_nodes, dirty_jobs = self._consume_dirty()
-        raw = self.cluster.list_all()
-        if self._base is None or needs_full or \
-                not features.enabled("IncrementalSnapshot"):
-            snap = self._build_full(raw)
-        else:
-            snap = self._build_incremental(raw, dirty_nodes, dirty_jobs)
+        needs_full, dirty_nodes, dirty_jobs, hn_dirty = \
+            self._consume_dirty()
+        with trace.span("snapshot_build", kind="action") as sp:
+            raw = self.cluster.list_all()
+            counts = (len(raw.pods), len(raw.nodes),
+                      len(raw.podgroups), len(raw.queues),
+                      len(raw.priority_classes))
+            self._gen += 1
+            gen = self._gen
+            incremental_ok = (self._base is not None and not needs_full
+                              and features.enabled("IncrementalSnapshot"))
+            if incremental_ok and not dirty_nodes and not dirty_jobs \
+                    and not hn_dirty and not self._unsteady_jobs \
+                    and counts == self._base_counts \
+                    and gen % self._TOTAL_REFRESH_EVERY:
+                # steady fast path: no event touched the reused model
+                # and no job carries in-flight scheduling state — the
+                # whole object graph carries over (fresh top-level
+                # dicts so in-session additions never alias the base)
+                snap = self._reuse_steady()
+                delta = SnapshotDelta(gen)
+                mode = "steady"
+            elif incremental_ok:
+                snap, delta = self._build_incremental(
+                    raw, dirty_nodes, dirty_jobs, hn_dirty, gen)
+                mode = "incremental"
+            else:
+                snap = self._build_full(raw)
+                snap._total = snap.total_resource()
+                delta = SnapshotDelta(gen, full=True,
+                                      hypernodes_changed=True)
+                mode = "full"
+            if sp is not None:
+                sp.labels["mode"] = mode
+        snap.gen = gen
         snap.goodput = self.goodput_book
         self._base = snap
+        self._base_counts = counts
+        self.last_delta = delta
+        self._deltas.append(delta)
         return snap
+
+    def _reuse_steady(self) -> Snapshot:
+        base = self._base
+        snap = Snapshot()
+        snap.jobs = dict(base.jobs)
+        snap.nodes = dict(base.nodes)
+        snap.queues = dict(base.queues)
+        snap.priority_classes = dict(base.priority_classes)
+        snap.hypernodes = base.hypernodes
+        snap._total = base._total
+        return snap
+
+    def delta_since(self, gen: int):
+        """Changes between snapshot *gen* and the current one,
+        composed from the delta ring: (changed_nodes, changed_jobs,
+        removed_jobs, hypernodes_changed), or None when *gen* has
+        fallen off the ring or a full rebuild intervened (the caller
+        must full-resync).  ``gen == current`` composes to empty."""
+        if gen == self._gen:
+            return set(), set(), set(), False
+        changed_nodes: set = set()
+        changed_jobs: set = set()
+        removed_jobs: set = set()
+        hn_changed = False
+        covered = gen
+        for d in self._deltas:
+            if d.gen <= gen:
+                continue
+            if d.gen != covered + 1 or d.full:
+                return None
+            covered = d.gen
+            changed_nodes |= set(d.changed_nodes)
+            # composition is ORDER-SENSITIVE per key: the last
+            # generation's verdict wins — changed-then-removed ships
+            # as a removal only, removed-then-recreated ships as a
+            # change (a plain set-difference at the end shipped a
+            # same-key resubmit as a removal and silently desynced
+            # every mirror that composed across the gap)
+            changed_jobs |= set(d.changed_jobs)
+            changed_jobs -= set(d.removed_jobs)
+            removed_jobs |= set(d.removed_jobs)
+            removed_jobs -= set(d.changed_jobs)
+            hn_changed = hn_changed or d.hypernodes_changed
+        if covered != self._gen:
+            return None
+        return changed_nodes, changed_jobs, removed_jobs, hn_changed
+
+    @staticmethod
+    def _node_capacity(ni: NodeInfo):
+        """One node's contribution to Snapshot.total_resource —
+        stable under task churn (allocatable/oversubscription/ready
+        only move with node-object rebuilds, which dirty the node)."""
+        from volcano_tpu.api.resource import Resource
+        cap = Resource()
+        if ni.ready:
+            cap.add(ni.allocatable)
+            cap.add(ni.oversubscription)
+        return cap
 
     def _build_full(self, raw) -> Snapshot:
         snap = Snapshot()
@@ -286,68 +433,101 @@ class SchedulerCache:
         self._build_hypernodes(snap, raw)
         for ni in snap.nodes.values():
             self._enrich_devices(ni)
+        self._unsteady_jobs = {
+            k for k, j in snap.jobs.items() if not self._job_steady(j)}
         return snap
 
     def _build_incremental(self, raw, dirty_nodes: set,
-                           dirty_jobs: set) -> Snapshot:
+                           dirty_jobs: set, hn_dirty: bool,
+                           gen: int):
         """Reuse the previous snapshot's steady nodes/jobs; rebuild
         only what cluster events or session mutations invalidated.
         Non-steady jobs (anything with in-flight tasks) always rebuild
         — their fit errors and partial state must come from truth.
         Correctness contract: a pod mutation dirties BOTH its node and
         its job, so a clean node can only hold tasks whose pods are
-        byte-identical to the base build's."""
+        byte-identical to the base build's.  Returns (snap, delta)."""
         base = self._base
         snap = Snapshot()
         snap.priority_classes = {pc.name: pc
                                  for pc in raw.priority_classes}
         self._build_queues(snap, raw)
 
-        # group pods once (cheap dict ops; the expensive TaskInfo math
-        # runs only for rebuilt jobs/nodes)
-        pods_by_job: Dict[str, list] = {}
-        pods_by_node: Dict[str, list] = {}
-        for pod in raw.pods:
-            if pod.scheduler_name != self.scheduler_name:
-                continue
-            jkey = self._job_key_for_pod(pod) or pod.key
-            pods_by_job.setdefault(jkey, []).append(pod)
-            if pod.node_name:
-                pods_by_node.setdefault(pod.node_name, []).append(pod)
-
-        # jobs: raw podgroups are the ground truth for existence
+        # jobs: raw podgroups are the ground truth for existence;
+        # decide reuse-vs-rebuild first so the single pods pass below
+        # groups only what a rebuild will actually consume (at 100k
+        # hosts, appending every pod to per-job/per-node lists was a
+        # fifth of the idle cycle)
         pg_keys = set()
+        rebuild_pgs = []
         for pg in raw.podgroups:
             pg_keys.add(pg.key)
             prev = base.jobs.get(pg.key)
             if prev is not None and pg.key not in dirty_jobs and \
                     prev.podgroup is pg and self._job_steady(prev):
                 snap.jobs[pg.key] = prev
+            else:
+                rebuild_pgs.append(pg)
+        # reusable shadow jobs (bare pods / orphaned groups): carried
+        # over unless an event dirtied them — a dirtied shadow job
+        # rebuilds purely from its grouped pods below
+        for jkey, prev in base.jobs.items():
+            if jkey in pg_keys or jkey in snap.jobs:
                 continue
-            job = JobInfo(uid=pg.key, podgroup=pg)
-            job.priority = self._priority_of(snap, pg.priority_class)
-            snap.jobs[pg.key] = job
-            for pod in pods_by_job.get(pg.key, ()):
-                self._make_task(snap, pod)
-        # shadow jobs (bare pods / orphaned groups)
-        for jkey, pods in pods_by_job.items():
-            if jkey in snap.jobs:
-                continue
-            prev = base.jobs.get(jkey)
-            if prev is not None and jkey not in dirty_jobs and \
-                    self._job_steady(prev):
+            if jkey not in dirty_jobs and self._job_steady(prev):
                 snap.jobs[jkey] = prev
-                continue
-            for pod in pods:
-                self._make_task(snap, pod)
 
-        # nodes
+        # nodes: membership is fixed inside the incremental path (any
+        # add/delete set _needs_full), so only dirty/replaced node
+        # objects rebuild
+        rebuild_raw_nodes = []
         for node in raw.nodes:
             prev = base.nodes.get(node.name)
             if prev is not None and node.name not in dirty_nodes and \
                     prev.node is node:
                 snap.nodes[node.name] = prev
+            else:
+                rebuild_raw_nodes.append(node)
+        rebuild_node_names = {n.name for n in rebuild_raw_nodes}
+
+        # ONE lean pass over pods (reused jobs keep their tasks, so a
+        # pod whose job is already in snap.jobs needs no grouping)
+        pods_by_job: Dict[str, list] = {}
+        pods_by_node: Dict[str, list] = {}
+        for pod in raw.pods:
+            if pod.scheduler_name != self.scheduler_name:
                 continue
+            jkey = self._job_key_for_pod(pod) or pod.key
+            if jkey not in snap.jobs:
+                pods_by_job.setdefault(jkey, []).append(pod)
+            node = pod.node_name
+            if node and node in rebuild_node_names:
+                pods_by_node.setdefault(node, []).append(pod)
+
+        for pg in rebuild_pgs:
+            job = JobInfo(uid=pg.key, podgroup=pg)
+            job.priority = self._priority_of(snap, pg.priority_class)
+            snap.jobs[pg.key] = job
+            for pod in pods_by_job.get(pg.key, ()):
+                self._make_task(snap, pod)
+        changed_jobs = {pg.key for pg in rebuild_pgs}
+        for jkey, pods in pods_by_job.items():
+            if jkey in snap.jobs:
+                continue            # a rebuilt podgroup consumed them
+            changed_jobs.add(jkey)
+            for pod in pods:
+                self._make_task(snap, pod)
+        # _make_task may mint shadow jobs under keys the grouping
+        # didn't predict (pod.owner fallbacks): count every job the
+        # base didn't have, or whose object was replaced, as changed
+        for jkey, job in snap.jobs.items():
+            if base.jobs.get(jkey) is not job:
+                changed_jobs.add(jkey)
+
+        total = base._total.clone() if base._total is not None else None
+        if gen % self._TOTAL_REFRESH_EVERY == 0:
+            total = None                    # periodic exact recompute
+        for node in rebuild_raw_nodes:
             ni = NodeInfo(node)
             snap.nodes[node.name] = ni
             for pod in pods_by_node.get(node.name, ()):
@@ -357,9 +537,44 @@ class SchedulerCache:
                          or task.status is TaskStatus.RELEASING):
                     ni.add_task(task)
             self._enrich_devices(ni)
+            if total is not None:
+                prev = base.nodes.get(node.name)
+                res = total.res
+                if prev is not None:
+                    for name, v in self._node_capacity(prev).res.items():
+                        left = res.get(name, 0.0) - v
+                        if left:
+                            res[name] = left
+                        else:
+                            res.pop(name, None)
+                total.add(self._node_capacity(ni))
+        snap._total = total if total is not None \
+            else snap.total_resource()
 
-        self._build_hypernodes(snap, raw)
-        return snap
+        # hypernodes: reuse unless a topology CR event fired or a
+        # rebuilt node's labels moved (membership can't change here)
+        labels_moved = any(
+            n.name in base.nodes
+            and base.nodes[n.name].node is not None
+            and base.nodes[n.name].node.labels is not n.labels
+            and base.nodes[n.name].node.labels != n.labels
+            for n in rebuild_raw_nodes)
+        hn_changed = hn_dirty or labels_moved or base.hypernodes is None
+        if hn_changed:
+            self._build_hypernodes(snap, raw)
+        else:
+            snap.hypernodes = base.hypernodes
+
+        removed_jobs = base.jobs.keys() - snap.jobs.keys()
+        changed_jobs -= removed_jobs
+        self._unsteady_jobs = {
+            k for k, j in snap.jobs.items() if not self._job_steady(j)}
+        delta = SnapshotDelta(
+            gen, changed_nodes=rebuild_node_names,
+            changed_jobs=changed_jobs,
+            removed_jobs=set(removed_jobs),
+            hypernodes_changed=hn_changed)
+        return snap, delta
 
     @staticmethod
     def _job_steady(job: JobInfo) -> bool:

@@ -42,6 +42,42 @@ def open_session(cache, conf: SchedulerConf) -> Session:
     return ssn
 
 
+def open_mirror_session(cache_stub, snapshot, conf: SchedulerConf
+                        ) -> Session:
+    """Session over a process-mirror snapshot — the worker half of
+    the process-pool sweep (actions/procpool.py).  Same plugin
+    construction as open_session minus the cache snapshot: resolution
+    of the prepared PreFilter/PreScore forms happens HERE, in the
+    worker, against shipped DATA only — callables never cross the
+    process boundary (the ship seam's pure pickler enforces it).
+    ``cache_stub`` carries the shipped read-only cluster maps plugins
+    consult at open (procpool.MirrorCache); mutation routes on it are
+    no-ops because workers only ever predicate and score."""
+    ssn = Session(cache_stub, snapshot, conf)
+    for tier in conf.tiers:
+        for opt in tier.plugins:
+            builder = get_plugin_builder(opt.name)
+            if builder is None:
+                log.warning("unknown plugin %s (skipped)", opt.name)
+                continue
+            plugin = builder(opt.arguments)
+            ssn.plugins[opt.name] = plugin
+            plugin.on_session_open(ssn)
+    # defense-in-depth when the race auditor is armed in the worker
+    # process: mirror snapshots freeze exactly like owner snapshots,
+    # so a worker-side write outside the replay seams is a recorded
+    # violation in the worker's own flushed report
+    freezeaudit.maybe_freeze_session(ssn)
+    return ssn
+
+
+def close_mirror_session(ssn: Session) -> None:
+    """Retire a mirror session before its snapshot absorbs the next
+    delta (plugin close hooks and the job updater are OWNER-side
+    duties; the worker only lifts the freeze)."""
+    freezeaudit.thaw_session(ssn)
+
+
 def close_session(ssn: Session) -> None:
     # lift the snapshot freeze first: plugin close hooks, the job
     # updater and the cache's post-session bookkeeping mutate freely

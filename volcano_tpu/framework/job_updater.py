@@ -18,11 +18,36 @@ from volcano_tpu.api.types import PodGroupPhase, TaskStatus
 QUEUE_ADMISSION_GATE = "volcano-tpu.io/queue-admission"
 
 
+def _scoped_jobs(ssn):
+    """Jobs a close-session publisher must examine.  On a delta-
+    tracked cache only jobs rebuilt this snapshot or touched this
+    session can need (re)publication: every gang-blocked job has
+    in-flight tasks, is therefore non-steady, and rebuilds into the
+    delta's changed set each cycle — while a steady job provably kept
+    whatever it published when it last changed.  Bare sessions and
+    full rebuilds walk everything (restart safety: a fresh process's
+    first snapshot is full, so stale annotations from a previous
+    incarnation still get cleared)."""
+    cache = getattr(ssn, "cache", None)
+    delta = getattr(cache, "last_delta", None)
+    if delta is None or delta.full or \
+            delta.gen != getattr(ssn, "snapshot_gen", None):
+        # no delta, a full rebuild, or a delta describing a NEWER
+        # snapshot than this session's (the cache snapshotted again
+        # underneath us — harness pattern): walk everything
+        return list(ssn.jobs.values())
+    keys = set(delta.changed_jobs)
+    keys.update(ssn.touched_jobs)
+    keys.update(ssn.dirty_jobs)
+    jobs = ssn.jobs
+    return [jobs[k] for k in keys if k in jobs]
+
+
 def remove_admission_gates(ssn) -> int:
     """Lift the queue-admission scheduling gate from pods of admitted
     podgroups (async in the reference; session-close here)."""
     removed = 0
-    for job in ssn.jobs.values():
+    for job in _scoped_jobs(ssn):
         pg = job.podgroup
         if pg is None or pg.phase is PodGroupPhase.PENDING:
             continue
@@ -55,7 +80,7 @@ def publish_scheduling_reasons(ssn) -> int:
     one session, so steady pending jobs cost no wire traffic."""
     published = 0
     blocked_keys = []
-    for job in ssn.jobs.values():
+    for job in _scoped_jobs(ssn):
         pg = job.podgroup
         gang_blocked = (pg is not None
                         and pg.phase in (PodGroupPhase.PENDING,

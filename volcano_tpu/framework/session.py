@@ -94,6 +94,21 @@ class Session:
         self.touched_nodes: Set[str] = set()
         self.touched_jobs: Set[str] = set()
 
+        # snapshot generation this session was opened over — the
+        # staleness token of the process-mirror protocol: every sweep
+        # row a pool worker returns is stamped with the generation it
+        # was computed against (actions/procpool.py)
+        self.snapshot_gen = getattr(snapshot, "gen", 0)
+        # within-cycle mutation journal: the 5 state primitives append
+        # one compact op each, and the process pool ships the unsent
+        # suffix to its mirror workers before every fan-out — workers
+        # replay the ops through their OWN session's primitives, so a
+        # mid-cycle sweep sees the same in-session view the owner
+        # does.  Always recorded (a tuple append per placement is
+        # noise next to the placement itself).
+        self.mirror_log: List[tuple] = []
+        self.mirror_shipped = 0
+
         # gangpreempt nominations made this session (job uid -> subjob
         # name -> hypernode), consumed by allocate next cycle.
         self.nominations: Dict[str, Dict[str, str]] = {}
@@ -523,6 +538,7 @@ class Session:
     def allocate(self, task: TaskInfo, node: NodeInfo):
         """Assign task to node with resources consumed now."""
         job = self.jobs[task.job]
+        self.mirror_log.append(("alloc", job.uid, task.uid, node.name))
         task.node_name = node.name
         if task.uid in node.tasks:
             node.update_task_status(task, TaskStatus.ALLOCATED)
@@ -540,6 +556,7 @@ class Session:
     def pipeline(self, task: TaskInfo, node: NodeInfo):
         """Assign task onto resources that are still being released."""
         job = self.jobs[task.job]
+        self.mirror_log.append(("pipe", job.uid, task.uid, node.name))
         task.node_name = node.name
         job.update_task_status(task, TaskStatus.PIPELINED)
         node.add_task(task)
@@ -553,6 +570,7 @@ class Session:
     def evict(self, task: TaskInfo, reason: str = ""):
         """Mark a running task as releasing (in-session view)."""
         job = self.jobs[task.job]
+        self.mirror_log.append(("evict", job.uid, task.uid))
         job.update_task_status(task, TaskStatus.RELEASING)
         node = self.nodes.get(task.node_name)
         if node is not None:
@@ -567,6 +585,7 @@ class Session:
     def deallocate(self, task: TaskInfo):
         """Undo an in-session allocate/pipeline (statement discard)."""
         job = self.jobs[task.job]
+        self.mirror_log.append(("dealloc", job.uid, task.uid))
         node = self.nodes.get(task.node_name)
         if node is not None:
             node.remove_task(task)
@@ -583,6 +602,8 @@ class Session:
         """Undo an in-session evict: restore the pre-evict status."""
         restore = prev_status or TaskStatus.RUNNING
         job = self.jobs[task.job]
+        self.mirror_log.append(
+            ("unevict", job.uid, task.uid, restore))
         job.update_task_status(task, restore)
         node = self.nodes.get(task.node_name)
         if node is not None:

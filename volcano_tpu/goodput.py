@@ -251,6 +251,95 @@ def _slice_stats_from_session(ssn) -> List[SliceStat]:
     return list(stats.values())
 
 
+def _node_frag_contrib(node) -> Optional[tuple]:
+    """One node's contribution to its slice's fragmentation stats:
+    (slice, domain, generation, chips, idle, bad) — *bad* is 1 when
+    the node breaks the slice's whole-idle property.  None for nodes
+    outside any slice."""
+    from volcano_tpu.api.types import TPU_SLICE_LABEL
+    from volcano_tpu.controllers.hypernode import DCN_POD_LABEL
+    raw = node.node
+    if raw is None:
+        return None
+    sl = raw.labels.get(TPU_SLICE_LABEL)
+    if not sl:
+        return None
+    chips = float(node.allocatable.get(TPU))
+    used = float(node.used.get(TPU))
+    bad = 1 if (used > 0 or node.tasks or not node.ready) else 0
+    return (sl, raw.labels.get(DCN_POD_LABEL, ""),
+            generation_of(raw.labels), chips,
+            max(0.0, chips - used), bad)
+
+
+def _slice_stats_incremental(ssn) -> Optional[List[SliceStat]]:
+    """Slice stats off a per-node contribution memo kept on the
+    scheduler cache, updated only for nodes the snapshot delta or
+    this session's own mutations touched — the observe pass used to
+    re-walk all 100k nodes every cycle.  Returns None when there is
+    no cache/delta to key the memo on (bare harness sessions) so the
+    caller falls back to the full walk."""
+    cache = getattr(ssn, "cache", None)
+    delta = getattr(cache, "last_delta", None)
+    if delta is None:
+        return None
+    memo = getattr(cache, "_frag_memo", None)
+    contrib: Dict[str, tuple]
+    if memo is None or delta.full or \
+            memo[0] not in (delta.gen - 1, delta.gen):
+        contrib = {}
+        agg: Dict[str, list] = {}
+        for name, node in ssn.nodes.items():
+            c = _node_frag_contrib(node)
+            if c is None:
+                continue
+            contrib[name] = c
+            _frag_fold(agg, c, 1)
+    else:
+        _gen, contrib, agg = memo
+        changed = set(delta.changed_nodes)
+        changed.update(delta.removed_nodes)
+        changed.update(getattr(ssn, "touched_nodes", ()))
+        for name in changed:
+            old = contrib.pop(name, None)
+            if old is not None:
+                _frag_fold(agg, old, -1)
+            node = ssn.nodes.get(name)
+            if node is None:
+                continue
+            c = _node_frag_contrib(node)
+            if c is not None:
+                contrib[name] = c
+                _frag_fold(agg, c, 1)
+    cache._frag_memo = (delta.gen, contrib, agg)
+    out = []
+    for sl, row in agg.items():
+        st = SliceStat(sl, row[0], row[1])
+        st.chips = row[2]
+        st.idle_chips = row[3]
+        st.whole_idle = row[4] == 0
+        out.append(st)
+    return out
+
+
+def _frag_fold(agg: Dict[str, list], c: tuple, sign: int) -> None:
+    sl, domain, gen, chips, idle, bad = c
+    row = agg.get(sl)
+    if row is None:
+        row = agg[sl] = [domain, gen, 0.0, 0.0, 0, 0]
+    elif sign > 0:
+        # a refreshed contribution carries the slice's CURRENT
+        # domain/generation labels — an in-place relabel must not
+        # stay attributed to the retired generation forever
+        row[0], row[1] = domain, gen
+    row[2] += sign * chips
+    row[3] += sign * idle
+    row[4] += sign * bad
+    row[5] += sign            # resident node count
+    if row[5] <= 0:
+        agg.pop(sl, None)
+
+
 def _slice_stats_from_cluster(nodes, pods) -> List[SliceStat]:
     """Same stats off raw store objects (vtpctl's path: works against
     a state file or a mirror, no scheduler required)."""
@@ -378,7 +467,10 @@ def observe_session(ssn, now: Optional[float] = None) -> dict:
     previous session's export or this one's, never half).  Returns
     the computed document (the dumper embeds it)."""
     now = time.time() if now is None else now
-    frag = fragmentation(_slice_stats_from_session(ssn))
+    stats = _slice_stats_incremental(ssn)
+    if stats is None:
+        stats = _slice_stats_from_session(ssn)
+    frag = fragmentation(stats)
     starve = starvation_ages(ssn, now)
 
     jobs_reporting = 0
