@@ -1118,6 +1118,35 @@ def bench_scale_100k(n_slices: int = 1563, gang: int = 8192,
             # the reuse — path every cycle, by design)
             cluster.tick()
 
+        # -- batched gang commit row (docs/design/sharding.md) ---------
+        # same gang, same fleet, but the allocator drains the whole
+        # spec through one heap + fill-to-capacity statement instead
+        # of the per-pod walk: the serial row above is its baseline
+        sched.conf.configurations["allocate"] = {"gangCommit": "batch"}
+        sched.run_once()                   # absorb prior dirty state
+        pg, pods = gang_job("g-gc", replicas=gang, min_available=gang,
+                            requests={"cpu": 8, TPU: 4})
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+        trace.reset()
+        t0 = time.perf_counter()
+        sched.run_once()
+        gc_s = time.perf_counter() - t0
+        bound = sum(1 for k, _ in cluster.binds
+                    if k.startswith("default/g-gc"))
+        assert bound == gang, f"gang commit bound {bound}/{gang}"
+        walk_s = cycles["serial"][f"gang{gang}_cycle_s"]
+        cycles["gang_commit_batch"] = {
+            f"gang{gang}_cycle_s": round(gc_s, 4),
+            "speedup_vs_walk": round(walk_s / gc_s, 2)}
+        kept = trace.recent_traces(limit=1)
+        if kept:
+            waterfall["gang_commit_batch"] = _span_waterfall(kept[-1])
+        print(f"  gang_commit_batch: gang{gang} {gc_s:.3f}s "
+              f"({walk_s / gc_s:.2f}x vs walk)", flush=True)
+        cluster.tick()
+
         # -- per-spec sweep rows: disarmed then armed ------------------
         pg, pods = gang_job("probe", replicas=gang,
                             min_available=gang,
@@ -1144,6 +1173,84 @@ def bench_scale_100k(n_slices: int = 1563, gang: int = 8192,
         close_session(ssn)
         audit = freezeaudit.report()
         freezeaudit.uninstall()
+
+        # -- sharded plane rows (docs/design/sharding.md) --------------
+        # N subtree-sharded schedulers over the SAME 100k-host fleet,
+        # batched commit on, the 8192-pod load split into 8 gangs of
+        # 1024 so the stable job->shard hash spreads them.  Each
+        # shard's cycle is timed on its own: on a real plane the
+        # shards run on separate hosts in parallel, so
+        # max_shard_cycle_s is the plane's wall-clock; here they
+        # serialize on one core (host_cpus recorded per row).
+        from volcano_tpu import shardmap
+
+        def _drain_gang(prefix):
+            # free the chips a finished bench gang holds: the fleet
+            # only has 40% headroom, and each plane below needs the
+            # full 8192x4 chips back
+            for key in [k for k in cluster.pods
+                        if k.startswith(f"default/{prefix}")]:
+                cluster.delete_object("pod", key)
+            for key in [k for k in cluster.podgroups
+                        if k.startswith(f"default/{prefix}")]:
+                cluster.delete_object("podgroup", key)
+
+        for prefix in ("g-serial", "g-thread", "g-process", "g-gc",
+                       "probe"):
+            _drain_gang(prefix)
+        sharded = {}
+        for count in (2, 4):
+            scheds = []
+            for si in range(count):
+                sconf = copy.deepcopy(BENCH_CONF)
+                sconf["configurations"] = {"allocate": {
+                    "gangCommit": "batch", "shard-spill": "soft"}}
+                s = Scheduler(cluster, conf=sconf, schedule_period=0,
+                              shard_index=si, shard_count=count)
+                s.run_once()             # warm full snapshot
+                scheds.append(s)
+            njobs = 8
+            names = [f"gs{count}-{i}" for i in range(njobs)]
+            homes = {n: shardmap.home_shard(f"default/{n}", count)
+                     for n in names}
+            for n in names:
+                pg, pods = gang_job(n, replicas=gang // njobs,
+                                    min_available=gang // njobs,
+                                    requests={"cpu": 8, TPU: 4})
+                cluster.add_podgroup(pg)
+                for p in pods:
+                    cluster.add_pod(p)
+            srows = []
+            bound_total = 0
+            for si, s in enumerate(scheds):
+                mine = [n for n in names if homes[n] == si]
+                t0 = time.perf_counter()
+                s.run_once()
+                dt = time.perf_counter() - t0
+                bound = sum(1 for k, _ in cluster.binds
+                            if any(k.startswith(f"default/{n}-")
+                                   for n in mine))
+                bound_total += bound
+                srows.append({"shard": f"{si}/{count}",
+                              "gangs_homed": len(mine),
+                              "pods_bound": bound,
+                              "cycle_s": round(dt, 4),
+                              "host_cpus": _os.cpu_count()})
+                print(f"  shard {si}/{count}: {len(mine)} gangs, "
+                      f"{bound} pods, cycle {dt:.3f}s", flush=True)
+            assert bound_total == gang, \
+                f"sharded plane bound {bound_total}/{gang}"
+            sharded[str(count)] = {
+                "per_shard": srows,
+                "max_shard_cycle_s": max(r["cycle_s"] for r in srows),
+                "sum_shard_cycle_s": round(
+                    sum(r["cycle_s"] for r in srows), 4)}
+            cluster.tick()
+            for s in scheds:
+                cluster.unwatch(s.cache._on_cluster_event)
+            del scheds
+            _drain_gang(f"gs{count}-")
+            gc.collect()
     finally:
         gc.unfreeze()
         procpool.shutdown()
@@ -1154,6 +1261,7 @@ def bench_scale_100k(n_slices: int = 1563, gang: int = 8192,
         "gang": gang,
         "cycles": cycles,
         "waterfall_s": waterfall,
+        "sharded_plane": sharded,
         "entry_rows_disarmed": rows,
         "entry_rows_armed": armed_rows,
         "entries_identical_all_backends_all_worker_counts":
@@ -2877,6 +2985,355 @@ def chaos_smoke() -> int:
 
 
 # ---------------------------------------------------------------------
+# Sharded both planes (docs/design/sharding.md): 2 subtree-partitioned
+# scheduler processes over 2 keyspace-partitioned leader groups, all
+# real OS processes.  One gang per home shard, then one cross-shard
+# gang (homed to the full shard, soft-spilled onto the other shard's
+# subtree).  The same workload replays on a single-shard plane and
+# the per-job node placements must be IDENTICAL — sharding buys
+# parallelism, never a different answer.
+
+def _shard_smoke_conf(logdir: str) -> str:
+    import copy
+    import os
+
+    conf = copy.deepcopy(BENCH_CONF)
+    conf["configurations"] = {"allocate": {"gangCommit": "batch",
+                                           "shard-spill": "soft"}}
+    path = os.path.join(logdir, "conf.json")   # JSON is valid YAML
+    with open(path, "w") as f:
+        json.dump(conf, f)
+    return path
+
+
+def _shard_smoke_topology(kubectl) -> int:
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.controllers.hypernode import LabelDiscoverer
+    from volcano_tpu.simulator import slice_nodes
+
+    nodes = []
+    for name in ("sa", "sb", "sc"):
+        nodes.extend(slice_nodes(slice_for(name, "v5e-16")))
+    for n in nodes:
+        kubectl.add_node(n)
+    # hypernodes via the label-discovery derivation the controller
+    # itself would run
+    for hn in LabelDiscoverer().discover(nodes):
+        kubectl.add_hypernode(hn)
+    return len(nodes)
+
+
+def _shard_smoke_submit(kubectl, name: str, replicas: int) -> None:
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.api.types import PodGroupPhase
+    from volcano_tpu.uthelper import gang_job
+
+    pg, pods = gang_job(name, replicas=replicas,
+                        requests={"cpu": 1, TPU: 4},
+                        pg_phase=PodGroupPhase.INQUEUE)
+    kubectl.add_podgroup(pg)
+    for p in pods:
+        kubectl.add_pod(p)
+
+
+def _shard_smoke_wait_bound(kubectl, name: str, replicas: int,
+                            plane, timeout: float = 40.0) -> dict:
+    from volcano_tpu.api.types import TaskStatus
+
+    want = {f"default/{name}-{i}" for i in range(replicas)}
+
+    def bound():
+        pods = kubectl.pods
+        return all(
+            k in pods and pods[k].node_name
+            and pods[k].phase in (TaskStatus.BOUND, TaskStatus.RUNNING)
+            for k in want)
+    _wire_wait(bound, timeout,
+               lambda: f"{name} bound ({plane.log_tails()[-1200:]})")
+    pods = kubectl.pods
+    return {k: pods[k].node_name for k in want}
+
+
+def _healthz(url: str) -> bool:
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url + "/healthz", timeout=1):
+            return True
+    except OSError:
+        return False
+
+
+def _shard_smoke_run_plane(sharded: bool) -> dict:
+    """Boot one plane (2 leader groups + 2 sharded schedulers, or
+    1 server + 1 scheduler), run the 3-gang workload, return per-job
+    sorted placements plus plane observables."""
+    import socket
+
+    from volcano_tpu import shardmap
+    from volcano_tpu.cache.partitioned import PartitionedCluster
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+
+    plane = _WirePlane()
+    kubectl = None
+    try:
+        conf_path = _shard_smoke_conf(plane.logdir)
+        urls = [plane.url]
+        plane.spawn("server-g0", "-m", "volcano_tpu.server",
+                    "--port", str(plane.port), "--tick-period", "0.05")
+        if sharded:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                port2 = s.getsockname()[1]
+            urls.append(f"http://127.0.0.1:{port2}")
+            plane.spawn("server-g1", "-m", "volcano_tpu.server",
+                        "--port", str(port2), "--tick-period", "0.05")
+        for u in urls:
+            _wire_wait(lambda u=u: _healthz(u), 20,
+                       f"state server {u}")
+        endpoints = ";".join(urls)
+        if sharded:
+            for idx in (0, 1):
+                plane.spawn(f"sched-{idx}", "-m", "volcano_tpu",
+                            "--cluster-url", endpoints,
+                            "--components", "scheduler",
+                            "--period", "0.05", "--conf", conf_path,
+                            "--shard-index", str(idx),
+                            "--shard-count", "2")
+            kubectl = PartitionedCluster(endpoints)
+        else:
+            plane.spawn("sched", "-m", "volcano_tpu",
+                        "--cluster-url", endpoints,
+                        "--components", "scheduler",
+                        "--period", "0.05", "--conf", conf_path)
+            kubectl = RemoteCluster(endpoints)
+
+        hosts = _shard_smoke_topology(kubectl)
+        out = {"hosts": hosts, "sharded": sharded, "jobs": {}}
+        # ga is homed to shard 0 (owns sa+sc), gb to shard 1 (owns
+        # sb) — stable-hash facts asserted, not assumed; gx is the
+        # cross-shard gang: its home subtree is full by the time it
+        # arrives, so the home shard soft-spills it wholly onto the
+        # other shard's free subtree
+        assert shardmap.home_shard("default/ga", 2) == 0
+        assert shardmap.home_shard("default/gb", 2) == 1
+        plan = shardmap.plan_partition(
+            shardmap.subtree_map(kubectl.nodes.values()), 2)
+        assert plan[0]["subtrees"] == ["sa", "sc"], plan
+        assert plan[1]["subtrees"] == ["sb"], plan
+
+        rv0 = None
+        if sharded:
+            rv0 = [g._request("GET", "/durability").get("rv", 0)
+                   for g in kubectl.groups]
+        for name, replicas in (("ga", 4), ("gb", 4), ("gx", 4)):
+            _shard_smoke_submit(kubectl, name, replicas)
+            placed = _shard_smoke_wait_bound(kubectl, name, replicas,
+                                             plane)
+            out["jobs"][name] = sorted(placed.values())
+        # the workload's shape proves the contract: ga fills its home
+        # subtree, gb fills its OWN home subtree (not spillover), gx
+        # lands wholly on the foreign free subtree
+        assert all(n.startswith("sa-") for n in out["jobs"]["ga"]), out
+        assert all(n.startswith("sb-") for n in out["jobs"]["gb"]), out
+        assert all(n.startswith("sc-") for n in out["jobs"]["gx"]), out
+
+        if sharded:
+            # both shards scheduled (their stamped cycle traces made
+            # it to the meta ring) ...
+            traces = kubectl._request(
+                "GET", "/traces?limit=64").get("traces", [])
+            shards_seen = {(t.get("root", {}).get("labels") or {})
+                           .get("shard") for t in traces}
+            out["sched_shards_traced"] = sorted(
+                s for s in shards_seen if s)
+            assert {"0/2", "1/2"} <= shards_seen, shards_seen
+            # ... and BOTH leader groups absorbed writes: gb's binds
+            # relocated its pods onto group 1's keyspace
+            rv1 = [g._request("GET", "/durability").get("rv", 0)
+                   for g in kubectl.groups]
+            out["leader_group_rv_delta"] = [
+                b - a for a, b in zip(rv0, rv1)]
+            assert all(d > 0 for d in out["leader_group_rv_delta"]), \
+                out["leader_group_rv_delta"]
+            out["endpoints_shape"] = "g0;g1"
+        return out
+    finally:
+        if kubectl is not None:
+            kubectl.close()
+        plane.shutdown()
+
+
+def bench_shard_smoke() -> dict:
+    sharded = _shard_smoke_run_plane(sharded=True)
+    single = _shard_smoke_run_plane(sharded=False)
+    identical = sharded["jobs"] == single["jobs"]
+    return {
+        "ok": identical,
+        "placements_identical": identical,
+        "sharded": sharded,
+        "single": single,
+    }
+
+
+_QPS_WRITE_WORKER = r'''
+import sys, time
+spec, subtree, dur = sys.argv[1], sys.argv[2], float(sys.argv[3])
+from volcano_tpu.api.pod import make_pod
+from volcano_tpu.api.types import TaskStatus
+if ";" in spec:
+    from volcano_tpu.cache.partitioned import PartitionedCluster
+    c = PartitionedCluster(spec)
+else:
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    c = RemoteCluster(spec)
+t_end = time.monotonic() + 10
+nodes = []
+while time.monotonic() < t_end:
+    nodes = sorted(n for n in c.nodes if n.startswith(subtree + "-"))
+    if len(nodes) >= 4:
+        break
+    time.sleep(0.05)
+assert nodes, f"no {subtree} nodes visible"
+n = 0
+t_end = time.monotonic() + dur
+while time.monotonic() < t_end:
+    p = make_pod("t", requests={"cpu": 1})
+    p.name = f"qw-{subtree}-{n % 64}"
+    p.namespace = "default"
+    p.node_name = nodes[n % len(nodes)]
+    p.phase = TaskStatus.BOUND
+    try:
+        c.put_object("pod", p)
+        n += 1
+    except Exception:
+        pass
+c.close()
+print(n)
+'''
+
+
+def bench_leader_write_qps(groups: int = 3, writers: int = 3,
+                           measure_s: float = 5.0) -> dict:
+    """The write-capacity row (docs/design/sharding.md): the same
+    keyed pod-status churn — the dominant production write — pushed
+    by N writer OS processes against ONE write leader, then against
+    the keyspace split across `groups` single-leader groups.  Each
+    writer churns one subtree, so under the partitioned config its
+    writes route to that subtree's owner group; aggregate QPS is
+    measured server-side as sum(rv delta)/window, never from client
+    counters.  host_cpus recorded per row: on a single core the
+    groups serialize, so this row measures protocol capacity split,
+    not hardware parallelism."""
+    import json as _json
+    import os as _os
+    import socket
+    import subprocess
+    import sys as _sys
+    import urllib.request
+
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.cache.partitioned import PartitionedCluster
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.simulator import slice_nodes
+
+    subtrees = [f"q{chr(ord('a') + i)}" for i in range(writers)]
+
+    def rv_of(url):
+        with urllib.request.urlopen(url + "/durability",
+                                    timeout=5) as r:
+            return int(_json.loads(r.read()).get("rv") or 0)
+
+    def run_config(n_groups):
+        plane = _WirePlane()
+        kubectl = None
+        procs = []
+        try:
+            urls = []
+            for gi in range(n_groups):
+                if gi == 0:
+                    port = plane.port
+                else:
+                    with socket.socket() as s:
+                        s.bind(("127.0.0.1", 0))
+                        port = s.getsockname()[1]
+                plane.spawn(f"server-g{gi}", "-m",
+                            "volcano_tpu.server", "--port", str(port),
+                            "--tick-period", "0.2")
+                urls.append(f"http://127.0.0.1:{port}")
+            for u in urls:
+                _wire_wait(lambda u=u: _healthz(u), 20,
+                           f"state server {u}")
+            spec = ";".join(urls)
+            kubectl = PartitionedCluster(spec) if n_groups > 1 \
+                else RemoteCluster(spec)
+            for sname in subtrees:
+                for node in slice_nodes(slice_for(sname, "v5e-16"),
+                                        dcn_pod="d0"):
+                    kubectl.put_object("node", node)
+            env = dict(_os.environ, PYTHONPATH=plane.repo,
+                       JAX_PLATFORMS="cpu")
+            procs = [subprocess.Popen(
+                [_sys.executable, "-c", _QPS_WRITE_WORKER, spec,
+                 subtrees[w % len(subtrees)], str(measure_s + 3.0)],
+                stdout=subprocess.PIPE, text=True, env=env,
+                cwd=plane.repo) for w in range(writers)]
+            time.sleep(2.0)        # workers connect + mirrors sync
+            rv0 = [rv_of(u) for u in urls]
+            t0 = time.monotonic()
+            time.sleep(measure_s)
+            dt = time.monotonic() - t0
+            rv1 = [rv_of(u) for u in urls]
+            ops = sum(int(p.communicate()[0].strip() or 0)
+                      for p in procs)
+            deltas = [b - a for a, b in zip(rv0, rv1)]
+            row = {"groups": n_groups, "writers": writers,
+                   "host_cpus": _os.cpu_count(),
+                   "per_group_rv_delta": deltas,
+                   "write_qps": round(sum(deltas) / dt, 1),
+                   "writer_ops_total": ops}
+            if n_groups > 1:
+                row["layout"] = kubectl.shard_layout()
+            return row
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            if kubectl is not None:
+                kubectl.close()
+            plane.shutdown()
+
+    one = run_config(1)
+    split = run_config(groups)
+    return {
+        "write_load": f"{writers} writer processes, keyed bound-pod "
+                      "status churn, one subtree each",
+        "measure_s": measure_s,
+        "single_leader": one,
+        "partitioned_leaders": split,
+        "scaling": round(split["write_qps"] / one["write_qps"], 2)
+        if one["write_qps"] else None,
+        "note": ("single-CPU host: all leader groups share one core, "
+                 "so this row proves the keyspace split carries the "
+                 "full write stream with per-group leaders — the "
+                 "hardware-parallel win needs a multi-core replay"),
+    }
+
+
+def shard_smoke() -> int:
+    """Seconds-scale sharded-plane drill for tier-1: 2 scheduler
+    shards + 2 leader groups as real OS processes, one cross-shard
+    gang, placements identical to the single-shard plane.  Prints one
+    JSON line."""
+    try:
+        out = bench_shard_smoke()
+        ok = out.get("ok", False)
+    except AssertionError as e:
+        out, ok = {"error": str(e)[-600:]}, False
+    print(json.dumps({"metric": "shard_smoke", "ok": ok, **out}))
+    return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------
 # Replicated control plane (server/replication.py): WAL-shipped
 # follower reads, quorum commit, kill-promote.  The tier-1 smoke runs
 # leader + 1 follower as real OS processes (~20s): continuous keyed
@@ -3750,6 +4207,8 @@ if __name__ == "__main__":
         sys.exit(crash_smoke())
     elif "--chaos-smoke" in sys.argv:
         sys.exit(chaos_smoke())
+    elif "--shard-smoke" in sys.argv:
+        sys.exit(shard_smoke())
     elif "--replication-smoke" in sys.argv:
         sys.exit(replication_smoke())
     elif "--trace-smoke" in sys.argv:
@@ -3783,10 +4242,15 @@ if __name__ == "__main__":
     elif "--scale-100k" in sys.argv:
         # the SCALE100K_r{N}.json artifact (ROADMAP item 3): 100k
         # hosts, idle + 8192-gang cycles per sweep backend with
-        # flight-recorder waterfalls, per-worker-count entry rows
-        # bit-identical to serial (disarmed + armed), and the 40k
-        # idle-cycle acceptance row
-        print(json.dumps({"metric": "scale_100k_hosts",
-                          **bench_scale_100k()}))
+        # flight-recorder waterfalls, the batched gang-commit row,
+        # per-shard cycle rows under 2- and 4-shard planes,
+        # per-worker-count entry rows bit-identical to serial
+        # (disarmed + armed), the 40k idle-cycle acceptance row, and
+        # the leader-group write-QPS scaling row (real OS servers)
+        doc = {"metric": "scale_100k_hosts", **bench_scale_100k()}
+        print("leader write-QPS scaling (3 groups vs 1)...",
+              flush=True)
+        doc["leader_write_qps"] = bench_leader_write_qps()
+        print(json.dumps(doc))
     else:
         main()

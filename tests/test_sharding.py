@@ -1,0 +1,532 @@
+"""Sharding both planes: the subtree partition map, the batched gang
+commit drain, subtree-sharded schedulers, cross-shard conflict
+arbitration on the wire, and the keyspace-partitioned write plane.
+
+The partition key is one deliberate choice tested here from both
+sides: scheduler shards and write-leader groups split by the SAME
+topology subtrees (shardmap.py), so a gang's binds land on the leader
+group owning its slice and two shards can only collide where one of
+them deliberately spilled.
+"""
+
+import time
+
+import pytest
+
+from volcano_tpu import metrics
+from volcano_tpu import shardmap
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.pod import make_pod
+from volcano_tpu.api.queue import Queue
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.types import (GROUP_NAME_ANNOTATION, PodGroupPhase,
+                                   TaskStatus)
+from volcano_tpu.cache.remote_cluster import RemoteCluster
+from volcano_tpu.server.state_server import serve
+from volcano_tpu.simulator import make_tpu_cluster
+from volcano_tpu.uthelper import TestContext, gang_job
+
+
+# ---------------------------------------------------------------- map
+
+def _subtrees(names_per_subtree):
+    out = {}
+    for subtree, names in names_per_subtree.items():
+        for n in names:
+            out[n] = subtree
+    return out
+
+
+def test_plan_partition_disjoint_exhaustive_deterministic():
+    subtrees = _subtrees({
+        "sa": [f"sa-w{i}" for i in range(4)],
+        "sb": [f"sb-w{i}" for i in range(4)],
+        "sc": [f"sc-w{i}" for i in range(2)],
+        shardmap.FLAT_SUBTREE: ["cpu0"],
+    })
+    plan = shardmap.plan_partition(subtrees, 3)
+    assert [row["shard"] for row in plan] == [0, 1, 2]
+    owned = [set(row["nodes"]) for row in plan]
+    # disjoint ...
+    for i in range(3):
+        for j in range(i + 1, 3):
+            assert not owned[i] & owned[j]
+    # ... exhaustive ...
+    assert set().union(*owned) == set(subtrees)
+    # ... never splits a subtree ...
+    for row in plan:
+        for name in row["nodes"]:
+            assert subtrees[name] in row["subtrees"]
+    # ... and deterministic (the routing table every process derives
+    # independently must agree)
+    assert plan == shardmap.plan_partition(dict(reversed(
+        list(subtrees.items()))), 3)
+    # owner_index is the inverse view of the same plan
+    owners = shardmap.owner_index(subtrees, 3)
+    for row in plan:
+        assert all(owners[n] == row["shard"] for n in row["nodes"])
+    for idx in range(3):
+        assert shardmap.owned_nodes(subtrees, 3, idx) == owned[idx]
+
+
+def test_home_shard_stable_and_in_range():
+    keys = [f"default/job-{i}" for i in range(64)]
+    homes = [shardmap.home_shard(k, 4) for k in keys]
+    assert homes == [shardmap.home_shard(k, 4) for k in keys]
+    assert set(homes) <= set(range(4))
+    # every shard gets some jobs at this scale (the hash spreads)
+    assert len(set(homes)) == 4
+    assert shardmap.home_shard("default/x", 1) == 0
+
+
+def test_subtree_of_flat_fallback():
+    assert shardmap.subtree_of(None) == shardmap.FLAT_SUBTREE
+    assert shardmap.subtree_of({}) == shardmap.FLAT_SUBTREE
+    assert shardmap.subtree_of(
+        {shardmap.TPU_SLICE_LABEL: "sa"}) == "sa"
+
+
+# --------------------------------------------- batched gang commit
+
+def _gang_ctx(gang_commit, slices=(("sa", "v5e-16"), ("sb", "v5e-16")),
+              jobs=(("ga", 8),)):
+    cluster = make_tpu_cluster(list(slices))
+    cluster.add_queue(Queue(name="default"))
+    for name, replicas in jobs:
+        pg, pods = gang_job(name, replicas=replicas,
+                            requests={"cpu": 1, TPU: 4},
+                            pg_phase=PodGroupPhase.INQUEUE)
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+    # the bench tier stack (incl. the topology scorer): the batch
+    # drain's fill-to-capacity contract is placement-identical to the
+    # walk under binpack/topology-compact scoring, and that is the
+    # stack the drain exists for
+    conf = {"actions": "enqueue, allocate, backfill",
+            "tiers": [
+                {"plugins": [{"name": "priority"}, {"name": "gang"},
+                             {"name": "conformance"}]},
+                {"plugins": [{"name": "overcommit"}, {"name": "drf"},
+                             {"name": "predicates"},
+                             {"name": "proportion"},
+                             {"name": "nodeorder"},
+                             {"name": "binpack"},
+                             {"name": "deviceshare"},
+                             {"name": "network-topology-aware"}]},
+            ],
+            "configurations": {"allocate": {"gangCommit": gang_commit}}}
+    return TestContext(cluster=cluster, conf=conf)
+
+
+def test_batch_commit_places_identically_to_walk():
+    # replicas of one spec are interchangeable (that is the batch
+    # contract), so identity means the same node multiset — which
+    # pod name lands on which of the equivalent hosts tracks task
+    # iteration order, not placement quality
+    walk = _gang_ctx("walk")
+    walk.run()
+    batch = _gang_ctx("batch")
+    batch.run()
+    assert sorted(walk.bind_map.values()) == \
+        sorted(batch.bind_map.values())
+    assert len(batch.bind_map) == 8
+
+
+def test_batch_commit_gang_all_or_nothing():
+    # 5 x 4 chips > one 16-chip slice: the gang cannot seat, the
+    # statement must discard — no partial binds leak
+    ctx = _gang_ctx("batch", slices=(("sa", "v5e-16"),),
+                    jobs=(("ga", 5),))
+    ctx.run()
+    ctx.expect_bind_num(0)
+    job = next(iter(ctx.last_session.jobs.values()))
+    assert job.fit_errors, "leftover tasks must carry fit errors"
+
+
+def test_batch_commit_multi_spec_and_bare_pods():
+    # two specs plus a spec-less bare pod in one podgroup: specs drain
+    # batched, the bare pod falls back to the walk — all seated
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    cluster.add_queue(Queue(name="default"))
+    pg, pods = gang_job("ga", replicas=2, requests={"cpu": 1, TPU: 4},
+                        pg_phase=PodGroupPhase.INQUEUE)
+    for i, p in enumerate(pods):
+        p.task_spec = f"spec{i}"
+    bare = make_pod("ga-bare", requests={"cpu": 1},
+                    annotations={GROUP_NAME_ANNOTATION: "ga"})
+    pg.min_member = 3
+    cluster.add_podgroup(pg)
+    for p in pods + [bare]:
+        cluster.add_pod(p)
+    conf = {"actions": "enqueue, allocate, backfill",
+            "tiers": [
+                {"plugins": [{"name": "priority"}, {"name": "gang"},
+                             {"name": "conformance"}]},
+                {"plugins": [{"name": "overcommit"},
+                             {"name": "predicates"},
+                             {"name": "proportion"},
+                             {"name": "nodeorder"},
+                             {"name": "binpack"}]},
+            ],
+            "configurations": {"allocate": {"gangCommit": "batch"}}}
+    ctx = TestContext(cluster=cluster, conf=conf)
+    ctx.run()
+    ctx.expect_bind_num(3)
+
+
+# --------------------------------------------- subtree-sharded plane
+
+def _shard_ctx(cluster, idx, count, spill="soft"):
+    conf = {"actions": "enqueue, allocate, backfill",
+            "tiers": [
+                {"plugins": [{"name": "priority"}, {"name": "gang"},
+                             {"name": "conformance"}]},
+                {"plugins": [{"name": "overcommit"},
+                             {"name": "predicates"},
+                             {"name": "proportion"},
+                             {"name": "nodeorder"},
+                             {"name": "binpack"}]},
+            ],
+            "configurations": {"allocate": {
+                "shard-mode": "subtree", "shard-index": idx,
+                "shard-count": count, "shard-spill": spill}}}
+    return TestContext(cluster=cluster, conf=conf)
+
+
+def test_two_shards_own_disjoint_subtrees_and_split_jobs():
+    # home_shard("default/ga", 2) == 0, ("default/gb") == 1 (stable
+    # hash); plan gives slice sa -> shard 0, sb -> shard 1
+    assert shardmap.home_shard("default/ga", 2) == 0
+    assert shardmap.home_shard("default/gb", 2) == 1
+
+    cluster = make_tpu_cluster([("sa", "v5e-16"), ("sb", "v5e-16")])
+    cluster.add_queue(Queue(name="default"))
+    for name in ("ga", "gb"):
+        pg, pods = gang_job(name, replicas=4,
+                            requests={"cpu": 1, TPU: 4},
+                            pg_phase=PodGroupPhase.INQUEUE)
+        cluster.add_podgroup(pg)
+        for p in pods:
+            cluster.add_pod(p)
+
+    shard0 = _shard_ctx(cluster, 0, 2)
+    shard0.run()
+    # shard 0 schedules ONLY its homed gang, onto its owned subtree
+    bound0 = dict(shard0.bind_map)
+    assert set(bound0) == {f"default/ga-{i}" for i in range(4)}
+    assert all(n.startswith("sa-") for n in bound0.values())
+
+    shard1 = _shard_ctx(cluster, 1, 2)
+    shard1.run()
+    bound1 = {k: v for k, v in shard1.bind_map.items()
+              if k not in bound0}
+    assert set(bound1) == {f"default/gb-{i}" for i in range(4)}
+    assert all(n.startswith("sb-") for n in bound1.values())
+
+
+def test_shard_soft_spill_crosses_subtree_when_home_is_full():
+    # gb is homed to shard 1 whose subtree (sb) is too small for it;
+    # soft spill lets the shard place the tail optimistically on
+    # foreign nodes — the server's check-and-bind arbitrates for real
+    cluster = make_tpu_cluster([("sa", "v5e-16"), ("sb", "v5e-16")])
+    cluster.add_queue(Queue(name="default"))
+    pg, pods = gang_job("gb", replicas=8, requests={"cpu": 1, TPU: 4},
+                        pg_phase=PodGroupPhase.INQUEUE)
+    cluster.add_podgroup(pg)
+    for p in pods:
+        cluster.add_pod(p)
+    shard1 = _shard_ctx(cluster, 1, 2, spill="soft")
+    shard1.run()
+    nodes_used = set(shard1.bind_map.values())
+    assert len(shard1.bind_map) == 8
+    assert any(n.startswith("sa-") for n in nodes_used), \
+        "spill must reach the foreign subtree"
+
+    # hard spill: the same gang must NOT cross; it cannot seat at all
+    cluster2 = make_tpu_cluster([("sa", "v5e-16"), ("sb", "v5e-16")])
+    cluster2.add_queue(Queue(name="default"))
+    pg, pods = gang_job("gb", replicas=8, requests={"cpu": 1, TPU: 4},
+                        pg_phase=PodGroupPhase.INQUEUE)
+    cluster2.add_podgroup(pg)
+    for p in pods:
+        cluster2.add_pod(p)
+    hard = _shard_ctx(cluster2, 1, 2, spill="hard")
+    hard.run()
+    hard.expect_bind_num(0)
+
+
+# ------------------------------------- cross-shard races on the wire
+
+@pytest.fixture()
+def wire():
+    httpd, state = serve(port=0)
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    clients = []
+
+    def client(**kw):
+        c = RemoteCluster(url, **kw)
+        clients.append(c)
+        return c
+
+    yield type("Wire", (), {"url": url, "state": state,
+                            "client": staticmethod(client)})
+    for c in clients:
+        c.close()
+    httpd.shutdown()
+
+
+def test_cross_shard_bind_race_exactly_one_winner(wire):
+    """Two shards race overlapping chips through /bind_batch: the
+    server's atomic check-and-bind admits exactly one, the other
+    collects a per-item 409 — never both, never neither."""
+    a = wire.client()
+    b = wire.client()
+    a.add_node(Node(name="sa-w0", allocatable={"cpu": "8", TPU: "4",
+                                               "pods": 110}))
+    a.add_pod(make_pod("ra", requests={"cpu": 1, TPU: 4}))
+    a.add_pod(make_pod("rb", requests={"cpu": 1, TPU: 4}))
+    time.sleep(0.2)   # let b's mirror see both pods
+    errs_a = a.bind_pods([("default", "ra", "sa-w0")])
+    errs_b = b.bind_pods([("default", "rb", "sa-w0")])
+    verdicts = [errs_a[0] is None, errs_b[0] is None]
+    assert verdicts.count(True) == 1, (errs_a, errs_b)
+    loser_err = errs_b[0] if verdicts[0] else errs_a[0]
+    assert "overcommit" in loser_err
+    # exactly one pod holds the chips server-side
+    bound = [p for p in wire.state.cluster.pods.values()
+             if p.phase is TaskStatus.BOUND]
+    assert len(bound) == 1
+
+
+def test_cross_shard_conflict_slug_metrics_and_requeue(wire):
+    """The losing shard's flush_binds brands the refusal with the
+    bounded cross-shard-conflict slug, counts refused per item and
+    requeued per job, and leaves the pods Pending for its next cycle."""
+    from volcano_tpu.api.job_info import TaskInfo
+    from volcano_tpu.cache.cache import SchedulerCache
+    from volcano_tpu.trace import normalize_reason
+
+    metrics.reset()
+    a = wire.client()
+    b = wire.client()
+    a.add_node(Node(name="sa-w0", allocatable={"cpu": "8", TPU: "4",
+                                               "pods": 110}))
+    a.add_pod(make_pod("wa", requests={"cpu": 1, TPU: 4}))
+    pods_b = [make_pod(f"wb-{i}", requests={"cpu": 1, TPU: 2},
+                       annotations={GROUP_NAME_ANNOTATION: "gb"})
+              for i in range(2)]
+    for p in pods_b:
+        a.add_pod(p)
+    time.sleep(0.2)
+    # shard 0 wins the chips first
+    assert a.bind_pods([("default", "wa", "sa-w0")]) == [None]
+    # shard 1's optimistic flush loses both items of one gang
+    cache = SchedulerCache(b)
+    cache.shard_plan = "1/2"
+    for p in pods_b:
+        t = TaskInfo(p)
+        t.node_name = "sa-w0"
+        cache.add_bind_task(t)
+    assert cache.flush_binds() == 0
+    assert len(cache.bind_failures) == 2
+    for _key, err in cache.bind_failures:
+        assert err.startswith("cross-shard conflict (shard 1/2): ")
+        assert normalize_reason(err) == "cross-shard-conflict"
+    assert metrics.get_counter("sched_cross_shard_conflicts_total",
+                               outcome="refused") == 2
+    # one requeue per JOB, not per item — the retry unit is the gang
+    assert metrics.get_counter("sched_cross_shard_conflicts_total",
+                               outcome="requeued") == 1
+    # loser's pods remain pending server-side for the next cycle
+    for p in pods_b:
+        assert wire.state.cluster.pods[p.key].phase \
+            is TaskStatus.PENDING
+
+
+def test_cross_shard_metric_family_is_enum_bounded():
+    from volcano_tpu.bundle import FAMILIES, FAMILY_LABELS
+    assert FAMILIES["sched_cross_shard_conflicts_total"] == "counter"
+    assert set(FAMILY_LABELS["sched_cross_shard_conflicts_total"]
+               ["outcome"]) == {"refused", "requeued"}
+    from volcano_tpu.trace import REASON_ENUM
+    assert "cross-shard-conflict" in REASON_ENUM
+
+
+# ----------------------------------- keyspace-partitioned write plane
+
+@pytest.fixture()
+def part():
+    srvs = [serve(port=0) for _ in range(3)]
+    from volcano_tpu.cache.partitioned import PartitionedCluster
+    urls = ";".join(f"http://127.0.0.1:{h.server_address[1]}"
+                    for h, _ in srvs)
+    pc = PartitionedCluster(urls)
+    yield type("Part", (), {"pc": pc, "srvs": srvs})
+    pc.close()
+    for h, _ in srvs:
+        h.shutdown()
+
+
+def _push_topology(pc, n_slices=6):
+    src = make_tpu_cluster([(f"s{i}", "v5e-16") for i in range(n_slices)])
+    for n in src.nodes.values():
+        pc.add_node(n)
+    for hn in src.hypernodes.values():
+        pc.add_hypernode(hn)
+    return src
+
+
+def test_partitioned_nodes_split_by_subtree(part):
+    src = _push_topology(part.pc)
+    layout = part.pc.shard_layout()
+    assert sum(r["nodes"] for r in layout) == len(src.nodes)
+    assert all(r["nodes"] > 0 for r in layout), layout
+    # hypernodes (meta kind) all live on group 0
+    assert len(part.pc.groups[0].hypernodes) == len(src.hypernodes)
+    for g in part.pc.groups[1:]:
+        assert not g.hypernodes
+    # no node is mirrored by two groups
+    for i, g in enumerate(part.pc.groups):
+        for j in range(i + 1, len(part.pc.groups)):
+            assert not set(g.nodes) & set(part.pc.groups[j].nodes)
+    # merged read surface sees the whole fleet
+    assert len(part.pc.nodes) == len(src.nodes)
+    assert len(part.pc.list_all().nodes) == len(src.nodes)
+
+
+def test_partitioned_bind_relocates_pod_to_owner_group(part):
+    _push_topology(part.pc)
+    pc = part.pc
+    pod = make_pod("p0", requests={"cpu": 1})
+    pc.add_pod(pod)
+    assert "default/p0" in pc.meta.pods, "pending pods live in meta"
+
+    # bind onto a node owned by a NON-meta group
+    tgt_group = next(i for i, g in enumerate(pc.groups)
+                     if i != 0 and g.nodes)
+    target = sorted(pc.groups[tgt_group].nodes)[0]
+    assert pc.bind_pods([("default", "p0", target)]) == [None]
+    # the pod followed its node: owner group's mirror + server have it
+    assert "default/p0" in pc.groups[tgt_group].pods
+    merged = pc.pods["default/p0"]
+    assert merged.node_name == target
+    assert merged.phase is TaskStatus.BOUND
+
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        srv_meta = part.srvs[0][1].cluster.pods
+        srv_tgt = part.srvs[tgt_group][1].cluster.pods
+        if ("default/p0" not in srv_meta
+                and "default/p0" in srv_tgt):
+            break
+        time.sleep(0.02)
+    assert "default/p0" not in part.srvs[0][1].cluster.pods
+    assert "default/p0" in part.srvs[tgt_group][1].cluster.pods
+
+    # a second bind of the relocated pod conflicts per-item
+    other = next(n for n in pc.nodes if n != target)
+    errs = pc.bind_pods([("default", "p0", other)])
+    assert errs[0] is not None
+
+    # status flush for the bound pod routes to its owner group
+    merged.status_message = "running along"
+    pc.put_object("pod", merged)
+    assert part.pc.groups[tgt_group].pods[
+        "default/p0"].status_message == "running along"
+
+
+def test_partitioned_gang_bind_splits_one_batch_per_group(part):
+    _push_topology(part.pc)
+    pc = part.pc
+    calls = []
+    for g in pc.groups:
+        orig = g._request
+
+        def counting(m, p, *args, _orig=orig, _g=g, **kw):
+            if p == "/bind_batch":
+                calls.append(_g)
+            return _orig(m, p, *args, **kw)
+
+        g._request = counting
+    pods, binds = [], []
+    # one pod per group's first node: a cross-group gang
+    for g in pc.groups:
+        node = sorted(g.nodes)[0]
+        p = make_pod(f"gp-{node}", requests={"cpu": 1})
+        pc.add_pod(p)
+        pods.append(p)
+        binds.append(("default", p.name, node))
+    assert pc.bind_pods(binds) == [None, None, None]
+    assert len(calls) == len(pc.groups), \
+        "one /bind_batch per touched leader group"
+    assert len({id(g) for g in calls}) == len(pc.groups)
+
+
+def test_vtpctl_shards_view(part, capsys):
+    """`vtpctl shards` against the partitioned endpoints: subtree
+    ownership table, (empty) per-shard cycle section, and one write-
+    QPS row per leader group."""
+    from volcano_tpu.cli.vtpctl import main as vtpctl
+
+    _push_topology(part.pc, n_slices=4)
+    endpoints = ";".join(g.endpoints[0] for g in part.pc.groups)
+    rc = vtpctl(["--server", endpoints, "shards", "--interval", "0.1"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "SHARD" in out and "OWNS" in out
+    assert "WRITE-QPS" in out
+    assert "meta+nodes" in out
+    # 3 groups -> 3 write-QPS rows, shard plan covers all 4 subtrees
+    assert out.count("\ns") >= 0  # smoke only; detailed below
+    lines = [l for l in out.splitlines() if l.strip()]
+    qps_rows = [l for l in lines if l.split() and
+                l.split()[0] in ("0", "1", "2") and
+                ("meta+nodes" in l or "nodes" in l)]
+    assert len(qps_rows) >= 3, out
+
+
+def test_bench_shard_smoke_mode():
+    """`bench.py --shard-smoke` boots 2 scheduler shards + 2 leader
+    groups as real OS processes, runs one cross-shard gang, and
+    asserts placements identical to the single-shard plane — the
+    sharded-plane drill guarded on every commit, mirroring
+    --wire-smoke/--crash-smoke."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"),
+         "--shard-smoke"],
+        capture_output=True, text=True, timeout=240, env=env, cwd=repo)
+    assert proc.returncode == 0, \
+        proc.stdout[-2000:] + proc.stderr[-2000:]
+    line = next(l for l in reversed(proc.stdout.strip().splitlines())
+                if l.startswith("{"))
+    out = json.loads(line)
+    assert out["ok"] is True, out
+    assert out["placements_identical"] is True
+    assert out["sharded"]["sched_shards_traced"] == ["0/2", "1/2"]
+    assert all(d > 0 for d in out["sharded"]["leader_group_rv_delta"])
+    assert out["sharded"]["jobs"] == out["single"]["jobs"]
+
+
+def test_partitioned_meta_kinds_and_commands_stay_on_meta(part):
+    pc = part.pc
+    _push_topology(pc)
+    from volcano_tpu.api.podgroup import PodGroup
+    pc.add_podgroup(PodGroup(name="pgx", min_member=1))
+    pc.add_queue(Queue(name="tenant"))
+    assert "default/pgx" in pc.groups[0].podgroups
+    assert "tenant" in pc.groups[0].queues
+    pc.add_command("default/pgx", "requeue")
+    assert [c["action"] for c in
+            pc.drain_commands("default/pgx")] == ["requeue"]
+    # merged views expose them too
+    assert "default/pgx" in pc.podgroups
+    assert "tenant" in pc.queues

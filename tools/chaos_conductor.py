@@ -350,7 +350,9 @@ def run_conductor(seed: int, duration: float,
                   classes=DEFAULT_CLASSES, logdir: str = "",
                   lock_audit: bool = False,
                   race_audit: bool = False,
-                  sweep_backend: str = "thread") -> dict:
+                  sweep_backend: str = "thread",
+                  scheduler_shards: int = 1,
+                  leader_groups: int = 1) -> dict:
     classes = set(classes.split(",")) if isinstance(classes, str) \
         else set(classes)
     sched = build_plan(seed, duration, classes)
@@ -409,6 +411,8 @@ def run_conductor(seed: int, duration: float,
 
     result = {"seed": seed, "duration_s": duration,
               "classes": sorted(classes),
+              "scheduler_shards": scheduler_shards,
+              "leader_groups": leader_groups,
               "windows": sched["windows"]}
     c = None
     proxy = None
@@ -449,27 +453,68 @@ def run_conductor(seed: int, duration: float,
         else:
             zoo.spawn_server(port, *server_faulted)
             chaoslib.wait_server(url)
+        # keyspace-partitioned write leaders (docs/design/sharding.md):
+        # group 0 is the fault-armed plane above (meta keyspace + its
+        # node subtrees); groups 1.. are clean single-server leaders
+        # owning the remaining subtrees.  Faults stay on group 0 — the
+        # invariants keep polling the faulted group while keyed writes
+        # (binds, pod status) split across every group.
+        g_urls = []
+        client_spec = plane_url
+        if leader_groups > 1:
+            for gi in range(1, leader_groups):
+                gp = chaoslib.free_port()
+                zoo.spawn_server(
+                    gp, "--data-dir",
+                    os.path.join(logdir, f"state-g{gi}"),
+                    name=f"server-g{gi}")
+                g_urls.append(f"http://127.0.0.1:{gp}")
+            for gu in g_urls:
+                chaoslib.wait_server(gu)
+            client_spec = ";".join([plane_url] + g_urls)
         t_plan0 = time.monotonic()     # ~ the server plan's t0
-        # leader-elected scheduler: the clock-jump invariant is about
-        # the LEASE surviving a wall step — there must be a lease
+        # leader-elected scheduler(s): the clock-jump invariant is
+        # about the LEASE surviving a wall step — there must be a
+        # lease.  With --scheduler-shards N, N schedulers each own a
+        # disjoint subtree shard and elect on their own per-shard
+        # lease ("scheduler-shard0", ...); the clock invariant tracks
+        # shard 0's lease.
         sched_extra = []
-        if race_audit:
-            # the pilot under certification: default conf + the
-            # parallel leaf-shard predicate sweep
+        if race_audit or scheduler_shards > 1:
             conf_path = os.path.join(logdir, "sched_conf.yaml")
             import yaml
             from volcano_tpu.conf import DEFAULT_SCHEDULER_CONF
             conf_doc = dict(DEFAULT_SCHEDULER_CONF)
-            conf_doc["configurations"] = {
-                "allocate": {"parallelPredicates": sweep_backend,
-                             "parallelPredicates.workers": 8}}
+            alloc_conf = {}
+            if race_audit:
+                # the pilot under certification: default conf + the
+                # parallel leaf-shard predicate sweep
+                alloc_conf.update(
+                    {"parallelPredicates": sweep_backend,
+                     "parallelPredicates.workers": 8})
+            if scheduler_shards > 1:
+                # the sharded plane runs the batched gang commit and
+                # soft cross-shard spill — the production shape the
+                # chaos certification is for
+                alloc_conf.update({"gangCommit": "batch",
+                                   "shard-spill": "soft"})
+            conf_doc["configurations"] = {"allocate": alloc_conf}
             with open(conf_path, "w", encoding="utf-8") as f:
                 yaml.safe_dump(conf_doc, f)
             sched_extra = ["--conf", conf_path]
-        zoo.spawn_plane("sched", plane_url, "scheduler",
-                        "--leader-elect", "--holder", "s1",
-                        "--lease-ttl", "1.5", *sched_extra)
-        zoo.spawn_plane("ctrl", plane_url, "controllers")
+        sched_lease = "scheduler-shard0" if scheduler_shards > 1 \
+            else "scheduler"
+        for si in range(scheduler_shards):
+            shard_flags = list(sched_extra)
+            if scheduler_shards > 1:
+                shard_flags += ["--shard-index", str(si),
+                                "--shard-count", str(scheduler_shards)]
+            zoo.spawn_plane(
+                f"sched-{si}" if scheduler_shards > 1 else "sched",
+                client_spec, "scheduler",
+                "--leader-elect", "--holder", f"s{si + 1}",
+                "--lease-ttl", "1.5", *shard_flags)
+        zoo.spawn_plane("ctrl", client_spec, "controllers")
 
         # high-rate sampler: the main loop slows down under injected
         # faults (that is the point), so the degrade/heal windows and
@@ -494,8 +539,8 @@ def run_conductor(seed: int, duration: float,
                 if dur:
                     samples.append((t_rel, dur.get("readonly") or "",
                                     int(dur.get("visible_rv") or 0)))
-                leader_track.append((t_rel,
-                                     chaoslib.leader(sample_url)))
+                leader_track.append((t_rel, chaoslib.leader(
+                    sample_url, sched_lease)))
                 if replication:
                     repl_reads.append(
                         (t_rel, chaoslib.http_json(
@@ -525,8 +570,15 @@ def run_conductor(seed: int, duration: float,
 
         # watches THROUGH every fault; with replication the client is
         # multi-endpoint — writes follow the leader across the kill,
-        # reads stick to one replica
-        c = RemoteCluster(plane_url)
+        # reads stick to one replica.  With partitioned leaders the
+        # mirror is the keyspace-routing client: one watch per group,
+        # merged reads, binds relocating pods to their owner group.
+        if leader_groups > 1:
+            from volcano_tpu.cache.partitioned import \
+                PartitionedCluster
+            c = PartitionedCluster(client_spec)
+        else:
+            c = RemoteCluster(plane_url)
         chaoslib.seed_slices(c, ("sa", "sb", "sc"))
         acked_jobs = set()
 
@@ -767,7 +819,7 @@ def run_conductor(seed: int, duration: float,
         c.resync()
         inv.poll()
         phases = chaoslib.phase_counts(c)
-        truth = chaoslib.snapshot_stores(truth_url)
+        truth = _truth_stores([truth_url] + g_urls)
         missing = [k for k in acked_jobs if k not in truth["vcjob"]]
         if missing:
             inv.note("acked_durable",
@@ -779,14 +831,15 @@ def run_conductor(seed: int, duration: float,
         # matches — only a divergence that never settles is real.
         final_rv = int((chaoslib.http_json(truth_url + "/durability")
                         or {}).get("visible_rv") or 0)
+        meta_mirror = c.groups[0] if leader_groups > 1 else c
         try:
-            chaoslib.wait_for(lambda: c._rv >= final_rv, 20,
+            chaoslib.wait_for(lambda: meta_mirror._rv >= final_rv, 20,
                               "mirror caught up after heal")
         except AssertionError as e:
             inv.note("mirror_converged", str(e))
         div = None
         for _ in range(8):
-            truth = chaoslib.snapshot_stores(truth_url)
+            truth = _truth_stores([truth_url] + g_urls)
             div = chaoslib.mirror_divergence(c, truth)
             if div == 0:
                 break
@@ -796,6 +849,26 @@ def run_conductor(seed: int, duration: float,
                      "(stable across 8 compares)")
         faults_fired = repl_state["faults_before_kill"] if replication \
             else chaoslib.http_json(url + "/faults") or {}
+        if scheduler_shards > 1:
+            # every shard's cycles stamp labels.shard on the meta
+            # /traces ring — the run only counts as sharded if every
+            # shard actually scheduled through the faults
+            tr = chaoslib.http_json(url + "/traces?limit=128") or {}
+            result["sched_shards_traced"] = sorted(
+                {(t.get("root", {}).get("labels") or {}).get("shard")
+                 for t in tr.get("traces", [])} - {None})
+            want = {f"{i}/{scheduler_shards}"
+                    for i in range(scheduler_shards)}
+            if not want <= set(result["sched_shards_traced"]):
+                inv.note("sharded_plane",
+                         f"sharded plane incomplete: traced "
+                         f"{result['sched_shards_traced']}, "
+                         f"wanted {sorted(want)}")
+        if leader_groups > 1:
+            result["leader_group_rv"] = [
+                int((chaoslib.http_json(u + "/durability") or {})
+                    .get("rv") or 0) for u in [url] + g_urls]
+            result["leader_group_layout"] = c.shard_layout()
 
         # -- CRC bit-rot drill: kill -9, flip one bit mid-WAL, boot
         # must REFUSE (exit 3); restore the byte, boot must recover —
@@ -1008,10 +1081,18 @@ def run_conductor(seed: int, duration: float,
             result["ok"] = result["ok"] and not \
                 result["race_audit"]["violations"]
         if not result["ok"]:
+            # the full plane layout rides along: shard count and
+            # leader-group layout change which scheduler binds what
+            # and which server absorbs which write, so a replay
+            # without them is a different run
             flag = (" --lock-audit" if lock_audit else "") + \
                 (" --race-audit" if race_audit else "") + \
                 (f" --sweep-backend {sweep_backend}"
-                 if race_audit and sweep_backend != "thread" else "")
+                 if race_audit and sweep_backend != "thread" else "") + \
+                (f" --scheduler-shards {scheduler_shards}"
+                 if scheduler_shards != 1 else "") + \
+                (f" --leader-groups {leader_groups}"
+                 if leader_groups != 1 else "")
             print(f"\nREPRODUCE: python tools/chaos_conductor.py "
                   f"--seed {seed} --duration {duration} "
                   f"--classes {','.join(sorted(classes))}{flag}",
@@ -1097,6 +1178,19 @@ def _collect_race_audit(race_dir: str) -> dict:
         "tracked_stores": tracked,
         "violations": violations,
     }
+
+
+def _truth_stores(urls) -> dict:
+    """Ground truth across every leader group: group 0 (meta) first,
+    then the node groups layered over it — the same merge order the
+    partitioned client reads with, so a relocated pod's bound copy
+    wins over a benign leftover meta copy."""
+    truth = chaoslib.snapshot_stores(urls[0])
+    for u in urls[1:]:
+        extra = chaoslib.snapshot_stores(u)
+        for kind, objs in extra.items():
+            truth.setdefault(kind, {}).update(objs)
+    return truth
 
 
 def _flippable_record(data_dir: str):
@@ -1249,13 +1343,17 @@ def read_qps_scaling(n_readers: int = 6, measure_s: float = 4.0,
 def run_matrix(seeds, duration: float, classes: str,
                out: str = "", lock_audit: bool = False,
                race_audit: bool = False,
-               sweep_backend: str = "thread") -> dict:
+               sweep_backend: str = "thread",
+               scheduler_shards: int = 1,
+               leader_groups: int = 1) -> dict:
     rows = []
     for seed in seeds:
         rows.append(run_conductor(seed, duration, classes,
                                   lock_audit=lock_audit,
                                   race_audit=race_audit,
-                                  sweep_backend=sweep_backend))
+                                  sweep_backend=sweep_backend,
+                                  scheduler_shards=scheduler_shards,
+                                  leader_groups=leader_groups))
         print(json.dumps({"seed": seed, "ok": rows[-1]["ok"]}),
               flush=True)
     invariant_names = sorted(rows[0]["invariants"]["passed"])
@@ -1272,6 +1370,8 @@ def run_matrix(seeds, duration: float, classes: str,
         "seeds": [r["seed"] for r in rows],
         "duration_s": duration,
         "classes": rows[0]["classes"],
+        "scheduler_shards": scheduler_shards,
+        "leader_groups": leader_groups,
         "hosts": 12,
         "invariant_matrix": matrix,
         "zero_violations": all(r["ok"] for r in rows),
@@ -1338,6 +1438,15 @@ def run_matrix(seeds, duration: float, classes: str,
         print("measuring read-QPS scaling row "
               "(leader+2 followers, write churn)...", flush=True)
         doc["read_qps_scaling"] = read_qps_scaling()
+    if scheduler_shards > 1:
+        doc["sched_shards_traced_all_seeds"] = all(
+            set(r.get("sched_shards_traced") or []) >=
+            {f"{i}/{scheduler_shards}"
+             for i in range(scheduler_shards)} for r in rows)
+    if leader_groups > 1:
+        doc["leader_groups_all_absorbed_writes"] = all(
+            (r.get("leader_group_rv") or []) and
+            all(rv > 0 for rv in r["leader_group_rv"]) for r in rows)
     if out:
         with open(out, "w", encoding="utf-8") as f:
             json.dump(doc, f, indent=1)
@@ -1371,6 +1480,15 @@ def main(argv=None) -> int:
                          "tracking), run the scheduler with the "
                          "parallel predicate sweep, and fail the run "
                          "on any race/freeze violation")
+    ap.add_argument("--scheduler-shards", type=int, default=1,
+                    help="run N subtree-sharded schedulers (each "
+                         "leader-elected on its own per-shard lease) "
+                         "instead of the single plane scheduler; "
+                         "carried on the REPRODUCE line")
+    ap.add_argument("--leader-groups", type=int, default=1,
+                    help="split the keyspace across N write-leader "
+                         "groups (group 0 keeps the fault plan + meta "
+                         "keyspace); carried on the REPRODUCE line")
     ap.add_argument("--sweep-backend", default="thread",
                     choices=("thread", "process"),
                     help="which parallel sweep backend the "
@@ -1390,7 +1508,9 @@ def main(argv=None) -> int:
                          classes, out=args.out,
                          lock_audit=args.lock_audit,
                          race_audit=args.race_audit,
-                         sweep_backend=args.sweep_backend)
+                         sweep_backend=args.sweep_backend,
+                         scheduler_shards=args.scheduler_shards,
+                         leader_groups=args.leader_groups)
         print(json.dumps({k: v for k, v in doc.items()
                           if k != "per_seed"}, indent=1))
         return 0 if doc["zero_violations"] else 1
@@ -1398,7 +1518,9 @@ def main(argv=None) -> int:
                         logdir=args.logdir,
                         lock_audit=args.lock_audit,
                         race_audit=args.race_audit,
-                        sweep_backend=args.sweep_backend)
+                        sweep_backend=args.sweep_backend,
+                        scheduler_shards=args.scheduler_shards,
+                        leader_groups=args.leader_groups)
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 

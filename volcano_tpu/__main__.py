@@ -100,6 +100,13 @@ def main(argv=None) -> int:
                              "member control plane's state-server URL "
                              "(repeatable); split members whose domain "
                              "is NAME are created THERE")
+    parser.add_argument("--shard-index", type=int, default=None,
+                        help="this scheduler's subtree-shard index "
+                             "(0-based); with --shard-count, the "
+                             "process schedules only the hypernode "
+                             "subtrees its shard owns")
+    parser.add_argument("--shard-count", type=int, default=None,
+                        help="total scheduler shards in the plane")
     parser.add_argument("--hypernode-discovery", default="label",
                         help="topology provider: 'label' (node labels) "
                              "or 'fabric:ENDPOINT[#TOKEN]' (fabric-"
@@ -126,12 +133,22 @@ def main(argv=None) -> int:
             parser.error(str(e))
     remote = bool(args.cluster_url)
     if remote:
-        from volcano_tpu.cache.remote_cluster import RemoteCluster
         from volcano_tpu.server.tlsutil import load_token
-        cluster = RemoteCluster(
-            args.cluster_url,
-            token=load_token(args.token, args.token_file),
-            ca_cert=args.ca_cert, insecure=args.insecure)
+        if ";" in args.cluster_url:
+            # semicolon-separated leader GROUPS (each a comma-
+            # separated replica list): the keyspace-partitioned
+            # write plane
+            from volcano_tpu.cache.partitioned import PartitionedCluster
+            cluster = PartitionedCluster(
+                args.cluster_url,
+                token=load_token(args.token, args.token_file),
+                ca_cert=args.ca_cert, insecure=args.insecure)
+        else:
+            from volcano_tpu.cache.remote_cluster import RemoteCluster
+            cluster = RemoteCluster(
+                args.cluster_url,
+                token=load_token(args.token, args.token_file),
+                ca_cert=args.ca_cert, insecure=args.insecure)
     elif args.state:
         try:
             # sniffs legacy pickle vs the snapshot-JSON format the
@@ -163,10 +180,18 @@ def main(argv=None) -> int:
     run_sched = "scheduler" in components
     run_ctrls = "controllers" in components
 
+    if (args.shard_index is None) != (args.shard_count is None):
+        parser.error("--shard-index and --shard-count go together")
+    if args.shard_count is not None and not (
+            0 <= args.shard_index < args.shard_count):
+        parser.error(f"--shard-index {args.shard_index} out of range "
+                     f"for --shard-count {args.shard_count}")
     sched = None
     if run_sched:
         sched = Scheduler(cluster, conf_path=args.conf or None,
-                          schedule_period=args.period)
+                          schedule_period=args.period,
+                          shard_index=args.shard_index,
+                          shard_count=args.shard_count)
     mgr = None
     if run_ctrls:
         ctrl_overrides = {}
@@ -220,8 +245,13 @@ def main(argv=None) -> int:
         holder = args.holder or f"pid-{os.getpid()}"
         # one lease per component set: scheduler replicas contend on
         # "scheduler", controller-manager replicas on "controllers" —
-        # never across roles
+        # never across roles.  Sharded schedulers contend per shard
+        # ("scheduler-shard0", ...): shards own disjoint subtrees, so
+        # each shard's replicas elect among themselves, never across
+        # shards
         lease_name = "+".join(sorted(components))
+        if args.shard_index is not None and "scheduler" in components:
+            lease_name += f"-shard{args.shard_index}"
         elector = LeaderElector(cluster, lease_name, holder,
                                 ttl=args.lease_ttl).start()
     agent_sched = None

@@ -63,7 +63,22 @@ class AllocateAction(Action):
         enqueue_configured = "enqueue" in ssn.conf.actions
 
         jobs_per_queue: Dict[str, PriorityQueue] = {}
+        shard_plan = self._subtree_plan(ssn)
         for job in ssn.jobs.values():
+            if shard_plan is not None and not job.tasks_in_status(
+                    TaskStatus.BINDING):
+                # partitioned schedulers: every pending job has ONE
+                # home shard driving its placement (stable hash, so
+                # all shards agree without coordination).  The home
+                # shard spills cross-subtree when its own subtrees
+                # can't seat the gang; only those optimistic spills
+                # ever race another shard, and the server's
+                # check-and-bind arbitrates them.  A job with BINDING
+                # tasks stays with whoever started it this cycle.
+                from volcano_tpu import shardmap
+                idx, count = shard_plan
+                if shardmap.home_shard(job.key, count) != idx:
+                    continue
             if not self._job_eligible(ssn, job, enqueue_configured):
                 continue
             queue = ssn.queues.get(job.queue)
@@ -137,17 +152,50 @@ class AllocateAction(Action):
         self._finish(ssn, job, stmt)
 
     @staticmethod
+    def _subtree_plan(ssn):
+        """(shard_index, shard_count) when the subtree partition is
+        on (``shard-mode: subtree`` + a shard-count > 1), else None."""
+        conf = ssn.conf.configurations.get("allocate", {})
+        if str(conf.get("shard-mode", "none")) != "subtree":
+            return None
+        try:
+            idx = int(conf.get("shard-index", 0))
+            count = int(conf.get("shard-count", 1))
+        except (TypeError, ValueError):
+            return None
+        if count <= 1 or not 0 <= idx < count:
+            return None
+        return idx, count
+
+    @staticmethod
     def _shard_view(ssn):
         """(own shard node set, mode) — None when sharding is off.
 
         Candidate-node gradient by shard (allocate.go:886-919): hard
         restricts to the scheduler's NodeShard; soft prefers it.
+        ``subtree`` mode instead derives ownership from the
+        deterministic topology-subtree partition (volcano_tpu/
+        shardmap.py) shared with the keyspace-partitioned write plane;
+        its spill gradient (``shard-spill``, default soft) is what
+        makes cross-subtree gangs optimistic rather than stuck.
         """
-        from volcano_tpu.controllers.sharding import shard_nodes_for
-        mode = str(ssn.conf.configurations.get("allocate", {})
-                   .get("shard-mode", "none"))
+        conf = ssn.conf.configurations.get("allocate", {})
+        mode = str(conf.get("shard-mode", "none"))
+        if mode == "subtree":
+            plan = AllocateAction._subtree_plan(ssn)
+            if plan is None:
+                return None, "none"
+            from volcano_tpu import shardmap
+            idx, count = plan
+            own = shardmap.owned_nodes(
+                shardmap.subtree_map(ssn.nodes.values()), count, idx)
+            ssn.cache.shard_plan = f"{idx}/{count}"
+            spill = str(conf.get("shard-spill", "soft"))
+            return (own or None), \
+                (spill if spill in ("soft", "hard") else "soft")
         if mode not in ("soft", "hard"):
             return None, "none"
+        from volcano_tpu.controllers.sharding import shard_nodes_for
         own = shard_nodes_for(ssn.cache.cluster,
                               ssn.cache.scheduler_name)
         if not own:
@@ -179,6 +227,19 @@ class AllocateAction(Action):
         """Try to place every pending non-best-effort task of *job* onto
         *candidate_nodes* (optionally restricted by *task_filter*).
         Returns number placed."""
+        if task_filter is None:
+            # gangCommit: batch — drain whole specs over the cached
+            # sweep instead of walking pod-at-a-time; None means the
+            # batch contract cannot hold and the walk below runs.
+            # (task_filter is how the batch path delegates its own
+            # non-cacheable leftovers here — never re-enter on it.)
+            from volcano_tpu.actions import gangcommit
+            if gangcommit.enabled(ssn):
+                placed = gangcommit.allocate_tasks_batched(
+                    ssn, queue, job, stmt, candidate_nodes,
+                    record_errors)
+                if placed is not None:
+                    return placed
         tasks = PriorityQueue(ssn.task_order_fn)
         for task in job.tasks_in_status(TaskStatus.PENDING):
             if task.best_effort:

@@ -98,6 +98,7 @@ class HyperNodesInfo:
         self.members: Dict[str, HyperNodeInfo] = {}
         self.node_to_leaf: Dict[str, str] = {}   # real node -> tier-1 hypernode
         self._lca_tier_cache: Dict[tuple, int] = {}
+        self._tier_row_cache: Dict[Optional[str], tuple] = {}
         real = list(real_nodes)
         node_labels = node_labels or {}
 
@@ -248,6 +249,49 @@ class HyperNodesInfo:
             # vtplint: disable=snapshot-write (idempotent memo: the tier is pure in the immutable member tree, so a racing GIL-atomic store publishes an equal value; a lost update only recomputes)
             self._lca_tier_cache[key] = cached
         return cached
+
+    def _leaf_buckets(self) -> Dict[str, List[str]]:
+        """hypernode -> leaf hypernodes under it (leaves inclusive),
+        built once per topology object."""
+        buckets = getattr(self, "_leaf_bucket_cache", None)
+        if buckets is None:
+            buckets = {}
+            for leaf in set(self.node_to_leaf.values()):
+                for anc in self.ancestors(leaf):
+                    buckets.setdefault(anc, []).append(leaf)
+            # vtplint: disable=snapshot-write (idempotent memo: pure in the immutable member tree; a lost GIL-atomic update only recomputes)
+            self._leaf_bucket_cache = buckets
+        return buckets
+
+    def leaf_tier_row(self, leaf: Optional[str],
+                      leaf_names: List[Optional[str]]) -> tuple:
+        """Tuple of LCA tiers between *leaf* and every leaf in
+        ``leaves()`` order.
+
+        Built by one root-to-leaf descendant-bucket walk — each
+        ancestor overwrites its leaf bucket with its (tighter) tier —
+        which is O(leaves) total instead of O(leaves) pairwise LCA
+        walks, and memoized on the topology object, which incremental
+        snapshots reuse while the CR set is unchanged (profiled: the
+        dominant ssn.allocate cost of an 8k-gang batched commit at
+        100k hosts was rebuilding these rows pairwise per session)."""
+        row = self._tier_row_cache.get(leaf)
+        if row is None:
+            root_tier = self.members[VIRTUAL_ROOT].tier
+            vals = [root_tier] * len(leaf_names)
+            if leaf is not None and leaf in self.members:
+                idx = {name: i for i, name in enumerate(leaf_names)}
+                buckets = self._leaf_buckets()
+                for anc in reversed(self.ancestors(leaf)):
+                    tier = self.members[anc].tier
+                    for other in buckets.get(anc, ()):
+                        i = idx.get(other)
+                        if i is not None:
+                            vals[i] = tier
+            row = tuple(vals)
+            # vtplint: disable=snapshot-write (idempotent memo: pure in the immutable member tree; a racing GIL-atomic store publishes an equal tuple and a lost update only recomputes)
+            self._tier_row_cache[leaf] = row
+        return row
 
     def lca_tier_of_nodes(self, node_a: str, node_b: str) -> int:
         """Tier of the LCA of the leaf hypernodes containing two real

@@ -166,6 +166,13 @@ FAMILIES: Dict[str, str] = {
     "sched_span_seconds": "histogram",
     "sched_traces_total": "counter",
     "sched_unschedulable_reasons_total": "counter",
+    # sharded planes (actions/gangcommit.py + cache/partitioned.py):
+    # one observation per spec drained as a batch, and every bind the
+    # server's check-and-bind refused to a losing scheduler shard,
+    # counted by the bounded outcome enum (refused = per-item 409,
+    # requeued = the loser re-queued the gang for its next cycle)
+    "sched_gang_commit_seconds": "histogram",
+    "sched_cross_shard_conflicts_total": "counter",
     # elastic gangs (actions/elastic.py decisions, controllers/
     # elastic.py execution): every label is the bounded resize-kind
     # enum (grow|shrink|migrate) — job keys and slice names never
@@ -290,6 +297,8 @@ FAMILY_LABELS: Dict[str, Dict[str, object]] = {
         "kept": ("error", "unschedulable", "slow", "sampled")},
     "sched_unschedulable_reasons_total": {
         "reason": "enum:volcano_tpu.trace:REASON_ENUM"},
+    "sched_cross_shard_conflicts_total": {
+        "outcome": ("refused", "requeued")},
     # elastic gangs: the bounded resize-kind enum, never job keys
     "elastic_decisions_total": {
         "kind": "enum:volcano_tpu.api.elastic:RESIZE_KINDS"},

@@ -143,6 +143,14 @@ class SchedulerCache:
         self._lock = threading.Lock()
         self._bind_queue: List[BindContext] = []
         self.bind_failures: List[Tuple[str, str]] = []   # (task key, error)
+        # "idx/count" when this scheduler owns a topology-subtree shard
+        # (allocate shard-mode: subtree); stamped per session by
+        # AllocateAction._shard_view.  flush_binds uses it to label
+        # per-item bind refusals as cross-shard conflicts: under the
+        # partitioned plane an overcommit 409 means another shard's
+        # optimistic spill won the server's atomic check-and-bind, and
+        # the loser's job retries through its next cycle.
+        self.shard_plan: Optional[str] = None
         # cross-session scratch for plugins (rate limiters etc.), keyed
         # by plugin name.  Plugin INSTANCES are rebuilt every session
         # (framework.open_session), so state that must survive cycles
@@ -686,11 +694,26 @@ class SchedulerCache:
               ctx.t_alloc)
              for ctx in queue])
         bound = 0
+        requeued: set = set()   # jobs already counted as conflict losers
         for ctx, err in zip(queue, errors):
             if err is None:
                 bound += 1
                 metrics.inc("schedule_attempts_total", result="scheduled")
             else:
+                if self.shard_plan is not None and "overcommit" in err:
+                    # another shard's optimistic spill won the server's
+                    # atomic check-and-bind for these chips; mark the
+                    # refusal so trace reason aggregation buckets it
+                    # under the bounded cross-shard-conflict slug and
+                    # the loser's next cycle retries with fresh state
+                    err = (f"cross-shard conflict (shard "
+                           f"{self.shard_plan}): {err}")
+                    metrics.inc("sched_cross_shard_conflicts_total",
+                                outcome="refused")
+                    if ctx.task.job not in requeued:
+                        requeued.add(ctx.task.job)
+                        metrics.inc("sched_cross_shard_conflicts_total",
+                                    outcome="requeued")
                 log.warning("bind failed for %s on %s: %s",
                             ctx.task.key, ctx.node_name, err)
                 self.bind_failures.append((ctx.task.key, err))

@@ -939,6 +939,81 @@ def cmd_trace(cluster, args):
     _phase_waterfall(cluster, pg, pods)
 
 
+def cmd_shards(cluster, args):
+    """Shard topology of both planes in one view: which subtrees (and
+    how many hosts) each scheduler shard owns under the deterministic
+    partition, the latest measured cycle time per scheduler shard
+    (trace ring, root label `shard`), and per-leader-group write QPS
+    (/durability rv deltas sampled twice).  Works against a single
+    server or a semicolon-partitioned endpoint list."""
+    import time as _time
+
+    from volcano_tpu import shardmap
+
+    count = args.shard_count
+    if count is None:
+        count = len(getattr(cluster, "groups", ())) or 1
+    subtrees = shardmap.subtree_map(cluster.nodes.values())
+    plan = shardmap.plan_partition(subtrees, max(1, count))
+    print(_table(
+        [[r["shard"], len(r["subtrees"]), r["hosts"],
+          ", ".join(r["subtrees"][:4])
+          + (" ..." if len(r["subtrees"]) > 4 else "")]
+         for r in plan],
+        ["SHARD", "SUBTREES", "HOSTS", "OWNS"]))
+
+    request = getattr(cluster, "_request", None)
+    if request is None:
+        return
+    # per-scheduler-shard cycle time: latest kept trace per root
+    # `shard` label; every sharded scheduler stamps it (scheduler.py)
+    try:
+        traces = request("GET", "/traces?limit=64").get("traces", [])
+    except Exception as e:  # noqa: BLE001 — observability only
+        print(f"(trace ring unavailable: {e})", file=sys.stderr)
+        traces = []
+    latest = {}
+    for t in traces:
+        root = t.get("root") or {}
+        shard = (root.get("labels") or {}).get("shard") or "unsharded"
+        latest[shard] = (root.get("dur", 0.0),
+                         (root.get("labels") or {}).get("cycle"))
+    if latest:
+        print()
+        print(_table(
+            [[shard, f"{dur * 1e3:.1f}ms", cycle]
+             for shard, (dur, cycle) in sorted(latest.items())],
+            ["SCHED-SHARD", "CYCLE-TIME", "CYCLE"]))
+
+    # per-leader-group write QPS: rv is the server's monotonic write
+    # counter, so two /durability samples give writes/second
+    groups = list(getattr(cluster, "groups", ())) or [cluster]
+    samples = []
+    for g in groups:
+        try:
+            samples.append(g._request("GET", "/durability").get("rv", 0))
+        except Exception:  # noqa: BLE001
+            samples.append(None)
+    t0 = _time.time()
+    _time.sleep(max(0.05, args.interval))
+    rows = []
+    for i, g in enumerate(groups):
+        label = "meta+nodes" if len(groups) > 1 and i == 0 else "nodes"
+        if len(groups) == 1:
+            label = "all"
+        try:
+            rv = g._request("GET", "/durability").get("rv", 0)
+        except Exception as e:  # noqa: BLE001
+            rows.append([i, label, f"unreachable: {e}", "-"])
+            continue
+        before = samples[i]
+        qps = "-" if before is None else \
+            f"{(rv - before) / max(1e-9, _time.time() - t0):.1f}"
+        rows.append([i, label, rv, qps])
+    print()
+    print(_table(rows, ["GROUP", "KEYSPACE", "RV", "WRITE-QPS"]))
+
+
 def cmd_server(cluster, args):
     """Durability + lease status of the live state server (GET
     /durability, GET /leases): whether writes are journaled, how much
@@ -1245,6 +1320,16 @@ def build_parser() -> argparse.ArgumentParser:
                        "replay, role/term/lag; needs --server)")
     p.set_defaults(fn=cmd_server)
 
+    p = sub.add_parser("shards", help="shard topology: subtree "
+                       "ownership per scheduler shard, per-shard "
+                       "cycle time, per-leader-group write QPS")
+    p.add_argument("--shard-count", type=int, default=None,
+                   help="scheduler shards to plan for (default: the "
+                        "number of leader groups in --server)")
+    p.add_argument("--interval", type=float, default=1.0,
+                   help="seconds between the two write-QPS samples")
+    p.set_defaults(fn=cmd_shards)
+
     p = sub.add_parser("tick",
                        help="advance the standalone control plane")
     p.add_argument("--cycles", type=int, default=1)
@@ -1287,11 +1372,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         # 503s the /snapshot bootstrap — the status view must not
         # block behind the mirror it never uses
         tolerant = getattr(args, "fn", None) is cmd_server
-        cluster = RemoteCluster(
-            args.server, start_watch=False,
-            tolerate_unreachable=tolerant,
-            token=load_token(args.token, args.token_file),
-            ca_cert=args.ca_cert, insecure=args.insecure)
+        if ";" in args.server:
+            # keyspace-partitioned plane: semicolon-separated leader
+            # groups — reads merge every group's mirror
+            from volcano_tpu.cache.partitioned import PartitionedCluster
+            cluster = PartitionedCluster(
+                args.server, start_watch=False,
+                token=load_token(args.token, args.token_file),
+                ca_cert=args.ca_cert, insecure=args.insecure,
+                tolerate_unreachable=tolerant)
+        else:
+            cluster = RemoteCluster(
+                args.server, start_watch=False,
+                tolerate_unreachable=tolerant,
+                token=load_token(args.token, args.token_file),
+                ca_cert=args.ca_cert, insecure=args.insecure)
     else:
         cluster = _load(args.state)
     from volcano_tpu.webhooks import AdmissionError

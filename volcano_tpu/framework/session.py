@@ -177,10 +177,13 @@ class Session:
         self.add_fn("nodeOrderPrepare", p, fn)
     def add_batch_node_order_fn(self, p, fn): self.add_fn("batchNodeOrder", p, fn)
     def add_grouped_batch_node_order_fn(self, p, fn):
-        """Optional leaf-grouped twin of a BatchNodeOrder fn: fn(task)
-        returns {group: score} per node-group (session.node_group),
-        letting allocate keep its heap fast path when every batch
-        scorer provides this form.
+        """Optional leaf-grouped twin of a BatchNodeOrder fn:
+        fn(task, groups=None) returns {group: score} per node-group
+        (session.node_group), letting allocate keep its heap fast path
+        when every batch scorer provides this form.  A non-None
+        `groups` is a set of group keys the caller will rank — the fn
+        may skip scoring work for groups outside it (it is a
+        restriction hint, never an obligation to cover every key).
 
         CONTRACT: fn must return a FRESH dict per call, never a
         memoized or otherwise shared mapping.  Callers treat the
@@ -466,8 +469,14 @@ class Session:
             return None
         return self.hypernodes.leaf_of_node(node_name)
 
-    def grouped_batch_node_order(self, task: TaskInfo):
-        """Accumulated per-group batch scores ({group: score})."""
+    def grouped_batch_node_order(self, task: TaskInfo, groups=None):
+        """Accumulated per-group batch scores ({group: score}).
+
+        groups (optional set of group keys) restricts scoring to the
+        groups the caller will actually rank — the batched gang drain
+        knows its entry's leaf set, and under a subtree-partitioned
+        scheduler that is a fraction of the fleet's leaves, so the
+        binpack scorer need not walk every domain."""
         fns = [fn for tier_fns in
                self._enabled_fns("groupedBatchNodeOrder")
                for _, fn in tier_fns]
@@ -479,10 +488,10 @@ class Session:
             # (see add_grouped_batch_node_order_fn), but a future
             # memoizing scorer must degrade to a cheap shallow copy
             # here, not to silent aliasing of its cache to callers.
-            return dict(fns[0](task))
+            return dict(fns[0](task, groups))
         totals: Dict[object, float] = defaultdict(float)
         for fn in fns:
-            for group, s in fn(task).items():
+            for group, s in fn(task, groups).items():
                 totals[group] += s
         return totals
 

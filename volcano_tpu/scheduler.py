@@ -29,11 +29,19 @@ class Scheduler:
     def __init__(self, cluster: Cluster, conf=None,
                  conf_path: Optional[str] = None,
                  schedule_period: float = DEFAULT_SCHEDULE_PERIOD,
-                 scheduler_name: str = "volcano-tpu"):
+                 scheduler_name: str = "volcano-tpu",
+                 shard_index: Optional[int] = None,
+                 shard_count: Optional[int] = None):
         self.cluster = cluster
         self.cache = SchedulerCache(cluster, scheduler_name)
         self.conf_path = conf_path
         self._conf_mtime = 0.0
+        # subtree-partition identity (--shard-index/--shard-count):
+        # survives conf hot-reloads by being re-applied in _load, so a
+        # reloaded file can change plugins but not silently merge two
+        # shards onto one subtree
+        self._shard = (shard_index, shard_count) \
+            if shard_index is not None and shard_count else None
         self.conf: SchedulerConf = self._load(conf)
         self.schedule_period = schedule_period
         self._stop = threading.Event()
@@ -43,8 +51,16 @@ class Scheduler:
         if self.conf_path and os.path.exists(self.conf_path):
             self._conf_mtime = os.path.getmtime(self.conf_path)
             with open(self.conf_path) as f:
-                return load_conf(f.read())
-        return load_conf(conf)
+                loaded = load_conf(f.read())
+        else:
+            loaded = load_conf(conf)
+        if self._shard is not None:
+            idx, count = self._shard
+            alloc = loaded.configurations.setdefault("allocate", {})
+            alloc["shard-mode"] = "subtree"
+            alloc["shard-index"] = idx
+            alloc["shard-count"] = count
+        return loaded
 
     def _maybe_reload_conf(self):
         """Hot reload on file change (scheduler.go:219-245)."""
@@ -53,9 +69,7 @@ class Scheduler:
         mtime = os.path.getmtime(self.conf_path)
         if mtime != self._conf_mtime:
             log.info("scheduler conf changed, reloading")
-            self._conf_mtime = mtime
-            with open(self.conf_path) as f:
-                self.conf = load_conf(f.read())
+            self.conf = self._load(None)   # re-applies shard identity
 
     def run_once(self):
         """One scheduling cycle (scheduler.go runOnce).  The whole
@@ -65,6 +79,12 @@ class Scheduler:
         self._maybe_reload_conf()
         start = time.perf_counter()
         root = trace.begin_session(cycle=self.cycles)
+        shard_conf = self.conf.configurations.get("allocate", {})
+        if str(shard_conf.get("shard-mode", "")) == "subtree":
+            # vtpctl shards reads per-shard cycle time off /traces by
+            # this label; the conductor REPRODUCE line replays it
+            root.labels["shard"] = (f"{shard_conf.get('shard-index', 0)}"
+                                    f"/{shard_conf.get('shard-count', 1)}")
         ssn = None
         try:
             with trace.span("open_session", kind="action"):

@@ -1226,6 +1226,20 @@ class _Handler(BaseHTTPRequestHandler):
             with st._bind_mutex:
                 for b in body.get("binds", []):
                     try:
+                        if b.get("pod") is not None and \
+                                f"{b['namespace']}/{b['name']}" \
+                                not in cl.pods:
+                            # keyspace-partitioned write plane: the
+                            # pending pod lived in the META leader
+                            # group; its bind relocates it here, to the
+                            # group owning the node, so this group's
+                            # chip accounting sees node AND occupant
+                            # together.  Admit-then-bind is one atomic
+                            # step under _bind_mutex; the admitted pod
+                            # is nodeless/Pending, so the put guard has
+                            # nothing to refuse and the capacity
+                            # verdict below is the only arbiter.
+                            cl.put_object("pod", codec.decode(b["pod"]))
                         err = st.check_bind_capacity(
                             b["namespace"], b["name"], b["node_name"])
                         if err:

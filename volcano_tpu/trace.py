@@ -147,6 +147,10 @@ REASON_ENUM = (
     "scheduling-gated",
     "gang-not-ready",
     "numa-mismatch",
+    # a scheduler shard lost the server's check-and-bind arbitration
+    # to another shard's optimistic cross-subtree gang (per-item 409);
+    # the gang re-queues through the loser's next cycle
+    "cross-shard-conflict",
     "other",
 )
 
@@ -159,6 +163,10 @@ _REASON_RULES: Tuple[Tuple[Tuple[str, ...], str], ...] = (
     # not read as a capacity wait
     (("elastic: waiting", "waiting for capacity"),
      "elastic-waiting-for-capacity"),
+    # before the device/insufficient rules: the flush_binds loser path
+    # prefixes the server's 409 refusal ("bind overcommit: node ...")
+    # with this marker when a subtree shard plan is active
+    (("cross-shard",), "cross-shard-conflict"),
     (("quarantin",), "quarantined"),
     (("warm spare",), "warm-spare-reserved"),
     (("node selector", "node affinity", "nodegroup", "affinity "),
