@@ -2499,6 +2499,704 @@ def goodput_smoke() -> int:
     return 0 if ok else 1
 
 
+# -- inference serving plane (ISSUE 17) --------------------------------
+
+
+SERVE_CONF = {
+    "actions": "enqueue, allocate, elastic, gangpreempt, backfill",
+    "tiers": [
+        {"plugins": [{"name": "priority"}, {"name": "gang"},
+                     {"name": "failover"}, {"name": "elastic"},
+                     {"name": "serving"}, {"name": "conformance"}]},
+        {"plugins": [{"name": "overcommit"}, {"name": "drf"},
+                     {"name": "predicates"}, {"name": "proportion"},
+                     {"name": "nodeorder"}, {"name": "binpack"},
+                     {"name": "deviceshare"},
+                     {"name": "network-topology-aware"}]},
+    ],
+    # unlike ELASTIC_CONF this plane wants a real cooldown: a serving
+    # gang the autoscaler just grew must not be handed back by
+    # shrink-pending-to-fit one session later
+    "configurations": {"elastic": {"elastic.cooldownSeconds": 5}},
+}
+
+
+def _serving_vcjob(name, slices, lo, hi, pods_per_slice, stats_dir,
+                   slo_ms=50.0, target_qps=100.0):
+    """Serving replica group = elastic gang + the SLO contract
+    (api/serving.py): min/max replicas ride the elastic min/max-slices
+    annotations, one slice per replica."""
+    from volcano_tpu.api import elastic as eapi
+    from volcano_tpu.api import serving as sapi
+    from volcano_tpu.api.pod import make_pod
+    from volcano_tpu.api.resource import TPU
+    from volcano_tpu.api.vcjob import TaskSpec, VCJob
+    return VCJob(
+        name=name, min_available=slices * pods_per_slice,
+        annotations={
+            sapi.SLO_P99_MS_ANNOTATION: str(slo_ms),
+            sapi.MIN_REPLICAS_ANNOTATION: str(lo),
+            sapi.MAX_REPLICAS_ANNOTATION: str(hi),
+            sapi.TARGET_QPS_ANNOTATION: str(target_qps),
+            sapi.STATS_DIR_ANNOTATION: stats_dir,
+            eapi.ELASTIC_MIN_SLICES_ANNOTATION: str(lo),
+            eapi.ELASTIC_MAX_SLICES_ANNOTATION: str(hi),
+            eapi.ELASTIC_SLICES_ANNOTATION: str(slices),
+        },
+        plugins={"jax": []},
+        tasks=[TaskSpec(name="replica",
+                        replicas=slices * pods_per_slice,
+                        template=make_pod(
+                            "s", requests={"cpu": 8, TPU: 4}))])
+
+
+def _serve_pool_tiers(kubectl, pool, gang_slices):
+    """min hypernode-LCA tier between a gang's slices and the serving
+    pool — the bench-side replica of the scheduler's victim score
+    (actions/elastic.py), computed from the SAME hypernode objects so
+    the adjacency assertion audits the scheduler from outside."""
+    from volcano_tpu.api.hypernode import HyperNodesInfo
+    hni = HyperNodesInfo(kubectl.hypernodes.values(),
+                         real_nodes=list(kubectl.nodes.keys()))
+    best = None
+    for gs in gang_slices:
+        for ps in pool:
+            if gs in hni.members and ps in hni.members:
+                tier = hni.lca_tier_of_leaves(gs, ps)
+            else:
+                tier = 99
+            best = tier if best is None else min(best, tier)
+    return 99 if best is None else best
+
+
+def _job_slices_now(kubectl, job_key):
+    from volcano_tpu.api.types import TPU_SLICE_LABEL, TaskStatus
+    j = kubectl.vcjobs.get(job_key)
+    if j is None:
+        return []
+    out = set()
+    for p in kubectl.pods.values():
+        if p.owner == j.uid and p.node_name \
+                and p.phase in (TaskStatus.BOUND, TaskStatus.RUNNING) \
+                and p.node_name in kubectl.nodes:
+            s = kubectl.nodes[p.node_name].labels.get(TPU_SLICE_LABEL)
+            if s:
+                out.add(s)
+    return sorted(out)
+
+
+def bench_serving_wire_smoke() -> dict:
+    """Traffic step -> replica stats -> REAL agents -> wire -> store
+    fold -> autoscaler scale-up -> topology-aware burst preemption
+    (the training gang shrinks, steered off the freed block) through
+    the REAL process control plane — the tier-1 guard that the
+    serving loop works over the wire, not just in-process."""
+    import os
+    import time as _time
+
+    from volcano_tpu.agent.agent import FakeUsageProvider, NodeAgent
+    from volcano_tpu.agent.collect import ServingCollector
+    from volcano_tpu.agent.handlers import ServingHandler
+    from volcano_tpu.api import elastic as eapi
+    from volcano_tpu.api import serving as sapi
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.api.types import JobPhase, TaskStatus
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.simulator import slice_nodes
+    from volcano_tpu.workloads.serve import ServingStatsReporter
+
+    plane = _WirePlane()
+    conf_path = os.path.join(plane.logdir, "serve-conf.yaml")
+    with open(conf_path, "w") as f:
+        json.dump(SERVE_CONF, f)     # JSON is valid YAML
+    kubectl = None
+    agents = {}
+    try:
+        plane.spawn("server", "-m", "volcano_tpu.server",
+                    "--port", str(plane.port), "--tick-period", "0.05")
+        import urllib.request
+
+        def up():
+            try:
+                with urllib.request.urlopen(plane.url + "/healthz",
+                                            timeout=1):
+                    return True
+            except OSError:
+                return False
+        _wire_wait(up, 20, "state server /healthz")
+        plane.spawn("controllers", "-m", "volcano_tpu",
+                    "--cluster-url", plane.url,
+                    "--components", "controllers", "--period", "0.05")
+        plane.spawn("scheduler", "-m", "volcano_tpu",
+                    "--cluster-url", plane.url,
+                    "--components", "scheduler", "--period", "0.05",
+                    "--conf", conf_path)
+        kubectl = RemoteCluster(plane.url)
+        # sa/sb share the serving DCN pod, sc sits across the DCN —
+        # the distance differential the victim score ranks on
+        for sname, dcn in (("sa", "dcn-0"), ("sb", "dcn-0"),
+                           ("sc", "dcn-1")):
+            for node in slice_nodes(slice_for(sname, "v5e-16"),
+                                    dcn_pod=dcn):
+                kubectl.add_node(node)
+
+        stats_dir = os.path.join(plane.logdir, "serving")
+        os.makedirs(stats_dir, exist_ok=True)
+        kubectl.add_vcjob(_serving_vcjob(
+            "infer", 1, 1, 2, 4, stats_dir, slo_ms=50.0,
+            target_qps=100.0))
+        kubectl.add_vcjob(_elastic_vcjob("train", 2, 1, 2, 4))
+
+        def running(jname, want):
+            j = kubectl.vcjobs.get(f"default/{jname}")
+            if j is None or j.phase is not JobPhase.RUNNING:
+                return False
+            return sum(1 for p in kubectl.pods.values()
+                       if p.owner == j.uid and p.node_name
+                       and p.phase is TaskStatus.RUNNING) >= want
+        _wire_wait(lambda: running("infer", 4) and running("train", 8),
+                   60, lambda: "serve smoke gangs never ran "
+                   f"({plane.log_tails()[-900:]})")
+
+        j = kubectl.vcjobs["default/infer"]
+        env_ok = all(
+            sapi.ENV_STATS_FILE in p.containers[0].env
+            for p in kubectl.pods.values() if p.owner == j.uid)
+
+        col = ServingCollector(stats_dir)
+        served = {"n": 0.0}
+        pod_req = {}                 # uid -> cumulative requests
+        flags = {"victim_marker": False, "victim_avoid": []}
+
+        def feed(qps, dt):
+            """One replica beat: the offered rate split across the
+            group's pods (each replica serves its share, as a load
+            balancer would spread it), cumulative stats -> REAL
+            per-host agents -> ServingReport over the wire.  The
+            store folds the group QPS back as the SUM of the shares."""
+            served["n"] += qps * dt
+            pg = kubectl.podgroups.get("default/infer")
+            sj = kubectl.vcjobs.get("default/infer")
+            if pg is None or sj is None:
+                return
+            epoch = int(pg.annotations.get(
+                eapi.ELASTIC_GENERATION_ANNOTATION, 0) or 0)
+            pods = [p for p in kubectl.pods.values()
+                    if p.owner == sj.uid and p.node_name
+                    and p.phase is TaskStatus.RUNNING]
+            for p in pods:
+                pod_req[p.uid] = pod_req.get(p.uid, 0.0) + \
+                    qps * dt / max(1, len(pods))
+                n = int(pod_req[p.uid])
+                ServingStatsReporter(
+                    sapi.stats_file_for(stats_dir, p.uid),
+                    epoch=epoch).report(
+                        requests=n, slo_ok=n,
+                        p50_ms=4.0, p99_ms=30.0)
+                if p.node_name not in agents:
+                    agents[p.node_name] = NodeAgent(
+                        kubectl, p.node_name, FakeUsageProvider(),
+                        handlers=[ServingHandler],
+                        serving_collector=col)
+            for a in agents.values():
+                a.sync()
+            tpg = kubectl.podgroups.get("default/train")
+            if tpg is not None and \
+                    tpg.annotations.get(sapi.VICTIM_ANNOTATION):
+                flags["victim_marker"] = True
+                flags["victim_avoid"] = list(
+                    eapi.avoid_slices(tpg))
+
+        def wait_feed(cond, timeout, msg, qps):
+            deadline = _time.monotonic() + timeout
+            while _time.monotonic() < deadline:
+                feed(qps, 0.25)
+                if cond():
+                    return
+                _time.sleep(0.25)
+            raise AssertionError(
+                "serve smoke: timed out waiting for "
+                + (msg() if callable(msg) else msg))
+
+        # phase 1: cruise below the scale-up threshold — the
+        # hysteresis must HOLD (no decision on quiet traffic)
+        for _ in range(8):
+            feed(60.0, 0.25)
+            _time.sleep(0.25)
+        pg = kubectl.podgroups["default/infer"]
+        no_premature = sapi.PG_LAST_DECISION_ANNOTATION \
+            not in pg.annotations
+        qps_low = sapi.ann_float(pg.annotations,
+                                 sapi.PG_QPS_ANNOTATION)
+
+        # phase 2: the traffic step — ONE decision sized for the
+        # burst, then the funded preemption frees the chips
+        t_step = _time.monotonic()
+        state = {}
+
+        def decision_seen():
+            g = kubectl.podgroups.get("default/infer")
+            d = "" if g is None else g.annotations.get(
+                sapi.PG_LAST_DECISION_ANNOTATION, "")
+            if d.startswith("scale-up") and "t" not in state:
+                state["t"] = _time.monotonic()
+                state["decision"] = d
+            return "t" in state
+        wait_feed(decision_seen, 30,
+                  lambda: "autoscaler decision after the step "
+                  f"({plane.log_tails()[-900:]})", 180.0)
+
+        def train_shrunk():
+            g = kubectl.podgroups.get("default/train")
+            if g is None or eapi.current_slices(g) != 1:
+                return False
+            if "t_free" not in state:
+                state["t_free"] = _time.monotonic()
+            return True
+        wait_feed(train_shrunk, 60,
+                  lambda: "victim shrink to free the burst chips "
+                  f"({plane.log_tails()[-900:]})", 180.0)
+
+        def serving_at_2():
+            g = kubectl.podgroups.get("default/infer")
+            return (g is not None and eapi.current_slices(g) == 2
+                    and running("infer", 8))
+        wait_feed(serving_at_2, 60,
+                  lambda: "serving gang running at 2 replicas "
+                  f"({plane.log_tails()[-900:]})", 180.0)
+        t_serving = _time.monotonic()
+
+        pg = kubectl.podgroups["default/infer"]
+        tpg = kubectl.podgroups["default/train"]
+        pool = sapi.pool_slices(pg)
+        train_slices = _job_slices_now(kubectl, "default/train")
+        hist = eapi.resize_history(tpg)
+        shrink_rec = [r for r in hist if r.get("kind") == "shrink"]
+        return {
+            "scale_up_ok": True,
+            "preempt_ok": bool(shrink_rec)
+            and all(int(r.get("to", 0)) >= 1 for r in hist),
+            "env_ok": env_ok,
+            "no_premature_decision": no_premature,
+            "victim_marker_seen": flags["victim_marker"],
+            "victim_avoid_slices": flags["victim_avoid"],
+            "qps_low": round(qps_low, 1),
+            "qps_high": round(sapi.ann_float(
+                pg.annotations, sapi.PG_QPS_ANNOTATION), 1),
+            "decision": state.get("decision", ""),
+            "step_to_decision_s": round(state["t"] - t_step, 3),
+            "decision_to_chips_free_s": round(
+                state["t_free"] - state["t"], 3),
+            "decision_to_serving_s": round(t_serving - state["t"], 3),
+            "replicas_final": eapi.current_slices(pg),
+            "pool_slices": pool,
+            "train_slices_final": train_slices,
+            "pool_disjoint_from_victim": not (
+                set(pool) & set(train_slices)),
+            "hosts": 12,
+        }
+    finally:
+        if kubectl is not None:
+            kubectl.close()
+        plane.shutdown()
+
+
+def serve_smoke() -> int:
+    """Seconds-scale serving drill for tier-1: one scale-up on a
+    traffic step + one topology-aware burst preemption through the
+    real process control plane, mirroring --elastic-smoke /
+    --goodput-smoke.  Prints one JSON line."""
+    try:
+        out = bench_serving_wire_smoke()
+        ok = (out["scale_up_ok"] and out["preempt_ok"]
+              and out["env_ok"] and out["no_premature_decision"]
+              and out["victim_marker_seen"]
+              and out["pool_disjoint_from_victim"]
+              and out["replicas_final"] == 2)
+    except AssertionError as e:
+        out, ok = {"error": str(e)[-900:]}, False
+    print(json.dumps({"metric": "serve_smoke", "ok": ok, **out}))
+    return 0 if ok else 1
+
+
+def bench_serving() -> dict:
+    """One compressed diurnal day against the REAL process plane:
+    REAL batched-forward serving replicas (workloads/serve.py
+    subprocesses) behind a bench-side load balancer, the SLO-driven
+    autoscaler riding the folded QPS/p99, topology-aware burst
+    preemption funding the scale-ups out of the nearest training
+    gang, and the elastic reabsorption on the descent — committed as
+    SERVE_r{N}.json with the serving/training Pareto row."""
+    import os
+    import subprocess
+    import sys as _sys
+    import time as _time
+
+    from volcano_tpu.agent.agent import FakeUsageProvider, NodeAgent
+    from volcano_tpu.agent.collect import (GoodputCollector,
+                                           ServingCollector)
+    from volcano_tpu.agent.handlers import (GoodputHandler,
+                                            ServingHandler)
+    from volcano_tpu.api import elastic as eapi
+    from volcano_tpu.api import goodput as gapi
+    from volcano_tpu.api import serving as sapi
+    from volcano_tpu.api.devices.tpu.topology import slice_for
+    from volcano_tpu.api.slicehealth import (
+        FAILOVER_GENERATION_ANNOTATION, LAST_STEP_ANNOTATION,
+        RESUME_STEP_ANNOTATION)
+    from volcano_tpu.api.types import JobPhase, TaskStatus
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.simulator import slice_nodes
+    from volcano_tpu.workloads.progress import ProgressReporter
+    from volcano_tpu.workloads.serve import DiurnalTraffic
+
+    DAY_S = 45.0
+    BASE_QPS, PEAK_QPS = 400.0, 3000.0
+    TARGET_QPS, SLO_MS = 800.0, 50.0
+    FLOOR_STEP = 500
+    BEAT_S = 0.25
+
+    plane = _WirePlane()
+    conf_path = os.path.join(plane.logdir, "serve-conf.yaml")
+    with open(conf_path, "w") as f:
+        json.dump(SERVE_CONF, f)
+    kubectl = None
+    agents = {}
+    workers = {}        # serving pod uid -> (Popen, logf)
+    try:
+        plane.spawn("server", "-m", "volcano_tpu.server",
+                    "--port", str(plane.port), "--tick-period", "0.05")
+        import urllib.request
+
+        def up():
+            try:
+                with urllib.request.urlopen(plane.url + "/healthz",
+                                            timeout=1):
+                    return True
+            except OSError:
+                return False
+        _wire_wait(up, 20, "state server /healthz")
+        plane.spawn("controllers", "-m", "volcano_tpu",
+                    "--cluster-url", plane.url,
+                    "--components", "controllers", "--period", "0.05")
+        plane.spawn("scheduler", "-m", "volcano_tpu",
+                    "--cluster-url", plane.url,
+                    "--components", "scheduler", "--period", "0.05",
+                    "--conf", conf_path)
+        kubectl = RemoteCluster(plane.url)
+        for sname, dcn in (("sa", "dcn-0"), ("sb", "dcn-0"),
+                           ("sc", "dcn-0"), ("sd", "dcn-1"),
+                           ("se", "dcn-1"), ("sf", "dcn-1")):
+            for node in slice_nodes(slice_for(sname, "v5e-16"),
+                                    dcn_pod=dcn):
+                kubectl.add_node(node)
+
+        stats_dir = os.path.join(plane.logdir, "serving")
+        progress_dir = os.path.join(plane.logdir, "progress")
+        traffic_dir = os.path.join(plane.logdir, "traffic")
+        for d in (stats_dir, progress_dir, traffic_dir):
+            os.makedirs(d, exist_ok=True)
+
+        kubectl.add_vcjob(_serving_vcjob(
+            "infer", 1, 1, 3, 4, stats_dir, slo_ms=SLO_MS,
+            target_qps=TARGET_QPS))
+        for tname in ("ta", "tb"):
+            tj = _elastic_vcjob(tname, 2, 1, 3, 4)
+            tj.annotations[LAST_STEP_ANNOTATION] = str(FLOOR_STEP)
+            tj.annotations[gapi.PROGRESS_DIR_ANNOTATION] = progress_dir
+            kubectl.add_vcjob(tj)
+
+        def running(jname, want):
+            j = kubectl.vcjobs.get(f"default/{jname}")
+            if j is None or j.phase is not JobPhase.RUNNING:
+                return False
+            return sum(1 for p in kubectl.pods.values()
+                       if p.owner == j.uid and p.node_name
+                       and p.phase is TaskStatus.RUNNING) >= want
+        # serving up + training absorbed every idle slice
+        _wire_wait(lambda: running("infer", 4)
+                   and _chip_utilization(kubectl) >= 0.99, 90,
+                   lambda: "serve bench gangs never filled the fleet "
+                   f"({plane.log_tails()[-900:]})")
+
+        scol = ServingCollector(stats_dir)
+        gcol = GoodputCollector(progress_dir)
+        for node in kubectl.nodes:
+            agents[node] = NodeAgent(
+                kubectl, node, FakeUsageProvider(),
+                handlers=[GoodputHandler, ServingHandler],
+                goodput_collector=gcol, serving_collector=scol)
+
+        traffic = DiurnalTraffic(base_qps=BASE_QPS,
+                                 peak_qps=PEAK_QPS, day_s=DAY_S,
+                                 seed=7)
+        fed = {g: {"step": FLOOR_STEP, "epoch": 0, "max_resume": 0}
+               for g in ("ta", "tb")}
+        floor_violations = 0
+        step_regressions = 0
+
+        def serving_pods():
+            sj = kubectl.vcjobs.get("default/infer")
+            if sj is None:
+                return []
+            return [p for p in kubectl.pods.values()
+                    if p.owner == sj.uid and p.node_name
+                    and p.phase is TaskStatus.RUNNING]
+
+        def lb_beat(t_rel):
+            """The load-balancer driver: evaluate the diurnal curve,
+            split it across the RUNNING replicas, reconcile one REAL
+            serve.py subprocess per replica (env straight off the
+            pod's injected container env — the jax-plugin contract)."""
+            total = traffic.qps_at(t_rel)
+            pods = serving_pods()
+            live = {p.uid for p in pods}
+            for uid in [u for u in workers if u not in live]:
+                proc, logf = workers.pop(uid)
+                proc.terminate()
+                try:
+                    proc.wait(timeout=5)
+                except Exception:  # noqa: BLE001
+                    proc.kill()
+                logf.close()
+            per = total / max(1, len(pods))
+            for p in pods:
+                tf = os.path.join(traffic_dir, f"lb-{p.uid}.json")
+                tmp = tf + ".tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump({"qps": per}, f)
+                os.replace(tmp, tf)
+                if p.uid not in workers:
+                    env = dict(os.environ, PYTHONPATH=plane.repo,
+                               JAX_PLATFORMS="cpu")
+                    env.pop("XLA_FLAGS", None)
+                    env.update(p.containers[0].env)
+                    env.update(SERVE_DURATION_S="600",
+                               SERVE_BEAT_S="0.2",
+                               SERVE_SLO_MS=str(SLO_MS),
+                               SERVE_TRAFFIC_FILE=tf,
+                               SERVE_MODE="synthetic")
+                    logf = open(os.path.join(
+                        plane.logdir, f"serve-{p.uid[:8]}.log"), "w")
+                    workers[p.uid] = (subprocess.Popen(
+                        [_sys.executable, "-m",
+                         "volcano_tpu.workloads.serve"],
+                        env=env, stdout=logf, stderr=logf,
+                        cwd=plane.repo), logf)
+            return total, len(pods)
+
+        def feed_training():
+            """Epoch-aware training progress (the chaos-conductor
+            contract): a resize drain resumes from the stamped floor,
+            never below it, and the fed step never rewinds."""
+            nonlocal floor_violations, step_regressions
+            for g in ("ta", "tb"):
+                pg = kubectl.podgroups.get(f"default/{g}")
+                tj = kubectl.vcjobs.get(f"default/{g}")
+                if pg is None or tj is None:
+                    continue
+
+                def _i(key):
+                    try:
+                        return int(pg.annotations.get(key, 0) or 0)
+                    except (TypeError, ValueError):
+                        return 0
+                epoch = _i(FAILOVER_GENERATION_ANNOTATION) + \
+                    _i(eapi.ELASTIC_GENERATION_ANNOTATION)
+                st = fed[g]
+                if epoch != st["epoch"]:
+                    st["epoch"] = epoch
+                    resume = _i(RESUME_STEP_ANNOTATION)
+                    if resume and resume < FLOOR_STEP:
+                        floor_violations += 1
+                    if resume and resume < st["max_resume"]:
+                        step_regressions += 1
+                    st["max_resume"] = max(st["max_resume"], resume)
+                    st["step"] = max(FLOOR_STEP, resume, st["step"])
+                st["step"] += 1
+                for p in kubectl.pods.values():
+                    if p.owner == tj.uid and p.node_name and \
+                            p.phase is TaskStatus.RUNNING:
+                        ProgressReporter(
+                            gapi.progress_file_for(progress_dir,
+                                                   p.uid),
+                            epoch=epoch).report(
+                                step=st["step"],
+                                examples=st["step"] * 8.0)
+
+        timeline = []
+        decisions = []
+        episodes = []           # completed scale-up episodes
+        pending_up = None
+        victims = {}      # (gang, freed slices) -> adjacency audit
+        decision_snap = None      # holdings + pool at decision time
+        t0 = _time.monotonic()
+        horizon = DAY_S + 30.0      # one day + the descent tail
+        while _time.monotonic() - t0 < horizon:
+            t_rel = _time.monotonic() - t0
+            total, nrep = lb_beat(min(t_rel, DAY_S + 29.0))
+            feed_training()
+            for a in agents.values():
+                try:
+                    a.sync()
+                except Exception:  # noqa: BLE001 — resize churn
+                    pass
+            pg = kubectl.podgroups.get("default/infer")
+            if pg is None:
+                _time.sleep(BEAT_S)
+                continue
+            cur = eapi.current_slices(pg)
+            ta_s = _job_slices_now(kubectl, "default/ta")
+            tb_s = _job_slices_now(kubectl, "default/tb")
+            timeline.append({
+                "t": round(t_rel, 2), "qps_offered": round(total, 1),
+                "replicas": cur, "replicas_running": nrep,
+                "ta_slices": len(ta_s), "tb_slices": len(tb_s),
+                "qps_folded": round(sapi.ann_float(
+                    pg.annotations, sapi.PG_QPS_ANNOTATION), 1),
+                "p99_folded_ms": round(sapi.ann_float(
+                    pg.annotations, sapi.PG_P99_MS_ANNOTATION), 2),
+            })
+            d = pg.annotations.get(sapi.PG_LAST_DECISION_ANNOTATION)
+            if d and (not decisions or decisions[-1]["text"] != d):
+                decisions.append({"t": round(t_rel, 2), "text": d})
+                if d.startswith("scale-up"):
+                    pending_up = {"t": _time.monotonic(),
+                                  "text": d,
+                                  "ta": len(ta_s), "tb": len(tb_s),
+                                  "t_free": None}
+                    # decision-time snapshot: the candidate holdings
+                    # and pool the scheduler's victim ranking will
+                    # see — the audit must score THESE, not whatever
+                    # placements exist after the post-episode churn
+                    decision_snap = {
+                        "ta": ta_s, "tb": tb_s,
+                        "pool": sapi.pool_slices(pg)}
+            if pending_up is not None:
+                if pending_up["t_free"] is None and (
+                        len(ta_s) < pending_up["ta"]
+                        or len(tb_s) < pending_up["tb"]):
+                    pending_up["t_free"] = _time.monotonic()
+                want = int(pending_up["text"].split("->")[1]
+                           .split(" ")[0].rstrip(")"))
+                if cur == want and running("infer", want * 4):
+                    now = _time.monotonic()
+                    episodes.append({
+                        "decision": pending_up["text"],
+                        "decision_to_chips_free_s": round(
+                            pending_up["t_free"] - pending_up["t"], 3)
+                        if pending_up["t_free"] else None,
+                        "decision_to_serving_s": round(
+                            now - pending_up["t"], 3),
+                    })
+                    pending_up = None
+            # the victim audit: catch the marker mid-episode and
+            # score the FREED block (the stamped avoid-slices — the
+            # victim's own placements are already draining) against
+            # the pool, vs the slices the OTHER candidate holds, from
+            # the same hypernode objects the scheduler used.  The
+            # assertion: the eviction freed a block at least as close
+            # to the serving pool as anything the alternative victim
+            # could have offered.
+            snap = decision_snap or {"ta": ta_s, "tb": tb_s,
+                                     "pool": sapi.pool_slices(pg)}
+            pool = snap["pool"] or sapi.pool_slices(pg)
+            for g in ("ta", "tb"):
+                tpg = kubectl.podgroups.get(f"default/{g}")
+                if tpg is None or not pool:
+                    continue
+                freed = list(eapi.avoid_slices(tpg))
+                if not tpg.annotations.get(sapi.VICTIM_ANNOTATION) \
+                        or not freed:
+                    continue
+                episode_key = (g, tuple(freed))
+                if episode_key in victims:
+                    continue
+                other = "tb" if g == "ta" else "ta"
+                ft = _serve_pool_tiers(kubectl, pool, freed)
+                ot = _serve_pool_tiers(kubectl, pool, snap[other])
+                victims[episode_key] = {
+                    "victim": g, "t": round(t_rel, 2),
+                    "freed_slices": freed,
+                    "freed_pool_tier": ft,
+                    "other": other, "other_pool_tier": ot,
+                    "ici_adjacent_ok": ft <= ot,
+                    "pool": pool,
+                }
+            _time.sleep(max(0.0, BEAT_S - 0.05))
+
+        pg = kubectl.podgroups["default/infer"]
+        reqs = sapi.ann_float(pg.annotations,
+                              sapi.PG_REQUESTS_ANNOTATION)
+        ok_n = sapi.ann_float(pg.annotations,
+                              sapi.PG_SLO_OK_ANNOTATION)
+        attainment = (ok_n / reqs) if reqs > 0 else 0.0
+        max_rep = max(r["replicas"] for r in timeline)
+        min_rep_after_peak = min(
+            r["replicas"] for r in timeline
+            if r["t"] > DAY_S)
+        train_rows = {}
+        floors_held = True
+        for g in ("ta", "tb"):
+            tpg = kubectl.podgroups.get(f"default/{g}")
+            hist = eapi.resize_history(tpg) if tpg is not None else []
+            if any(int(r.get("to", 9)) < 1 for r in hist):
+                floors_held = False
+            avg_slices = sum(
+                r[f"{g}_slices"] for r in timeline) / len(timeline)
+            train_rows[g] = {
+                "goodput": gapi.ann_float(
+                    tpg.annotations, gapi.PG_GOODPUT_ANNOTATION)
+                if tpg is not None else 0.0,
+                "final_step": int(gapi.ann_float(
+                    tpg.annotations, gapi.PG_STEP_ANNOTATION))
+                if tpg is not None else 0,
+                "avg_slices": round(avg_slices, 2),
+                "resize_history": hist,
+            }
+        return {
+            "hosts": 24,
+            "day_s": DAY_S,
+            "slo_ms": SLO_MS,
+            "target_qps_per_replica": TARGET_QPS,
+            "requests_served": int(reqs),
+            "slo_attainment": round(attainment, 4),
+            "slo_attainment_ok": attainment >= 0.99,
+            "replicas_max": max_rep,
+            "replicas_after_descent": min_rep_after_peak,
+            "scaled_down_after_peak": min_rep_after_peak < max_rep,
+            "decisions": decisions,
+            "burst_preemption_episodes": episodes,
+            "victim_audit": sorted(victims.values(),
+                                   key=lambda v: v["t"]),
+            "victim_ici_adjacent_all": bool(victims) and all(
+                v["ici_adjacent_ok"] for v in victims.values()),
+            "training_floors_held": floors_held
+            and floor_violations == 0,
+            "training_step_regressions": step_regressions,
+            "pareto": {
+                "serving_slo_attainment": round(attainment, 4),
+                "serving_replicas_avg": round(sum(
+                    r["replicas"] for r in timeline)
+                    / len(timeline), 2),
+                "training": train_rows,
+            },
+            "timeline_tail": timeline[-8:],
+        }
+    finally:
+        for proc, logf in workers.values():
+            proc.terminate()
+        for proc, logf in workers.values():
+            try:
+                proc.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                proc.kill()
+            logf.close()
+        if kubectl is not None:
+            kubectl.close()
+        plane.shutdown()
+
+
 # -- control-plane crash chaos (kill -9 + WAL recovery) ----------------
 
 
@@ -4185,6 +4883,16 @@ if __name__ == "__main__":
         sys.exit(elastic_smoke())
     elif "--goodput-smoke" in sys.argv:
         sys.exit(goodput_smoke())
+    elif "--serve-smoke" in sys.argv:
+        sys.exit(serve_smoke())
+    elif "--serve" in sys.argv:
+        # the standalone serving-plane row committed as
+        # SERVE_r{N}.json: diurnal day against the real process
+        # plane, p99 SLO attainment >= 99%, topology-aware burst
+        # preemption latencies, training floors held, victim ICI
+        # adjacency audited from the scheduler's own hypernodes
+        print(json.dumps({"metric": "serving_diurnal_day",
+                          **bench_serving()}))
     elif "--goodput" in sys.argv:
         # the standalone goodput-observatory row committed as
         # GOODPUT_r{N}.json: learned throughput vectors within 10% of

@@ -40,17 +40,17 @@ def mk_agent(tmp_path, pods=(), usage=None):
                               enforcer=cg), cg
 
 
-def test_default_pipeline_has_eleven_registered_handlers():
+def test_default_pipeline_has_twelve_registered_handlers():
     """The sync loop owns no concerns: everything is a registered
     handler (adding one = registering, not editing the loop).
     netaccounting dispatches AFTER networkqos (same-sync caps are its
-    watermarks) and before enforcement; goodput rides the same pods
-    event after it."""
+    watermarks) and before enforcement; goodput and serving ride the
+    same pods event after it."""
     names = [cls.name for cls in registered_handlers()]
     assert names == [
         "usagereporter", "tpuhealth", "oversubscription", "cpuqos",
         "memoryqosv2", "networkqos", "netaccounting", "goodput",
-        "numaexporter", "enforcement", "eviction"]
+        "serving", "numaexporter", "enforcement", "eviction"]
     # subscriptions are typed: eviction never sees plain usage events
     by_name = {cls.name: cls for cls in registered_handlers()}
     assert by_name["eviction"].events == (EVENT_PRESSURE,)
@@ -58,6 +58,7 @@ def test_default_pipeline_has_eleven_registered_handlers():
     assert by_name["enforcement"].events == (EVENT_PODS,)
     assert by_name["netaccounting"].events == (EVENT_PODS,)
     assert by_name["goodput"].events == (EVENT_PODS,)
+    assert by_name["serving"].events == (EVENT_PODS,)
 
 
 def test_custom_handler_registers_and_dispatches(tmp_path):

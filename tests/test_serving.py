@@ -1,0 +1,570 @@
+"""Inference serving plane (ISSUE 17): SLO-driven elastic replica
+groups with topology-aware preemption under diurnal traffic.
+
+The subsystem spans four layers:
+
+  workload   (workloads/serve.py): batched-forward serving workers
+      pull a request queue, measure per-request latency, and publish
+      one CUMULATIVE stats record per beat to the jax-plugin-injected
+      VTP_SERVING_STATS_FILE, stamped with the restart/resize epoch;
+  agent      (agent/collect.py ServingCollector + handlers.py
+      ServingHandler): EWMA QPS off the SHARED RateWindow machinery
+      ("restart" reset policy), one ServingReport per node per sync
+      (change-elided, debt-reposted);
+  store      (cache/fake_cluster.py): the report folds into PODGROUP
+      annotations — QPS summed across replicas, p99 maxed, request/
+      SLO ledgers accumulated idempotently — and sticks across
+      whole-podgroup writes from stale mirrors;
+  scheduler  (controllers/serving.py + plugins/serving.py +
+      actions/elastic.py): the autoscaler turns the folded signal
+      into the SAME desired-slices decision the elastic controller
+      executes; a scale-up that outruns idle capacity is funded by a
+      topology-aware shrink of the training gang nearest the serving
+      pool (hypernode LCA), through the checkpointed elastic drain —
+      never a kill.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from volcano_tpu.agent.agent import FakeUsageProvider, NodeAgent
+from volcano_tpu.agent.collect import ServingCollector
+from volcano_tpu.agent.handlers import ServingHandler
+from volcano_tpu.api import elastic as eapi
+from volcano_tpu.api import serving as sapi
+from volcano_tpu.api.codec import decode, encode
+from volcano_tpu.api.pod import make_pod
+from volcano_tpu.api.podgroup import PodGroup
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.types import GROUP_NAME_ANNOTATION, TaskStatus
+from volcano_tpu.controllers.serving import (
+    HOLD_DOWN_SYNCS,
+    P99_HEADROOM_FRAC,
+    RESIZE_STABILIZE_S,
+    SCALE_DOWN_FRAC,
+    SCALE_UP_FRAC,
+    SIGNAL_STALE_S,
+    ServingController,
+)
+from volcano_tpu.simulator import make_tpu_cluster
+from volcano_tpu.util import RateWindow
+from volcano_tpu.workloads.serve import ServingStatsReporter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class Clock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> None:
+        self.t += dt
+
+
+def write_stats(root, uid, requests, slo_ok=None, p50=4.0, p99=20.0,
+                epoch=0, ts=0.0):
+    ServingStatsReporter(
+        sapi.stats_file_for(root, uid), epoch=epoch,
+        now=lambda: ts).report(
+            requests=requests,
+            slo_ok=requests if slo_ok is None else slo_ok,
+            p50_ms=p50, p99_ms=p99)
+
+
+def serving_podgroup(qps=0.0, p99=20.0, cur=1, lo=1, hi=3,
+                     target=100.0, slo=50.0, epoch=0, gen=0,
+                     updated=None, now=1000.0, **extra):
+    """A podgroup carrying a folded serving summary, as the store
+    would leave it."""
+    ann = {
+        sapi.SLO_P99_MS_ANNOTATION: str(slo),
+        sapi.MIN_REPLICAS_ANNOTATION: str(lo),
+        sapi.MAX_REPLICAS_ANNOTATION: str(hi),
+        sapi.TARGET_QPS_ANNOTATION: str(target),
+        eapi.ELASTIC_MIN_SLICES_ANNOTATION: str(lo),
+        eapi.ELASTIC_MAX_SLICES_ANNOTATION: str(hi),
+        eapi.ELASTIC_SLICES_ANNOTATION: str(cur),
+        sapi.PG_QPS_ANNOTATION: f"{qps:.3f}",
+        sapi.PG_P99_MS_ANNOTATION: f"{p99:.3f}",
+        sapi.PG_EPOCH_ANNOTATION: str(epoch),
+        sapi.PG_UPDATED_TS_ANNOTATION:
+            f"{(now if updated is None else updated):.3f}",
+    }
+    if gen:
+        ann[eapi.ELASTIC_GENERATION_ANNOTATION] = str(gen)
+    ann.update(extra)
+    return PodGroup(name="infer", namespace="default",
+                    annotations=ann)
+
+
+def controller_with(pg, clock):
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    cluster.add_podgroup(pg)
+    ctrl = ServingController(now=clock)
+    ctrl.initialize(cluster)
+    return ctrl, cluster
+
+
+# -- annotations, enums, helpers ---------------------------------------
+
+def test_scale_kinds_enum_is_bounded_and_registered():
+    """The serving_scale_decisions_total `kind` label resolves to the
+    bounded enum — vtplint's cardinality guarantee."""
+    from volcano_tpu import bundle
+    assert sapi.SCALE_KINDS == ("up", "down")
+    spec = bundle.FAMILY_LABELS["serving_scale_decisions_total"]
+    assert spec["kind"] == "enum:volcano_tpu.api.serving:SCALE_KINDS"
+    for fam in ("serving_groups", "serving_qps_total",
+                "serving_slo_attainment_min",
+                "serving_scale_decisions_total",
+                "serving_victim_shrinks_total"):
+        assert fam in bundle.FAMILIES
+
+
+def test_hysteresis_constants_pinned():
+    """The damping constants the burst tests below rely on.  Moving
+    any of these changes flap behavior under step traffic — retune
+    the RateWindow tests in this file alongside."""
+    assert SCALE_UP_FRAC == 1.15
+    assert SCALE_DOWN_FRAC == 0.60
+    assert P99_HEADROOM_FRAC == 0.80
+    assert HOLD_DOWN_SYNCS == 3
+    assert SIGNAL_STALE_S == 60.0
+    assert RESIZE_STABILIZE_S == 10.0
+
+
+def test_serving_contract_helpers():
+    pg = serving_podgroup(target=250.0, lo=2, hi=5, slo=75.0)
+    assert sapi.is_serving(pg)
+    assert sapi.slo_p99_ms(pg) == 75.0
+    assert sapi.replica_range(pg) == (2, 5)
+    assert sapi.target_qps_per_replica(pg) == 250.0
+    assert not sapi.is_serving(PodGroup(name="t", namespace="d"))
+    # invalid ranges collapse to None, never a crash
+    bad = PodGroup(name="b", namespace="d", annotations={
+        sapi.SLO_P99_MS_ANNOTATION: "50",
+        sapi.MIN_REPLICAS_ANNOTATION: "3",
+        sapi.MAX_REPLICAS_ANNOTATION: "1"})
+    assert sapi.replica_range(bad) is None
+
+
+def test_serving_report_codec_roundtrip():
+    rep = sapi.ServingReport(node="n0", ts=123.5, usages=[
+        sapi.ReplicaServing(pod_key="default/p0", uid="u1",
+                            job="default/infer", epoch=2, qps=55.5,
+                            p50_ms=4.0, p99_ms=21.0, requests=1200,
+                            slo_ok=1188)])
+    back = decode(json.loads(json.dumps(encode(rep))))
+    assert isinstance(back, sapi.ServingReport)
+    assert back.node == "n0" and back.name == "n0"
+    u = back.usages[0]
+    assert (u.uid, u.job, u.epoch, u.requests, u.slo_ok) == \
+        ("u1", "default/infer", 2, 1200, 1188)
+    assert u.qps == pytest.approx(55.5)
+
+
+# -- RateWindow under bursty arrivals (the damping substrate) ----------
+
+def test_rate_window_step_burst_no_overshoot():
+    """A step-function arrival rate (the diurnal burst edge) must
+    converge monotonically toward the new rate WITHOUT overshooting —
+    overshoot would double-trigger the autoscaler's up rule, turning
+    one burst into two resizes."""
+    w = RateWindow(alpha=0.5, reset="restart")
+    total, t = 0.0, 0.0
+    w.fold(total, t)
+    for _ in range(10):           # cruise: 10 req/s
+        t += 1.0
+        total += 10
+        w.fold(total, t)
+    assert w.rate == pytest.approx(10.0, rel=0.01)
+    rates = []
+    for _ in range(10):           # step to 100 req/s
+        t += 1.0
+        total += 100
+        rates.append(w.fold(total, t))
+    assert all(r <= 100.0 * 1.001 for r in rates), rates
+    assert all(b >= a for a, b in zip(rates, rates[1:])), rates
+    assert rates[-1] == pytest.approx(100.0, rel=0.01)
+    # alpha=0.5 damping: one outlier beat moves the EWMA halfway,
+    # so crossing the SCALE_UP_FRAC threshold from cruise needs a
+    # SUSTAINED burst (>= 2 beats at ~2x), not a single spike
+    assert rates[0] == pytest.approx(55.0, rel=0.01)
+
+
+def test_rate_window_counter_reset_during_burst():
+    """A replica restarting MID-BURST (counter back to 0, "restart"
+    policy) must neither go negative nor spike: the EWMA carries and
+    decays, and steady post-restart traffic converges back."""
+    w = RateWindow(alpha=0.5, reset="restart")
+    total, t = 0.0, 0.0
+    w.fold(total, t)
+    for _ in range(8):            # burst at 100 req/s
+        t += 1.0
+        total += 100
+        w.fold(total, t)
+    peak = w.rate
+    assert peak == pytest.approx(100.0, rel=0.05)
+    # crash: counter restarts at 30 (below last reading)
+    t += 1.0
+    r = w.fold(30.0, t)
+    assert 0 <= r <= peak * 1.001          # no spike, no negative
+    total = 30.0
+    for _ in range(8):            # burst continues
+        t += 1.0
+        total += 100
+        w.fold(total, t)
+    assert w.rate == pytest.approx(100.0, rel=0.05)
+    # out-of-band restart (epoch bump) with a HIGHER counter: the
+    # explicit restart() wins over the counter heuristic
+    w.restart()
+    t += 1.0
+    r = w.fold(total + 5000, t)
+    assert 0 <= r <= 100.0 * 1.05
+
+
+def test_collector_epoch_bump_restarts_window(tmp_path):
+    """ServingCollector: a resize epoch bump force-restarts the QPS
+    window even when the resumed counter lands higher — absolute
+    counts across an epoch boundary are not traffic."""
+    root = str(tmp_path)
+    clock = Clock()
+    col = ServingCollector(root, now=clock)
+    write_stats(root, "u1", requests=100, epoch=0, ts=1000.0)
+    col.collect("n0")
+    clock.tick(1)
+    write_stats(root, "u1", requests=200, epoch=0, ts=1001.0)
+    col.collect("n0")
+    steady = col.rates()["u1"].qps
+    assert steady == pytest.approx(100.0)
+    clock.tick(1)
+    write_stats(root, "u1", requests=5000, epoch=1, ts=1002.0)
+    col.collect("n0")
+    st = col.rates()["u1"]
+    assert st.restarts == 1 and st.epoch == 1
+    assert 0 <= st.qps <= steady * 1.01
+    assert st.requests == 5000      # ledger carries the absolute
+
+
+# -- agent -> wire -> store fold ---------------------------------------
+
+def serving_pod(name, node, uid, job="infer"):
+    return make_pod(name, requests={"cpu": 4, TPU: 4},
+                    node_name=node, phase=TaskStatus.RUNNING,
+                    uid=uid,
+                    annotations={GROUP_NAME_ANNOTATION: job})
+
+
+def agent_with_serving(cluster, node, root, clock):
+    provider = FakeUsageProvider()
+    provider.set(node, cpu_fraction=0.2, tpu_chips_detected=4,
+                 tpu_chips_healthy=4)
+    return NodeAgent(cluster, node, provider,
+                     handlers=[ServingHandler],
+                     serving_collector=ServingCollector(
+                         root, now=clock))
+
+
+def test_fold_sums_qps_maxes_p99_accumulates_ledgers(tmp_path):
+    """Two replicas on two nodes: the store fold SUMS their QPS,
+    takes the max p99, and accumulates both request ledgers — with a
+    re-posted (lost-ack) report folding to a no-op."""
+    clock = Clock()
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    cluster.add_podgroup(PodGroup(name="infer", namespace="default"))
+    cluster.add_pod(serving_pod("i-0", "sa-w0", "u1"))
+    cluster.add_pod(serving_pod("i-1", "sa-w1", "u2"))
+    roots = {n: str(tmp_path / n) for n in ("sa-w0", "sa-w1")}
+    agents = {n: agent_with_serving(cluster, n, roots[n], clock)
+              for n in roots}
+    write_stats(roots["sa-w0"], "u1", 100, p99=20.0, ts=1000.0)
+    write_stats(roots["sa-w1"], "u2", 100, p99=35.0, ts=1000.0)
+    for a in agents.values():
+        a.sync()
+    clock.tick(2)
+    write_stats(roots["sa-w0"], "u1", 300, slo_ok=290, p99=20.0,
+                ts=1002.0)
+    write_stats(roots["sa-w1"], "u2", 200, p99=35.0, ts=1002.0)
+    for a in agents.values():
+        a.sync()
+    pg = cluster.podgroups["default/infer"]
+    qps = sapi.ann_float(pg, sapi.PG_QPS_ANNOTATION)
+    assert qps == pytest.approx(150.0, rel=0.05)       # 100 + 50
+    assert sapi.ann_float(pg, sapi.PG_P99_MS_ANNOTATION) == \
+        pytest.approx(35.0)
+    assert sapi.ann_float(pg, sapi.PG_REQUESTS_ANNOTATION) == 500
+    assert sapi.ann_float(pg, sapi.PG_SLO_OK_ANNOTATION) == 490
+    # lost-ack re-post: same cumulative ledgers fold to zero diff
+    for a in agents.values():
+        a.handlers[0]._last_report = None      # force re-post
+        a.sync()
+    assert sapi.ann_float(pg, sapi.PG_REQUESTS_ANNOTATION) == 500
+    assert sapi.ann_float(pg, sapi.PG_SLO_OK_ANNOTATION) == 490
+
+
+def test_fold_sticks_across_stale_whole_podgroup_write(tmp_path):
+    """A mirror writing back a whole podgroup it read BEFORE the fold
+    must not erase the serving summary (the goodput-stick argument,
+    serving keys)."""
+    clock = Clock()
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    cluster.add_podgroup(PodGroup(name="infer", namespace="default"))
+    cluster.add_pod(serving_pod("i-0", "sa-w0", "u1"))
+    root = str(tmp_path)
+    agent = agent_with_serving(cluster, "sa-w0", root, clock)
+    write_stats(root, "u1", 100, ts=1000.0)
+    agent.sync()
+    clock.tick(2)
+    write_stats(root, "u1", 300, ts=1002.0)
+    agent.sync()
+    pg = cluster.podgroups["default/infer"]
+    assert sapi.ann_float(pg, sapi.PG_REQUESTS_ANNOTATION) == 300
+    stale = PodGroup(name="infer", namespace="default")  # pre-fold copy
+    cluster.put_object("podgroup", stale)
+    pg = cluster.podgroups["default/infer"]
+    assert sapi.ann_float(pg, sapi.PG_REQUESTS_ANNOTATION) == 300
+    assert sapi.ann_float(pg, sapi.PG_QPS_ANNOTATION) > 0
+
+
+# -- the autoscaler (controllers/serving.py) ---------------------------
+
+def test_step_traffic_one_scale_up_sized_for_the_burst():
+    """A step burst triggers EXACTLY ONE scale-up, sized straight to
+    ceil(qps/target) — and the in-flight decision blocks re-deciding
+    while it executes."""
+    clock = Clock()
+    pg = serving_podgroup(qps=60.0, cur=1, target=100.0,
+                          now=clock.t)
+    ctrl, cluster = controller_with(pg, clock)
+    for _ in range(5):           # cruise below 1.15x: no decision
+        ctrl.sync()
+    assert eapi.desired_slices(pg) is None
+    assert sapi.PG_LAST_DECISION_ANNOTATION not in pg.annotations
+    pg.annotations[sapi.PG_QPS_ANNOTATION] = "290.0"   # the step
+    for _ in range(5):
+        ctrl.sync()
+    assert eapi.desired_slices(pg) == 3    # ceil(290/100)
+    d = pg.annotations[sapi.PG_LAST_DECISION_ANNOTATION]
+    assert d.startswith("scale-up 1->3")
+    # still only ONE decision despite five syncs
+    assert pg.annotations[eapi.ELASTIC_RESIZE_REASON_ANNOTATION] == \
+        eapi.RESIZE_GROW
+
+
+def test_p99_breach_scales_up_even_below_qps_threshold():
+    clock = Clock()
+    pg = serving_podgroup(qps=50.0, p99=80.0, cur=1, target=100.0,
+                          slo=50.0, now=clock.t)
+    ctrl, _ = controller_with(pg, clock)
+    ctrl.sync()
+    assert eapi.desired_slices(pg) == 2
+    assert "p99-over-slo" in \
+        pg.annotations[sapi.PG_LAST_DECISION_ANNOTATION]
+
+
+def test_scale_down_needs_fresh_signals_not_syncs():
+    """The down-streak counts DISTINCT fold timestamps: re-reading
+    one low sample between agent beats is not three observations of
+    receding traffic."""
+    clock = Clock()
+    pg = serving_podgroup(qps=10.0, cur=2, target=100.0, now=clock.t)
+    ctrl, _ = controller_with(pg, clock)
+    for _ in range(10):          # many syncs, ONE stale-ish sample
+        ctrl.sync()
+    assert eapi.desired_slices(pg) is None
+    for i in range(HOLD_DOWN_SYNCS):     # three FRESH low signals
+        clock.tick(1)
+        pg.annotations[sapi.PG_UPDATED_TS_ANNOTATION] = \
+            f"{clock.t:.3f}"
+        ctrl.sync()
+    assert eapi.desired_slices(pg) == 1
+    assert "traffic-receding" in \
+        pg.annotations[sapi.PG_LAST_DECISION_ANNOTATION]
+
+
+def test_no_scale_down_below_floor_or_above_ceiling():
+    clock = Clock()
+    pg = serving_podgroup(qps=0.0, cur=1, lo=1, hi=3, target=100.0,
+                          now=clock.t)
+    ctrl, _ = controller_with(pg, clock)
+    for _ in range(10):
+        clock.tick(1)
+        pg.annotations[sapi.PG_UPDATED_TS_ANNOTATION] = \
+            f"{clock.t:.3f}"
+        ctrl.sync()
+    assert eapi.desired_slices(pg) is None     # floor holds
+    pg2 = serving_podgroup(qps=10000.0, cur=3, lo=1, hi=3,
+                           target=100.0, now=clock.t)
+    ctrl2, _ = controller_with(pg2, clock)
+    ctrl2.sync()
+    assert eapi.desired_slices(pg2) is None    # ceiling holds
+
+
+def test_stale_signal_holds_both_directions():
+    """Quiet-vs-dead: a signal older than SIGNAL_STALE_S means no
+    decision — a dead agent must not read as zero traffic."""
+    clock = Clock()
+    pg = serving_podgroup(qps=0.0, cur=3, target=100.0,
+                          updated=clock.t - SIGNAL_STALE_S - 1,
+                          now=clock.t)
+    ctrl, _ = controller_with(pg, clock)
+    for _ in range(10):
+        ctrl.sync()
+    assert eapi.desired_slices(pg) is None
+
+
+def test_epoch_settle_guard_blocks_post_resize_flap():
+    """Right after a resize executes, the folded signal still carries
+    the OLD epoch while the drained replicas' EWMA decays toward
+    zero.  Without the settle guard that reads as traffic receding
+    and reverts the scale-up mid-drain (the flap the wire smoke
+    caught live)."""
+    clock = Clock()
+    pg = serving_podgroup(qps=0.0, cur=2, target=100.0, epoch=0,
+                          gen=1, now=clock.t)
+    ctrl, _ = controller_with(pg, clock)
+    for _ in range(10):
+        clock.tick(1)
+        pg.annotations[sapi.PG_UPDATED_TS_ANNOTATION] = \
+            f"{clock.t:.3f}"
+        ctrl.sync()
+    assert eapi.desired_slices(pg) is None     # held: epoch 0 < gen 1
+    # replicas of the new incarnation report in: decisions resume
+    pg.annotations[sapi.PG_EPOCH_ANNOTATION] = "1"
+    for _ in range(HOLD_DOWN_SYNCS):
+        clock.tick(1)
+        pg.annotations[sapi.PG_UPDATED_TS_ANNOTATION] = \
+            f"{clock.t:.3f}"
+        ctrl.sync()
+    assert eapi.desired_slices(pg) == 1
+
+
+def test_stabilize_window_holds_scale_down_not_scale_up():
+    """Within RESIZE_STABILIZE_S of an executed resize, warm-up QPS
+    readings must not scale the group down — but a genuine burst may
+    still scale it UP (late up burns the SLO)."""
+    clock = Clock()
+    pg = serving_podgroup(
+        qps=5.0, cur=2, target=100.0, now=clock.t,
+        **{eapi.ELASTIC_LAST_RESIZE_TS_ANNOTATION:
+           f"{clock.t - 1:.3f}"})
+    ctrl, _ = controller_with(pg, clock)
+    for _ in range(HOLD_DOWN_SYNCS + 2):
+        clock.tick(1)
+        pg.annotations[sapi.PG_UPDATED_TS_ANNOTATION] = \
+            f"{clock.t:.3f}"
+        ctrl.sync()
+    assert eapi.desired_slices(pg) is None     # down held
+    pg.annotations[sapi.PG_QPS_ANNOTATION] = "500.0"
+    ctrl.sync()
+    assert eapi.desired_slices(pg) == 3        # up live in-window
+    assert pg.annotations[
+        eapi.ELASTIC_RESIZE_REASON_ANNOTATION] == eapi.RESIZE_GROW
+
+
+def test_autoscaler_adopts_serving_only_group_as_elastic():
+    """A group declaring only the serving contract is adopted: the
+    replica range is mirrored onto the elastic annotations so the
+    unchanged elastic controller can execute resizes."""
+    clock = Clock()
+    pg = PodGroup(name="infer", namespace="default", annotations={
+        sapi.SLO_P99_MS_ANNOTATION: "50",
+        sapi.MIN_REPLICAS_ANNOTATION: "1",
+        sapi.MAX_REPLICAS_ANNOTATION: "4",
+        sapi.TARGET_QPS_ANNOTATION: "100"})
+    ctrl, cluster = controller_with(pg, clock)
+    ctrl.sync()
+    assert eapi.is_elastic(pg)
+    assert eapi.elastic_range(pg) == (1, 4)
+
+
+def test_metrics_gauges_exported():
+    from volcano_tpu import metrics
+    clock = Clock()
+    pg = serving_podgroup(
+        qps=120.0, cur=1, target=100.0, now=clock.t,
+        **{sapi.PG_REQUESTS_ANNOTATION: "1000",
+           sapi.PG_SLO_OK_ANNOTATION: "995"})
+    ctrl, _ = controller_with(pg, clock)
+    ctrl.sync()
+    assert metrics.get_gauge("serving_groups") == 1
+    assert metrics.get_gauge("serving_qps_total") == \
+        pytest.approx(120.0)
+    assert metrics.get_gauge("serving_slo_attainment_min") == \
+        pytest.approx(0.995)
+
+
+# -- vtpctl serve ------------------------------------------------------
+
+def test_vtpctl_serve_renders_from_state_file(tmp_path, capsys):
+    import pickle
+
+    from volcano_tpu.cli.vtpctl import main as vtpctl_main
+    clock = Clock()
+    pg = serving_podgroup(
+        qps=150.0, cur=2, target=100.0, now=clock.t,
+        **{sapi.PG_REQUESTS_ANNOTATION: "1800",
+           sapi.PG_SLO_OK_ANNOTATION: "1791",
+           sapi.PG_LAST_DECISION_ANNOTATION:
+               "scale-up 1->2 (qps-above-target: qps=150.0 "
+               "p99=20.0ms)",
+           sapi.PG_LAST_DECISION_TS_ANNOTATION: "999.0"})
+    cluster = make_tpu_cluster([("sa", "v5e-16")])
+    cluster.add_podgroup(pg)
+    state = str(tmp_path / "state.pkl")
+    with open(state, "wb") as f:
+        pickle.dump(cluster, f)
+    assert vtpctl_main(["--state", state, "serve"]) == 0
+    out = capsys.readouterr().out
+    assert "default/infer" in out and "scale-up" in out
+    assert vtpctl_main(["--state", state, "serve", "infer"]) == 0
+    out = capsys.readouterr().out
+    assert "slo-attainment" in out or "attain" in out.lower()
+    assert "150.0" in out
+
+
+# -- pending-reason slugs ----------------------------------------------
+
+def test_serving_pending_reason_slugs_bounded():
+    from volcano_tpu import trace
+    assert "serving-slo-pressure" in trace.REASON_ENUM
+    assert "serving-preemption-victim" in trace.REASON_ENUM
+    assert trace.normalize_reason(
+        "serving: slo pressure — scale-up awaiting chips near the "
+        "replica pool") == "serving-slo-pressure"
+    assert trace.normalize_reason(
+        "slice freed for serving scale-up") == \
+        "serving-preemption-victim"
+
+
+# -- tier-1 smoke: the whole loop through real processes ---------------
+
+def test_bench_serve_smoke_mode():
+    """`bench.py --serve-smoke` drives a traffic step -> replica
+    stats -> REAL agents -> wire -> store fold -> autoscaler
+    scale-up -> topology-aware burst preemption (training victim
+    shrunk, steered off the freed block) -> serving at 2 replicas,
+    through a REAL process control plane."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--serve-smoke"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=REPO)
+    assert proc.returncode == 0, \
+        proc.stdout[-2000:] + proc.stderr[-2000:]
+    line = next(l for l in reversed(proc.stdout.strip().splitlines())
+                if l.startswith("{"))
+    out = json.loads(line)
+    assert out["ok"] is True, out
+    assert out["no_premature_decision"] and out["victim_marker_seen"]
+    assert out["replicas_final"] == 2
+    assert out["pool_disjoint_from_victim"]

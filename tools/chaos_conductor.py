@@ -25,6 +25,10 @@ The invariants, checked while the faults fly and audited at the end:
                       rewinds (elastic/failover churn on a long gang)
     goodput_monotonic the folded goodput ledger never regresses
                       (progress files -> real agents -> wire -> fold)
+    serving_monotonic (``serving`` class) the folded serving request
+                      ledger never regresses while the autoscaler +
+                      elastic controller churn the replica group
+                      (stats files -> real agents -> wire -> fold)
     mirror_converged  a live mirror that watched THROUGH all faults
                       matches the server's snapshot exactly at the end
     clock_lease       the lease holder stays stable across the
@@ -169,10 +173,11 @@ class InvariantTracker:
     the server's /durability endpoint."""
 
     def __init__(self, cluster, url: str, floor_key: str,
-                 repl: dict = None):
+                 repl: dict = None, serving_key: str = ""):
         self.c = cluster
         self.url = url
         self.floor_key = floor_key
+        self.serving_key = serving_key
         # replication topology, kept current by the conductor as roles
         # change: {"leader": url, "followers": [urls]}.  None = the
         # classic single-server plane.
@@ -183,6 +188,8 @@ class InvariantTracker:
         self.max_alloc = 0.0
         self.resume_seen = False
         self.goodput_seen = False
+        self.serving_seen = False
+        self.max_serving_requests = 0.0
         self._pod_nodes = {}
         self._max_visible = {}          # replica url -> max visible_rv
         self._prev_leader_visible = 0
@@ -330,6 +337,21 @@ class InvariantTracker:
                               f"{getattr(pg, 'uid', '')[:8]} ann="
                               f"{dict(pg.annotations)})")
                 self.max_alloc = max(self.max_alloc, alloc)
+        if self.serving_key:
+            spg = self.c.podgroups.get(self.serving_key)
+            if spg is not None:
+                from volcano_tpu.api import serving as sapi
+                reqs = sapi.ann_float(spg.annotations,
+                                      sapi.PG_REQUESTS_ANNOTATION)
+                if reqs > 0:
+                    self.serving_seen = True
+                    if reqs + 1e-6 < self.max_serving_requests:
+                        self.note("serving_monotonic",
+                                  f"request ledger {reqs} < seen "
+                                  f"{self.max_serving_requests} (pg "
+                                  f"uid={getattr(spg, 'uid', '')[:8]})")
+                    self.max_serving_requests = max(
+                        self.max_serving_requests, reqs)
 
     def summary(self) -> dict:
         failed = {v["invariant"] for v in self.violations}
@@ -338,10 +360,11 @@ class InvariantTracker:
             "passed": {inv: inv not in failed for inv in (
                 "acked_durable", "rv_monotonic", "no_overcommit",
                 "no_double_bind", "resume_floor", "goodput_monotonic",
-                "mirror_converged", "crc_refusal", "clock_lease",
-                "bounded_staleness")},
+                "serving_monotonic", "mirror_converged", "crc_refusal",
+                "clock_lease", "bounded_staleness")},
             "resume_floor_exercised": self.resume_seen,
             "goodput_ledger_exercised": self.goodput_seen,
+            "serving_ledger_exercised": self.serving_seen,
             "staleness_checks": self.staleness_checks,
         }
 
@@ -604,6 +627,34 @@ def run_conductor(seed: int, duration: float,
                                              "1000000"}))]))
         acked_jobs.add(elastic_key)
 
+        # the serving churn class: a serving-class elastic gang whose
+        # replica stats (REAL ServingCollector/Handler -> wire -> fold)
+        # feed the autoscaler while the classic faults fly — decisions
+        # ride the same elastic resize path the echaos gang churns
+        serving_key = ""
+        serving_root = os.path.join(logdir, "serving")
+        if "serving" in classes:
+            from volcano_tpu.api import serving as sapi
+            os.makedirs(serving_root, exist_ok=True)
+            serving_key = "default/schaos"
+            c.add_vcjob(VCJob(
+                name="schaos", min_available=4,
+                annotations={
+                    sapi.SLO_P99_MS_ANNOTATION: "50",
+                    sapi.MIN_REPLICAS_ANNOTATION: "1",
+                    sapi.MAX_REPLICAS_ANNOTATION: "2",
+                    sapi.TARGET_QPS_ANNOTATION: "100",
+                    sapi.STATS_DIR_ANNOTATION: serving_root,
+                    eapi.ELASTIC_SLICES_ANNOTATION: "1",
+                },
+                plugins={"jax": []},
+                tasks=[TaskSpec(name="replica", replicas=4,
+                                template=make_pod(
+                                    "s", requests={"cpu": 4, TPU: 4},
+                                    annotations={RUN_TICKS_ANNOTATION:
+                                                 "1000000"}))]))
+            acked_jobs.add(serving_key)
+
         from volcano_tpu.agent.agent import FakeUsageProvider, NodeAgent
         from volcano_tpu.agent.collect import GoodputCollector
         from volcano_tpu.agent.handlers import GoodputHandler
@@ -649,8 +700,56 @@ def run_conductor(seed: int, duration: float,
                 except Exception as e:  # noqa: BLE001 — chaos is on
                     print("goodput agent sync failed:", e, flush=True)
 
+        serving_agents = {}
+        served = {"requests": 0, "slo_ok": 0}
+
+        def feed_serving():
+            """Play the serving gang's replicas + node agents for one
+            beat: cumulative stats files (epoch = elastic generation,
+            so a resize restart reads as a ledger restart, not a
+            regression) -> REAL ServingCollector/Handler -> wire ->
+            store fold the serving_monotonic invariant audits."""
+            if not serving_key:
+                return
+            from volcano_tpu.agent.collect import ServingCollector
+            from volcano_tpu.agent.handlers import ServingHandler
+            from volcano_tpu.api import serving as sapi
+            from volcano_tpu.workloads.serve import \
+                ServingStatsReporter
+            spg = c.podgroups.get(serving_key)
+            sj = c.vcjobs.get(serving_key)
+            if spg is None or sj is None:
+                return
+            epoch = _iann(spg.annotations,
+                          eapi.ELASTIC_GENERATION_ANNOTATION)
+            served["requests"] += 30
+            served["slo_ok"] += 30
+            pods = [p for p in c.pods.values()
+                    if p.owner == sj.uid and p.node_name
+                    and getattr(p.phase, "value", p.phase)
+                    == "Running"]
+            for p in pods:
+                ServingStatsReporter(
+                    sapi.stats_file_for(serving_root, p.uid),
+                    epoch=epoch).report(
+                        requests=served["requests"],
+                        slo_ok=served["slo_ok"],
+                        p50_ms=4.0, p99_ms=30.0)
+                if p.node_name not in serving_agents:
+                    serving_agents[p.node_name] = NodeAgent(
+                        c, p.node_name, FakeUsageProvider(),
+                        handlers=[ServingHandler],
+                        serving_collector=ServingCollector(
+                            serving_root))
+            for agent in serving_agents.values():
+                try:
+                    agent.sync()
+                except Exception as e:  # noqa: BLE001 — chaos is on
+                    print("serving agent sync failed:", e, flush=True)
+
         inv = InvariantTracker(c, url, elastic_key,
-                               repl=repl_topology)
+                               repl=repl_topology,
+                               serving_key=serving_key)
         import random as _random
         churn_rng = _random.Random(seed * 7919 + 13)
         submit_latencies = []
@@ -781,6 +880,7 @@ def run_conductor(seed: int, duration: float,
                     print("fail_host failed:", e, flush=True)
                     killed_host = None
             feed_goodput()
+            feed_serving()
             inv.poll()
             time.sleep(churn_rng.uniform(0.25, 0.6))
 
@@ -789,6 +889,7 @@ def run_conductor(seed: int, duration: float,
         settle_until = time.monotonic() + min(30.0, duration)
         while time.monotonic() < settle_until:
             feed_goodput()
+            feed_serving()
             inv.poll()
             done = sum(1 for j in c.vcjobs.values()
                        if getattr(j.phase, "value", j.phase)
@@ -1400,6 +1501,9 @@ def run_matrix(seeds, duration: float, classes: str,
             r["invariants"]["resume_floor_exercised"] for r in rows),
         "goodput_ledger_exercised": any(
             r["invariants"]["goodput_ledger_exercised"] for r in rows),
+        "serving_ledger_exercised": any(
+            r["invariants"].get("serving_ledger_exercised")
+            for r in rows),
         "per_seed": rows,
     }
     if "replication" in rows[0]["classes"]:
@@ -1460,7 +1564,7 @@ def main(argv=None) -> int:
     ap.add_argument("--duration", type=float, default=30.0)
     ap.add_argument("--classes", default=DEFAULT_CLASSES,
                     help="comma set of wire,disk,clock,slice,"
-                         "replication")
+                         "replication,serving")
     ap.add_argument("--logdir", default="")
     ap.add_argument("--matrix", type=int, default=0,
                     help="run seeds 1..N and aggregate the "

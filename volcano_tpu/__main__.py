@@ -79,7 +79,8 @@ def main(argv=None) -> int:
                         help="also run the fast-path scheduler")
     parser.add_argument("--controllers", default="job,podgroup,queue,"
                         "hypernode,garbagecollector,jobflow,jobtemplate,"
-                        "cronjob,sharding,hyperjob,failover,elastic")
+                        "cronjob,sharding,hyperjob,failover,elastic,"
+                        "serving")
     parser.add_argument("--node-agents", default="",
                         help="run per-node QoS agents: 'all' or a "
                              "comma-separated list of node names")

@@ -206,7 +206,16 @@ class ElasticAction(Action):
         # and the fleet oscillates (gang A grows into gang B's drain,
         # B re-places into A's, forever).  Pending-to-fit above is
         # exempt: it only ever shrinks a gang toward what exists.
-        if any(self._in_flight(j.podgroup) for j in elastic_jobs):
+        # DEMAND-side gangs are exempt too: a gang requeued by its
+        # own grow (the serving scale-up path) is pending precisely
+        # FOR the capacity this cycle must produce — counting it
+        # would wedge the funding shrink below until the cooldown
+        # expires and pending-to-fit reverts the grow instead.  Its
+        # vacated slices are already subtracted from the deficit, so
+        # nothing is double-spent.
+        demand = {id(j) for j in pending_jobs}
+        if any(self._in_flight(j.podgroup) for j in elastic_jobs
+               if id(j) not in demand):
             return
         # slices reserved for pending fixed demand are not growable
         reserve = pending_chips
@@ -280,11 +289,18 @@ class ElasticAction(Action):
 
     def _grow(self, ssn, elastic_jobs, pool: List[SliceView],
               cooldown: float, now: float) -> None:
+        from volcano_tpu.api import serving as sapi
         growable = PriorityQueue(ssn.job_order_fn)
         for job in elastic_jobs:
             pg = job.podgroup
             rng = eapi.elastic_range(pg)
             if rng is None or pg.phase is not PodGroupPhase.RUNNING:
+                continue
+            # serving groups size from TRAFFIC, not from idle chips:
+            # the SLO autoscaler (controllers/serving.py) owns their
+            # replica count — greedy absorption would hand a quiet
+            # group chips it must immediately shed
+            if sapi.is_serving(pg):
                 continue
             if self._in_flight(pg) or self._cooling(pg, cooldown, now):
                 continue
@@ -338,13 +354,46 @@ class ElasticAction(Action):
 
     # -- shrink (running victims, topology-aware) ------------------------
 
+    @staticmethod
+    def _slice_leaf(ssn, view: SliceView) -> Optional[str]:
+        """Leaf hypernode hosting a slice (tier-1: hypernode == ICI
+        slice, so any member node resolves it)."""
+        hn = getattr(ssn, "hypernodes", None)
+        if hn is None:
+            return None
+        for node in view.nodes:
+            leaf = hn.leaf_of_node(node.name)
+            if leaf:
+                return leaf
+        return None
+
+    def _serving_tier(self, ssn, slices, anchor_leaves,
+                      job: JobInfo, slice_name: str) -> float:
+        """ICI/DCN distance (hypernode LCA tier; lower = closer) from
+        one of the victim's slices to the nearest serving-pool slice."""
+        view = slices.get(slice_name)
+        if view is None:
+            return math.inf
+        leaf = self._slice_leaf(ssn, view)
+        if leaf is None:
+            return math.inf
+        hn = ssn.hypernodes
+        return min((hn.lca_tier_of_leaves(leaf, al)
+                    for al in anchor_leaves), default=math.inf)
+
     def _shrink(self, ssn, elastic_jobs, slices, idle, deficit: float,
                 cooldown: float, now: float) -> None:
+        from volcano_tpu.api import serving as sapi
         victims = []
         for job in elastic_jobs:
             pg = job.podgroup
             rng = eapi.elastic_range(pg)
             if rng is None or pg.phase is not PodGroupPhase.RUNNING:
+                continue
+            # serving groups are never donors: shedding a replica to
+            # fund generic pending demand trades a latency SLO for
+            # queue progress — only their own autoscaler shrinks them
+            if sapi.is_serving(pg):
                 continue
             if self._in_flight(pg) or self._cooling(pg, cooldown, now):
                 continue
@@ -367,10 +416,37 @@ class ElasticAction(Action):
                         if sl in slices), default=0.0)
 
         # lowest-allocation-priority victims shed first (reverse job
-        # order), then stable-sorted so domain affinity dominates
+        # order), then stable-sorted so the topology key dominates
         by_priority = list(PriorityQueue(ssn.job_order_fn, victims))
         by_priority.reverse()
-        ranked = sorted(by_priority, key=lambda j: -domain_affinity(j))
+
+        # serving burst preemption (plugins/serving.py exported the
+        # anchor): rank victims by hypernode-LCA proximity of their
+        # occupied slices to the SERVING POOL, so the eviction frees
+        # an ICI-contiguous block next to the replicas — not merely
+        # an equally-sized hole anywhere.  Without an anchor, fall
+        # back to idle-domain affinity (freed + idle form one block).
+        anchors = {s for s in getattr(ssn, "serving_anchor_slices",
+                                      ()) or () if s in slices}
+        anchor_leaves = []
+        if anchors and getattr(ssn, "hypernodes", None) is not None:
+            anchor_leaves = [
+                leaf for leaf in (self._slice_leaf(ssn, slices[a])
+                                  for a in anchors)
+                if leaf is not None]
+        serving_mode = bool(anchor_leaves)
+
+        def pool_tier(job: JobInfo) -> float:
+            return min((self._serving_tier(ssn, slices, anchor_leaves,
+                                           job, sl)
+                        for sl in job_slices(ssn, job)),
+                       default=math.inf)
+
+        if serving_mode:
+            ranked = sorted(by_priority, key=pool_tier)
+        else:
+            ranked = sorted(by_priority,
+                            key=lambda j: -domain_affinity(j))
         for job in ranked:
             if deficit <= 0:
                 break
@@ -385,8 +461,29 @@ class ElasticAction(Action):
             if take <= 0:
                 continue
             deficit -= take * per_slice
+            detail = f"freeing {take} slice(s) for pending demand"
+            if serving_mode:
+                # steer the victim's re-placement OFF its slices
+                # nearest the serving pool: the avoid preference
+                # (elastic plugin predicate, yield-guarded by the
+                # controller) makes the freed block the ADJACENT one,
+                # not whichever slices the re-place happens to leave
+                near = sorted(
+                    job_slices(ssn, job),
+                    key=lambda sl: self._serving_tier(
+                        ssn, slices, anchor_leaves, job, sl))[:take]
+                if near:
+                    from volcano_tpu.api import serving as sapi
+                    pg.annotations[
+                        eapi.ELASTIC_AVOID_SLICES_ANNOTATION] = \
+                        ",".join(near)
+                    pg.annotations[sapi.VICTIM_ANNOTATION] = "true"
+                    detail = (f"freeing {take} ICI-adjacent slice(s) "
+                              f"({', '.join(near)}) for a serving "
+                              f"scale-up")
+                metrics.inc("serving_victim_shrinks_total")
             self._stamp(ssn, job, cur - take, eapi.RESIZE_SHRINK,
-                        f"freeing {take} slice(s) for pending demand")
+                        detail)
 
     # -- pending elastic jobs: fit down / name the wait ------------------
 

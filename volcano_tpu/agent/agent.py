@@ -99,7 +99,8 @@ class NodeAgent:
                  oversub_factor: float = 0.6,
                  eviction_threshold: float = 0.95,
                  enforcer=None, handlers=None, probes=None,
-                 net_collector=None, goodput_collector=None):
+                 net_collector=None, goodput_collector=None,
+                 serving_collector=None):
         from volcano_tpu.agent import handlers as _default  # registers
         from volcano_tpu.agent.enforcer import NullEnforcer
         from volcano_tpu.agent.framework import (
@@ -120,6 +121,8 @@ class NodeAgent:
         self.net_collector = net_collector
         # same contract for the goodput handler's progress collector
         self.goodput_collector = goodput_collector
+        # ... and the serving handler's stats collector
+        self.serving_collector = serving_collector
         # probe -> queue -> handler pipeline; handlers come from the
         # registry unless injected (tests can run a subset)
         self.probes = list(probes) if probes is not None \

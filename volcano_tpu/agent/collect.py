@@ -505,6 +505,145 @@ class GoodputCollector(Collector):
         return dict(self._rates)
 
 
+class PodServingRate:
+    """One serving replica's traffic accounting state kept by
+    ServingCollector: the cumulative request counter folds into an
+    EWMA QPS (util.RateWindow, "restart" policy — a restarted replica
+    re-opens its counter at 0 and must not read as a negative delta),
+    latency quantiles carry through from the replica's own window."""
+
+    __slots__ = ("uid", "epoch", "requests", "slo_ok", "p50_ms",
+                 "p99_ms", "restarts", "_requests")
+
+    def __init__(self, uid: str, alpha: float = 0.5):
+        from volcano_tpu.util import RateWindow
+        self.uid = uid
+        self.epoch: Optional[int] = None
+        # cumulative ledgers over this replica's lifetime on this
+        # node; shipped cumulative, store folds the diff (the
+        # GoodputReport idempotency argument)
+        self.requests = 0
+        self.slo_ok = 0
+        self.p50_ms = 0.0
+        self.p99_ms = 0.0
+        self.restarts = 0            # observed epoch bumps
+        self._requests = RateWindow(alpha=alpha, reset="restart")
+
+    @property
+    def qps(self) -> float:
+        return self._requests.rate
+
+
+@register_collector("serving")
+class ServingCollector(Collector):
+    """Per-replica serving-traffic accounting off the workload stats
+    files (api/serving.py contract: serving workers write one JSON
+    record per beat to VTP_SERVING_STATS_FILE under a shared root,
+    named vtps-<pod uid>.json — the goodput progress-file convention).
+
+    Per walk, for every vtps-<uid>.json under the root: the
+    cumulative request counter folds into an EWMA QPS on the SHARED
+    RateWindow machinery ("restart" policy), an epoch change
+    force-restarts the window (out-of-band restart signal beats the
+    counter heuristic), quantiles and ledgers carry through for the
+    ServingHandler to post.  Vanished/stale files drop their state
+    (same lifetime rule as GoodputCollector)."""
+
+    FILE_PREFIX = "vtps-"
+    FILE_SUFFIX = ".json"
+    ALPHA = 0.5
+    MIN_INTERVAL_S = 0.05
+    STALE_FILE_S = 3600.0
+
+    def __init__(self, root: str = "/var/run/volcano/serving",
+                 alpha: float = ALPHA, now=None):
+        import time
+        self.root = root
+        self.alpha = float(alpha)
+        self._now = now if now is not None else time.monotonic
+        self._rates: Dict[str, PodServingRate] = {}
+        self._last_walk: Optional[float] = None
+        self._totals: Dict[str, float] = {}
+
+    @staticmethod
+    def _read_record(path: str) -> Optional[dict]:
+        import json
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            return None       # mid-rewrite/corrupt: window spans on
+        return doc if isinstance(doc, dict) else None
+
+    def _sample_one(self, st: PodServingRate, path: str,
+                    ts: float) -> None:
+        rec = self._read_record(path)
+        if rec is None:
+            return
+        try:
+            requests = int(rec.get("requests", 0))
+            slo_ok = int(rec.get("slo_ok", 0))
+            epoch = int(rec.get("epoch", 0))
+            p50 = float(rec.get("p50_ms", 0.0) or 0.0)
+            p99 = float(rec.get("p99_ms", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            return
+        if epoch != st.epoch:
+            if st.epoch is not None:
+                st.restarts += 1
+            st.epoch = epoch
+            st._requests.restart()
+        st._requests.fold(requests, ts)
+        st.requests = requests
+        st.slo_ok = slo_ok
+        st.p50_ms = p50
+        st.p99_ms = p99
+
+    def collect(self, node_name: str) -> Dict[str, float]:
+        """Walk the stats files once; returns node totals (extra keys
+        NodeUsage ignores); per-replica detail via rates()."""
+        ts = self._now()
+        if self._last_walk is not None and \
+                ts - self._last_walk < self.MIN_INTERVAL_S:
+            return dict(self._totals)
+        self._last_walk = ts
+        seen = set()
+        try:
+            entries = os.listdir(self.root)
+        except OSError:
+            return {}
+        import time as _time
+        wall = _time.time()
+        for e in entries:
+            if not (e.startswith(self.FILE_PREFIX)
+                    and e.endswith(self.FILE_SUFFIX)):
+                continue
+            uid = e[len(self.FILE_PREFIX):-len(self.FILE_SUFFIX)]
+            if not uid:
+                continue
+            path = os.path.join(self.root, e)
+            try:
+                if wall - os.stat(path).st_mtime > self.STALE_FILE_S:
+                    continue        # dead replica's leftover
+            except OSError:
+                continue
+            seen.add(uid)
+            st = self._rates.get(uid)
+            if st is None:
+                st = self._rates[uid] = PodServingRate(uid, self.alpha)
+            self._sample_one(st, path, ts)
+        for uid in set(self._rates) - seen:   # departed: drop state
+            del self._rates[uid]
+        self._totals = {
+            "serving_qps": sum(r.qps for r in self._rates.values())}
+        return dict(self._totals)
+
+    def rates(self) -> Dict[str, PodServingRate]:
+        """uid -> PodServingRate as of the last collect() (the
+        ServingHandler's read surface; no re-walk)."""
+        return dict(self._rates)
+
+
 @register_collector("tpu")
 class TpuChipCollector(Collector):
     """Chip inventory from the accelerator device nodes (the VFIO /

@@ -703,6 +703,115 @@ class GoodputHandler(Handler):
 
 
 @register_handler
+class ServingHandler(Handler):
+    """Traffic half of the serving plane (api/serving.py): the
+    ServingCollector turns per-replica stats files into EWMA QPS and
+    latency quantiles; this handler pairs that state with the node's
+    pods, publishes per-pod QPS/p99 annotations, and posts one
+    ServingReport per sync — the store folds the per-group summary
+    into PODGROUP annotations the serving autoscaler
+    (controllers/serving.py) scales from.
+
+    Same posting discipline as GoodputHandler: cumulative per-replica
+    ledgers on the wire (idempotent store fold), change-elision on a
+    (uid, requests, epoch, qps) signature, and a debt re-post once
+    POST_DEBT_S of unreported requests accumulate — a group whose
+    traffic went flat must still refresh its updated-ts so the
+    autoscaler can tell quiet from dead."""
+
+    name = "serving"
+    events = (EVENT_PODS,)
+
+    POST_DEBT_S = 5.0
+    PUBLISH_DEADBAND_FRAC = 0.05
+
+    def __init__(self, agent):
+        super().__init__(agent)
+        self._published = {}           # uid -> published qps
+        self._last_report = None       # change-elision signature
+        self._last_post_ts = 0.0
+
+    def _collector(self):
+        col = getattr(self.agent, "serving_collector", None)
+        if col is not None:
+            return col
+        from volcano_tpu.agent.collect import ServingCollector
+        for c in getattr(self.agent.provider, "collectors", ()):
+            if isinstance(c, ServingCollector):
+                return c
+        return None
+
+    def _publish_rate(self, uid: str, rate: float) -> float:
+        pub = self._published.get(uid)
+        if pub is not None and abs(rate - pub) <= \
+                max(0.01, self.PUBLISH_DEADBAND_FRAC * pub):
+            return pub
+        pub = round(rate, 3)
+        self._published[uid] = pub
+        return pub
+
+    @staticmethod
+    def _job_key(pod) -> str:
+        from volcano_tpu.api.types import GROUP_NAME_ANNOTATION
+        group = pod.annotations.get(GROUP_NAME_ANNOTATION) or pod.owner
+        if not group:
+            return ""
+        return group if "/" in group else f"{pod.namespace}/{group}"
+
+    def handle(self, event: Event) -> None:
+        import time as _time
+
+        from volcano_tpu.api.serving import (
+            POD_P99_MS_ANNOTATION, POD_QPS_ANNOTATION, ReplicaServing,
+            ServingReport)
+        collector = self._collector()
+        if collector is None:
+            return                    # serving not deployed: no-op
+        agent = self.agent
+        try:
+            collector.collect(agent.node_name)
+        except Exception as e:  # noqa: BLE001 — degrade, keep sync
+            log.warning("serving sample failed: %s", e)
+        rates = collector.rates()
+        usages = []
+        current_uids = set()
+        for pod in event.pods:
+            st = rates.get(pod.uid)
+            if st is None:
+                continue              # no stats file for this pod
+            current_uids.add(pod.uid)
+            qps_pub = self._publish_rate(pod.uid, st.qps)
+            pod.annotations[POD_QPS_ANNOTATION] = f"{qps_pub:.3f}"
+            pod.annotations[POD_P99_MS_ANNOTATION] = \
+                f"{st.p99_ms:.3f}"
+            usages.append(ReplicaServing(
+                pod_key=pod.key, uid=pod.uid,
+                job=self._job_key(pod), epoch=st.epoch or 0,
+                qps=qps_pub, p50_ms=round(st.p50_ms, 3),
+                p99_ms=round(st.p99_ms, 3),
+                requests=st.requests, slo_ok=st.slo_ok))
+        for uid in set(self._published) - current_uids:
+            del self._published[uid]
+        if not usages:
+            return
+        sig = tuple((u.uid, u.requests, u.epoch, u.qps)
+                    for u in usages)
+        now = _time.time()
+        if sig == self._last_report and \
+                now - self._last_post_ts < self.POST_DEBT_S:
+            return                    # steady and recently refreshed
+        report = ServingReport(node=agent.node_name,
+                               ts=round(now, 3), usages=usages)
+        try:
+            agent.cluster.put_object("servingreport", report)
+        except Exception as e:  # noqa: BLE001 — reporting must never
+            log.warning("serving report post failed: %s", e)  # kill sync
+            return
+        self._last_report = sig
+        self._last_post_ts = now
+
+
+@register_handler
 class NumaExporterHandler(Handler):
     """Exporter half of the Numatopology contract: republish per-cell
     FREE amounts so the scheduler's single-NUMA gate sees placements

@@ -89,12 +89,14 @@ def _build_registry() -> None:
         return
     from volcano_tpu.api import (goodput, hypernode, jobflow, netusage,
                                  node_info, numatopology, pod, podgroup,
-                                 queue, shard, slicehealth, types, vcjob)
+                                 queue, serving, shard, slicehealth,
+                                 types, vcjob)
     from volcano_tpu.cache import cluster as cluster_mod
     from volcano_tpu.controllers import cronjob, hyperjob
     for mod in (types, pod, node_info, podgroup, queue, hypernode,
-                vcjob, jobflow, netusage, goodput, numatopology, shard,
-                slicehealth, cluster_mod, cronjob, hyperjob):
+                vcjob, jobflow, netusage, goodput, serving,
+                numatopology, shard, slicehealth, cluster_mod, cronjob,
+                hyperjob):
         _scan(mod)
     _built = True
 

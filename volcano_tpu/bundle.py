@@ -201,6 +201,16 @@ FAMILIES: Dict[str, str] = {
     "frag_largest_block_chips": "gauge",
     "starvation_age_seconds": "gauge",
     "starvation_pending_gangs": "gauge",
+    # serving plane (controllers/serving.py + agent serving handler +
+    # actions/elastic.py burst preemption): group census, folded fleet
+    # QPS, worst-group SLO attainment, scale decisions (bounded
+    # up|down enum) and serving-funded victim shrinks — never
+    # group/pod/node labels
+    "serving_groups": "gauge",
+    "serving_qps_total": "gauge",
+    "serving_slo_attainment_min": "gauge",
+    "serving_scale_decisions_total": "counter",
+    "serving_victim_shrinks_total": "counter",
 }
 
 # -- label schema (enforced by volcano_tpu/analysis + tests/test_lint) --
@@ -321,6 +331,9 @@ FAMILY_LABELS: Dict[str, Dict[str, object]] = {
         "generation": "enum:volcano_tpu.api.goodput:GENERATIONS"},
     "starvation_age_seconds": {"queue": CONFIG},
     "starvation_pending_gangs": {"queue": CONFIG},
+    # serving plane: the bounded scale-direction enum, never group keys
+    "serving_scale_decisions_total": {
+        "kind": "enum:volcano_tpu.api.serving:SCALE_KINDS"},
 }
 
 
@@ -590,7 +603,7 @@ ROLES = [
     ("agents", "volcano-tpu --cluster-url http://127.0.0.1:{port} "
                "--components none --agent-scheduler --node-agents all "
                "--usage-source collectors:local,tpu,netaccounting,"
-               "goodput "
+               "goodput,serving "
                "--enforcer cgroup:/sys/fs/cgroup,tc:eth0 "
                "--metrics-port {port3} "
                "--token-file {bundle_dir}/token", 3),

@@ -46,6 +46,7 @@ class FakeCluster(Cluster):
         self.bandwidthreports: Dict[str, object] = {}  # api/netusage.py
         self.slicehealthreports: Dict[str, object] = {}  # api/slicehealth.py
         self.goodputreports: Dict[str, object] = {}    # api/goodput.py
+        self.servingreports: Dict[str, object] = {}    # api/serving.py
         self.services: Dict[str, dict] = {}       # svc plugin artifacts
         self.config_maps: Dict[str, dict] = {}
         self.secrets: Dict[str, dict] = {}
@@ -97,7 +98,8 @@ class FakeCluster(Cluster):
             for kind, attr in (("bandwidthreport", "bandwidthreports"),
                                ("slicehealthreport",
                                 "slicehealthreports"),
-                               ("goodputreport", "goodputreports")):
+                               ("goodputreport", "goodputreports"),
+                               ("servingreport", "servingreports")):
                 with self._lock:
                     had = name in getattr(self, attr)
                 if had:
@@ -226,12 +228,17 @@ class FakeCluster(Cluster):
 
     def put_object(self, kind: str, obj, key: Optional[str] = None):
         from volcano_tpu.cache.kinds import KINDS, key_for
-        prev_goodput = None
+        prev_goodput = prev_serving = None
         if kind == "goodputreport":
             # the node's PREVIOUS report is the fold's diff base (the
             # wire carries cumulative ledgers; see _fold_goodput_report)
             with self._lock:
                 prev_goodput = self.goodputreports.get(
+                    key_for(kind, obj, key))
+        elif kind == "servingreport":
+            # same cumulative-ledger diff base as goodput
+            with self._lock:
+                prev_serving = self.servingreports.get(
                     key_for(kind, obj, key))
         if kind == "vcjob" and key is None:
             # keep the admission-gated create path authoritative
@@ -279,6 +286,7 @@ class FakeCluster(Cluster):
                 cur = self.podgroups.get(k)
                 if cur is not None:
                     self._apply_goodput_stick(obj, cur)
+                    self._apply_serving_stick(obj, cur)
             getattr(self, spec.attr)[k] = obj
         self._notify(kind, obj if spec.key_of else {"key": k, "obj": obj})
         if kind == "bandwidthreport":
@@ -287,6 +295,8 @@ class FakeCluster(Cluster):
             self._fold_health_report(obj)
         elif kind == "goodputreport":
             self._fold_goodput_report(obj, prev_goodput)
+        elif kind == "servingreport":
+            self._fold_serving_report(obj, prev_serving)
         return obj
 
     @staticmethod
@@ -488,6 +498,99 @@ class FakeCluster(Cluster):
             if changed:     # unchanged summary: no watch traffic
                 self._notify("podgroup", pg)
 
+    @staticmethod
+    def _apply_serving_stick(obj, cur) -> None:
+        """Same stale-copy protection as _apply_goodput_stick for the
+        serving summary: copy keys the incoming write lacks, max-merge
+        the monotone ones (request/SLO ledgers, epoch, stamp)."""
+        from volcano_tpu.api import serving as sapi
+        ann, cur_ann = obj.annotations, cur.annotations
+        for key in sapi.PG_FOLD_KEYS:
+            if key not in cur_ann:
+                continue
+            if key not in ann:
+                ann[key] = cur_ann[key]
+            elif key in (sapi.PG_REQUESTS_ANNOTATION,
+                         sapi.PG_SLO_OK_ANNOTATION,
+                         sapi.PG_EPOCH_ANNOTATION,
+                         sapi.PG_UPDATED_TS_ANNOTATION):
+                if sapi.ann_float(cur_ann, key) > \
+                        sapi.ann_float(ann, key):
+                    ann[key] = cur_ann[key]
+
+    def _fold_serving_report(self, report, prev=None) -> None:
+        """Fold a node agent's ServingReport into the owning PODGROUP
+        annotations at the store — the serving mirror of
+        _fold_goodput_report.  Request/SLO-ok ledgers are CUMULATIVE
+        per replica on the wire; the fold accumulates per-pod diffs
+        against *prev* (idempotent under lost-ack re-post, no double
+        counting across nodes).  QPS SUMS across a group's replicas
+        (each serves its own share of the traffic), latency quantiles
+        take the report's max (the group's p99 is bounded by its
+        slowest replica — optimistic per-replica mixing would hide a
+        hot-spotted one)."""
+        from volcano_tpu.api import serving as sapi
+        prev_by_uid = {u.uid: u for u in getattr(prev, "usages", ())} \
+            if prev is not None else {}
+
+        def ledger_diff(u, field):
+            cur = getattr(u, field)
+            p = prev_by_uid.get(u.uid)
+            base = getattr(p, field) if p is not None else 0
+            return cur - base if cur >= base else cur
+
+        by_job: Dict[str, list] = {}
+        for u in getattr(report, "usages", ()):
+            if u.job:
+                by_job.setdefault(u.job, []).append(u)
+        for job_key, usages in by_job.items():
+            with self._lock:
+                pg = self.podgroups.get(job_key)
+                if pg is None:
+                    continue
+                ann = pg.annotations
+                before = {k: ann.get(k) for k in sapi.PG_FOLD_KEYS}
+                # the group summary spans EVERY node's stored report:
+                # one group's replicas land on many hosts and each
+                # agent reports only its own pods, so folding just the
+                # incoming report would shrink the group QPS to the
+                # last poster's share.  Usages are filtered to live
+                # pod uids — a drained replica's final report stops
+                # counting the moment its pod object is deleted
+                live = {p.uid for p in self.pods.values()}
+                group = [u for rep in self.servingreports.values()
+                         for u in getattr(rep, "usages", ())
+                         if u.job == job_key and u.uid in live]
+                if not group:
+                    group = usages
+                qps = sum(u.qps for u in group)
+                ann[sapi.PG_QPS_ANNOTATION] = f"{qps:.3f}"
+                ann[sapi.PG_P50_MS_ANNOTATION] = \
+                    f"{max(u.p50_ms for u in group):.3f}"
+                ann[sapi.PG_P99_MS_ANNOTATION] = \
+                    f"{max(u.p99_ms for u in group):.3f}"
+                reqs = sapi.ann_float(
+                    ann, sapi.PG_REQUESTS_ANNOTATION) + \
+                    sum(ledger_diff(u, "requests") for u in usages)
+                ok = sapi.ann_float(
+                    ann, sapi.PG_SLO_OK_ANNOTATION) + \
+                    sum(ledger_diff(u, "slo_ok") for u in usages)
+                ann[sapi.PG_REQUESTS_ANNOTATION] = f"{reqs:.0f}"
+                ann[sapi.PG_SLO_OK_ANNOTATION] = f"{ok:.0f}"
+                ann[sapi.PG_REPLICAS_ANNOTATION] = str(len(group))
+                epoch = max(u.epoch for u in group)
+                if epoch >= sapi.ann_float(ann,
+                                           sapi.PG_EPOCH_ANNOTATION):
+                    ann[sapi.PG_EPOCH_ANNOTATION] = str(epoch)
+                ts = getattr(report, "ts", 0.0)
+                if ts > sapi.ann_float(ann,
+                                       sapi.PG_UPDATED_TS_ANNOTATION):
+                    ann[sapi.PG_UPDATED_TS_ANNOTATION] = f"{ts:.3f}"
+                changed = before != {k: ann.get(k)
+                                     for k in sapi.PG_FOLD_KEYS}
+            if changed:     # unchanged summary: no watch traffic
+                self._notify("podgroup", pg)
+
     def delete_object(self, kind: str, key: str) -> None:
         from volcano_tpu.cache.kinds import KINDS
         spec = KINDS[kind]
@@ -504,7 +607,8 @@ class FakeCluster(Cluster):
             for rkind, attr in (("bandwidthreport", "bandwidthreports"),
                                 ("slicehealthreport",
                                  "slicehealthreports"),
-                                ("goodputreport", "goodputreports")):
+                                ("goodputreport", "goodputreports"),
+                                ("servingreport", "servingreports")):
                 with self._lock:
                     had = key in getattr(self, attr)
                 if had:

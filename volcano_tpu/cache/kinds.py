@@ -57,6 +57,10 @@ KINDS: Dict[str, KindSpec] = {
     # so scheduler mirrors learn per-job step rates / goodput from
     # ordinary podgroup events
     "goodputreport": KindSpec("goodputreports", _name),
+    # per-node serving traffic report (api/serving.py): posted by the
+    # node agent, folded into PODGROUP annotations by the store so the
+    # serving autoscaler reads QPS/p99 from ordinary podgroup events
+    "servingreport": KindSpec("servingreports", _name),
     # plain-dict kinds (plugin/operator supplied payloads)
     # namespace -> annotations dict (podgroup mutate webhook reads the
     # per-namespace default-queue annotation)

@@ -739,6 +739,123 @@ def cmd_goodput(cluster, args):
             ["GEN", "KIND", "SLICES"]))
 
 
+def cmd_serve(cluster, args):
+    """Serving-group view: replicas current/min/max, the store-folded
+    traffic summary (QPS, p99 vs the declared SLO, cumulative SLO
+    attainment), the autoscaler's last decision and its age, and the
+    per-replica rates the node agents last reported (ServingReport
+    store) — the operator's answer to "is this group inside its SLO,
+    and what did the autoscaler last do about it".  With no name:
+    one row per serving group."""
+    import datetime
+    import time as _time
+
+    from volcano_tpu.api import elastic as eapi
+    from volcano_tpu.api import serving as sapi
+
+    def _summary(pg):
+        ann = pg.annotations
+        qps = sapi.ann_float(ann, sapi.PG_QPS_ANNOTATION)
+        p99 = sapi.ann_float(ann, sapi.PG_P99_MS_ANNOTATION)
+        reqs = sapi.ann_float(ann, sapi.PG_REQUESTS_ANNOTATION)
+        ok = sapi.ann_float(ann, sapi.PG_SLO_OK_ANNOTATION)
+        att = ok / reqs if reqs > 0 else None
+        return qps, p99, reqs, ok, att
+
+    if not args.name:
+        rows = []
+        for pg in sorted(cluster.podgroups.values(),
+                         key=lambda g: g.key):
+            if not sapi.is_serving(pg):
+                continue
+            rng = sapi.replica_range(pg) or ("?", "?")
+            qps, p99, _reqs, _ok, att = _summary(pg)
+            slo = sapi.slo_p99_ms(pg)
+            rows.append([
+                pg.key, eapi.current_slices(pg), rng[0], rng[1],
+                f"{qps:g}", f"{p99:g}",
+                f"{slo:g}" if slo is not None else "-",
+                f"{att:.4f}" if att is not None else "-",
+                pg.annotations.get(
+                    sapi.PG_LAST_DECISION_ANNOTATION, "-"),
+            ])
+        print(_table(rows, ["PODGROUP", "REPLICAS", "MIN", "MAX",
+                            "QPS", "P99-MS", "SLO-MS", "ATTAIN",
+                            "LAST-DECISION"]))
+        return
+
+    key = f"{args.namespace}/{args.name}"
+    pg = cluster.podgroups.get(key)
+    if pg is None:
+        sys.exit(f"podgroup {key} not found")
+    if not sapi.is_serving(pg):
+        sys.exit(f"{key} is not serving-class (no "
+                 f"{sapi.SLO_P99_MS_ANNOTATION})")
+    ann = pg.annotations
+    rng = sapi.replica_range(pg) or ("?", "?")
+    qps, p99, reqs, ok, att = _summary(pg)
+    slo = sapi.slo_p99_ms(pg)
+    tgt = sapi.target_qps_per_replica(pg)
+    print(f"group: {key}")
+    print(f"phase: {pg.phase.value}  (queue={pg.queue})")
+    print(f"replicas: {eapi.current_slices(pg)}"
+          f"  (min {rng[0]} / max {rng[1]})"
+          + (f"  target-qps/replica: {tgt:g}" if tgt else ""))
+    if sapi.PG_QPS_ANNOTATION not in ann:
+        print("no serving data published (no replica stats reported "
+              "yet — does the job declare "
+              f"{sapi.STATS_DIR_ANNOTATION}?)")
+        return
+    over = ""
+    if slo is not None and p99 > slo:
+        over = "  OVER SLO"
+    print(f"qps: {qps:g}  p99: {p99:g}ms"
+          + (f"  (slo {slo:g}ms{over})" if slo is not None else ""))
+    print(f"requests: {int(reqs)}  slo-ok: {int(ok)}"
+          + (f"  attainment: {att:.4f}" if att is not None else ""))
+    updated = sapi.ann_float(ann, sapi.PG_UPDATED_TS_ANNOTATION)
+    print(f"reporting-replicas: "
+          f"{int(sapi.ann_float(ann, sapi.PG_REPLICAS_ANNOTATION))}"
+          f"  epoch: "
+          f"{int(sapi.ann_float(ann, sapi.PG_EPOCH_ANNOTATION))}"
+          f"  updated: "
+          + (datetime.datetime.fromtimestamp(updated).isoformat(
+              timespec="seconds") if updated else "-"))
+    decision = ann.get(sapi.PG_LAST_DECISION_ANNOTATION)
+    if decision:
+        ts = sapi.ann_float(ann, sapi.PG_LAST_DECISION_TS_ANNOTATION)
+        age = f" ({_time.time() - ts:.0f}s ago)" if ts else ""
+        print(f"last-decision: {decision}{age}")
+    pool = sapi.pool_slices(pg)
+    if pool:
+        print(f"pool-slices: {','.join(pool)}")
+    desired = eapi.desired_slices(pg)
+    if desired is not None:
+        print(f"resizing: ->{desired} "
+              f"({ann.get(eapi.ELASTIC_RESIZE_REASON_ANNOTATION, '?')})")
+    rows = []
+    for name in sorted(getattr(cluster, "servingreports", {})):
+        rep = cluster.servingreports[name]
+        for u in rep.usages:
+            if u.job != key:
+                continue
+            rows.append([
+                rep.node, u.pod_key, f"{u.qps:g}", f"{u.p50_ms:g}",
+                f"{u.p99_ms:g}", u.requests, u.slo_ok, u.epoch])
+    if rows:
+        print()
+        print(_table(rows, ["NODE", "POD", "QPS", "P50-MS", "P99-MS",
+                            "REQUESTS", "SLO-OK", "EPOCH"]))
+    hist = eapi.resize_history(pg)
+    if hist:
+        print()
+        print(_table(
+            [[rec.get("gen", "?"), rec.get("kind", "?"),
+              f"{rec.get('from', '?')} -> {rec.get('to', '?')}"]
+             for rec in hist],
+            ["GEN", "KIND", "REPLICAS"]))
+
+
 def cmd_fleet(cluster, args):
     """Fleet observatory rollup: per-job measured throughput (from
     the folded podgroup annotations), then the cluster gauges the
@@ -1292,6 +1409,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("name", help="job / podgroup name")
     p.add_argument("-n", "--namespace", default="default")
     p.set_defaults(fn=cmd_goodput)
+
+    p = sub.add_parser("serve", help="serving groups: replicas "
+                       "cur/min/max, folded QPS and p99 vs SLO, "
+                       "last autoscaler decision + age, per-replica "
+                       "agent rates")
+    p.add_argument("name", nargs="?", default="",
+                   help="serving group name (omit to list all)")
+    p.add_argument("-n", "--namespace", default="default")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("fleet", help="fleet observatory rollup: "
                        "per-job measured steps/s + goodput, ICI "

@@ -23,6 +23,7 @@ import volcano_tpu.controllers.hyperjob          # noqa: E402,F401
 import volcano_tpu.controllers.colocation        # noqa: E402,F401
 import volcano_tpu.controllers.failover          # noqa: E402,F401
 import volcano_tpu.controllers.elastic           # noqa: E402,F401
+import volcano_tpu.controllers.serving           # noqa: E402,F401
 
 __all__ = ["Controller", "ControllerManager", "register_controller",
            "CONTROLLERS"]

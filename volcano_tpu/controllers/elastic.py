@@ -365,9 +365,11 @@ class ElasticController(Controller):
                     # the durable in-flight marker must die with the
                     # episode, or the decision guard wedges on a gang
                     # that will never resume
+                    from volcano_tpu.api import serving as sapi
                     changed = False
                     for key in (eapi.ELASTIC_RESIZING_ANNOTATION,
-                                eapi.ELASTIC_AVOID_SLICES_ANNOTATION):
+                                eapi.ELASTIC_AVOID_SLICES_ANNOTATION,
+                                sapi.VICTIM_ANNOTATION):
                         if pg.annotations.pop(key, None):
                             changed = True
                     if changed:
@@ -411,6 +413,8 @@ class ElasticController(Controller):
                         pg is not None and pg.annotations.pop(
                             eapi.ELASTIC_AVOID_SLICES_ANNOTATION,
                             None) is not None:
+                    from volcano_tpu.api import serving as sapi
+                    pg.annotations.pop(sapi.VICTIM_ANNOTATION, None)
                     # no destination materialized: the steering
                     # preference yields so the gang may land back on
                     # its old slices instead of starving
@@ -434,6 +438,7 @@ class ElasticController(Controller):
             # and the bench quote
             metrics.observe("elastic_migration_mttr_seconds", total)
         if pg is not None:
+            from volcano_tpu.api import serving as sapi
             stamped = self._int_ann(pg, RESUME_STEP_ANNOTATION)
             last = self._int_ann(pg, LAST_STEP_ANNOTATION)
             if stamped is not None and last is not None:
@@ -442,7 +447,8 @@ class ElasticController(Controller):
             changed = False
             for key in (REQUEUED_ANNOTATION,
                         eapi.ELASTIC_RESIZING_ANNOTATION,
-                        eapi.ELASTIC_AVOID_SLICES_ANNOTATION):
+                        eapi.ELASTIC_AVOID_SLICES_ANNOTATION,
+                        sapi.VICTIM_ANNOTATION):
                 if pg.annotations.pop(key, None):
                     changed = True
             if changed:

@@ -126,6 +126,18 @@ class JaxPlugin(JobPlugin):
             set_env(pod, ENV_EPOCH, str(
                 _int_ann(FAILOVER_GENERATION_ANNOTATION)
                 + _int_ann(_eapi.ELASTIC_GENERATION_ANNOTATION)))
+        # serving plane (api/serving.py): same contract, different
+        # record — a serving-class job declaring a stats dir gets a
+        # per-pod stats-file path plus the same restart/resize epoch
+        from volcano_tpu.api.serving import (
+            ENV_STATS_FILE, STATS_DIR_ANNOTATION, stats_file_for)
+        stats_dir = job.annotations.get(STATS_DIR_ANNOTATION)
+        if stats_dir:
+            set_env(pod, ENV_STATS_FILE,
+                    stats_file_for(stats_dir, pod.uid))
+            set_env(pod, ENV_EPOCH, str(
+                _int_ann(FAILOVER_GENERATION_ANNOTATION)
+                + _int_ann(_eapi.ELASTIC_GENERATION_ANNOTATION)))
 
         tasks = self._worker_tasks(job)
         num_slices = len({sid for _, sid in tasks})

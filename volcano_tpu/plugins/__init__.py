@@ -34,3 +34,4 @@ import volcano_tpu.plugins.elastic       # noqa: F401
 import volcano_tpu.plugins.datalocality  # noqa: F401
 import volcano_tpu.plugins.volumebinding # noqa: F401
 import volcano_tpu.plugins.dra           # noqa: F401
+import volcano_tpu.plugins.serving       # noqa: F401

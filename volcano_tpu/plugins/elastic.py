@@ -50,6 +50,8 @@ class ElasticPlugin(Plugin):
         now = time.time()
         # job uid -> set of slice names its re-placement must avoid
         self._avoid: Dict[str, Set[str]] = {}
+        # job uids whose avoid preference funds a serving scale-up
+        self._serving_victims: Set[str] = set()
         shrink_in_flight = False
         for job in ssn.jobs.values():
             pg = job.podgroup
@@ -73,6 +75,9 @@ class ElasticPlugin(Plugin):
             avoid = set(eapi.avoid_slices(pg))
             if avoid:
                 self._avoid[job.uid] = avoid
+                from volcano_tpu.api import serving as sapi
+                if pg.annotations.get(sapi.VICTIM_ANNOTATION):
+                    self._serving_victims.add(job.uid)
         if shrink_in_flight:
             ssn.add_job_starving_fn(self.name, self._not_starving)
         if self._avoid:
@@ -90,6 +95,11 @@ class ElasticPlugin(Plugin):
         if not avoid or node.node is None:
             return None
         if node.node.labels.get(TPU_SLICE_LABEL) in avoid:
-            return unschedulable(
-                "slice vacated by elastic migration", self.name)
+            msg = "slice vacated by elastic migration"
+            if task.job in self._serving_victims:
+                # distinguishable wait for `vtpctl explain`: this gang
+                # shrank to fund a serving scale-up and is steered off
+                # the ICI-adjacent block it freed
+                msg = "slice freed for serving scale-up"
+            return unschedulable(msg, self.name)
         return None

@@ -130,6 +130,12 @@ def observe_phase_metrics(pod_annotations: Dict[str, str],
 # podgroup annotation's `detail` samples only.
 REASON_ENUM = (
     "elastic-waiting-for-capacity",
+    # a serving group's SLO burst is waiting on chips (the scale-up is
+    # pending while the serving-aware shrink frees an adjacent block)
+    "serving-slo-pressure",
+    # a training gang shrunk to fund that scale-up, re-placing off its
+    # ICI-adjacent slices (the elastic plugin's avoid filter)
+    "serving-preemption-victim",
     "quarantined",
     "node-affinity-mismatch",
     "taint-not-tolerated",
@@ -163,6 +169,11 @@ _REASON_RULES: Tuple[Tuple[Tuple[str, ...], str], ...] = (
     # not read as a capacity wait
     (("elastic: waiting", "waiting for capacity"),
      "elastic-waiting-for-capacity"),
+    # before the generic rules: the serving plugin's pressure marker
+    # and the avoid-filter message a shrunk victim sees while steered
+    # off the slices it freed for the serving pool
+    (("serving: slo pressure",), "serving-slo-pressure"),
+    (("freed for serving",), "serving-preemption-victim"),
     # before the device/insufficient rules: the flush_binds loser path
     # prefixes the server's 409 refusal ("bind overcommit: node ...")
     # with this marker when a subtree shard plan is active
