@@ -2848,11 +2848,13 @@ def bench_serving() -> dict:
     from volcano_tpu.cache.remote_cluster import RemoteCluster
     from volcano_tpu.simulator import slice_nodes
     from volcano_tpu.workloads.progress import ProgressReporter
-    from volcano_tpu.workloads.serve import DiurnalTraffic
+    from volcano_tpu.workloads.serve import (DiurnalTraffic,
+                                             WeightedLoadBalancer)
 
     DAY_S = 45.0
     BASE_QPS, PEAK_QPS = 400.0, 3000.0
     TARGET_QPS, SLO_MS = 800.0, 50.0
+    CANARY_QPS = 150.0      # flat offered load on the second group
     FLOOR_STEP = 500
     BEAT_S = 0.25
 
@@ -2900,6 +2902,15 @@ def bench_serving() -> dict:
         kubectl.add_vcjob(_serving_vcjob(
             "infer", 1, 1, 3, 4, stats_dir, slo_ms=SLO_MS,
             target_qps=TARGET_QPS))
+        # the contending group: a fixed-size (lo == hi == 1) canary
+        # replica group behind the SAME front-end LB — multi-group
+        # serving contention: it shares the fleet and the balancer
+        # with `infer` but its traffic must never bleed across, and
+        # the burst preemption funding infer's scale-up must come out
+        # of the training gangs, not the other serving group
+        kubectl.add_vcjob(_serving_vcjob(
+            "canary", 1, 1, 1, 4, stats_dir, slo_ms=SLO_MS,
+            target_qps=TARGET_QPS))
         for tname in ("ta", "tb"):
             tj = _elastic_vcjob(tname, 2, 1, 3, 4)
             tj.annotations[LAST_STEP_ANNOTATION] = str(FLOOR_STEP)
@@ -2913,8 +2924,8 @@ def bench_serving() -> dict:
             return sum(1 for p in kubectl.pods.values()
                        if p.owner == j.uid and p.node_name
                        and p.phase is TaskStatus.RUNNING) >= want
-        # serving up + training absorbed every idle slice
-        _wire_wait(lambda: running("infer", 4)
+        # both serving groups up + training absorbed every idle slice
+        _wire_wait(lambda: running("infer", 4) and running("canary", 4)
                    and _chip_utilization(kubectl) >= 0.99, 90,
                    lambda: "serve bench gangs never filled the fleet "
                    f"({plane.log_tails()[-900:]})")
@@ -2935,21 +2946,28 @@ def bench_serving() -> dict:
         floor_violations = 0
         step_regressions = 0
 
-        def serving_pods():
-            sj = kubectl.vcjobs.get("default/infer")
+        def serving_pods(jname):
+            sj = kubectl.vcjobs.get(f"default/{jname}")
             if sj is None:
                 return []
             return [p for p in kubectl.pods.values()
                     if p.owner == sj.uid and p.node_name
                     and p.phase is TaskStatus.RUNNING]
 
+        lb = WeightedLoadBalancer()
+
         def lb_beat(t_rel):
-            """The load-balancer driver: evaluate the diurnal curve,
-            split it across the RUNNING replicas, reconcile one REAL
-            serve.py subprocess per replica (env straight off the
-            pod's injected container env — the jax-plugin contract)."""
+            """The front-end driver: evaluate the diurnal curve and
+            route BOTH groups' offered load across their RUNNING
+            replicas weighted by each replica's OBSERVED p99 (read
+            back from the stats file it publishes — the same feedback
+            the autoscaler folds), reconciling one REAL serve.py
+            subprocess per replica (env straight off the pod's
+            injected container env — the jax-plugin contract)."""
             total = traffic.qps_at(t_rel)
-            pods = serving_pods()
+            by_group = {"infer": serving_pods("infer"),
+                        "canary": serving_pods("canary")}
+            pods = [p for ps in by_group.values() for p in ps]
             live = {p.uid for p in pods}
             for uid in [u for u in workers if u not in live]:
                 proc, logf = workers.pop(uid)
@@ -2959,12 +2977,24 @@ def bench_serving() -> dict:
                 except Exception:  # noqa: BLE001
                     proc.kill()
                 logf.close()
-            per = total / max(1, len(pods))
+                lb.forget(uid)
+            for p in pods:
+                try:
+                    with open(sapi.stats_file_for(stats_dir, p.uid),
+                              encoding="utf-8") as f:
+                        lb.observe(p.uid,
+                                   float(json.load(f).get("p99_ms", 0)))
+                except (OSError, ValueError, TypeError):
+                    pass     # cold replica: priced at the group mean
+            shares = lb.route(
+                {"infer": total, "canary": CANARY_QPS},
+                {g: [p.uid for p in ps]
+                 for g, ps in by_group.items()})
             for p in pods:
                 tf = os.path.join(traffic_dir, f"lb-{p.uid}.json")
                 tmp = tf + ".tmp"
                 with open(tmp, "w", encoding="utf-8") as f:
-                    json.dump({"qps": per}, f)
+                    json.dump({"qps": shares.get(p.uid, 0.0)}, f)
                 os.replace(tmp, tf)
                 if p.uid not in workers:
                     env = dict(os.environ, PYTHONPATH=plane.repo,
@@ -2983,7 +3013,10 @@ def bench_serving() -> dict:
                          "volcano_tpu.workloads.serve"],
                         env=env, stdout=logf, stderr=logf,
                         cwd=plane.repo), logf)
-            return total, len(pods)
+            infer_shares = [shares[p.uid] for p in by_group["infer"]]
+            skew = (max(infer_shares) / max(min(infer_shares), 1e-9)) \
+                if len(infer_shares) > 1 else 1.0
+            return total, len(by_group["infer"]), skew
 
         def feed_training():
             """Epoch-aware training progress (the chaos-conductor
@@ -3029,12 +3062,15 @@ def bench_serving() -> dict:
         episodes = []           # completed scale-up episodes
         pending_up = None
         victims = {}      # (gang, freed slices) -> adjacency audit
+        # multi-group guard: the burst preemption funding infer must
+        # never take the OTHER serving group as its victim
+        canary_victimized = False
         decision_snap = None      # holdings + pool at decision time
         t0 = _time.monotonic()
         horizon = DAY_S + 30.0      # one day + the descent tail
         while _time.monotonic() - t0 < horizon:
             t_rel = _time.monotonic() - t0
-            total, nrep = lb_beat(min(t_rel, DAY_S + 29.0))
+            total, nrep, lb_skew = lb_beat(min(t_rel, DAY_S + 29.0))
             feed_training()
             for a in agents.values():
                 try:
@@ -3045,12 +3081,17 @@ def bench_serving() -> dict:
             if pg is None:
                 _time.sleep(BEAT_S)
                 continue
+            cpg = kubectl.podgroups.get("default/canary")
+            if cpg is not None and \
+                    cpg.annotations.get(sapi.VICTIM_ANNOTATION):
+                canary_victimized = True
             cur = eapi.current_slices(pg)
             ta_s = _job_slices_now(kubectl, "default/ta")
             tb_s = _job_slices_now(kubectl, "default/tb")
             timeline.append({
                 "t": round(t_rel, 2), "qps_offered": round(total, 1),
                 "replicas": cur, "replicas_running": nrep,
+                "lb_skew": round(lb_skew, 3),
                 "ta_slices": len(ta_s), "tb_slices": len(tb_s),
                 "qps_folded": round(sapi.ann_float(
                     pg.annotations, sapi.PG_QPS_ANNOTATION), 1),
@@ -3131,6 +3172,13 @@ def bench_serving() -> dict:
         ok_n = sapi.ann_float(pg.annotations,
                               sapi.PG_SLO_OK_ANNOTATION)
         attainment = (ok_n / reqs) if reqs > 0 else 0.0
+        cpg = kubectl.podgroups.get("default/canary")
+        c_reqs = sapi.ann_float(cpg.annotations,
+                                sapi.PG_REQUESTS_ANNOTATION) \
+            if cpg is not None else 0.0
+        c_ok = sapi.ann_float(cpg.annotations,
+                              sapi.PG_SLO_OK_ANNOTATION) \
+            if cpg is not None else 0.0
         max_rep = max(r["replicas"] for r in timeline)
         min_rep_after_peak = min(
             r["replicas"] for r in timeline
@@ -3174,6 +3222,23 @@ def bench_serving() -> dict:
             "training_floors_held": floors_held
             and floor_violations == 0,
             "training_step_regressions": step_regressions,
+            "lb": {
+                "policy": "p99-weighted",
+                "skew_max": round(max(
+                    r["lb_skew"] for r in timeline), 3),
+                "replica_p99_ewma_ms": {
+                    u[:8]: round(v, 2)
+                    for u, v in lb.latencies().items()},
+            },
+            "contention": {
+                "canary_qps_offered": CANARY_QPS,
+                "canary_requests": int(c_reqs),
+                "canary_slo_attainment": round(
+                    (c_ok / c_reqs) if c_reqs > 0 else 0.0, 4),
+                "canary_slices_final": eapi.current_slices(cpg)
+                if cpg is not None else 0,
+                "canary_never_victimized": not canary_victimized,
+            },
             "pareto": {
                 "serving_slo_attainment": round(attainment, 4),
                 "serving_replicas_avg": round(sum(
@@ -3195,6 +3260,523 @@ def bench_serving() -> dict:
         if kubectl is not None:
             kubectl.close()
         plane.shutdown()
+
+
+# -- federation: multi-region fleet behind one global queue ------------
+
+
+class _FederationFleet:
+    """N regional control planes (each a full _WirePlane: server +
+    controllers + elastic scheduler as OS processes) plus one GLOBAL
+    state server holding the job queue + region registry, with the
+    FederationRouter reconciling over the real wire (RemoteCluster
+    writes, RegionMirror tailing /wal?mirror=1)."""
+
+    def __init__(self, regions, ttl=3.0, arbitrage_after=4.0,
+                 poll_s=0.3, sync_s=0.25):
+        import os
+        import threading
+
+        from volcano_tpu.api import federation as fedapi
+        from volcano_tpu.api.devices.tpu.topology import slice_for
+        from volcano_tpu.cache.remote_cluster import RemoteCluster
+        from volcano_tpu.federation.mirror import RegionMirror
+        from volcano_tpu.federation.router import FederationRouter
+        from volcano_tpu.simulator import slice_nodes
+
+        self.gplane = _WirePlane()
+        conf_path = os.path.join(self.gplane.logdir, "elastic.yaml")
+        with open(conf_path, "w") as f:
+            json.dump(ELASTIC_CONF, f)     # JSON is valid YAML
+        # the global store runs NO scheduler and NO controllers —
+        # it is a queue + registry, not a control plane
+        self.gplane.spawn("server", "-m", "volcano_tpu.server",
+                          "--port", str(self.gplane.port),
+                          "--tick-period", "0.05", "--data-dir",
+                          os.path.join(self.gplane.logdir, "state"))
+        _wire_wait(lambda: _healthz(self.gplane.url), 20,
+                   "global state server /healthz")
+        self.g = RemoteCluster(self.gplane.url)
+        self.planes = {}
+        self.clients = {}
+        self.hosts = 0
+        self.router = FederationRouter(
+            self.g, ttl=ttl, arbitrage_after=arbitrage_after,
+            start_mirrors=False)
+        for name, n_slices, price in regions:
+            p = _WirePlane()
+            # --data-dir makes the region durable: the mirror lane
+            # (/replica_snapshot + /wal?mirror=1) only ships a WAL
+            p.spawn("server", "-m", "volcano_tpu.server",
+                    "--port", str(p.port), "--tick-period", "0.05",
+                    "--data-dir", os.path.join(p.logdir, "state"))
+            _wire_wait(lambda: _healthz(p.url), 20,
+                       f"region {name} server /healthz")
+            p.spawn("controllers", "-m", "volcano_tpu",
+                    "--cluster-url", p.url,
+                    "--components", "controllers", "--period", "0.05")
+            p.spawn("scheduler", "-m", "volcano_tpu",
+                    "--cluster-url", p.url,
+                    "--components", "scheduler", "--period", "0.05",
+                    "--conf", conf_path)
+            client = RemoteCluster(p.url, tolerate_unreachable=True)
+            for i in range(n_slices):
+                for node in slice_nodes(
+                        slice_for(f"{name}-s{i}", "v5e-16"),
+                        dcn_pod=f"{name}-dcn"):
+                    client.add_node(node)
+                    self.hosts += 1
+            mirror = RegionMirror(name, p.url)
+            mirror.start(poll_s=poll_s)
+            self.router.attach_region(
+                fedapi.region_record(name, p.url, price=price),
+                client=client, mirror=mirror)
+            self.planes[name] = p
+            self.clients[name] = client
+        # the router loop runs on its own thread (exactly what
+        # `python -m volcano_tpu.federation.router` does), pausable so
+        # scenarios can stage multi-job races into ONE sync pass
+        self._stop = threading.Event()
+        self.paused = threading.Event()
+        self.sync_errors = []
+
+        def _route():
+            while not self._stop.wait(sync_s):
+                if self.paused.is_set():
+                    continue
+                try:
+                    self.router.sync()
+                except Exception as e:  # noqa: BLE001 — keep going
+                    self.sync_errors.append(repr(e)[-200:])
+        self._thread = threading.Thread(target=_route, daemon=True,
+                                        name="fed-router")
+        self._thread.start()
+
+    def kill_region(self, name):
+        """SIGKILL every process of one regional plane — whole-region
+        loss, the blast radius the global queue must absorb."""
+        import signal as _signal
+        plane = self.planes[name]
+        for proc in plane.procs.values():
+            if proc.poll() is None:
+                proc.send_signal(_signal.SIGKILL)
+        for proc in plane.procs.values():
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001
+                proc.kill()
+
+    def set_region_state(self, name, state):
+        """Registry write, exactly what `vtpctl federate --drain`
+        issues."""
+        rec = dict(self.g.regions[name])
+        rec["state"] = state
+        self.g.put_object("region", rec, key=name)
+
+    def log_tails(self, n=900):
+        out = [self.gplane.log_tails(n)]
+        out += [p.log_tails(n) for p in self.planes.values()]
+        return "\n".join(out)[-4 * n:]
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+        self.router.close()
+        for client in self.clients.values():
+            client.close()
+        self.g.close()
+        for plane in self.planes.values():
+            plane.shutdown()
+        self.gplane.shutdown()
+
+
+def _fed_job(name, slices=1, locality=""):
+    """A global elastic gang: ordinary vcjob + locality preference —
+    the submitter's whole contract with the federation tier."""
+    from volcano_tpu.api import federation as fedapi
+    job = _elastic_vcjob(name, slices, 1, slices, 4)
+    if locality:
+        job.annotations[fedapi.FED_DATA_LOCALITY_ANNOTATION] = locality
+    return job
+
+
+def _fed_view(g, jname):
+    """(admitted region, folded regional phase) off the GLOBAL record
+    alone — what `vtpctl federate` renders."""
+    from volcano_tpu.api import federation as fedapi
+    j = g.vcjobs.get(f"default/{jname}")
+    if j is None:
+        return None, None
+    return (fedapi.admitted_region(j),
+            j.annotations.get(fedapi.FED_REGIONAL_PHASE_ANNOTATION))
+
+
+def _fed_running(g, jname, region=None):
+    adm, phase = _fed_view(g, jname)
+    return (adm is not None and phase == "Running"
+            and (region is None or adm == region))
+
+
+def _fed_stamp_steps(client, jname, step):
+    """What the regional progress fold does in production: acked
+    checkpoint metadata lands on the regional job's annotations."""
+    from volcano_tpu.api.slicehealth import (
+        CHECKPOINT_DIR_ANNOTATION, LAST_STEP_ANNOTATION,
+        RESUME_STEP_ANNOTATION)
+    j = client.vcjobs.get(f"default/{jname}")
+    if j is None:
+        return False
+    j.annotations[LAST_STEP_ANNOTATION] = str(step)
+    j.annotations[RESUME_STEP_ANNOTATION] = str(step)
+    j.annotations[CHECKPOINT_DIR_ANNOTATION] = f"gs://ckpt/{jname}"
+    client.update_vcjob(j)
+    return True
+
+
+def _fed_stamp_and_fold(fleet, region, jname, step, timeout=30):
+    """Stamp acked steps on the regional copy and wait until the
+    router folds them onto the GLOBAL record, re-stamping on retry (a
+    concurrent controller status flush can clobber one write)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        assert _fed_stamp_steps(fleet.clients[region], jname, step), \
+            f"no regional copy of {jname} in {region} to stamp"
+        inner = time.monotonic() + 3
+        while time.monotonic() < inner:
+            if _fed_folded_step(fleet.g, jname) == step:
+                return
+            time.sleep(0.05)
+    raise AssertionError(
+        f"acked step {step} of {jname} never folded globally "
+        f"({fleet.log_tails()})")
+
+
+def _fed_finish(fleet, region, jname, timeout=30):
+    """Retire a gang (the submitter cancels it): the global record
+    plus the regional copy with its podgroup and pods — the chips
+    return to the region's idle pool.  Deletes retry until the
+    objects STAY gone: a deleted RUNNING job has no finished-TTL (no
+    gc cascade), and a concurrent controller status flush is an
+    upsert that can resurrect a just-deleted record."""
+    from volcano_tpu.api.types import GROUP_NAME_ANNOTATION
+    key = f"default/{jname}"
+    client = fleet.clients[region]
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        gone = True
+        try:
+            for pod in list(client.pods.values()):
+                if pod.annotations.get(
+                        GROUP_NAME_ANNOTATION) == jname:
+                    client.delete_pod(pod.key)
+                    gone = False
+            for cl in (client, fleet.g):
+                if cl.vcjobs.get(key) is not None:
+                    cl.delete_vcjob(key)
+                    gone = False
+            if client.podgroups.get(key) is not None:
+                client.delete_podgroup(key)
+                gone = False
+        except OSError:
+            gone = False            # transient wire hiccup: retry
+        if gone:
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"gang {jname} would not stay deleted in "
+                         f"{region} ({fleet.log_tails()})")
+
+
+def _fed_folded_step(g, jname):
+    from volcano_tpu.api.slicehealth import LAST_STEP_ANNOTATION
+    j = g.vcjobs.get(f"default/{jname}")
+    try:
+        return int(j.annotations.get(LAST_STEP_ANNOTATION, 0) or 0)
+    except (AttributeError, TypeError, ValueError):
+        return 0
+
+
+def bench_federation() -> dict:
+    """The three federation headlines against a REAL 3-region process
+    fleet (each region: state server + controllers + elastic
+    scheduler; one global store; the router reconciling over the
+    wire):
+
+      placement      one global queue, goodput/locality/price-routed
+                     admission — every gang placed while the silo
+                     baseline (jobs pinned to their home region)
+                     strands most of them in one queue;
+      follow-the-sun `vtpctl federate --drain`-style region drain: the
+                     RUNNING gangs checkpoint-drain (elastic evacuate),
+                     park under the evacuated hold, and cut over to
+                     another region carrying their resume step;
+      region loss    SIGKILL a whole regional plane: its gangs requeue
+                     GLOBALLY with the folded checkpoint metadata —
+                     zero acked state lost — and re-run elsewhere
+                     (MTTR measured), plus burst arbitrage: a gang
+                     stuck PENDING behind a full regional queue
+                     migrates to idle capacity instead of waiting.
+
+    Committed as FED_r{N}.json."""
+    import time as _time
+
+    from volcano_tpu import metrics as _metrics
+    from volcano_tpu.api import federation as fedapi
+
+    STAMP_DRAIN = 7000       # acked step before the drain
+    STAMP_KILL = 9000        # acked step before the region kill
+    fleet = _FederationFleet(
+        (("ra", 2, 1.0), ("rb", 2, 0.7), ("rc", 2, 0.9)))
+    g = fleet.g
+    try:
+        # -- phase A: spread admission + utilization vs silos ----------
+        jobs = [f"j{i}" for i in range(1, 7)]
+        admission_s = {}
+        for jname in jobs:       # staggered: registry refresh between
+            g.add_vcjob(_fed_job(jname, 1, locality="ra"))
+            t0 = _time.monotonic()
+            _wire_wait(lambda j=jname: _fed_running(g, j), 60,
+                       lambda j=jname: f"federated admission of {j} "
+                       f"({fleet.log_tails()})")
+            admission_s[jname] = round(_time.monotonic() - t0, 3)
+        placed = {j: _fed_view(g, j)[0] for j in jobs}
+        by_region = {}
+        for jname, region in placed.items():
+            by_region.setdefault(region, []).append(jname)
+        # silo baseline: every job pinned to its home (locality)
+        # region, no cross-region queue — ra fits 2 of the 6
+        silo_placed = min(len(jobs), 2)
+        placement = {
+            "jobs": len(jobs),
+            "by_region": {r: sorted(v)
+                          for r, v in sorted(by_region.items())},
+            "placed_federated": len([r for r in placed.values() if r]),
+            "placed_silo_homed": silo_placed,
+            "placed_fraction_federated": 1.0,
+            "placed_fraction_silo": round(silo_placed / len(jobs), 3),
+            "admission_s": admission_s,
+        }
+        assert all(placed.values()), f"unplaced: {placed}"
+        assert len(by_region) == 3, \
+            f"no spread (one queue collapsed): {by_region}"
+
+        # -- phase B: follow-the-sun drain out of ra -------------------
+        # free rc first so the drain has somewhere to land: finish
+        # (cancel) the two gangs it took
+        for jname in list(by_region.get("rc", [])):
+            _fed_finish(fleet, "rc", jname)
+            jobs.remove(jname)
+        ra_jobs = sorted(by_region.get("ra", []))
+        for jname in ra_jobs:
+            # two ascending stamps teach the router a steps/sec/chip
+            # rate for ra (the learned-goodput input), then the final
+            # stamp is the drain's resume floor
+            _fed_stamp_and_fold(fleet, "ra", jname, STAMP_DRAIN - 500)
+            _fed_stamp_and_fold(fleet, "ra", jname, STAMP_DRAIN)
+        fleet.set_region_state("ra", fedapi.REGION_STATE_DRAINING)
+        t_drain = _time.monotonic()
+        _wire_wait(lambda: all(_fed_running(g, j) and
+                               _fed_view(g, j)[0] != "ra"
+                               for j in ra_jobs), 120,
+                   lambda: "follow-the-sun migration out of ra "
+                   f"({[_fed_view(g, j) for j in ra_jobs]}) "
+                   f"({fleet.log_tails()})")
+        sun_s = round(_time.monotonic() - t_drain, 3)
+        from volcano_tpu.api.slicehealth import RESUME_STEP_ANNOTATION
+        resume_ok = []
+        for jname in ra_jobs:
+            region = _fed_view(g, jname)[0]
+            copy = fleet.clients[region].vcjobs[f"default/{jname}"]
+            resume_ok.append(int(copy.annotations.get(
+                RESUME_STEP_ANNOTATION, 0)) >= STAMP_DRAIN)
+        cutovers = _metrics.get_observations(
+            "federation_cutover_seconds")
+        follow_the_sun = {
+            "drained_region": "ra",
+            "jobs_migrated": len(ra_jobs),
+            "dest_regions": sorted({_fed_view(g, j)[0]
+                                    for j in ra_jobs}),
+            "drain_to_running_s": sun_s,
+            "cutover_s": [round(c, 3) for c in cutovers],
+            "resume_continuity_ok": all(resume_ok),
+            "cutover_refusals": int(sum(
+                _metrics.get_counter(
+                    "federation_cutover_refusals_total", region=r)
+                for r in ("ra", "rb", "rc"))),
+        }
+
+        # -- phase C: whole-region loss (SIGKILL rb's plane) -----------
+        rb_jobs = sorted(by_region.get("rb", []))
+        for jname in rb_jobs:
+            _fed_stamp_and_fold(fleet, "rb", jname, STAMP_KILL - 500)
+            _fed_stamp_and_fold(fleet, "rb", jname, STAMP_KILL)
+        # ra drained empty above: reopen it as the failover target
+        fleet.set_region_state("ra", fedapi.REGION_STATE_READY)
+        fleet.kill_region("rb")
+        t_kill = _time.monotonic()
+        mttr = {}
+
+        def _replaced(jname):
+            if not _fed_running(g, jname):
+                return False
+            if _fed_view(g, jname)[0] == "rb":
+                return False
+            mttr.setdefault(jname,
+                            round(_time.monotonic() - t_kill, 3))
+            return True
+        _wire_wait(lambda: all(_replaced(j) for j in rb_jobs), 120,
+                   lambda: "global requeue out of the dead region "
+                   f"({[_fed_view(g, j) for j in rb_jobs]}) "
+                   f"({fleet.log_tails()})")
+        lost_folds = [j for j in rb_jobs
+                      if _fed_folded_step(g, j) != STAMP_KILL]
+        region_loss = {
+            "killed_region": "rb",
+            "detected_lost": g.regions["rb"]["state"] == "lost",
+            "jobs_requeued": len(rb_jobs),
+            "mttr_s": mttr,
+            "acked_steps_lost": len(lost_folds),
+            "requeue_attempt_bumped": all(
+                int(g.vcjobs[f"default/{j}"].annotations.get(
+                    fedapi.FED_ATTEMPT_ANNOTATION, 0)) >= 1
+                for j in rb_jobs),
+        }
+
+        # -- phase D: burst arbitrage ----------------------------------
+        # leave exactly one idle slice (in the region hosting the
+        # ex-ra gangs), then race TWO one-slice gangs into one router
+        # pass: both admit there, one runs, one sits PENDING — and
+        # must migrate as soon as a freed region scores better
+        sun_dest = follow_the_sun["dest_regions"][0]
+        victim = sorted(j for j in jobs
+                        if _fed_view(g, j)[0] == sun_dest)[0]
+        _fed_finish(fleet, sun_dest, victim)
+        jobs.remove(victim)
+        _wire_wait(lambda: float(g.regions[sun_dest].get(
+            "idle_chips", 0)) >= 16.0, 30,
+            f"freed slice visible in {sun_dest}'s registry record")
+        fleet.paused.set()       # stage both into ONE admit pass
+        g.add_vcjob(_fed_job("jx", 1))
+        g.add_vcjob(_fed_job("jy", 1))
+        _time.sleep(0.5)
+        fleet.paused.clear()
+        _wire_wait(lambda: all(_fed_view(g, j)[0] is not None
+                               for j in ("jx", "jy")), 60,
+                   lambda: "race pair admission "
+                   f"({fleet.log_tails()})")
+        _wire_wait(lambda: sum(1 for j in ("jx", "jy")
+                               if _fed_running(g, j)) >= 1, 60,
+                   "one of the race pair running")
+        # free a slice in ANOTHER region: the pending gang must beat
+        # its local queue by migrating, not by waiting
+        other = sorted(j for j in jobs
+                       if _fed_view(g, j)[0] not in (None, sun_dest))
+        freed_from = _fed_view(g, other[0])[0]
+        _fed_finish(fleet, freed_from, other[0])
+        jobs.remove(other[0])
+        t_arb = _time.monotonic()
+        _wire_wait(lambda: all(_fed_running(g, j)
+                               for j in ("jx", "jy")), 90,
+                   lambda: "arbitrage migration of the pending gang "
+                   f"({[_fed_view(g, j) for j in ('jx', 'jy')]}) "
+                   f"({fleet.log_tails()})")
+        arbitrage = {
+            "race_pair_regions": {j: _fed_view(g, j)[0]
+                                  for j in ("jx", "jy")},
+            "pending_migrations": int(_metrics.get_counter(
+                "federation_migrations_total", kind="pending")),
+            "pending_to_running_s": round(
+                _time.monotonic() - t_arb, 3),
+        }
+
+        util = {name: round(_chip_utilization(
+            fleet.clients[name]), 4)
+            for name in ("ra", "rc")}
+        return {
+            "hosts": fleet.hosts,
+            "regions": {n: {"price": p, "slices": s}
+                        for n, s, p in (("ra", 2, 1.0), ("rb", 2, 0.7),
+                                        ("rc", 2, 0.9))},
+            "placement": placement,
+            "follow_the_sun": follow_the_sun,
+            "region_loss": region_loss,
+            "arbitrage": arbitrage,
+            "surviving_region_utilization": util,
+            "learned_goodput": {f"{r}/{gen}": round(v, 4)
+                                for (r, gen), v in
+                                fleet.router._goodput.items()},
+            "router_sync_errors": fleet.sync_errors[-5:],
+        }
+    finally:
+        fleet.shutdown()
+
+
+def bench_federation_wire_smoke() -> dict:
+    """Seconds-scale federation drill for tier-1: locality-routed
+    admission across two REAL regional planes, then whole-region loss
+    — the dead region's gang requeues globally, lands in the survivor
+    and resumes from the folded step (zero acked state lost)."""
+    import time as _time
+
+    from volcano_tpu.api import federation as fedapi
+
+    STAMP = 4200
+    fleet = _FederationFleet(
+        (("ra", 2, 1.0), ("rb", 1, 0.7)), ttl=2.0)
+    g = fleet.g
+    try:
+        g.add_vcjob(_fed_job("anchor", 1, locality="ra"))
+        g.add_vcjob(_fed_job("roamer", 1, locality="rb"))
+        _wire_wait(lambda: _fed_running(g, "anchor", "ra")
+                   and _fed_running(g, "roamer", "rb"), 60,
+                   lambda: "locality-routed admission "
+                   f"({_fed_view(g, 'anchor')} "
+                   f"{_fed_view(g, 'roamer')}) ({fleet.log_tails()})")
+        locality_ok = True
+        _fed_stamp_and_fold(fleet, "rb", "roamer", STAMP)
+        fleet.kill_region("rb")
+        t_kill = _time.monotonic()
+        _wire_wait(lambda: _fed_running(g, "roamer", "ra"), 90,
+                   lambda: "requeue into the surviving region "
+                   f"({_fed_view(g, 'roamer')}) ({fleet.log_tails()})")
+        mttr = round(_time.monotonic() - t_kill, 3)
+        from volcano_tpu.api.slicehealth import RESUME_STEP_ANNOTATION
+        copy = fleet.clients["ra"].vcjobs["default/roamer"]
+        gjob = g.vcjobs["default/roamer"]
+        return {
+            "regions": 2, "hosts": fleet.hosts,
+            "locality_routed_ok": locality_ok,
+            "region_detected_lost":
+                g.regions["rb"]["state"] == "lost",
+            "requeue_mttr_s": mttr,
+            "folded_step_survived":
+                _fed_folded_step(g, "roamer") == STAMP,
+            "resume_step_in_survivor": int(copy.annotations.get(
+                RESUME_STEP_ANNOTATION, 0)),
+            "attempt": int(gjob.annotations.get(
+                fedapi.FED_ATTEMPT_ANNOTATION, 0)),
+            "migrated_from": gjob.annotations.get(
+                fedapi.FED_MIGRATED_FROM_ANNOTATION, ""),
+            "router_sync_errors": fleet.sync_errors[-3:],
+        }
+    finally:
+        fleet.shutdown()
+
+
+def federation_smoke() -> int:
+    """Tier-1 federation drill, mirroring --elastic-smoke /
+    --serve-smoke.  Prints one JSON line."""
+    try:
+        out = bench_federation_wire_smoke()
+        ok = (out["locality_routed_ok"]
+              and out["region_detected_lost"]
+              and out["folded_step_survived"]
+              and out["resume_step_in_survivor"] >= 4200
+              and out["migrated_from"] == "rb"
+              and not out["router_sync_errors"])
+    except AssertionError as e:
+        out, ok = {"error": str(e)[-900:]}, False
+    print(json.dumps({"metric": "federation_smoke", "ok": ok, **out}))
+    return 0 if ok else 1
 
 
 # -- control-plane crash chaos (kill -9 + WAL recovery) ----------------
@@ -4885,6 +5467,18 @@ if __name__ == "__main__":
         sys.exit(goodput_smoke())
     elif "--serve-smoke" in sys.argv:
         sys.exit(serve_smoke())
+    elif "--federation-smoke" in sys.argv:
+        sys.exit(federation_smoke())
+    elif "--federation" in sys.argv:
+        # the standalone federation-tier row committed as
+        # FED_r{N}.json: 3 REAL regional control planes behind one
+        # global queue — goodput/locality/price-routed placement vs
+        # the silo baseline, follow-the-sun drain with checkpoint
+        # resume continuity, whole-region SIGKILL with zero acked
+        # state lost + global-requeue MTTR, and burst arbitrage of a
+        # pending gang onto freed capacity
+        print(json.dumps({"metric": "federation_3region_fleet",
+                          **bench_federation()}))
     elif "--serve" in sys.argv:
         # the standalone serving-plane row committed as
         # SERVE_r{N}.json: diurnal day against the real process

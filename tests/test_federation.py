@@ -1,0 +1,526 @@
+"""Federation tier: one global queue over N regional planes (ISSUE 18).
+
+The contract under test (docs/design/federation.md):
+
+  mirror     the PR-9 WAL-shipping lane reused as an ASYNC object
+      mirror: bootstrap from /replica_snapshot, tail /wal?mirror=1
+      with the same CRC + sequence verification a replica runs
+      (corrupt/gapped batches refused WHOLESALE), staleness ADVERTISED
+      and enforced at read_checked() — never part of the commit
+      quorum;
+  router     unadmitted global gangs score into the best ready region
+      (locality x learned goodput / price, gated on fit); the
+      admission key is deterministic over (job, attempt) so a router
+      restart mid-admission finds its own half-finished placement
+      instead of double-placing; a lost region's gangs requeue
+      globally carrying the folded resume metadata (nothing acked to
+      the global store dies with a region);
+  migration  a RUNNING gang moves via the elastic evacuate drain: the
+      source controller checkpoints + drains + parks the gang under
+      the `evacuated` hold (the enqueue gate keeps it out of INQUEUE),
+      and the router cuts over create-then-delete — refusing to act
+      through a stale destination mirror.
+"""
+
+import os
+import tempfile
+import time
+
+import pytest
+
+from volcano_tpu import metrics, trace
+from volcano_tpu.api import elastic as eapi
+from volcano_tpu.api import federation as fedapi
+from volcano_tpu.api.node_info import Node
+from volcano_tpu.api.pod import Container, Pod, make_pod
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.slicehealth import (
+    LAST_STEP_ANNOTATION,
+    RESUME_STEP_ANNOTATION,
+)
+from volcano_tpu.api.types import JobPhase, PodGroupPhase
+from volcano_tpu.api.vcjob import TaskSpec, VCJob
+from volcano_tpu.cache.fake_cluster import FakeCluster
+from volcano_tpu.federation.mirror import MirrorStaleError, RegionMirror
+from volcano_tpu.federation.router import FederationRouter, job_chips
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- harness -----------------------------------------------------------
+
+class FakeMirror:
+    """Zero-wire mirror for router unit tests: reads come straight
+    from the regional FakeCluster; staleness is a knob."""
+
+    def __init__(self, name, cluster, age=0.0):
+        self.name = name
+        self.cluster = cluster
+        self.age = age
+
+    def age_s(self):
+        return self.age
+
+    def read_checked(self, max_age_s=None):
+        bound = fedapi.MIRROR_MAX_AGE_S if max_age_s is None \
+            else max_age_s
+        if self.age > bound:
+            raise MirrorStaleError(self.name, self.age, bound)
+        return self.cluster
+
+    def status(self):
+        return {"region": self.name, "age_s": self.age}
+
+    def stop(self):
+        pass
+
+
+def tpu_region(name, nodes=4, chips=4):
+    c = FakeCluster()
+    for i in range(nodes):
+        c.add_node(Node(
+            name=f"{name}-n{i}",
+            labels={"cloud.google.com/gke-tpu-accelerator":
+                    "tpu-v5-lite-podslice"},
+            allocatable={TPU: chips, "cpu": 64}))
+    return c
+
+
+def global_job(name="train", replicas=2, chips=4, annotations=None):
+    tpl = Pod(name="w", containers=[Container(
+        requests={TPU: chips, "cpu": 8})])
+    return VCJob(name=name, min_available=replicas,
+                 annotations=dict(annotations or {}),
+                 tasks=[TaskSpec(name="w", replicas=replicas,
+                                 template=tpl)])
+
+
+def fleet(regions, clock=None):
+    """(global cluster, router, {name: (client_cluster, mirror)})."""
+    g = FakeCluster()
+    now = clock if clock is not None else time.time
+    router = FederationRouter(g, now=now, start_mirrors=False)
+    handles = {}
+    for name, kwargs in regions.items():
+        rc = tpu_region(name, **kwargs.pop("nodes_kw", {}))
+        m = FakeMirror(name, rc)
+        router.attach_region(
+            fedapi.region_record(name, f"fake://{name}", **kwargs),
+            client=rc, mirror=m)
+        handles[name] = (rc, m)
+    return g, router, handles
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- API contract ------------------------------------------------------
+
+def test_federation_api_contract():
+    # the region registry is a first-class dict-kind: snapshot/WAL
+    # codecs, watch fan-out and the mirror all treat it generically
+    from volcano_tpu.cache.kinds import KINDS
+    assert "region" in KINDS and KINDS["region"].attr == "regions"
+    assert FakeCluster().regions == {}
+
+    # deterministic admission key: same (job, attempt) -> same key
+    # across router restarts; a new attempt is a new key
+    k1 = fedapi.admission_key("default/train", 0)
+    assert k1 == fedapi.admission_key("default/train", 0)
+    assert k1 != fedapi.admission_key("default/train", 1)
+
+    rec = fedapi.region_record("ra", "http://x", price=0.5)
+    assert fedapi.region_ready(rec) and fedapi.region_alive(rec)
+    rec["state"] = fedapi.REGION_STATE_DRAINING
+    assert fedapi.region_alive(rec) and not fedapi.region_ready(rec)
+    rec["state"] = fedapi.REGION_STATE_LOST
+    assert not fedapi.region_alive(rec)
+
+    # the evacuate resize kind + hold annotations and the bounded
+    # enqueue-hold reason are part of the cross-layer contract
+    assert eapi.RESIZE_EVACUATE in eapi.RESIZE_KINDS
+    assert "evacuating-region" in trace.REASON_ENUM
+    assert trace.normalize_reason(
+        "held: evacuating to region rb") == "evacuating-region"
+    pg_like = VCJob(name="x", annotations={
+        eapi.ELASTIC_EVACUATED_ANNOTATION: "true"})
+    assert eapi.evacuating(pg_like)
+
+    # every federation_* family emitted by router/mirror is declared
+    from volcano_tpu.bundle import FAMILIES
+    for fam in ("federation_regions", "federation_pending_jobs",
+                "federation_admissions_total",
+                "federation_requeues_total",
+                "federation_migrations_total",
+                "federation_cutover_refusals_total",
+                "federation_mirror_records_total",
+                "federation_mirror_resyncs_total",
+                "federation_mirror_refused_batches_total"):
+        assert fam in FAMILIES, fam
+
+
+# -- mirror: staleness contract ----------------------------------------
+
+def test_mirror_staleness_bound_enforced():
+    """read_checked() is the cutover gate: within the bound it serves
+    the cached store, past it it refuses with the advertised age —
+    never silently returns stale state."""
+    t = Clock(100.0)
+    m = RegionMirror("ra", "http://unused", max_age_s=30.0,
+                     now=t)
+    # never bootstrapped: infinitely stale
+    assert m.age_s() == float("inf")
+    with pytest.raises(MirrorStaleError):
+        m.read_checked()
+    # pretend a successful poll landed at t=100
+    m._bootstrapped = True
+    m._fresh_ts = t()
+    t.t = 120.0
+    assert m.read_checked() is m.cluster       # 20s < 30s bound
+    t.t = 140.0
+    with pytest.raises(MirrorStaleError) as ei:
+        m.read_checked()                       # 40s > 30s bound
+    assert ei.value.age_s == pytest.approx(40.0)
+    assert ei.value.bound_s == pytest.approx(30.0)
+    # a caller may tighten the bound per read
+    t.t = 101.0
+    with pytest.raises(MirrorStaleError):
+        m.read_checked(max_age_s=0.5)
+
+
+def _wait(cond, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_mirror_tails_live_server():
+    """Wire test: bootstrap from /replica_snapshot, tail the
+    non-quorum /wal?mirror=1 lane, fold adds and deletes."""
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.server.durability import DurableStore
+    from volcano_tpu.server.state_server import serve
+
+    d = tempfile.mkdtemp()
+    httpd, st = serve(port=0, durable=DurableStore(d))
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    rc = RemoteCluster(url)
+    try:
+        rc.add_node(Node(name="n0", allocatable={TPU: 4}))
+        rc.add_vcjob(global_job("j1"))
+        m = RegionMirror("ra", url)
+        m.poll()
+        assert set(m.cluster.nodes) == {"n0"}
+        assert set(m.cluster.vcjobs) == {"default/j1"}
+        rc.add_node(Node(name="n1", allocatable={TPU: 4}))
+        rc.delete_vcjob("default/j1")
+        applied = m.poll(timeout=3.0)
+        assert applied >= 2
+        assert set(m.cluster.nodes) == {"n0", "n1"}
+        assert m.cluster.vcjobs == {}
+        assert m.age_s() < 5.0
+        s = m.status()
+        assert s["resyncs"] == 1 and s["refused_batches"] == 0
+    finally:
+        rc.close()
+        httpd.shutdown()
+
+
+def test_mirror_refuses_corrupt_batch_wholesale():
+    """A corrupt_ship fault on the shared /wal lane flips a byte in
+    one shipped record: the mirror must refuse the WHOLE batch
+    (counted), re-request, and converge to the exact source state
+    once the injection budget is spent — a prefix apply would desync
+    its seq cursor forever."""
+    from volcano_tpu import faults
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.server.durability import DurableStore
+    from volcano_tpu.server.replication import ShippedCorruptionError
+    from volcano_tpu.server.state_server import serve
+
+    d = tempfile.mkdtemp()
+    plan = faults.FaultPlan(7, [faults.FaultRule(
+        "server", "corrupt_ship", route="/wal", max_injections=1)])
+    httpd, st = serve(port=0, durable=DurableStore(d), faults=plan)
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    rc = RemoteCluster(url)
+    try:
+        m = RegionMirror("ra", url)
+        m.poll()                     # bootstrap carries no records
+        for i in range(6):
+            rc.add_node(Node(name=f"n{i}", allocatable={TPU: 4}))
+        with pytest.raises(ShippedCorruptionError):
+            m.poll(timeout=3.0)
+        assert m.refused_batches == 1
+        # the refused batch must not have advanced the cursor past
+        # the corruption; the re-request gets the records clean
+        m.poll(timeout=3.0)
+        assert len(m.cluster.nodes) == 6
+    finally:
+        rc.close()
+        httpd.shutdown()
+
+
+# -- router: admission -------------------------------------------------
+
+def test_admission_scores_locality_over_price():
+    clock = Clock()
+    g, router, handles = fleet(
+        {"ra": {"price": 1.0}, "rb": {"price": 0.5}}, clock=clock)
+    router.sync()                    # fold capacity into the registry
+    # price alone: rb wins
+    g.add_vcjob(global_job("cheap"))
+    # locality boost outweighs rb's price edge
+    g.add_vcjob(global_job("near", annotations={
+        fedapi.FED_DATA_LOCALITY_ANNOTATION: "ra"}))
+    router.sync()
+    cheap, near = g.vcjobs["default/cheap"], g.vcjobs["default/near"]
+    assert fedapi.admitted_region(cheap) == "rb"
+    assert fedapi.admitted_region(near) == "ra"
+    for job, region in ((cheap, "rb"), (near, "ra")):
+        copy = handles[region][0].vcjobs[job.key]
+        assert fedapi.home_key(copy) == job.key
+        assert copy.annotations[
+            fedapi.FED_ORIGIN_REGION_ANNOTATION] == region
+        assert copy.annotations[
+            fedapi.FED_ADMISSION_KEY_ANNOTATION] == \
+            fedapi.admission_key(job.key, 0)
+    # a gang too big for any region stays globally queued
+    g.add_vcjob(global_job("huge", replicas=64))
+    router.sync()
+    assert fedapi.admitted_region(g.vcjobs["default/huge"]) is None
+
+
+def test_router_restart_mid_admission_readmits_idempotently():
+    """The crash window: the regional create landed, the global
+    admitted-region stamp did not.  A restarted router re-derives the
+    SAME admission key, finds its half-finished placement, and
+    re-stamps — it must NOT place the gang a second time."""
+    clock = Clock()
+    g, router, handles = fleet(
+        {"ra": {"price": 0.5}, "rb": {"price": 1.0}}, clock=clock)
+    router.sync()
+    g.add_vcjob(global_job("train"))
+    router.sync()
+    job = g.vcjobs["default/train"]
+    assert fedapi.admitted_region(job) == "ra"
+    # simulate the crash: the stamp is lost, the regional copy is not
+    for k in (fedapi.FED_ADMITTED_REGION_ANNOTATION,
+              fedapi.FED_ADMITTED_TS_ANNOTATION,
+              fedapi.FED_ADMISSION_KEY_ANNOTATION,
+              fedapi.FED_REGIONAL_PHASE_ANNOTATION):
+        job.annotations.pop(k, None)
+    g.update_vcjob(job)
+    # ... and make the OTHER region look better, so a non-idempotent
+    # re-admission would visibly double-place into rb
+    ra_rec = dict(g.regions["ra"])
+    ra_rec["price"] = 10.0
+    g.put_object("region", ra_rec, key="ra")
+    router2 = FederationRouter(g, now=clock, start_mirrors=False)
+    for name, (rc, m) in handles.items():
+        router2.handles[name] = type(router.handles[name])(
+            name, dict(g.regions[name]), rc, m)
+    router2.sync()
+    job = g.vcjobs["default/train"]
+    assert fedapi.admitted_region(job) == "ra", \
+        "restart re-admitted instead of recovering its own placement"
+    assert "default/train" in handles["ra"][0].vcjobs
+    assert "default/train" not in handles["rb"][0].vcjobs
+
+
+def test_region_loss_requeues_globally_with_folded_resume():
+    """Whole-region loss: the gangs admitted there requeue GLOBALLY
+    with a bumped attempt, and the re-placed copy carries the resume
+    metadata the router folded while the region was alive — acked
+    progress survives the region."""
+    clock = Clock()
+    g, router, handles = fleet(
+        {"ra": {"price": 0.5}, "rb": {"price": 1.0}}, clock=clock)
+    router.sync()
+    g.add_vcjob(global_job("train"))
+    router.sync()
+    job = g.vcjobs["default/train"]
+    assert fedapi.admitted_region(job) == "ra"
+    ra, ra_mirror = handles["ra"]
+    # the regional plane runs and checkpoints: step 1200 acked
+    copy = ra.vcjobs["default/train"]
+    copy.phase = JobPhase.RUNNING
+    copy.annotations[LAST_STEP_ANNOTATION] = "1200"
+    copy.annotations[RESUME_STEP_ANNOTATION] = "1200"
+    router.sync()                    # fold regional -> global
+    assert job.annotations[LAST_STEP_ANNOTATION] == "1200"
+    assert job.annotations[
+        fedapi.FED_REGIONAL_PHASE_ANNOTATION] == "Running"
+    # region ra goes dark: mirror stops proving freshness and the
+    # heartbeat ages past the TTL
+    ra_mirror.age = 10_000.0
+    clock.t += fedapi.REGION_TTL_S + 10
+    router.sync()
+    job = g.vcjobs["default/train"]
+    assert g.regions["ra"]["state"] == fedapi.REGION_STATE_LOST
+    assert fedapi.admitted_region(job) == "rb"
+    assert job.annotations[fedapi.FED_ATTEMPT_ANNOTATION] == "1"
+    assert job.annotations[
+        fedapi.FED_MIGRATED_FROM_ANNOTATION] == "ra"
+    new_copy = handles["rb"][0].vcjobs["default/train"]
+    # loss-continuity: the new copy resumes from the folded step
+    assert new_copy.annotations[RESUME_STEP_ANNOTATION] == "1200"
+    assert new_copy.annotations[
+        fedapi.FED_ADMISSION_KEY_ANNOTATION] == \
+        fedapi.admission_key(job.key, 1)
+
+
+# -- router: migration cutover -----------------------------------------
+
+def _evacuated_fleet(clock):
+    """A fleet where 'train' runs in ra and the source plane has
+    ALREADY drained it for evacuation to rb (the elastic controller's
+    half is exercised end-to-end in test_evacuate_drain_* below)."""
+    from volcano_tpu.api.podgroup import PodGroup
+    g, router, handles = fleet(
+        {"ra": {"price": 0.5}, "rb": {"price": 1.0}}, clock=clock)
+    router.sync()
+    g.add_vcjob(global_job("train"))
+    router.sync()
+    job = g.vcjobs["default/train"]
+    assert fedapi.admitted_region(job) == "ra"
+    ra = handles["ra"][0]
+    copy = ra.vcjobs["default/train"]
+    copy.phase = JobPhase.RUNNING
+    copy.annotations[RESUME_STEP_ANNOTATION] = "900"
+    pg = PodGroup(name="train", namespace="default", min_member=2)
+    pg.annotations[eapi.ELASTIC_EVACUATE_ANNOTATION] = "rb"
+    pg.annotations[eapi.ELASTIC_EVACUATED_ANNOTATION] = "true"
+    ra.add_podgroup(pg)
+    job.annotations[fedapi.FED_EVACUATING_TO_ANNOTATION] = "rb"
+    g.update_vcjob(job)
+    return g, router, handles
+
+
+def test_cutover_refused_on_stale_destination_mirror():
+    """The cutover gate: with the DESTINATION mirror past its
+    staleness bound the router must refuse (counted, evented) and
+    leave the source intact — acting on stale state could
+    double-place.  Once the mirror freshens, the same pass cuts over
+    create-then-delete."""
+    clock = Clock()
+    g, router, handles = _evacuated_fleet(clock)
+    job = g.vcjobs["default/train"]
+    rb, rb_mirror = handles["rb"]
+    rb_mirror.age = fedapi.MIRROR_MAX_AGE_S + 5.0
+    before = metrics.get_counter(
+        "federation_cutover_refusals_total", region="rb")
+    router.sync()
+    job = g.vcjobs["default/train"]
+    # refused: nothing created, nothing deleted, still admitted to ra
+    assert "default/train" not in rb.vcjobs
+    assert "default/train" in handles["ra"][0].vcjobs
+    assert fedapi.admitted_region(job) == "ra"
+    after = metrics.get_counter(
+        "federation_cutover_refusals_total", region="rb")
+    assert after == before + 1
+    # mirror catches up -> the cutover proceeds
+    rb_mirror.age = 0.0
+    router.sync()
+    job = g.vcjobs["default/train"]
+    assert fedapi.admitted_region(job) == "rb"
+    assert fedapi.migration_count(job) == 1
+    assert "default/train" not in handles["ra"][0].vcjobs
+    new_copy = rb.vcjobs["default/train"]
+    # the destination copy resumes from the source's checkpoint and
+    # carries provenance, not the evacuation markers
+    assert new_copy.annotations[RESUME_STEP_ANNOTATION] == "900"
+    assert new_copy.annotations[
+        fedapi.FED_MIGRATED_FROM_ANNOTATION] == "ra"
+    assert eapi.ELASTIC_EVACUATE_ANNOTATION not in new_copy.annotations
+    assert eapi.ELASTIC_EVACUATED_ANNOTATION not in new_copy.annotations
+
+
+# -- the source plane's half: drain + hold -----------------------------
+
+def test_evacuate_drain_holds_gang_for_cutover():
+    """The elastic evacuate decision on a RUNNING gang drains it via
+    the checkpointed restart, stamps the `evacuated` hold, and the
+    enqueue gate then keeps the gang OUT of INQUEUE — the source
+    scheduler must never re-place a gang the router is cutting over."""
+    from test_elastic import drive, elastic_job, plane
+
+    cluster, mgr, sched = plane([("sa", "v5e-16"), ("sb", "v5e-16")])
+    job = elastic_job("etrain", slices=2, lo=1, hi=2)
+    cluster.add_vcjob(job)
+    drive(cluster, mgr, sched, 3)
+    job = cluster.vcjobs["default/etrain"]
+    assert job.phase is JobPhase.RUNNING
+    pg = cluster.podgroups["default/etrain"]
+    # the router's stamp: evacuate at the current size
+    now = time.time()
+    pg.annotations[eapi.ELASTIC_EVACUATE_ANNOTATION] = "rb"
+    pg.annotations[eapi.ELASTIC_DESIRED_SLICES_ANNOTATION] = \
+        str(eapi.current_slices(pg))
+    pg.annotations[eapi.ELASTIC_RESIZE_REASON_ANNOTATION] = \
+        eapi.RESIZE_EVACUATE
+    pg.annotations[eapi.ELASTIC_DECIDED_TS_ANNOTATION] = f"{now:.3f}"
+    cluster.update_podgroup_status(pg)
+    drive(cluster, mgr, sched, 6)
+    pg = cluster.podgroups["default/etrain"]
+    assert pg.annotations.get(
+        eapi.ELASTIC_EVACUATED_ANNOTATION) == "true", \
+        "drain did not park the gang under the evacuated hold"
+    # held: nothing of the gang is placed or admitted, and it stays
+    # that way no matter how many cycles the source plane runs
+    drive(cluster, mgr, sched, 3)
+    pg = cluster.podgroups["default/etrain"]
+    assert pg.phase is not PodGroupPhase.RUNNING
+    assert pg.phase is not PodGroupPhase.INQUEUE
+    from volcano_tpu.api.types import GROUP_NAME_ANNOTATION
+    placed = [p for p in cluster.pods.values()
+              if p.annotations.get(GROUP_NAME_ANNOTATION) == "etrain"
+              and p.node_name]
+    assert placed == [], "evacuated gang was re-placed locally"
+    # the hold is visible as the bounded reason enum
+    assert eapi.evacuating(pg)
+
+
+def test_job_chips_counts_gang_demand():
+    assert job_chips(global_job("j", replicas=8, chips=4)) == 32.0
+    assert job_chips(VCJob(name="cpu", tasks=[TaskSpec(
+        name="d", replicas=2,
+        template=make_pod("t", requests={"cpu": 4}))])) == 0.0
+
+
+# -- tier-1 smoke: the whole federation loop through real processes ----
+
+def test_bench_federation_smoke_mode():
+    """`bench.py --federation-smoke` boots two REAL regional control
+    planes plus a global store, routes two gangs by data locality,
+    then SIGKILLs one whole region: the dead region's gang must
+    requeue globally and resume in the survivor from the folded
+    checkpoint step — zero acked state lost."""
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--federation-smoke"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=REPO)
+    assert proc.returncode == 0, \
+        proc.stdout[-2000:] + proc.stderr[-2000:]
+    import json
+    line = next(l for l in reversed(proc.stdout.strip().splitlines())
+                if l.startswith("{"))
+    out = json.loads(line)
+    assert out["ok"] is True, out
+    assert out["locality_routed_ok"] and out["region_detected_lost"]
+    assert out["folded_step_survived"]
+    assert out["migrated_from"] == "rb"
+    assert out["attempt"] >= 1

@@ -43,6 +43,7 @@ from volcano_tpu.api.resource import TPU
 from volcano_tpu.api.types import GROUP_NAME_ANNOTATION, TaskStatus
 from volcano_tpu.controllers.serving import (
     HOLD_DOWN_SYNCS,
+    MAX_DOWN_STEP,
     P99_HEADROOM_FRAC,
     RESIZE_STABILIZE_S,
     SCALE_DOWN_FRAC,
@@ -52,7 +53,8 @@ from volcano_tpu.controllers.serving import (
 )
 from volcano_tpu.simulator import make_tpu_cluster
 from volcano_tpu.util import RateWindow
-from volcano_tpu.workloads.serve import ServingStatsReporter
+from volcano_tpu.workloads.serve import (ServingStatsReporter,
+                                         WeightedLoadBalancer)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -138,6 +140,7 @@ def test_hysteresis_constants_pinned():
     assert HOLD_DOWN_SYNCS == 3
     assert SIGNAL_STALE_S == 60.0
     assert RESIZE_STABILIZE_S == 10.0
+    assert MAX_DOWN_STEP == 4
 
 
 def test_serving_contract_helpers():
@@ -389,6 +392,53 @@ def test_scale_down_needs_fresh_signals_not_syncs():
         pg.annotations[sapi.PG_LAST_DECISION_ANNOTATION]
 
 
+def down_streak(ctrl, pg, clock):
+    """Feed HOLD_DOWN_SYNCS fresh low signals so the streak clears."""
+    for _ in range(HOLD_DOWN_SYNCS):
+        clock.tick(1)
+        pg.annotations[sapi.PG_UPDATED_TS_ANNOTATION] = \
+            f"{clock.t:.3f}"
+        ctrl.sync()
+
+
+def test_scale_down_multi_step_bounded_by_max_down_step():
+    """Traffic collapsing far below one replica's comfort zone sheds
+    MULTIPLE replicas in one decision — but never more than
+    MAX_DOWN_STEP, even when the signal would justify the floor."""
+    clock = Clock()
+    pg = serving_podgroup(qps=10.0, cur=8, lo=1, hi=10,
+                          target=100.0, now=clock.t)
+    ctrl, _ = controller_with(pg, clock)
+    down_streak(ctrl, pg, clock)
+    # qps=10 comfortably fits ONE replica, but 8 -> 4 is the cap
+    assert eapi.desired_slices(pg) == 8 - MAX_DOWN_STEP
+    assert "traffic-receding" in \
+        pg.annotations[sapi.PG_LAST_DECISION_ANNOTATION]
+
+
+def test_scale_down_multi_step_stops_where_comfort_fails():
+    """Each extra down-step must re-prove the comfort rule at its own
+    size: the descent stops at the smallest size that still absorbs
+    the observed rate with the hysteresis margin."""
+    clock = Clock()
+    pg = serving_podgroup(qps=260.0, cur=8, lo=1, hi=10,
+                          target=100.0, now=clock.t)
+    ctrl, _ = controller_with(pg, clock)
+    down_streak(ctrl, pg, clock)
+    # 260 qps: 5 replicas is the last size where
+    # qps < SCALE_DOWN_FRAC * target * (size - 1) still holds
+    assert eapi.desired_slices(pg) == 5
+
+
+def test_scale_down_multi_step_respects_replica_floor():
+    clock = Clock()
+    pg = serving_podgroup(qps=0.0, cur=3, lo=2, hi=10,
+                          target=100.0, now=clock.t)
+    ctrl, _ = controller_with(pg, clock)
+    down_streak(ctrl, pg, clock)
+    assert eapi.desired_slices(pg) == 2        # lo wins over the cap
+
+
 def test_no_scale_down_below_floor_or_above_ceiling():
     clock = Clock()
     pg = serving_podgroup(qps=0.0, cur=1, lo=1, hi=3, target=100.0,
@@ -542,6 +592,198 @@ def test_serving_pending_reason_slugs_bounded():
     assert trace.normalize_reason(
         "slice freed for serving scale-up") == \
         "serving-preemption-victim"
+
+
+# -- funding shrink vs the in-flight drain (over-evict regression) -----
+
+FUNDING_CONF = {
+    "actions": "enqueue, allocate, elastic, gangpreempt, backfill",
+    "tiers": [
+        {"plugins": [{"name": "priority"}, {"name": "gang"},
+                     {"name": "failover"}, {"name": "elastic"},
+                     {"name": "conformance"}]},
+        {"plugins": [{"name": "overcommit"}, {"name": "drf"},
+                     {"name": "predicates"}, {"name": "proportion"},
+                     {"name": "nodeorder"}, {"name": "binpack"},
+                     {"name": "deviceshare"},
+                     {"name": "network-topology-aware"}]},
+    ],
+    "configurations": {"elastic": {"elastic.cooldownSeconds": 0}},
+}
+
+
+def test_funding_shrink_credits_in_flight_drain_no_over_evict():
+    """The over-evict race: a serving gang requeued by its own 2->3
+    grow still OCCUPIES its two old slices while the checkpointed
+    drain executes — they read busy, not idle.  The funding deficit
+    must credit those draining chips at decision time: the scale-up
+    needs ONE victim slice (3 wanted - 2 about to be freed), not
+    three.  Before the fix the deficit counted the gang's whole new
+    footprint and collapsed the training donor to its floor."""
+    import time as _time
+
+    from volcano_tpu.api.types import (JobPhase, PodGroupPhase,
+                                       TPU_SLICE_LABEL)
+    from volcano_tpu.api.vcjob import TaskSpec, VCJob
+    from volcano_tpu.controllers import ControllerManager
+    from volcano_tpu.scheduler import Scheduler
+    from volcano_tpu.webhooks import default_admission
+
+    cluster = make_tpu_cluster(
+        [(f"s{i}", "v5e-16") for i in range(5)])
+    cluster.admission = default_admission()
+    mgr = ControllerManager(cluster, enabled=[
+        "job", "podgroup", "queue", "failover", "elastic"])
+    sched = Scheduler(cluster, conf=FUNDING_CONF, schedule_period=0)
+
+    # training donor: elastic 1..3, running at 3 slices
+    cluster.add_vcjob(VCJob(
+        name="etrain", min_available=12,
+        annotations={eapi.ELASTIC_MIN_SLICES_ANNOTATION: "1",
+                     eapi.ELASTIC_MAX_SLICES_ANNOTATION: "3",
+                     eapi.ELASTIC_SLICES_ANNOTATION: "3"},
+        plugins={"jax": []},
+        tasks=[TaskSpec(name="worker", replicas=12,
+                        template=make_pod(
+                            "t", requests={"cpu": 8, TPU: 4}))]))
+    for _ in range(6):
+        mgr.sync_all()
+        sched.run_once()
+        cluster.tick()
+    train = cluster.vcjobs["default/etrain"]
+    assert train.phase is JobPhase.RUNNING
+    busy = {cluster.nodes[p.node_name].labels[TPU_SLICE_LABEL]
+            for p in cluster.pods.values()
+            if p.owner == train.uid and p.node_name}
+    assert len(busy) == 3
+    free_slices = sorted(
+        {n.labels[TPU_SLICE_LABEL]
+         for n in cluster.nodes.values()} - busy)
+    assert len(free_slices) == 2
+
+    # the serving gang mid-grow, exactly as the scheduler sees it
+    # between the controller's drain and the re-place: podgroup
+    # requeued with a fresh grow decision executing, the OLD
+    # incarnation still bound to its 2 slices, the NEW 12-pod cohort
+    # (3 slices) already pending
+    now = _time.time()
+    spg = PodGroup(name="infer", namespace="default", min_member=12,
+                   annotations={
+                       sapi.SLO_P99_MS_ANNOTATION: "50",
+                       sapi.MIN_REPLICAS_ANNOTATION: "1",
+                       sapi.MAX_REPLICAS_ANNOTATION: "3",
+                       sapi.TARGET_QPS_ANNOTATION: "100",
+                       eapi.ELASTIC_MIN_SLICES_ANNOTATION: "1",
+                       eapi.ELASTIC_MAX_SLICES_ANNOTATION: "3",
+                       eapi.ELASTIC_SLICES_ANNOTATION: "3",
+                       eapi.ELASTIC_DESIRED_SLICES_ANNOTATION: "3",
+                       eapi.ELASTIC_RESIZE_REASON_ANNOTATION:
+                           eapi.RESIZE_GROW,
+                       eapi.ELASTIC_DECIDED_TS_ANNOTATION:
+                           f"{now:.3f}",
+                       eapi.ELASTIC_RESIZING_ANNOTATION: "grow",
+                   })
+    from volcano_tpu.api.slicehealth import REQUEUED_ANNOTATION
+    spg.annotations[REQUEUED_ANNOTATION] = "true"
+    spg.phase = PodGroupPhase.INQUEUE
+    cluster.add_podgroup(spg)
+    i = 0
+    for sl in free_slices:
+        for node in sorted(n for n, nd in cluster.nodes.items()
+                           if nd.labels[TPU_SLICE_LABEL] == sl):
+            cluster.add_pod(serving_pod(f"infer-old-{i}", node,
+                                        f"uo{i}"))
+            i += 1
+    assert i == 8                     # 2 slices x 4 draining pods
+    for j in range(12):
+        cluster.add_pod(make_pod(
+            f"infer-new-{j}", requests={"cpu": 8, TPU: 4},
+            phase=TaskStatus.PENDING, uid=f"un{j}",
+            annotations={GROUP_NAME_ANNOTATION: "infer"}))
+
+    sched.run_once()
+
+    tpg = cluster.podgroups["default/etrain"]
+    # fixed: deficit = 48 wanted - 0 idle - 32 draining = 1 slice;
+    # the bug computed 3 slices and took the donor to its floor
+    assert eapi.desired_slices(tpg) == 2
+    assert tpg.annotations[eapi.ELASTIC_RESIZE_REASON_ANNOTATION] \
+        == eapi.RESIZE_SHRINK
+    assert not cluster.evictions      # funded by drain, never a kill
+
+
+# -- the bench front-end: latency-weighted load balancing --------------
+
+def test_lb_cold_group_splits_even():
+    lb = WeightedLoadBalancer()
+    shares = lb.split(300.0, ["a", "b", "c"])
+    assert all(s == pytest.approx(100.0) for s in shares.values())
+    assert sum(shares.values()) == pytest.approx(300.0)
+
+
+def test_lb_slow_replica_sheds_load():
+    lb = WeightedLoadBalancer(alpha=1.0)
+    lb.observe("fast", 10.0)
+    lb.observe("slow", 30.0)
+    shares = lb.split(400.0, ["fast", "slow"])
+    # inverse-latency: 3:1 in favor of the fast replica
+    assert shares["fast"] == pytest.approx(300.0)
+    assert shares["slow"] == pytest.approx(100.0)
+    assert sum(shares.values()) == pytest.approx(400.0)
+
+
+def test_lb_skew_bounded_no_starvation():
+    """A momentarily terrible replica keeps max_skew^-1 of the fast
+    replica's share — a zero share would freeze its observed latency
+    at the bad sample and it could never prove recovery."""
+    lb = WeightedLoadBalancer(alpha=1.0, max_skew=4.0)
+    lb.observe("fast", 5.0)
+    lb.observe("awful", 5000.0)
+    shares = lb.split(500.0, ["fast", "awful"])
+    assert shares["awful"] == pytest.approx(shares["fast"] / 4.0)
+    assert shares["awful"] > 0
+    assert sum(shares.values()) == pytest.approx(500.0)
+
+
+def test_lb_cold_replica_priced_at_group_mean():
+    """A fresh scale-up replica ramps at the group's mean latency —
+    neither starved (no observation != terrible) nor flooded (no
+    observation != infinitely fast)."""
+    lb = WeightedLoadBalancer(alpha=1.0)
+    lb.observe("a", 10.0)
+    lb.observe("b", 20.0)
+    shares = lb.split(300.0, ["a", "b", "new"])
+    assert shares["b"] < shares["new"] < shares["a"]
+    # forgetting a replica (scale-down / death) drops its history
+    lb.forget("a")
+    assert "a" not in lb.latencies()
+
+
+def test_lb_ewma_smooths_thrash():
+    lb = WeightedLoadBalancer(alpha=0.4)
+    lb.observe("a", 10.0)
+    lb.observe("a", 100.0)      # one spike moves the EWMA only 40%
+    assert lb.latencies()["a"] == pytest.approx(46.0)
+    lb.observe("a", 0.0)        # replica served nothing: not a sample
+    lb.observe("a", None)
+    assert lb.latencies()["a"] == pytest.approx(46.0)
+
+
+def test_lb_multi_group_traffic_never_crosses():
+    """One balancer fronts both serving groups: each group's offered
+    QPS is conserved WITHIN the group, whatever the other group's
+    replicas observe — groups contend for chips, not for traffic."""
+    lb = WeightedLoadBalancer(alpha=1.0)
+    lb.observe("i1", 10.0)
+    lb.observe("i2", 40.0)
+    lb.observe("c1", 500.0)     # canary is slow: must not leech infer
+    shares = lb.route({"infer": 1000.0, "canary": 150.0},
+                      {"infer": ["i1", "i2"], "canary": ["c1"]})
+    assert shares["i1"] + shares["i2"] == pytest.approx(1000.0)
+    assert shares["c1"] == pytest.approx(150.0)
+    assert shares["i1"] > shares["i2"]
+    # an empty group routes nothing and breaks nothing
+    assert lb.route({"infer": 100.0}, {"infer": []}) == {}
 
 
 # -- tier-1 smoke: the whole loop through real processes ---------------

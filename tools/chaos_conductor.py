@@ -157,8 +157,19 @@ def build_plan(seed: int, duration: float, classes) -> dict:
                                         rng.uniform(0.78, 0.85), 2)}
         windows["repl_partition"] = repl["partition"]
         windows["repl_lag"] = (l0, l1)
+    region = None
+    if "region" in classes:
+        # whole-region loss under the federation tier: the kill lands
+        # mid-run, after acked progress has climbed (drawn AFTER every
+        # other class so their plans stay byte-identical)
+        region = {"victim": "rb",
+                  "kill_at": round(duration * rng.uniform(0.35, 0.55),
+                                   2)}
+        windows["region_kill"] = (region["kill_at"],
+                                  region["kill_at"])
     return {"seed": seed, "rules": rules, "windows": windows,
-            "slice_kill_at": slice_kill_at, "replication": repl}
+            "slice_kill_at": slice_kill_at, "replication": repl,
+            "region": region}
 
 
 def _iann(ann, key, default=0):
@@ -369,6 +380,117 @@ class InvariantTracker:
         }
 
 
+def run_region_kill(seed: int, duration: float, classes,
+                    logdir: str = "") -> dict:
+    """The ``region`` fault class: whole-region loss under the
+    federation tier.  Boots bench.py's 2-region process fleet (each
+    region a REAL server + controllers + elastic scheduler plane, one
+    global store, the router reconciling over the wire), lets acked
+    training progress climb, then SIGKILLs every process of the
+    victim region at the seeded kill time.  Invariants:
+
+        requeued_globally   every gang admitted to the dead region is
+                            re-admitted into a survivor and reaches
+                            Running (MTTR reported)
+        region_lost         the registry record flips to state=lost
+        resume_floor        the globally folded resume step never
+                            rewinds — before, across, or after the
+                            kill
+        acked_durable       the re-admitted copy resumes at >= the
+                            last step acked to the global store
+                            before the kill (zero acked state lost)
+        survivor_untouched  the surviving region's resident gang
+                            stays Running through the whole episode
+    """
+    import bench
+    from volcano_tpu.api.slicehealth import RESUME_STEP_ANNOTATION
+    classes = set(classes.split(",")) if isinstance(classes, str) \
+        else set(classes)
+    sched = build_plan(seed, duration, classes)
+    kill_at = sched["region"]["kill_at"]
+    print(f"chaos conductor: seed={seed} duration={duration}s "
+          f"classes={sorted(classes)} (federation fleet, "
+          f"region kill at t+{kill_at}s)", flush=True)
+    violations = []
+
+    def note(inv: str, detail: str):
+        violations.append({"invariant": inv, "detail": detail})
+        print(f"INVARIANT VIOLATION [{inv}]: {detail}", flush=True)
+
+    t0 = time.monotonic()
+    fleet = bench._FederationFleet(
+        (("ra", 2, 1.0), ("rb", 1, 0.7)), ttl=2.0)
+    g = fleet.g
+    mttr = acked = resume = -1
+    try:
+        g.add_vcjob(bench._fed_job("anchor", 1, locality="ra"))
+        g.add_vcjob(bench._fed_job("roamer", 1, locality="rb"))
+        try:
+            chaoslib.wait_for(
+                lambda: bench._fed_running(g, "anchor", "ra")
+                and bench._fed_running(g, "roamer", "rb"), 60,
+                "locality-routed admission")
+        except AssertionError as e:
+            note("requeued_globally", f"admission never settled: {e}")
+            raise
+        # acked progress climbs until the kill window; the globally
+        # folded floor must never rewind while the faults fly
+        step, floor = 1000, 0
+        while time.monotonic() - t0 < kill_at:
+            bench._fed_stamp_and_fold(fleet, "rb", "roamer", step)
+            f = bench._fed_folded_step(g, "roamer")
+            if f < floor:
+                note("resume_floor",
+                     f"folded step rewound {floor} -> {f}")
+            floor = max(floor, f)
+            step += 500
+            time.sleep(0.3)
+        acked = floor
+        fleet.kill_region("rb")
+        t_kill = time.monotonic()
+        try:
+            chaoslib.wait_for(
+                lambda: bench._fed_running(g, "roamer", "ra"), 90,
+                "global requeue into the survivor")
+            mttr = round(time.monotonic() - t_kill, 3)
+        except AssertionError:
+            note("requeued_globally",
+                 f"gang never re-ran after the region kill "
+                 f"({bench._fed_view(g, 'roamer')})")
+        if g.regions.get("rb", {}).get("state") != "lost":
+            note("region_lost",
+                 f"registry state: {g.regions.get('rb', {})}")
+        folded = bench._fed_folded_step(g, "roamer")
+        if folded < acked:
+            note("resume_floor",
+                 f"fold rewound across the kill: {acked} -> {folded}")
+        copy = fleet.clients["ra"].vcjobs.get("default/roamer")
+        resume = int(copy.annotations.get(RESUME_STEP_ANNOTATION, 0)
+                     ) if copy is not None else -1
+        if resume < acked:
+            note("acked_durable",
+                 f"survivor resumes at {resume} < acked {acked}")
+        if not bench._fed_running(g, "anchor", "ra"):
+            note("survivor_untouched",
+                 f"anchor left Running: {bench._fed_view(g, 'anchor')}")
+        if fleet.sync_errors:
+            note("router_sync", "; ".join(fleet.sync_errors[-3:]))
+    finally:
+        fleet.shutdown()
+    result = {"seed": seed, "duration_s": duration,
+              "classes": sorted(classes),
+              "windows": sched["windows"],
+              "region_kill_at_s": kill_at,
+              "region_mttr_s": mttr,
+              "acked_step_before_kill": acked,
+              "resume_step_in_survivor": resume,
+              "violations": violations, "ok": not violations}
+    print(f"REPRODUCE: python tools/chaos_conductor.py "
+          f"--seed {seed} --duration {duration:g} "
+          f"--classes {','.join(sorted(classes))}", flush=True)
+    return result
+
+
 def run_conductor(seed: int, duration: float,
                   classes=DEFAULT_CLASSES, logdir: str = "",
                   lock_audit: bool = False,
@@ -378,6 +500,11 @@ def run_conductor(seed: int, duration: float,
                   leader_groups: int = 1) -> dict:
     classes = set(classes.split(",")) if isinstance(classes, str) \
         else set(classes)
+    if "region" in classes:
+        # whole-region loss runs on a different topology entirely
+        # (the federation fleet: 2 regions behind one global queue),
+        # so like the replication class it gets its own scenario
+        return run_region_kill(seed, duration, classes, logdir)
     sched = build_plan(seed, duration, classes)
     plan_doc = {"seed": seed, "rules": sched["rules"]}
     logdir = logdir or f"/tmp/chaos_conductor/seed-{seed}"
@@ -1564,7 +1691,7 @@ def main(argv=None) -> int:
     ap.add_argument("--duration", type=float, default=30.0)
     ap.add_argument("--classes", default=DEFAULT_CLASSES,
                     help="comma set of wire,disk,clock,slice,"
-                         "replication,serving")
+                         "replication,serving,region")
     ap.add_argument("--logdir", default="")
     ap.add_argument("--matrix", type=int, default=0,
                     help="run seeds 1..N and aggregate the "

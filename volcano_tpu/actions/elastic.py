@@ -189,6 +189,11 @@ class ElasticAction(Action):
             if pg is None or pg.phase not in (PodGroupPhase.PENDING,
                                               PodGroupPhase.INQUEUE):
                 continue
+            if eapi.evacuating(pg):
+                # a gang drained (or draining) for a cross-region
+                # cutover is LEAVING: its held pods are not demand
+                # this region should shrink donors to fund
+                continue
             pending = [t for t in
                        job.tasks_in_status(TaskStatus.PENDING)
                        if not t.best_effort]
@@ -228,12 +233,43 @@ class ElasticAction(Action):
                 continue
             grow_pool.append(s)
         self._grow(ssn, elastic_jobs, grow_pool, cooldown, now)
-        deficit = pending_chips - sum(s.chips for s in idle)
+        # the deficit is recomputed against IN-FLIGHT DRAINS at
+        # decision time: a demand-side gang requeued by its own grow
+        # (the serving scale-up path) still OCCUPIES its old slices
+        # while the drain executes — they read busy, not idle, yet
+        # the restart is guaranteed to vacate them before the gang
+        # re-places.  Counting those chips as neither idle nor freed
+        # inflated the deficit by the gang's whole old footprint and
+        # over-evicted training victims (a 2->3 serving grow funded 3
+        # slices instead of 1, self-correcting only a cooldown later
+        # via regrow).  Credit the draining chips up front instead.
+        draining = self._draining_chips(ssn, pending_jobs, now)
+        deficit = pending_chips - sum(s.chips for s in idle) - draining
         if deficit > 0:
             self._shrink(ssn, elastic_jobs, slices, idle, deficit,
                          cooldown, now)
 
     # -- decision plumbing ---------------------------------------------
+
+    def _draining_chips(self, ssn, pending_jobs, now: float) -> float:
+        """Chips that in-flight drains of DEMAND-SIDE gangs are about
+        to free: every node-holding task (allocated/bound/running, or
+        already releasing) of a pending elastic gang whose resize or
+        requeue is executing.  These are exactly the gangs the
+        in-flight barrier exempts — their teardown is the other half
+        of the capacity this cycle's deficit must produce."""
+        from volcano_tpu.api.types import ALLOCATED_TASK_STATUSES
+        holding = ALLOCATED_TASK_STATUSES | {TaskStatus.RELEASING}
+        freed = 0.0
+        for job in pending_jobs:
+            pg = job.podgroup
+            if pg is None or not eapi.is_elastic(pg) or \
+                    not self._in_flight(pg, now):
+                continue
+            freed += sum(float(t.resreq.get(TPU))
+                         for t in job.tasks.values()
+                         if t.status in holding and t.node_name)
+        return freed
 
     @staticmethod
     def _in_flight(pg, now: Optional[float] = None) -> bool:

@@ -22,10 +22,20 @@ class EnqueueAction(Action):
     name = "enqueue"
 
     def execute(self, ssn) -> None:
+        from volcano_tpu import metrics
+        from volcano_tpu.api import elastic as eapi
         jobs_per_queue = {}
         for job in ssn.jobs.values():
             if job.podgroup is None or \
                     job.podgroup.phase is not PodGroupPhase.PENDING:
+                continue
+            if eapi.evacuating(job.podgroup):
+                # cross-region evacuation hold (api/elastic.py): the
+                # drained gang belongs to the federation cutover now —
+                # admitting it would race the destination region's
+                # re-place against a local one
+                metrics.inc("sched_unschedulable_reasons_total",
+                            reason="evacuating-region")
                 continue
             queue = ssn.queues.get(job.queue)
             if queue is None or not queue.is_open():

@@ -91,9 +91,33 @@ ELASTIC_LAST_RESIZE_TS_ANNOTATION = \
 RESIZE_GROW = "grow"
 RESIZE_SHRINK = "shrink"
 RESIZE_MIGRATE = "migrate"
-RESIZE_KINDS = (RESIZE_GROW, RESIZE_SHRINK, RESIZE_MIGRATE)
+# cross-region evacuation (api/federation.py): the checkpointed drain
+# with NO local re-place — the gang parks under the `evacuated` hold
+# until the federation router cuts it over to the destination region
+RESIZE_EVACUATE = "evacuate"
+RESIZE_KINDS = (RESIZE_GROW, RESIZE_SHRINK, RESIZE_MIGRATE,
+                RESIZE_EVACUATE)
+
+# -- evacuation (federation router <-> elastic controller) -------------
+# stamped by the router on the SOURCE podgroup: the destination region
+# name.  The elastic controller executes the drain exactly like a
+# migrate, then stamps `evacuated` instead of letting the gang
+# re-place; actions/enqueue.py holds an evacuated gang out of INQUEUE
+# (reason: `evacuating-region`) so the source scheduler never races
+# the cutover.  The router clears both after the destination accepts.
+ELASTIC_EVACUATE_ANNOTATION = "elastic.volcano-tpu.io/evacuate-to"
+ELASTIC_EVACUATED_ANNOTATION = "elastic.volcano-tpu.io/evacuated"
 
 HISTORY_KEEP = 8    # resize records retained on the annotation
+
+
+def evacuating(obj) -> bool:
+    """True while *obj* (podgroup or vcjob) is anywhere inside a
+    cross-region evacuation: decision stamped, drain in flight, or
+    drained-and-held awaiting the router's cutover."""
+    ann = _ann(obj)
+    return bool(ann.get(ELASTIC_EVACUATE_ANNOTATION) or
+                ann.get(ELASTIC_EVACUATED_ANNOTATION))
 
 
 def _ann(obj) -> dict:

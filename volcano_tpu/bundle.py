@@ -211,6 +211,26 @@ FAMILIES: Dict[str, str] = {
     "serving_slo_attainment_min": "gauge",
     "serving_scale_decisions_total": "counter",
     "serving_victim_shrinks_total": "counter",
+    # federation tier (federation/router.py + federation/mirror.py):
+    # region census by bounded state enum, per-region capacity and
+    # learned goodput (region names are operator config), global-queue
+    # depth, admission/requeue/migration tallies, the cutover timing
+    # and its stale-mirror refusals, and the async object mirror's
+    # stream accounting — job keys never label these families
+    "federation_regions": "gauge",
+    "federation_pending_jobs": "gauge",
+    "federation_region_capacity_chips": "gauge",
+    "federation_region_idle_chips": "gauge",
+    "federation_region_goodput_steps_per_chip": "gauge",
+    "federation_admissions_total": "counter",
+    "federation_requeues_total": "counter",
+    "federation_migrations_total": "counter",
+    "federation_cutover_seconds": "histogram",
+    "federation_cutover_refusals_total": "counter",
+    "federation_source_reaps_total": "counter",
+    "federation_mirror_records_total": "counter",
+    "federation_mirror_resyncs_total": "counter",
+    "federation_mirror_refused_batches_total": "counter",
 }
 
 # -- label schema (enforced by volcano_tpu/analysis + tests/test_lint) --
@@ -334,6 +354,22 @@ FAMILY_LABELS: Dict[str, Dict[str, object]] = {
     # serving plane: the bounded scale-direction enum, never group keys
     "serving_scale_decisions_total": {
         "kind": "enum:volcano_tpu.api.serving:SCALE_KINDS"},
+    # federation tier: region names are operator configuration (the
+    # registry), states/kinds bounded enums — never job keys
+    "federation_regions": {
+        "state": "enum:volcano_tpu.api.federation:REGION_STATES"},
+    "federation_region_capacity_chips": {"region": CONFIG},
+    "federation_region_idle_chips": {"region": CONFIG},
+    "federation_region_goodput_steps_per_chip": {"region": CONFIG},
+    "federation_admissions_total": {"region": CONFIG},
+    "federation_requeues_total": {"region": CONFIG},
+    "federation_migrations_total": {
+        "kind": ("pending", "running")},
+    "federation_cutover_refusals_total": {"region": CONFIG},
+    "federation_source_reaps_total": {"region": CONFIG},
+    "federation_mirror_records_total": {"region": CONFIG},
+    "federation_mirror_resyncs_total": {"region": CONFIG},
+    "federation_mirror_refused_batches_total": {"region": CONFIG},
 }
 
 

@@ -53,6 +53,7 @@ class FakeCluster(Cluster):
         self.pvcs: Dict[str, dict] = {}           # volumebinding claims
         self.pvs: Dict[str, dict] = {}            # volumebinding volumes
         self.datasources: Dict[str, dict] = {}    # datadependency/v1alpha1
+        self.regions: Dict[str, dict] = {}        # api/federation.py registry
         self.events: List[Tuple[str, str, str]] = []
         self._run_progress: Dict[str, int] = {}   # pod uid -> ticks run
         self.binds: List[Tuple[str, str]] = []    # (pod key, node) history

@@ -65,6 +65,10 @@ KINDS: Dict[str, KindSpec] = {
     # namespace -> annotations dict (podgroup mutate webhook reads the
     # per-namespace default-queue annotation)
     "namespace": KindSpec("namespaces", None),
+    # federation region registry (api/federation.py): region name ->
+    # record dict {url, price, locality, heartbeat...}, held by the
+    # GLOBAL store and reconciled by the federation router
+    "region": KindSpec("regions", None),
     "service": KindSpec("services", None),
     "config_map": KindSpec("config_maps", None),
     "secret": KindSpec("secrets", None),

@@ -1131,6 +1131,100 @@ def cmd_shards(cluster, args):
     print(_table(rows, ["GROUP", "KEYSPACE", "RV", "WRITE-QPS"]))
 
 
+def cmd_regions(cluster, args):
+    """Federation region registry (the `region` dict-kind on the
+    GLOBAL store): one row per regional plane with its advertised
+    price/locality and the router-folded liveness + capacity.  --add /
+    --remove edit the registry; the router attaches/detaches on its
+    next pass."""
+    import time as _time
+
+    from volcano_tpu.api import federation as fedapi
+
+    if args.add:
+        name, _, url = args.add.partition("=")
+        if not url:
+            sys.exit("--add wants NAME=URL")
+        rec = fedapi.region_record(
+            name, url, price=args.price, locality=args.locality,
+            mirror_url=args.mirror_url)
+        cluster.put_object("region", rec, key=name)
+        print(f"region {name} registered at {url}")
+        return
+    if args.remove:
+        cluster.delete_object("region", args.remove)
+        print(f"region {args.remove} removed")
+        return
+    rows = []
+    now = _time.time()
+    for name, rec in sorted(cluster.regions.items()):
+        try:
+            age = now - float(rec.get("heartbeat_ts", 0) or 0)
+        except (TypeError, ValueError):
+            age = float("inf")
+        rows.append([
+            name, rec.get("state", "?"), rec.get("url", ""),
+            f"{float(rec.get('price', 1.0) or 1.0):g}",
+            rec.get("locality", "") or "-",
+            f"{float(rec.get('capacity_chips', 0) or 0):g}",
+            f"{float(rec.get('idle_chips', 0) or 0):g}",
+            f"{age:.0f}s" if age < 1e6 else "never",
+        ])
+    print(_table(rows, ["REGION", "STATE", "URL", "PRICE", "LOCALITY",
+                        "CAP-CHIPS", "IDLE-CHIPS", "HEARTBEAT"]))
+
+
+def cmd_federate(cluster, args):
+    """Federated fleet view from the GLOBAL store alone: every global
+    job with its admitted region, router-folded regional phase and
+    migration provenance.  --migrate stamps the cross-region evacuate
+    trigger; --drain/--undrain cordon a whole region (the router
+    evacuates its running gangs — follow-the-sun)."""
+    from volcano_tpu.api import federation as fedapi
+
+    if args.drain or args.undrain:
+        name = args.drain or args.undrain
+        rec = dict(cluster.regions.get(name) or {})
+        if not rec:
+            sys.exit(f"unknown region {name}")
+        rec["state"] = fedapi.REGION_STATE_DRAINING if args.drain \
+            else fedapi.REGION_STATE_READY
+        cluster.put_object("region", rec, key=name)
+        print(f"region {name} -> {rec['state']}")
+        return
+    if args.migrate:
+        ns, _, name = args.migrate.rpartition("/")
+        key = f"{ns or 'default'}/{name}"
+        job = cluster.vcjobs.get(key)
+        if job is None:
+            sys.exit(f"unknown global job {key}")
+        job.annotations[fedapi.FED_EVACUATE_ANNOTATION] = \
+            args.to or "auto"
+        cluster.update_vcjob(job)
+        print(f"migration requested: {key} -> {args.to or 'auto'}")
+        return
+    rows = []
+    for job in sorted(cluster.vcjobs.values(), key=lambda j: j.key):
+        if fedapi.home_key(job) is not None:
+            continue            # a regional copy, not a global record
+        region = fedapi.admitted_region(job) or "-"
+        evac = job.annotations.get(
+            fedapi.FED_EVACUATING_TO_ANNOTATION) or \
+            job.annotations.get(fedapi.FED_EVACUATE_ANNOTATION)
+        rows.append([
+            job.key, job.phase.value, region,
+            job.annotations.get(
+                fedapi.FED_REGIONAL_PHASE_ANNOTATION, "-"),
+            fedapi.migration_count(job),
+            job.annotations.get(fedapi.FED_MIGRATED_FROM_ANNOTATION,
+                                "-"),
+            f"->{evac}" if evac else "-",
+            ",".join(fedapi.data_locality(job)) or "-",
+        ])
+    print(_table(rows, ["JOB", "PHASE", "REGION", "REGIONAL-PHASE",
+                        "MOVES", "FROM", "EVACUATING", "LOCALITY"]))
+
+
 def cmd_server(cluster, args):
     """Durability + lease status of the live state server (GET
     /durability, GET /leases): whether writes are journaled, how much
@@ -1455,6 +1549,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--interval", type=float, default=1.0,
                    help="seconds between the two write-QPS samples")
     p.set_defaults(fn=cmd_shards)
+
+    p = sub.add_parser("regions", help="federation region registry: "
+                       "liveness, price, capacity per regional plane")
+    p.add_argument("--add", default="",
+                   help="register a region: NAME=URL")
+    p.add_argument("--price", type=float, default=1.0)
+    p.add_argument("--locality", default="")
+    p.add_argument("--mirror-url", default="")
+    p.add_argument("--remove", default="",
+                   help="deregister a region by name")
+    p.set_defaults(fn=cmd_regions)
+
+    p = sub.add_parser("federate", help="federated fleet view; "
+                       "cross-region migration and region drain")
+    p.add_argument("--migrate", default="",
+                   help="global job ([ns/]name) to move cross-region")
+    p.add_argument("--to", default="",
+                   help="destination region for --migrate "
+                        "(default: auto-pick)")
+    p.add_argument("--drain", default="",
+                   help="cordon a region: evacuate its running gangs")
+    p.add_argument("--undrain", default="",
+                   help="reopen a drained region")
+    p.set_defaults(fn=cmd_federate)
 
     p = sub.add_parser("tick",
                        help="advance the standalone control plane")

@@ -202,7 +202,8 @@ class ElasticController(Controller):
         kind = pg.annotations.get(
             eapi.ELASTIC_RESIZE_REASON_ANNOTATION, "") or (
             eapi.RESIZE_GROW if desired > cur else eapi.RESIZE_SHRINK)
-        if desired == cur and kind != eapi.RESIZE_MIGRATE:
+        if desired == cur and kind not in (eapi.RESIZE_MIGRATE,
+                                           eapi.RESIZE_EVACUATE):
             self._clear_decision(pg)
             return
         self._execute(job, pg, cur, desired, kind, now)
@@ -399,6 +400,30 @@ class ElasticController(Controller):
                     if ep.kind == eapi.RESIZE_SHRINK:
                         metrics.observe("elastic_shrink_seconds",
                                         now - ep.decided_ts)
+                    if ep.kind == eapi.RESIZE_EVACUATE:
+                        # the drain IS the whole LOCAL episode: stamp
+                        # the evacuated hold (actions/enqueue.py keeps
+                        # the gang out of INQUEUE) and stop — resume
+                        # happens in the destination region after the
+                        # federation router's cutover, never here
+                        pg = self.cluster.podgroups.get(ep.pg_key)
+                        if pg is not None:
+                            from volcano_tpu.api.slicehealth import \
+                                REQUEUED_ANNOTATION as _REQ
+                            pg.annotations[
+                                eapi.ELASTIC_EVACUATED_ANNOTATION] = \
+                                "true"
+                            pg.annotations.pop(_REQ, None)
+                            pg.annotations.pop(
+                                eapi.ELASTIC_RESIZING_ANNOTATION,
+                                None)
+                            self.cluster.update_podgroup_status(pg)
+                        self.cluster.record_event(
+                            ep.pg_key, "ElasticEvacuated",
+                            f"drained in {now - ep.decided_ts:.3f}s; "
+                            f"held for cross-region cutover")
+                        del self._episodes[ep.pg_key]
+                        continue
             if ep.drained_ts is not None and ep.resumed_ts is None:
                 running = sum(1 for p in pods
                               if p.phase is TaskStatus.RUNNING)

@@ -25,9 +25,15 @@ Hysteresis (the damping the RateWindow burst tests pin):
               P99_HEADROOM_FRAC x SLO, sustained for HOLD_DOWN_SYNCS
               consecutive FRESH signals (distinct fold timestamps —
               re-reading one low sample between agent beats is not
-              three observations); then one replica at a time.  The
-              asymmetry is deliberate: a late scale-up burns the SLO,
-              a late scale-down burns only chips.
+              three observations); then a BOUNDED multi-replica step:
+              once the streak clears, the decision descends while
+              each successively smaller size ALSO satisfies the same
+              comfort rule (qps < SCALE_DOWN_FRAC x target x
+              (size - 1)), at most MAX_DOWN_STEP replicas per
+              decision — a group left 6 replicas over after a burst
+              recedes converges in one or two drains instead of six.
+              The asymmetry is deliberate: a late scale-up burns the
+              SLO, a late scale-down burns only chips.
 
   hold        no fresh traffic signal (updated-ts older than
               SIGNAL_STALE_S, or none yet) means no decision in
@@ -68,6 +74,10 @@ SCALE_DOWN_FRAC = 0.60
 P99_HEADROOM_FRAC = 0.80
 HOLD_DOWN_SYNCS = 3
 SIGNAL_STALE_S = 60.0
+# ceiling on replicas shed by ONE down decision: each extra step must
+# re-prove the comfort rule at its own size, and the bound keeps a
+# mis-folded zero from collapsing a big group to its floor in one move
+MAX_DOWN_STEP = 4
 # no scale-DOWN within this window of the last executed resize: right
 # after a resize the fresh replicas' EWMA QPS warms up from zero, and
 # the first few below-threshold readings are warm-up artifacts, not
@@ -169,7 +179,15 @@ class ServingController(Controller):
             if streak < HOLD_DOWN_SYNCS:
                 return
             self._down_streak.pop(pg.key, None)
-            self._decide(pg, cur, cur - 1, "down",
+            # bounded multi-replica descent: keep stepping down while
+            # the NEXT size also clears the same comfort rule the
+            # hysteresis proved for cur - 1
+            desired = cur - 1
+            floor = max(lo, cur - MAX_DOWN_STEP)
+            while desired > floor and \
+                    qps < SCALE_DOWN_FRAC * target * (desired - 1):
+                desired -= 1
+            self._decide(pg, cur, desired, "down",
                          "traffic-receding", qps, p99, now)
             return
         self._down_streak.pop(pg.key, None)

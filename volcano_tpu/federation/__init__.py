@@ -1,0 +1,12 @@
+"""Federation tier: one global queue over N regional planes.
+
+See api/federation.py for the annotation contract, federation/mirror.py
+for the async WAL object mirror, federation/router.py for the global
+admission/migration reconciler, and docs/design/federation.md for the
+full protocol (router, mirror-vs-quorum contract, cutover).
+"""
+
+from volcano_tpu.federation.mirror import MirrorStaleError, RegionMirror
+from volcano_tpu.federation.router import FederationRouter
+
+__all__ = ["MirrorStaleError", "RegionMirror", "FederationRouter"]

@@ -1,0 +1,806 @@
+"""FederationRouter: one global queue over N regional planes.
+
+The router is deliberately THIN (Singularity's global scheduler,
+arxiv 2202.07848): it owns placement of whole GANGS into regions and
+nothing below that — each region keeps its existing scheduler,
+controllers and server plane unchanged, and the global store is an
+ordinary durable state server holding only the global job queue plus
+the region registry (the `region` dict-kind).
+
+One reconcile pass:
+
+  liveness   a region is alive while its mirror keeps proving itself
+             fresh (the mirror tails the region's WAL — a successful
+             poll IS a heartbeat); silent past REGION_TTL_S the region
+             is declared lost and every gang admitted there requeues
+             GLOBALLY.  Nothing acked is lost with a region: the
+             global store is the source of truth, and the router folds
+             checkpoint/resume metadata onto the global record as it
+             lands, so the re-placed gang resumes from the last folded
+             step.
+
+  admission  unadmitted global jobs are scored into the READY region
+             maximizing
+
+                 locality x learned-goodput(generation) / price
+
+             gated on the region actually fitting the gang (idle
+             chips from the mirror).  The admission key is
+             DETERMINISTIC over (job key, attempt): a router that
+             crashed between the regional create and the
+             admitted-region stamp re-derives the same key on restart
+             and finds its own half-finished admission instead of
+             double-placing the gang.
+
+  goodput    per-(region, generation) EWMA of observed steps/sec/chip
+             learned from the mirrors' LAST_STEP deltas — the
+             "goodput-per-generation" term of the score, so a region
+             whose v5p fleet measurably outruns another's v5e fleet
+             attracts the next gang even at equal price.
+
+  arbitrage  a gang pending in its region past ARBITRAGE_PENDING_S
+             while another ready region could run it NOW is
+             re-admitted there (delete the pending copy, bump the
+             attempt, place again) — burst capacity is bought where
+             it exists.
+
+  migration  a RUNNING gang moves via the elastic evacuate drain
+             (api/elastic.py RESIZE_EVACUATE): the router stamps the
+             decision on the SOURCE podgroup, the regional elastic
+             controller checkpoints + drains and parks the gang under
+             the `evacuated` hold, and the router cuts over — create
+             the destination copy carrying the resume metadata, THEN
+             delete the source.  The cutover refuses to act through a
+             stale destination mirror (MirrorStaleError): acting on
+             state older than MIRROR_MAX_AGE_S could double-place.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, Optional
+
+from volcano_tpu import metrics
+from volcano_tpu.api import elastic as eapi
+from volcano_tpu.api import federation as fedapi
+from volcano_tpu.api.goodput import generation_of
+from volcano_tpu.api.resource import TPU
+from volcano_tpu.api.types import (GROUP_NAME_ANNOTATION, JobPhase,
+                                   PodGroupPhase)
+from volcano_tpu.api.vcjob import VCJob
+from volcano_tpu.federation.mirror import MirrorStaleError, RegionMirror
+
+log = logging.getLogger(__name__)
+
+# goodput EWMA smoothing for the learned steps/sec/chip signal
+GOODPUT_ALPHA = 0.3
+# score boost for a region named in the job's data-locality list
+LOCALITY_BOOST = 2.0
+# resume/progress annotations folded regional -> global every pass,
+# so a region loss never loses acked training progress
+_FOLD_KEYS = ()     # filled below (import-cycle-free)
+
+
+def _fold_keys():
+    global _FOLD_KEYS
+    if not _FOLD_KEYS:
+        from volcano_tpu.api.slicehealth import (
+            CHECKPOINT_DIR_ANNOTATION, LAST_STEP_ANNOTATION,
+            RESUME_STEP_ANNOTATION)
+        _FOLD_KEYS = (LAST_STEP_ANNOTATION, RESUME_STEP_ANNOTATION,
+                      CHECKPOINT_DIR_ANNOTATION,
+                      eapi.ELASTIC_GENERATION_ANNOTATION,
+                      eapi.ELASTIC_SLICES_ANNOTATION)
+    return _FOLD_KEYS
+
+
+def job_chips(job: VCJob) -> float:
+    """The gang's TPU demand in chips (replicas x per-pod request)."""
+    total = 0.0
+    for spec in job.tasks:
+        pod = spec.template_pod()
+        total += spec.replicas * float(
+            pod.resource_requests().get(TPU) or 0)
+    return total
+
+
+class RegionHandle:
+    """One attached region: registry record + write client + mirror."""
+
+    __slots__ = ("name", "record", "client", "mirror")
+
+    def __init__(self, name: str, record: dict, client, mirror):
+        self.name = name
+        self.record = record
+        self.client = client
+        self.mirror = mirror
+
+
+class FederationRouter:
+    """Reconciles the global queue against the regional planes.
+
+    *client_factory(record)* builds the region WRITE handle (defaults
+    to a RemoteCluster against record["url"]); *mirror_factory(record)*
+    builds the read mirror (defaults to RegionMirror tailing
+    record["mirror_url"]).  Tests inject in-process fakes for both.
+    """
+
+    def __init__(self, global_cluster, now: Callable[[], float] = time.time,
+                 client_factory=None, mirror_factory=None,
+                 ttl: float = fedapi.REGION_TTL_S,
+                 arbitrage_after: float = fedapi.ARBITRAGE_PENDING_S,
+                 start_mirrors: bool = True):
+        self.cluster = global_cluster
+        self.now = now
+        self.ttl = ttl
+        self.arbitrage_after = arbitrage_after
+        self._start_mirrors = start_mirrors
+        self._client_factory = client_factory or self._default_client
+        self._mirror_factory = mirror_factory or self._default_mirror
+        self.handles: Dict[str, RegionHandle] = {}
+        # learned goodput: (region, generation) -> EWMA steps/sec/chip
+        self._goodput: Dict[tuple, float] = {}
+        # per-job last observed (step, ts) for rate derivation
+        self._progress: Dict[str, tuple] = {}
+        # in-flight evacuation start ts (timing only; the durable
+        # episode state is the evacuating-to annotation)
+        self._evac_started: Dict[str, float] = {}
+
+    # -- region attachment ---------------------------------------------
+
+    @staticmethod
+    def _default_client(rec: dict):
+        from volcano_tpu.cache.remote_cluster import RemoteCluster
+        return RemoteCluster(rec["url"], token=rec.get("token", ""),
+                             tolerate_unreachable=True)
+
+    def _default_mirror(self, rec: dict):
+        m = RegionMirror(rec["name"],
+                         rec.get("mirror_url") or rec["url"],
+                         token=rec.get("token", ""))
+        if self._start_mirrors:
+            m.start()
+        return m
+
+    def attach_region(self, record: dict, client=None, mirror=None) -> None:
+        """Register a region (tests pass explicit client/mirror)."""
+        name = record["name"]
+        h = RegionHandle(name, record,
+                         client or self._client_factory(record),
+                         mirror or self._mirror_factory(record))
+        self.handles[name] = h
+        self.cluster.put_object("region", dict(record), key=name)
+
+    def close(self) -> None:
+        for h in self.handles.values():
+            stop = getattr(h.mirror, "stop", None)
+            if stop:
+                stop()
+
+    # -- reconcile ------------------------------------------------------
+
+    def sync(self) -> None:
+        now = self.now()
+        self._refresh_regions(now)
+        self._observe_goodput(now)
+        self._fold_and_requeue(now)
+        self._reap_migrated_residuals(now)
+        self._evacuations(now)
+        self._arbitrage(now)
+        self._admit(now)
+        self._gauges()
+
+    def _refresh_regions(self, now: float) -> None:
+        """Fold mirror liveness + capacity into the registry records
+        (persisted to the global store so `vtpctl regions` renders the
+        fleet from one place)."""
+        for name, rec in list(self.cluster.regions.items()):
+            if name not in self.handles:
+                # registry entry with no handle yet (submitted via
+                # vtpctl / another router instance): attach lazily
+                self.handles[name] = RegionHandle(
+                    name, dict(rec), self._client_factory(rec),
+                    self._mirror_factory(rec))
+        for name in [n for n in self.handles
+                     if n not in self.cluster.regions]:
+            h = self.handles.pop(name)
+            stop = getattr(h.mirror, "stop", None)
+            if stop:
+                stop()
+        for h in self.handles.values():
+            rec = dict(self.cluster.regions.get(h.name, h.record))
+            age = h.mirror.age_s()
+            changed = False
+            if age <= self.ttl:
+                # a fresh mirror poll IS the heartbeat: the region's
+                # server answered with (or confirmed) its WAL horizon
+                rec["heartbeat_ts"] = now
+                if rec.get("state") == fedapi.REGION_STATE_LOST:
+                    rec["state"] = fedapi.REGION_STATE_READY
+                    log.info("region %s recovered", h.name)
+                cap, idle = self._mirror_chips(h)
+                if (cap, idle) != (rec.get("capacity_chips"),
+                                   rec.get("idle_chips")):
+                    rec["capacity_chips"], rec["idle_chips"] = cap, idle
+                changed = True
+            elif not fedapi.region_alive(rec, now, self.ttl) and \
+                    rec.get("state") != fedapi.REGION_STATE_LOST:
+                rec["state"] = fedapi.REGION_STATE_LOST
+                changed = True
+                log.warning("region %s lost (mirror %.1fs stale)",
+                            h.name, age)
+                self.cluster.record_event(
+                    f"region/{h.name}", "RegionLost",
+                    f"no heartbeat for {age:.1f}s; requeueing its "
+                    f"gangs globally")
+            if changed:
+                h.record = rec
+                self.cluster.put_object("region", rec, key=h.name)
+                metrics.set_gauge("federation_region_capacity_chips",
+                                  float(rec.get("capacity_chips", 0)),
+                                  region=h.name)
+                metrics.set_gauge("federation_region_idle_chips",
+                                  float(rec.get("idle_chips", 0)),
+                                  region=h.name)
+
+    def _mirror_chips(self, h: RegionHandle) -> tuple:
+        """(capacity, idle) TPU chips from the region mirror's view."""
+        c = h.mirror.cluster
+        cap = sum(float((n.allocatable or {}).get(TPU) or 0)
+                  for n in c.nodes.values())
+        used = 0.0
+        for p in c.pods.values():
+            if p.node_name and not p.is_terminated():
+                used += float(p.resource_requests().get(TPU) or 0)
+        return cap, max(0.0, cap - used)
+
+    def _region_generation(self, h: RegionHandle) -> str:
+        """The region's dominant TPU generation (bounded enum)."""
+        counts: Dict[str, float] = {}
+        for n in h.mirror.cluster.nodes.values():
+            chips = float((n.allocatable or {}).get(TPU) or 0)
+            if chips > 0:
+                gen = generation_of(n.labels)
+                counts[gen] = counts.get(gen, 0.0) + chips
+        if not counts:
+            return "other"
+        return max(counts, key=counts.get)
+
+    # -- learned goodput ------------------------------------------------
+
+    def _observe_goodput(self, now: float) -> None:
+        """Fold LAST_STEP deltas from each mirror into the
+        per-(region, generation) steps/sec/chip EWMA."""
+        from volcano_tpu.api.slicehealth import LAST_STEP_ANNOTATION
+        live = set()
+        for h in self.handles.values():
+            gen = self._region_generation(h)
+            for job in h.mirror.cluster.vcjobs.values():
+                raw = job.annotations.get(LAST_STEP_ANNOTATION)
+                if raw is None or job.phase is not JobPhase.RUNNING:
+                    continue
+                try:
+                    step = int(raw)
+                except (TypeError, ValueError):
+                    continue
+                jk = f"{h.name}:{job.key}"
+                live.add(jk)
+                prev = self._progress.get(jk)
+                self._progress[jk] = (step, now)
+                if prev is None:
+                    continue
+                pstep, pts = prev
+                dt = now - pts
+                if dt <= 0 or step <= pstep:
+                    continue
+                chips = job_chips(job)
+                if chips <= 0:
+                    continue
+                rate = (step - pstep) / dt / chips
+                key = (h.name, gen)
+                old = self._goodput.get(key)
+                self._goodput[key] = rate if old is None else \
+                    old + GOODPUT_ALPHA * (rate - old)
+                metrics.set_gauge(
+                    "federation_region_goodput_steps_per_chip",
+                    self._goodput[key], region=h.name)
+        for jk in [k for k in self._progress if k not in live]:
+            del self._progress[jk]
+
+    def _goodput_factor(self, h: RegionHandle) -> float:
+        """This region's learned rate relative to the fleet mean —
+        1.0 until anything has been learned (cold start is neutral)."""
+        if not self._goodput:
+            return 1.0
+        gen = self._region_generation(h)
+        mine = self._goodput.get((h.name, gen))
+        if mine is None:
+            return 1.0
+        mean = sum(self._goodput.values()) / len(self._goodput)
+        return mine / mean if mean > 0 else 1.0
+
+    # -- admission ------------------------------------------------------
+
+    def _global_jobs(self):
+        return [j for j in self.cluster.vcjobs.values()
+                if fedapi.home_key(j) is None]
+
+    def _score(self, h: RegionHandle, job: VCJob, need: float
+               ) -> float:
+        rec = self.cluster.regions.get(h.name, h.record)
+        if not fedapi.region_ready(rec, self.now(), self.ttl):
+            return 0.0
+        idle = float(rec.get("idle_chips", 0) or 0)
+        cap = float(rec.get("capacity_chips", 0) or 0)
+        if need > 0 and cap < need:
+            return 0.0              # can never fit, even empty
+        # fractional fit: a region that can take the gang NOW beats
+        # one that must first drain something
+        fit = 1.0 if need <= 0 or idle >= need else \
+            0.25 * (idle / need)
+        price = max(1e-9, float(rec.get("price", 1.0) or 1.0))
+        locality = LOCALITY_BOOST if h.name in \
+            fedapi.data_locality(job) else 1.0
+        return locality * self._goodput_factor(h) * fit / price
+
+    def _pick_region(self, job: VCJob, exclude=() ) -> Optional[str]:
+        need = job_chips(job)
+        best, best_score = None, 0.0
+        for name in sorted(self.handles):
+            if name in exclude:
+                continue
+            score = self._score(self.handles[name], job, need)
+            if score > best_score:
+                best, best_score = name, score
+        return best
+
+    def _attempt(self, job: VCJob) -> int:
+        try:
+            return int(job.annotations.get(
+                fedapi.FED_ATTEMPT_ANNOTATION, 0) or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    def _find_admitted_copy(self, key: str) -> Optional[str]:
+        """Scan every region for a copy carrying *key* — the restart
+        recovery path: the create landed, the global stamp did not."""
+        for h in self.handles.values():
+            for view in (h.mirror.cluster, h.client):
+                jobs = getattr(view, "vcjobs", {})
+                for rjob in list(jobs.values()):
+                    if rjob.annotations.get(
+                            fedapi.FED_ADMISSION_KEY_ANNOTATION) == key:
+                        return h.name
+        return None
+
+    def _regional_copy(self, job: VCJob, region: str, key: str,
+                       extra: Optional[dict] = None) -> VCJob:
+        copy = job.clone()
+        # fresh status: the copy starts life as a new regional job
+        copy.phase = JobPhase.PENDING
+        copy.version = 0
+        copy.retry_count = 0
+        copy.conditions = []
+        copy.pending = copy.running = copy.succeeded = 0
+        copy.failed = copy.terminating = copy.unknown = 0
+        copy.finish_time = None
+        ann = copy.annotations
+        ann[fedapi.FED_HOME_ANNOTATION] = job.key
+        ann[fedapi.FED_ORIGIN_REGION_ANNOTATION] = region
+        ann[fedapi.FED_ADMISSION_KEY_ANNOTATION] = key
+        for k in (fedapi.FED_ADMITTED_REGION_ANNOTATION,
+                  fedapi.FED_ADMITTED_TS_ANNOTATION,
+                  fedapi.FED_EVACUATE_ANNOTATION,
+                  fedapi.FED_EVACUATING_TO_ANNOTATION):
+            ann.pop(k, None)
+        if extra:
+            ann.update(extra)
+        return copy
+
+    def _stamp_admitted(self, job: VCJob, region: str, key: str,
+                        now: float) -> None:
+        job.annotations[fedapi.FED_ADMISSION_KEY_ANNOTATION] = key
+        job.annotations[fedapi.FED_ADMITTED_REGION_ANNOTATION] = region
+        job.annotations[fedapi.FED_ADMITTED_TS_ANNOTATION] = \
+            f"{now:.3f}"
+        job.annotations[fedapi.FED_REGIONAL_PHASE_ANNOTATION] = \
+            JobPhase.PENDING.value
+        self.cluster.update_vcjob(job)
+
+    def _admit(self, now: float) -> None:
+        from volcano_tpu.api.types import FINISHED_JOB_PHASES
+        for job in self._global_jobs():
+            if job.phase in FINISHED_JOB_PHASES or \
+                    fedapi.admitted_region(job) is not None:
+                continue
+            key = fedapi.admission_key(job.key, self._attempt(job))
+            # restart recovery BEFORE placing: did a previous router
+            # life already create this attempt's copy somewhere?
+            prior = self._find_admitted_copy(key)
+            if prior is not None:
+                log.info("admission of %s (key %s) already landed in "
+                         "%s; re-stamping", job.key, key, prior)
+                self._stamp_admitted(job, prior, key, now)
+                continue
+            region = self._pick_region(job)
+            if region is None:
+                continue            # nothing ready/fitting: stay queued
+            h = self.handles[region]
+            copy = self._regional_copy(job, region, key)
+            try:
+                h.client.add_vcjob(copy)
+            except OSError as e:
+                log.warning("admission of %s to %s failed on the "
+                            "wire: %s", job.key, region, e)
+                continue
+            self._stamp_admitted(job, region, key, now)
+            self.cluster.record_event(
+                job.key, "FederationAdmitted",
+                f"admitted to region {region} (key {key})")
+            metrics.inc("federation_admissions_total", region=region)
+
+    # -- phase folding + region-loss requeue ---------------------------
+
+    def _copy_of(self, h: RegionHandle, key: str):
+        """The regional copy as the MIRROR sees it (falling back to
+        the write client's view while the mirror warms up)."""
+        job = h.mirror.cluster.vcjobs.get(key)
+        if job is None:
+            job = getattr(h.client, "vcjobs", {}).get(key)
+        return job
+
+    def _fold_and_requeue(self, now: float) -> None:
+        from volcano_tpu.api.types import FINISHED_JOB_PHASES
+        for job in self._global_jobs():
+            region = fedapi.admitted_region(job)
+            if region is None or job.phase in FINISHED_JOB_PHASES:
+                continue
+            h = self.handles.get(region)
+            rec = self.cluster.regions.get(region,
+                                           h.record if h else None)
+            if h is None or not fedapi.region_alive(rec, now,
+                                                    self.ttl):
+                self._requeue(job, region, "region lost")
+                continue
+            copy = self._copy_of(h, job.key)
+            if copy is None:
+                continue            # not visible yet (mirror lag)
+            changed = False
+            phase = copy.phase.value
+            if job.annotations.get(
+                    fedapi.FED_REGIONAL_PHASE_ANNOTATION) != phase:
+                job.annotations[
+                    fedapi.FED_REGIONAL_PHASE_ANNOTATION] = phase
+                changed = True
+            # fold acked progress up: these annotations ARE the
+            # migration/loss continuity story — once folded, a whole-
+            # region loss resumes from this step, not from zero
+            for k in _fold_keys():
+                v = copy.annotations.get(k)
+                if v is not None and job.annotations.get(k) != v:
+                    job.annotations[k] = v
+                    changed = True
+            if copy.phase in FINISHED_JOB_PHASES:
+                job.phase = copy.phase
+                job.finish_time = copy.finish_time or now
+                changed = True
+            if changed:
+                self.cluster.update_vcjob(job)
+
+    def _requeue(self, job: VCJob, region: Optional[str],
+                 why: str) -> None:
+        ann = job.annotations
+        ann.pop(fedapi.FED_ADMITTED_REGION_ANNOTATION, None)
+        ann.pop(fedapi.FED_ADMITTED_TS_ANNOTATION, None)
+        ann.pop(fedapi.FED_REGIONAL_PHASE_ANNOTATION, None)
+        ann.pop(fedapi.FED_EVACUATING_TO_ANNOTATION, None)
+        if region:
+            ann[fedapi.FED_MIGRATED_FROM_ANNOTATION] = region
+        ann[fedapi.FED_ATTEMPT_ANNOTATION] = \
+            str(self._attempt(job) + 1)
+        self.cluster.update_vcjob(job)
+        self.cluster.record_event(
+            job.key, "FederationRequeued",
+            f"requeued out of {region or '?'}: {why}")
+        metrics.inc("federation_requeues_total",
+                    region=region or "unknown")
+        self._evac_started.pop(job.key, None)
+
+    # -- pending-gang burst arbitrage ----------------------------------
+
+    def _arbitrage(self, now: float) -> None:
+        for job in self._global_jobs():
+            region = fedapi.admitted_region(job)
+            if region is None or job.annotations.get(
+                    fedapi.FED_EVACUATING_TO_ANNOTATION):
+                continue
+            try:
+                admitted_ts = float(job.annotations.get(
+                    fedapi.FED_ADMITTED_TS_ANNOTATION, 0) or 0)
+            except (TypeError, ValueError):
+                continue
+            if now - admitted_ts < self.arbitrage_after:
+                continue
+            h = self.handles.get(region)
+            copy = self._copy_of(h, job.key) if h else None
+            if copy is None or copy.phase is not JobPhase.PENDING:
+                continue
+            pg = h.mirror.cluster.podgroups.get(job.key)
+            if pg is not None and pg.phase is PodGroupPhase.RUNNING:
+                continue
+            need = job_chips(job)
+            cur_score = self._score(h, job, need)
+            better = None
+            for name in sorted(self.handles):
+                if name == region:
+                    continue
+                cand = self.handles[name]
+                rec = self.cluster.regions.get(name, cand.record)
+                if float(rec.get("idle_chips", 0) or 0) < need:
+                    continue        # arbitrage only to a region with
+                                    # the chips idle RIGHT NOW
+                if self._score(cand, job, need) > cur_score:
+                    better = name
+                    break
+            if better is None:
+                continue
+            try:
+                h.client.delete_vcjob(job.key)
+            except OSError as e:
+                log.warning("arbitrage delete of %s in %s failed: %s",
+                            job.key, region, e)
+                continue
+            n = fedapi.migration_count(job) + 1
+            job.annotations[fedapi.FED_MIGRATIONS_ANNOTATION] = str(n)
+            self._requeue(job, region,
+                          f"pending {now - admitted_ts:.0f}s while "
+                          f"{better} has idle capacity")
+            metrics.inc("federation_migrations_total", kind="pending")
+
+    # -- cross-region migration of RUNNING gangs ------------------------
+
+    def _wants_evacuation(self, job: VCJob, region: str) -> bool:
+        if job.annotations.get(fedapi.FED_EVACUATE_ANNOTATION):
+            return True
+        rec = self.cluster.regions.get(region)
+        return bool(rec) and \
+            rec.get("state") == fedapi.REGION_STATE_DRAINING
+
+    def _evacuations(self, now: float) -> None:
+        for job in self._global_jobs():
+            region = fedapi.admitted_region(job)
+            if region is None or region not in self.handles:
+                continue
+            dest = job.annotations.get(
+                fedapi.FED_EVACUATING_TO_ANNOTATION)
+            if dest:
+                self._drive_cutover(job, region, dest, now)
+            elif self._wants_evacuation(job, region):
+                self._start_evacuation(job, region, now)
+
+    def _start_evacuation(self, job: VCJob, src: str,
+                          now: float) -> None:
+        h = self.handles[src]
+        copy = self._copy_of(h, job.key)
+        if copy is None or copy.phase is not JobPhase.RUNNING:
+            # not running: arbitrage/requeue is the cheaper move —
+            # nothing checkpointed to carry
+            return
+        want = job.annotations.get(fedapi.FED_EVACUATE_ANNOTATION, "")
+        if want and want != "auto" and want != src and \
+                want in self.handles and fedapi.region_ready(
+                    self.cluster.regions.get(want, {}), now, self.ttl):
+            dest = want
+        else:
+            dest = self._pick_region(job, exclude=(src,))
+        if dest is None:
+            return                  # nowhere to go yet; retry later
+        pg = getattr(h.client, "podgroups", {}).get(job.key)
+        if pg is None:
+            pg = h.mirror.cluster.podgroups.get(job.key)
+        if pg is None:
+            return
+        ann = pg.annotations
+        ann[eapi.ELASTIC_EVACUATE_ANNOTATION] = dest
+        ann[eapi.ELASTIC_DESIRED_SLICES_ANNOTATION] = \
+            str(eapi.current_slices(pg))
+        ann[eapi.ELASTIC_RESIZE_REASON_ANNOTATION] = \
+            eapi.RESIZE_EVACUATE
+        ann[eapi.ELASTIC_DECIDED_TS_ANNOTATION] = f"{now:.3f}"
+        try:
+            h.client.update_podgroup_status(pg)
+        except OSError as e:
+            log.warning("evacuate stamp on %s in %s failed: %s",
+                        job.key, src, e)
+            return
+        job.annotations[fedapi.FED_EVACUATING_TO_ANNOTATION] = dest
+        self.cluster.update_vcjob(job)
+        self._evac_started[job.key] = now
+        self.cluster.record_event(
+            job.key, "FederationEvacuating",
+            f"draining out of {src} toward {dest}")
+
+    def _reap_migrated_residuals(self, now: float) -> None:
+        """Sweep migration husks out of SOURCE regions, once per pass
+        until they stay gone.  The cutover's source delete races the
+        regional job controller: an in-flight status flush is an
+        upsert that resurrects the just-deleted copy, and the drain's
+        RestartJob re-materializes pods that outlive the job as
+        orphans (which the podgroup normalizer would re-adopt and the
+        scheduler would then place — ghost pods eating real chips).
+        Detection reads the mirror; deletes go through the write
+        client and repeat next pass if anything reappears."""
+        for job in self._global_jobs():
+            src = job.annotations.get(
+                fedapi.FED_MIGRATED_FROM_ANNOTATION)
+            region = fedapi.admitted_region(job)
+            if not src or src == region:
+                continue
+            h = self.handles.get(src)
+            if h is None or not fedapi.region_alive(
+                    self.cluster.regions.get(src, {}), now, self.ttl):
+                continue            # dead source: nothing to reap yet
+            c = h.mirror.cluster
+            name = job.key.rsplit("/", 1)[-1]
+            victims = [p.key for p in c.pods.values()
+                       if p.annotations.get(
+                           GROUP_NAME_ANNOTATION) == name]
+            if c.vcjobs.get(job.key) is None and \
+                    c.podgroups.get(job.key) is None and not victims:
+                continue
+            try:
+                if c.vcjobs.get(job.key) is not None:
+                    h.client.delete_vcjob(job.key)
+                if c.podgroups.get(job.key) is not None:
+                    h.client.delete_podgroup(job.key)
+                for pkey in victims:
+                    h.client.delete_pod(pkey)
+            except OSError as e:
+                log.warning("residual reap of %s in %s failed "
+                            "(will retry): %s", job.key, src, e)
+                continue
+            metrics.inc("federation_source_reaps_total", region=src)
+            log.info("reaped migration residue of %s in %s "
+                     "(%d pods)", job.key, src, len(victims))
+
+    def _drive_cutover(self, job: VCJob, src: str, dest: str,
+                       now: float) -> None:
+        h = self.handles[src]
+        dh = self.handles.get(dest)
+        if dh is None or not fedapi.region_ready(
+                self.cluster.regions.get(dest, {}), now, self.ttl):
+            # destination fell over mid-drain: abort toward a re-pick
+            job.annotations.pop(
+                fedapi.FED_EVACUATING_TO_ANNOTATION, None)
+            self.cluster.update_vcjob(job)
+            return
+        copy = self._copy_of(h, job.key)
+        if copy is None:
+            return
+        pg = h.mirror.cluster.podgroups.get(job.key)
+        if pg is None or pg.annotations.get(
+                eapi.ELASTIC_EVACUATED_ANNOTATION) != "true":
+            return                  # source drain still in flight
+        # the cutover gate: BOTH mirrors must be within the staleness
+        # bound — the source's for the resume metadata we carry, the
+        # destination's to see what we'd collide with.  A stale mirror
+        # refuses (MirrorStaleError) rather than guessing.
+        try:
+            h.mirror.read_checked()
+            dh.mirror.read_checked()
+        except MirrorStaleError as e:
+            metrics.inc("federation_cutover_refusals_total",
+                        region=e.region)
+            self.cluster.record_event(
+                job.key, "FederationCutoverRefused", str(e))
+            return
+        key = fedapi.admission_key(job.key, self._attempt(job) + 1)
+        if dh.mirror.cluster.vcjobs.get(job.key) is None and \
+                self._find_admitted_copy(key) is None:
+            resume = {k: v for k in _fold_keys()
+                      if (v := copy.annotations.get(k)) is not None}
+            resume[fedapi.FED_MIGRATED_FROM_ANNOTATION] = src
+            dcopy = self._regional_copy(job, dest, key, extra=resume)
+            dcopy.annotations.pop(eapi.ELASTIC_EVACUATE_ANNOTATION,
+                                  None)
+            dcopy.annotations.pop(eapi.ELASTIC_EVACUATED_ANNOTATION,
+                                  None)
+            try:
+                dh.client.add_vcjob(dcopy)
+            except OSError as e:
+                log.warning("cutover create of %s in %s failed: %s",
+                            job.key, dest, e)
+                return
+        # destination accepted: the source copy (and its held pods)
+        # can go — ORDER MATTERS, delete only after the create landed
+        try:
+            h.client.delete_vcjob(job.key)
+        except OSError as e:
+            log.warning("source delete of %s in %s failed "
+                        "(will retry): %s", job.key, src, e)
+        ann = job.annotations
+        n = fedapi.migration_count(job) + 1
+        ann[fedapi.FED_MIGRATIONS_ANNOTATION] = str(n)
+        ann[fedapi.FED_MIGRATED_FROM_ANNOTATION] = src
+        ann[fedapi.FED_ATTEMPT_ANNOTATION] = \
+            str(self._attempt(job) + 1)
+        ann[fedapi.FED_ADMITTED_REGION_ANNOTATION] = dest
+        ann[fedapi.FED_ADMITTED_TS_ANNOTATION] = f"{now:.3f}"
+        ann[fedapi.FED_ADMISSION_KEY_ANNOTATION] = key
+        ann.pop(fedapi.FED_EVACUATE_ANNOTATION, None)
+        ann.pop(fedapi.FED_EVACUATING_TO_ANNOTATION, None)
+        self.cluster.update_vcjob(job)
+        started = self._evac_started.pop(job.key, None)
+        if started is not None:
+            metrics.observe("federation_cutover_seconds",
+                            now - started)
+        self.cluster.record_event(
+            job.key, "FederationMigrated",
+            f"cut over {src} -> {dest} (migration #{n})")
+        metrics.inc("federation_migrations_total", kind="running")
+
+    # -- census ---------------------------------------------------------
+
+    def _gauges(self) -> None:
+        states = {s: 0 for s in fedapi.REGION_STATES}
+        now = self.now()
+        for name, rec in self.cluster.regions.items():
+            state = rec.get("state", fedapi.REGION_STATE_LOST)
+            if state == fedapi.REGION_STATE_READY and \
+                    not fedapi.region_alive(rec, now, self.ttl):
+                state = fedapi.REGION_STATE_LOST
+            if state not in states:
+                state = fedapi.REGION_STATE_LOST
+            states[state] += 1
+        for state, n in states.items():
+            metrics.set_gauge("federation_regions", n, state=state)
+        pending = sum(1 for j in self._global_jobs()
+                      if fedapi.admitted_region(j) is None
+                      and j.phase is JobPhase.PENDING)
+        metrics.set_gauge("federation_pending_jobs", pending)
+
+
+def main(argv=None) -> int:
+    """`python -m volcano_tpu.federation.router --store URL`"""
+    import argparse
+
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    ap = argparse.ArgumentParser(
+        description="federation router: one global queue over N "
+                    "regional planes")
+    ap.add_argument("--store", required=True,
+                    help="global state server URL (may be a comma-"
+                         "separated replica group)")
+    ap.add_argument("--token", default="")
+    ap.add_argument("--sync-s", type=float, default=2.0)
+    ap.add_argument("--ttl-s", type=float, default=fedapi.REGION_TTL_S,
+                    help="region loss TTL (bench planes compress it)")
+    ap.add_argument("--arbitrage-s", type=float,
+                    default=fedapi.ARBITRAGE_PENDING_S)
+    ap.add_argument("--metrics-port", type=int, default=0)
+    args = ap.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    cluster = RemoteCluster(args.store, token=args.token,
+                            tolerate_unreachable=True)
+    if args.metrics_port:
+        metrics.serve(args.metrics_port)
+    router = FederationRouter(cluster, ttl=args.ttl_s,
+                              arbitrage_after=args.arbitrage_s)
+    try:
+        while True:
+            try:
+                router.sync()
+            except Exception:       # noqa: BLE001 — keep reconciling
+                log.exception("router sync failed")
+            time.sleep(args.sync_s)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        router.close()
+        cluster.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

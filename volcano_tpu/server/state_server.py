@@ -519,6 +519,49 @@ class StateServer:
                 self._rebuild_chip_maps()
                 self._event_cv.notify_all()
 
+    def mirror_ship(self, since_seq: int, timeout: float) -> dict:
+        """The `/wal?mirror=1` lane: framed WAL records for a
+        cross-region OBJECT MIRROR (federation/mirror.py).  Same frame
+        + CRC + seq stream the replica tail consumes, with two
+        deliberate differences from Replication.ship():
+
+          * NON-QUORUM — the caller is never registered as a follower
+            and its ack never counts toward the commit quorum: a
+            mirror is a read cache at advertised staleness, and a
+            distant region tailing the WAL must not be able to slow
+            (or wedge) the source region's write acks.
+          * DURABLE-ONLY — works on any durable server, replicated or
+            not (a single-server lab region can be mirrored).
+
+        Leading a replica group, shipped records are CAPPED at the
+        quorum horizon: a mirror must never hold a record a leader
+        failover could un-happen (the same gate _visible_rv applies
+        to watchers).  On a follower the local synced prefix is
+        served as-is — the mirror's contract is staleness, not
+        quorum, and cutover correctness gates on the GLOBAL store."""
+        from volcano_tpu.server.replication import SHIP_BATCH
+        deadline = time.monotonic() + max(0.0, min(timeout, 30.0))
+        while True:
+            out = self.durable.ship_since(since_seq, limit=SHIP_BATCH)
+            if self.repl is not None and self.repl.is_leader:
+                q = self.repl.quorum_seq()
+                if q < out["last_seq"]:
+                    keep = max(0, q - since_seq)
+                    out = {"records": out["records"][:keep],
+                           "last_seq": max(since_seq, q),
+                           "resync": out["resync"]}
+            if out["records"] or out["resync"] or \
+                    time.monotonic() >= deadline:
+                break
+            with self._event_cv:
+                self._event_cv.wait(
+                    min(0.5, max(0.01,
+                                 deadline - time.monotonic())))
+        return {"epoch": self.epoch, "rv": self._visible_rv(),
+                "snapshot_rv": self.durable.snapshot_rv,
+                "last_seq": out["last_seq"],
+                "resync": out["resync"], "records": out["records"]}
+
     def apply_shipped(self, lines) -> None:
         """Fold one shipped batch into this follower: verify EVERY
         record's CRC + sequence first (a corrupt or torn shipped
@@ -942,12 +985,12 @@ class _Handler(BaseHTTPRequestHandler):
                                         enabled=True,
                                         epoch=st.epoch))
         if url.path == "/wal":
-            # WAL shipping lane (leader): framed records past the
-            # follower's seq, long-polled; the request doubles as the
-            # follower's durability ack (applied_seq/applied_rv feed
-            # the commit quorum)
-            if st.repl is None:
-                return self._json(404, {"error": "not replicated"})
+            # WAL shipping lane: framed records past the caller's seq,
+            # long-polled.  Two classes of tail share the route:
+            # replica followers (the request doubles as the follower's
+            # durability ack — applied_seq/applied_rv feed the commit
+            # quorum) and, with ?mirror=1, federation object mirrors
+            # (non-quorum, durable-only; see StateServer.mirror_ship)
             q = parse_qs(url.query)
 
             def qi(name, default=0):
@@ -959,13 +1002,21 @@ class _Handler(BaseHTTPRequestHandler):
                 timeout = min(float(q.get("timeout", ["5"])[0]), 30.0)
             except (TypeError, ValueError):
                 timeout = 5.0
-            resp = st.repl.ship(
-                since_seq=qi("since_seq"),
-                follower=q.get("follower", ["?"])[0],
-                applied_seq=qi("applied_seq"),
-                applied_rv=qi("applied_rv"),
-                term=qi("term"),
-                timeout=timeout)
+            if q.get("mirror", ["0"])[0] in ("1", "true"):
+                if st.durable is None:
+                    return self._json(404, {"error": "not durable"})
+                resp = st.mirror_ship(since_seq=qi("since_seq"),
+                                      timeout=timeout)
+            elif st.repl is None:
+                return self._json(404, {"error": "not replicated"})
+            else:
+                resp = st.repl.ship(
+                    since_seq=qi("since_seq"),
+                    follower=q.get("follower", ["?"])[0],
+                    applied_seq=qi("applied_seq"),
+                    applied_rv=qi("applied_rv"),
+                    term=qi("term"),
+                    timeout=timeout)
             if self.faults is not None and resp.get("records"):
                 rule = self.faults.decide("server", "/wal",
                                           kinds=("corrupt_ship",))

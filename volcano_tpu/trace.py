@@ -157,6 +157,11 @@ REASON_ENUM = (
     # to another shard's optimistic cross-subtree gang (per-item 409);
     # the gang re-queues through the loser's next cycle
     "cross-shard-conflict",
+    # a drained gang parked by the federation router mid cross-region
+    # cutover (api/elastic.py evacuate contract): the source enqueue
+    # gate holds it out of INQUEUE so the local scheduler never races
+    # the destination region's re-place
+    "evacuating-region",
     "other",
 )
 
@@ -178,6 +183,9 @@ _REASON_RULES: Tuple[Tuple[Tuple[str, ...], str], ...] = (
     # prefixes the server's 409 refusal ("bind overcommit: node ...")
     # with this marker when a subtree shard plan is active
     (("cross-shard",), "cross-shard-conflict"),
+    # before the generic rules: the enqueue hold the federation
+    # cutover stamps ("evacuating to region ...")
+    (("evacuat",), "evacuating-region"),
     (("quarantin",), "quarantined"),
     (("warm spare",), "warm-spare-reserved"),
     (("node selector", "node affinity", "nodegroup", "affinity "),

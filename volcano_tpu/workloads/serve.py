@@ -172,6 +172,80 @@ class ServingStatsReporter:
             return False
 
 
+class WeightedLoadBalancer:
+    """Latency-weighted front-end traffic splitter (the bench's LB).
+
+    Replaces the even split: a replica's share of the offered QPS is
+    inversely proportional to its OBSERVED p99 (EWMA-smoothed from
+    the stats it publishes), so a replica dragging on a contended or
+    degraded slice sheds load to faster peers instead of dragging the
+    group p99 — the standard least-latency front-end policy.  Two
+    guard rails keep the feedback loop stable:
+
+      cold start   a replica with no observation yet is priced at the
+                   group's mean latency (or floor_ms when the whole
+                   group is cold), so a fresh scale-up RAMPS rather
+                   than starving or flooding;
+      max_skew     no replica's weight falls below fastest/max_skew —
+                   a momentarily slow replica keeps receiving enough
+                   traffic to prove recovery (a zero share would
+                   freeze its observed latency at the bad sample).
+
+    One balancer fronts MULTIPLE serving groups (`route`): groups
+    contend for the fleet's chips, never for each other's traffic —
+    each group's offered QPS is split only across its own replicas.
+    """
+
+    __slots__ = ("alpha", "floor_ms", "max_skew", "_lat")
+
+    def __init__(self, alpha: float = 0.4, floor_ms: float = 1.0,
+                 max_skew: float = 4.0):
+        self.alpha = float(alpha)
+        self.floor_ms = float(floor_ms)
+        self.max_skew = max(1.0, float(max_skew))
+        self._lat: dict = {}          # replica uid -> EWMA p99_ms
+
+    def observe(self, uid: str, p99_ms: float) -> None:
+        try:
+            p99 = float(p99_ms)
+        except (TypeError, ValueError):
+            return
+        if p99 <= 0.0:
+            return                    # replica has served nothing yet
+        prev = self._lat.get(uid)
+        self._lat[uid] = p99 if prev is None else \
+            prev + self.alpha * (p99 - prev)
+
+    def forget(self, uid: str) -> None:
+        self._lat.pop(uid, None)
+
+    def latencies(self) -> dict:
+        return dict(self._lat)
+
+    def split(self, total_qps: float, uids: List[str]) -> dict:
+        """{uid: qps} — conserves total_qps across the group."""
+        if not uids:
+            return {}
+        known = [self._lat[u] for u in uids if u in self._lat]
+        cold = (sum(known) / len(known)) if known else self.floor_ms
+        lat = {u: max(self.floor_ms, self._lat.get(u, cold))
+               for u in uids}
+        w = {u: 1.0 / l for u, l in lat.items()}
+        lo = max(w.values()) / self.max_skew
+        w = {u: max(v, lo) for u, v in w.items()}
+        norm = sum(w.values())
+        return {u: float(total_qps) * v / norm for u, v in w.items()}
+
+    def route(self, offered: dict, groups: dict) -> dict:
+        """Split each group's offered QPS across that group's
+        replicas: `offered` is {group: qps}, `groups` is
+        {group: [uids]}; returns one {uid: qps} map for the beat."""
+        out = {}
+        for g, total in offered.items():
+            out.update(self.split(total, groups.get(g, [])))
+        return out
+
+
 def synthetic_forward(base_ms: float = 2.0,
                       per_item_ms: float = 0.4) -> Callable[[int], None]:
     """Deterministic forward cost model: one batched call costs
