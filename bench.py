@@ -3273,7 +3273,8 @@ class _FederationFleet:
     writes, RegionMirror tailing /wal?mirror=1)."""
 
     def __init__(self, regions, ttl=3.0, arbitrage_after=4.0,
-                 poll_s=0.3, sync_s=0.25):
+                 poll_s=0.3, sync_s=0.25, router_procs=0,
+                 lease_ttl=2.0):
         import os
         import threading
 
@@ -3300,7 +3301,17 @@ class _FederationFleet:
         self.planes = {}
         self.clients = {}
         self.hosts = 0
-        self.router = FederationRouter(
+        # router_procs > 0 = the HA replica-set topology: N router OS
+        # processes contending for the term-fenced lease in the global
+        # store (each with its own clients + mirrors, regions attached
+        # lazily off the registry).  0 = the embedded single router.
+        self._router_procs = router_procs
+        self._ttl, self._sync_s = ttl, sync_s
+        self._arbitrage_after, self._poll_s = arbitrage_after, poll_s
+        self._lease_ttl = lease_ttl
+        self.router_holders = []
+        self._routers_spawned = 0
+        self.router = None if router_procs else FederationRouter(
             self.g, ttl=ttl, arbitrage_after=arbitrage_after,
             start_mirrors=False)
         for name, n_slices, price in regions:
@@ -3326,19 +3337,32 @@ class _FederationFleet:
                         dcn_pod=f"{name}-dcn"):
                     client.add_node(node)
                     self.hosts += 1
-            mirror = RegionMirror(name, p.url)
-            mirror.start(poll_s=poll_s)
-            self.router.attach_region(
-                fedapi.region_record(name, p.url, price=price),
-                client=client, mirror=mirror)
+            if router_procs:
+                # router processes build their own clients + mirrors
+                # off this registry record (lazy attach)
+                self.g.put_object(
+                    "region",
+                    fedapi.region_record(name, p.url, price=price),
+                    key=name)
+            else:
+                mirror = RegionMirror(name, p.url)
+                mirror.start(poll_s=poll_s)
+                self.router.attach_region(
+                    fedapi.region_record(name, p.url, price=price),
+                    client=client, mirror=mirror)
             self.planes[name] = p
             self.clients[name] = client
-        # the router loop runs on its own thread (exactly what
-        # `python -m volcano_tpu.federation.router` does), pausable so
-        # scenarios can stage multi-job races into ONE sync pass
         self._stop = threading.Event()
         self.paused = threading.Event()
         self.sync_errors = []
+        self._thread = None
+        if router_procs:
+            for _ in range(router_procs):
+                self.spawn_router()
+            return
+        # the router loop runs on its own thread (exactly what
+        # `python -m volcano_tpu.federation.router` does), pausable so
+        # scenarios can stage multi-job races into ONE sync pass
 
         def _route():
             while not self._stop.wait(sync_s):
@@ -3351,6 +3375,71 @@ class _FederationFleet:
         self._thread = threading.Thread(target=_route, daemon=True,
                                         name="fed-router")
         self._thread.start()
+
+    # -- HA router replica set (router_procs mode) ---------------------
+
+    def spawn_router(self, holder=""):
+        """One more contender for the router lease — a real
+        `python -m volcano_tpu.federation.router` OS process."""
+        self._routers_spawned += 1
+        holder = holder or f"rt{self._routers_spawned}"
+        self.gplane.spawn(
+            f"router-{holder}", "-m", "volcano_tpu.federation.router",
+            "--store", self.gplane.url, "--holder", holder,
+            "--sync-s", str(self._sync_s),
+            "--ttl-s", str(self._ttl),
+            "--arbitrage-s", str(self._arbitrage_after),
+            "--lease-ttl-s", str(self._lease_ttl),
+            "--mirror-poll-s", str(self._poll_s))
+        self.router_holders.append(holder)
+        return holder
+
+    def leaseholder(self):
+        """The holder of the router lease right now (None while the
+        lease is vacant/expired), straight off the global store."""
+        from volcano_tpu.api import federation as fedapi
+        try:
+            rec = self.g.leases().get(fedapi.ROUTER_LEASE_NAME)
+        except OSError:
+            return None
+        if not rec or float(rec.get("expires_in", 0)) <= 0:
+            return None
+        return rec.get("holder")
+
+    def router_term(self):
+        from volcano_tpu.api import federation as fedapi
+        try:
+            rec = self.g.leases().get(fedapi.ROUTER_LEASE_NAME) or {}
+        except OSError:
+            return 0
+        return int(rec.get("term", 0) or 0)
+
+    def _router_proc(self, holder):
+        return self.gplane.procs.get(f"router-{holder}")
+
+    def kill_router(self, holder):
+        """SIGKILL one router process — the crash the lease + fence
+        machinery must absorb."""
+        import signal as _signal
+        proc = self._router_proc(holder)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(_signal.SIGKILL)
+            proc.wait(timeout=10)
+
+    def sigstop_router(self, holder):
+        """SIGSTOP = the router<->fleet partition / GC-pause model:
+        the process is alive but can neither renew its lease nor see
+        that it lost it."""
+        import signal as _signal
+        proc = self._router_proc(holder)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(_signal.SIGSTOP)
+
+    def sigcont_router(self, holder):
+        import signal as _signal
+        proc = self._router_proc(holder)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(_signal.SIGCONT)
 
     def kill_region(self, name):
         """SIGKILL every process of one regional plane — whole-region
@@ -3380,8 +3469,13 @@ class _FederationFleet:
 
     def shutdown(self):
         self._stop.set()
-        self._thread.join(timeout=10)
-        self.router.close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        if self.router is not None:
+            self.router.close()
+        for holder in self.router_holders:
+            # SIGCONT first: a SIGSTOP'd router ignores SIGTERM
+            self.sigcont_router(holder)
         for client in self.clients.values():
             client.close()
         self.g.close()
@@ -3776,6 +3870,388 @@ def federation_smoke() -> int:
     except AssertionError as e:
         out, ok = {"error": str(e)[-900:]}, False
     print(json.dumps({"metric": "federation_smoke", "ok": ok, **out}))
+    return 0 if ok else 1
+
+
+# -- federation HA: leased router replica set --------------------------
+
+
+def _fed_copy_regions(fleet, jname):
+    """Regions currently holding a copy of the gang (each client's
+    watch mirror — the exactly-once census)."""
+    return sorted(r for r, c in fleet.clients.items()
+                  if f"default/{jname}" in c.vcjobs)
+
+
+def _fed_regions_ready(g, names):
+    """Every named region is ready WITH capacity folded into the
+    registry (a fresh mirror poll stamped it).  Submitting before
+    this is a race: admission scores only the regions that have
+    folded, the gang lands in whichever region's mirror won the
+    boot race, and admission is sticky — a locality assertion then
+    times out on a perfectly healthy fleet."""
+    from volcano_tpu.api import federation as fedapi
+    regs = getattr(g, "regions", None) or {}
+    return all(
+        (regs.get(n) or {}).get("state") == fedapi.REGION_STATE_READY
+        and float((regs.get(n) or {}).get("capacity_chips", 0) or 0) > 0
+        for n in names)
+
+
+def _fed_dual_sampler(fleet, jobs, violations, stop):
+    """Continuously assert the no-dual-placement invariant: a gang
+    never has LIVE PLACED PODS in two regions at once, sampled
+    through every region's watch mirror while routers crash and fail
+    over.  The census is pods, not the vcjob phase field: a drained
+    source husk awaiting the create-then-delete reap keeps its stale
+    Running phase for a beat after its pods are gone — execution is
+    what must never be doubled."""
+    import threading
+
+    from volcano_tpu.api.types import GROUP_NAME_ANNOTATION
+
+    def _live(c, jname):
+        return any(
+            p.annotations.get(GROUP_NAME_ANNOTATION) == jname
+            and p.node_name and not p.is_terminated()
+            for p in c.pods.values())
+
+    def _sample():
+        while not stop.wait(0.1):
+            for jname in jobs:
+                running = [region
+                           for region, c in fleet.clients.items()
+                           if _live(c, jname)]
+                if len(running) > 1:
+                    violations.append(
+                        {"job": jname, "regions": running})
+    th = threading.Thread(target=_sample, daemon=True,
+                          name="fed-dual-sampler")
+    th.start()
+    return th
+
+
+def bench_federation_ha() -> dict:
+    """The router-HA headlines against a REAL fleet: 2 regional
+    control planes, one global store, and a 2-process router replica
+    set contending for the term-fenced lease.  Four episodes:
+
+      kill_admission   SIGKILL the leaseholder right after a gang
+                       enters the global queue — the standby promotes
+                       (new term), fences the regions, adopts, and
+                       the gang lands in EXACTLY one region
+      kill_cutover     SIGKILL the leaseholder mid-migration (source
+                       drained, evacuating-to stamped, cutover not
+                       driven) — the promoted router resumes the
+                       create-then-delete cutover idempotently with
+                       the folded checkpoint step intact
+      partition        SIGSTOP the leaseholder (the GC-pause / router
+                       <->fleet partition model): the standby takes
+                       over, and a write stamped with the deposed
+                       term is REFUSED 409 by the regional plane
+                       (counted on /fences)
+      vacancy          kill every router: regions run autonomously,
+                       the global queue accumulates (admission
+                       delayed, never lost), and one fresh router
+                       drains the backlog
+
+    The no-dual-placement invariant is sampled at 10Hz through every
+    region's live mirror for the whole run.  Committed as
+    FEDHA_r{N}.json."""
+    import threading
+    import time as _time
+
+    from volcano_tpu.api import federation as fedapi
+    from volcano_tpu.api.slicehealth import RESUME_STEP_ANNOTATION
+
+    STAMP = 7000
+    fleet = _FederationFleet(
+        (("ra", 2, 1.0), ("rb", 2, 0.7)), ttl=4.0,
+        arbitrage_after=60.0, router_procs=2, lease_ttl=2.0)
+    g = fleet.g
+    dual, stop = [], threading.Event()
+    sampler = _fed_dual_sampler(
+        fleet, ("anchor", "j-adm", "roamer", "j-queue"), dual, stop)
+    try:
+        # -- baseline: a leaseholder emerges and routes by locality --
+        _wire_wait(lambda: fleet.leaseholder() is not None, 30,
+                   lambda: f"router lease acquisition "
+                   f"({fleet.log_tails()})")
+        h0, term0 = fleet.leaseholder(), fleet.router_term()
+        _wire_wait(lambda: _fed_regions_ready(g, ("ra", "rb")), 30,
+                   lambda: f"region capacity folded "
+                   f"({dict(getattr(g, 'regions', {}))})")
+        g.add_vcjob(_fed_job("anchor", 1, locality="ra"))
+        _wire_wait(lambda: _fed_running(g, "anchor", "ra"), 60,
+                   lambda: f"anchor admission "
+                   f"({_fed_view(g, 'anchor')}) ({fleet.log_tails()})")
+
+        # -- episode 1: SIGKILL the leaseholder mid-admission --------
+        g.add_vcjob(_fed_job("j-adm", 1, locality="rb"))
+        fleet.kill_router(h0)
+        t_kill = _time.monotonic()
+        _wire_wait(lambda: fleet.leaseholder() not in (None, h0), 30,
+                   lambda: f"standby promotion after SIGKILL "
+                   f"({fleet.log_tails()})")
+        promo_adm = round(_time.monotonic() - t_kill, 3)
+        term1 = fleet.router_term()
+        _wire_wait(lambda: _fed_running(g, "j-adm", "rb"), 60,
+                   lambda: "adopted admission "
+                   f"({_fed_view(g, 'j-adm')}) ({fleet.log_tails()})")
+        mttr_adm = round(_time.monotonic() - t_kill, 3)
+        adm_copies = _fed_copy_regions(fleet, "j-adm")
+
+        # -- episode 2: SIGKILL the leaseholder mid-cutover ----------
+        fleet.spawn_router()            # keep the replica set at 2
+        g.add_vcjob(_fed_job("roamer", 1, locality="rb"))
+        _wire_wait(lambda: _fed_running(g, "roamer", "rb"), 60,
+                   lambda: "roamer admission "
+                   f"({_fed_view(g, 'roamer')}) ({fleet.log_tails()})")
+        _fed_stamp_and_fold(fleet, "rb", "roamer", STAMP)
+        gj = g.vcjobs["default/roamer"]
+        gj.annotations[fedapi.FED_EVACUATE_ANNOTATION] = "ra"
+        g.update_vcjob(gj)
+        _wire_wait(lambda: (g.vcjobs["default/roamer"].annotations.get(
+                       fedapi.FED_EVACUATING_TO_ANNOTATION)) == "ra",
+                   60, lambda: f"evacuation start "
+                   f"({fleet.log_tails()})")
+        h_cut = fleet.leaseholder()
+        fleet.kill_router(h_cut)
+        t_kill2 = _time.monotonic()
+        _wire_wait(lambda: _fed_running(g, "roamer", "ra"), 90,
+                   lambda: "adopted cutover "
+                   f"({_fed_view(g, 'roamer')}) ({fleet.log_tails()})")
+        mttr_cut = round(_time.monotonic() - t_kill2, 3)
+        _wire_wait(lambda: _fed_copy_regions(fleet, "roamer") ==
+                   ["ra"], 60,
+                   lambda: "source residual reap "
+                   f"({_fed_copy_regions(fleet, 'roamer')}) "
+                   f"({fleet.log_tails()})")
+        gj = g.vcjobs["default/roamer"]
+        cut_migrations = fedapi.migration_count(gj)
+        cut_folded = _fed_folded_step(g, "roamer")
+        racopy = fleet.clients["ra"].vcjobs["default/roamer"]
+        cut_resume = int(racopy.annotations.get(
+            RESUME_STEP_ANNOTATION, 0) or 0)
+
+        # -- episode 3: partition (SIGSTOP) + fenced stale write -----
+        fleet.spawn_router()
+        _wire_wait(lambda: fleet.leaseholder() is not None, 30,
+                   "leaseholder before partition")
+        h2, term2 = fleet.leaseholder(), fleet.router_term()
+        fleet.sigstop_router(h2)
+        t_stop = _time.monotonic()
+        _wire_wait(lambda: fleet.leaseholder() not in (None, h2), 30,
+                   lambda: f"takeover from partitioned holder "
+                   f"({fleet.log_tails()})")
+        mttr_part = round(_time.monotonic() - t_stop, 3)
+        term3 = fleet.router_term()
+        rbc = fleet.clients["rb"]
+        _wire_wait(lambda: int(rbc.fences().get(
+                       fedapi.ROUTER_LEASE_NAME, {}).get("term", 0)
+                   ) >= term3, 30,
+                   lambda: f"fence advance to term {term3} "
+                   f"({rbc.fences()})")
+        fleet.sigcont_router(h2)
+        # the deposed holder's write, replayed deterministically from
+        # the conductor: stamped with the old term, it must be 409'd
+        stale_refused = False
+        rbc.set_fence(fedapi.ROUTER_LEASE_NAME, term2)
+        try:
+            rbc.add_vcjob(_fed_job("stale-probe", 1))
+        except ValueError as e:
+            stale_refused = str(e).startswith("fenced")
+        finally:
+            rbc.set_fence("", 0)
+        fenced_count = int(rbc.fences().get(
+            fedapi.ROUTER_LEASE_NAME, {}).get("refused", 0) or 0)
+
+        # -- episode 4: total router vacancy -------------------------
+        for holder in list(fleet.router_holders):
+            fleet.kill_router(holder)
+        _wire_wait(lambda: fleet.leaseholder() is None, 30,
+                   "lease vacancy after killing every router")
+        g.add_vcjob(_fed_job("j-queue", 1))
+        _time.sleep(2.0)
+        queued_while_vacant = fedapi.admitted_region(
+            g.vcjobs["default/j-queue"]) is None
+        anchor_through_vacancy = _fed_running(g, "anchor", "ra")
+        fleet.spawn_router()
+        t_fresh = _time.monotonic()
+        _wire_wait(lambda: _fed_running(g, "j-queue"), 90,
+                   lambda: "backlog drain by the fresh router "
+                   f"({_fed_view(g, 'j-queue')}) "
+                   f"({fleet.log_tails()})")
+        mttr_vacancy = round(_time.monotonic() - t_fresh, 3)
+        term_final = fleet.router_term()
+        result = {
+            "hosts": fleet.hosts, "regions": 2,
+            "routers_spawned": fleet._routers_spawned,
+            "lease_ttl_s": fleet._lease_ttl,
+            "terms": {"initial": term0, "after_kill": term1,
+                      "before_partition": term2,
+                      "after_partition": term3, "final": term_final},
+            "terms_strictly_monotonic":
+                term0 < term1 <= term2 < term3 <= term_final,
+            "kill_admission": {
+                "promotion_s": promo_adm, "mttr_s": mttr_adm,
+                "copy_regions": adm_copies,
+                "exactly_once": adm_copies == ["rb"]},
+            "kill_cutover": {
+                "mttr_s": mttr_cut,
+                "migrations": cut_migrations,
+                "folded_step": cut_folded,
+                "resume_step": cut_resume,
+                "exactly_once": cut_migrations == 1 and
+                    _fed_copy_regions(fleet, "roamer") == ["ra"],
+                "acked_step_survived": cut_folded == STAMP and
+                    cut_resume >= STAMP},
+            "partition": {
+                "takeover_s": mttr_part,
+                "stale_fence_refused": stale_refused,
+                "fenced_writes_counted": fenced_count},
+            "vacancy": {
+                "queued_while_vacant": queued_while_vacant,
+                "anchor_ran_through": anchor_through_vacancy,
+                "backlog_drain_s": mttr_vacancy},
+            "no_dual_placement": not dual,
+            "dual_placement_violations": dual[:5],
+            "router_sync_errors": fleet.sync_errors[-3:],
+        }
+    finally:
+        stop.set()
+        sampler.join(timeout=2)
+        fleet.shutdown()
+    # the seeded router fault matrix (same scenario engine the chaos
+    # conductor exposes as --classes router) rides in the artifact so
+    # the committed row proves the invariants across DIFFERENT seeded
+    # kill/partition timings, not one lucky schedule
+    from tools import chaos_conductor
+    matrix = []
+    for seed in (1, 2):
+        row = chaos_conductor.run_router_failover(seed, 30.0,
+                                                  {"router"})
+        matrix.append({"seed": seed, "ok": row["ok"],
+                       "windows": row["windows"],
+                       "failover_mttr_s": row["failover_mttr_s"],
+                       "violations": row["violations"]})
+    result["mttr_bound_s"] = chaos_conductor.ROUTER_MTTR_BOUND_S
+    result["mttr_within_bound"] = all(
+        m <= chaos_conductor.ROUTER_MTTR_BOUND_S for m in (
+            result["kill_admission"]["mttr_s"],
+            result["kill_cutover"]["mttr_s"],
+            result["partition"]["takeover_s"],
+            result["vacancy"]["backlog_drain_s"]))
+    result["chaos_matrix"] = matrix
+    result["chaos_matrix_green"] = all(r["ok"] for r in matrix)
+    return result
+
+
+def bench_federation_ha_wire_smoke() -> dict:
+    """Seconds-scale router-HA drill for tier-1: two router
+    processes, SIGKILL the leaseholder mid-cutover — the standby
+    promotes under a higher term, adopts the half-done migration and
+    completes it exactly once; a write stamped with the dead router's
+    term is refused by the regional plane."""
+    import time as _time
+
+    from volcano_tpu.api import federation as fedapi
+    from volcano_tpu.api.slicehealth import RESUME_STEP_ANNOTATION
+
+    STAMP = 4200
+    fleet = _FederationFleet(
+        (("ra", 2, 1.0), ("rb", 1, 0.7)), ttl=4.0,
+        arbitrage_after=60.0, router_procs=2, lease_ttl=2.0)
+    g = fleet.g
+    try:
+        _wire_wait(lambda: fleet.leaseholder() is not None, 30,
+                   lambda: f"router lease acquisition "
+                   f"({fleet.log_tails()})")
+        h0, term0 = fleet.leaseholder(), fleet.router_term()
+        _wire_wait(lambda: _fed_regions_ready(g, ("ra", "rb")), 30,
+                   lambda: f"region capacity folded "
+                   f"({dict(getattr(g, 'regions', {}))})")
+        g.add_vcjob(_fed_job("anchor", 1, locality="ra"))
+        g.add_vcjob(_fed_job("roamer", 1, locality="rb"))
+        _wire_wait(lambda: _fed_running(g, "anchor", "ra")
+                   and _fed_running(g, "roamer", "rb"), 60,
+                   lambda: "locality-routed admission "
+                   f"({_fed_view(g, 'anchor')} "
+                   f"{_fed_view(g, 'roamer')}) ({fleet.log_tails()})")
+        _fed_stamp_and_fold(fleet, "rb", "roamer", STAMP)
+        gj = g.vcjobs["default/roamer"]
+        gj.annotations[fedapi.FED_EVACUATE_ANNOTATION] = "ra"
+        g.update_vcjob(gj)
+        _wire_wait(lambda: (g.vcjobs["default/roamer"].annotations.get(
+                       fedapi.FED_EVACUATING_TO_ANNOTATION)) == "ra",
+                   60, lambda: f"evacuation start "
+                   f"({fleet.log_tails()})")
+        holder_kill = fleet.leaseholder()
+        fleet.kill_router(holder_kill)
+        t_kill = _time.monotonic()
+        _wire_wait(lambda: fleet.leaseholder()
+                   not in (None, holder_kill), 30,
+                   lambda: f"standby promotion ({fleet.log_tails()})")
+        term1 = fleet.router_term()
+        _wire_wait(lambda: _fed_running(g, "roamer", "ra"), 90,
+                   lambda: "adopted cutover "
+                   f"({_fed_view(g, 'roamer')}) ({fleet.log_tails()})")
+        mttr = round(_time.monotonic() - t_kill, 3)
+        _wire_wait(lambda: _fed_copy_regions(fleet, "roamer") ==
+                   ["ra"], 60,
+                   lambda: "source residual reap "
+                   f"({_fed_copy_regions(fleet, 'roamer')})")
+        # the dead leaseholder's late write, stamped with its term
+        rbc = fleet.clients["rb"]
+        stale_refused = False
+        rbc.set_fence(fedapi.ROUTER_LEASE_NAME, term0)
+        try:
+            rbc.add_vcjob(_fed_job("stale-probe", 1))
+        except ValueError as e:
+            stale_refused = str(e).startswith("fenced")
+        finally:
+            rbc.set_fence("", 0)
+        gj = g.vcjobs["default/roamer"]
+        racopy = fleet.clients["ra"].vcjobs["default/roamer"]
+        return {
+            "regions": 2, "hosts": fleet.hosts,
+            "routers": 2, "killed_holder": holder_kill,
+            "term_before": term0, "term_after": term1,
+            "term_bumped": term1 > term0,
+            "failover_mttr_s": mttr,
+            "migrations": fedapi.migration_count(gj),
+            "cutover_exactly_once":
+                fedapi.migration_count(gj) == 1 and
+                _fed_copy_regions(fleet, "roamer") == ["ra"],
+            "folded_step_survived":
+                _fed_folded_step(g, "roamer") == STAMP,
+            "resume_step_in_dest": int(racopy.annotations.get(
+                RESUME_STEP_ANNOTATION, 0) or 0),
+            "stale_fence_refused": stale_refused,
+            "fenced_writes_counted": int(rbc.fences().get(
+                fedapi.ROUTER_LEASE_NAME, {}).get("refused", 0) or 0),
+            "anchor_untouched": _fed_running(g, "anchor", "ra"),
+        }
+    finally:
+        fleet.shutdown()
+
+
+def federation_ha_smoke() -> int:
+    """Tier-1 router-HA drill, mirroring --federation-smoke.  Prints
+    one JSON line."""
+    try:
+        out = bench_federation_ha_wire_smoke()
+        ok = (out["term_bumped"]
+              and out["cutover_exactly_once"]
+              and out["folded_step_survived"]
+              and out["resume_step_in_dest"] >= 4200
+              and out["stale_fence_refused"]
+              and out["fenced_writes_counted"] >= 1
+              and out["anchor_untouched"])
+    except AssertionError as e:
+        out, ok = {"error": str(e)[-900:]}, False
+    print(json.dumps({"metric": "federation_ha_smoke", "ok": ok,
+                      **out}))
     return 0 if ok else 1
 
 
@@ -5469,6 +5945,17 @@ if __name__ == "__main__":
         sys.exit(serve_smoke())
     elif "--federation-smoke" in sys.argv:
         sys.exit(federation_smoke())
+    elif "--federation-ha-smoke" in sys.argv:
+        sys.exit(federation_ha_smoke())
+    elif "--federation-ha" in sys.argv:
+        # the router-HA row committed as FEDHA_r{N}.json: a 2-process
+        # router replica set over 2 REAL regional planes — SIGKILL
+        # the leaseholder mid-admission and mid-cutover, SIGSTOP
+        # partition with a fenced stale-term write, and total router
+        # vacancy, with the no-dual-placement invariant sampled at
+        # 10Hz the whole run
+        print(json.dumps({"metric": "federation_router_ha",
+                          **bench_federation_ha()}))
     elif "--federation" in sys.argv:
         # the standalone federation-tier row committed as
         # FED_r{N}.json: 3 REAL regional control planes behind one

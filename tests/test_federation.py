@@ -160,8 +160,28 @@ def test_federation_api_contract():
                 "federation_cutover_refusals_total",
                 "federation_mirror_records_total",
                 "federation_mirror_resyncs_total",
-                "federation_mirror_refused_batches_total"):
+                "federation_mirror_delta_resyncs_total",
+                "federation_mirror_refused_batches_total",
+                # the router-HA surface: lease, adoption, the shared
+                # RPC policy's breaker, and the fence refusal counter
+                "federation_router_is_leader",
+                "federation_router_term",
+                "federation_router_adoptions_total",
+                "federation_router_rpc_failures_total",
+                "federation_router_rpc_skipped_total",
+                "federation_router_breaker_opens_total",
+                "federation_router_breaker_state",
+                "federation_region_serving_headroom",
+                "fenced_writes_total"):
         assert fam in FAMILIES, fam
+
+    # the router lease contract: a name every plane agrees on, and a
+    # fence-refusal typed OUTSIDE the transient-RPC hierarchy so no
+    # per-region error handler can swallow a deposition
+    from volcano_tpu.federation.retry import (FedRPCError,
+                                              RouterFencedError)
+    assert fedapi.ROUTER_LEASE_NAME == "federation-router"
+    assert not issubclass(RouterFencedError, FedRPCError)
 
 
 # -- mirror: staleness contract ----------------------------------------
@@ -524,3 +544,352 @@ def test_bench_federation_smoke_mode():
     assert out["folded_step_survived"]
     assert out["migrated_from"] == "rb"
     assert out["attempt"] >= 1
+
+
+# -- HA: the shared cross-region RPC policy ----------------------------
+
+def test_retry_policy_contract():
+    """One retry discipline for every cross-region mutation: capped
+    exponential backoff with DETERMINISTIC jitter (seeded chaos
+    replays byte-identically), a per-region breaker that degrades a
+    sick region to mirror-only observation, and fence-409 classified
+    as deposition — never retried, never swallowed as transient."""
+    from volcano_tpu.federation import retry as fr
+
+    # deterministic half-jitter inside the capped exponential envelope
+    for attempt in range(1, 12):
+        d = fr.backoff_delay(attempt, "ra")
+        assert d == fr.backoff_delay(attempt, "ra")
+        env = min(fr.BREAKER_COOLDOWN_CAP_S,
+                  fr.BREAKER_COOLDOWN_BASE_S * 2 ** (attempt - 1))
+        assert env / 2 <= d < env
+    assert fr.backoff_delay(20, "ra") < fr.BREAKER_COOLDOWN_CAP_S
+
+    t = Clock(0.0)
+    rpc = fr.FedRPC(now=t)
+
+    def boom():
+        raise OSError("connection refused")
+
+    # threshold consecutive transient failures open the breaker
+    for _ in range(fr.BREAKER_THRESHOLD):
+        with pytest.raises(fr.FedRPCError):
+            rpc.call("ra", "add_vcjob", boom)
+    assert rpc.state("ra") == fr.STATE_OPEN
+    # open: nothing is attempted at all (mirror-only degradation)
+    with pytest.raises(fr.RegionTrippedError):
+        rpc.call("ra", "add_vcjob", lambda: "never runs")
+    assert not rpc.available("ra")
+    # cooldown elapses -> half-open admits ONE probe; success closes
+    t.t = 100.0
+    assert rpc.available("ra")
+    assert rpc.call("ra", "add_vcjob", lambda: "ok") == "ok"
+    assert rpc.state("ra") == fr.STATE_CLOSED
+
+    # a half-open probe FAILING re-opens with a longer cooldown
+    for _ in range(fr.BREAKER_THRESHOLD):
+        with pytest.raises(fr.FedRPCError):
+            rpc.call("rc", "add_vcjob", boom)
+    first_wait = rpc.breaker("rc").retry_in(t())
+    t.t += first_wait + 0.01
+    with pytest.raises(fr.FedRPCError):
+        rpc.call("rc", "add_vcjob", boom)
+    assert rpc.state("rc") == fr.STATE_OPEN
+    assert rpc.breaker("rc").opens == 2
+
+    # fence 409 = deposed: typed OUTSIDE FedRPCError so per-region
+    # "skip this pass" handlers cannot swallow it, and the breaker is
+    # NOT fed (the region is healthy — WE are stale)
+    def fenced():
+        raise ValueError("fenced: router term 1 below floor 2")
+    with pytest.raises(fr.RouterFencedError):
+        rpc.call("rb", "update_vcjob", fenced)
+    assert rpc.state("rb") == fr.STATE_CLOSED
+    # typed 4xx verdicts propagate unchanged — retrying a verdict
+    # gets the same answer forever
+    def verdict():
+        raise KeyError("default/nope")
+    with pytest.raises(KeyError):
+        rpc.call("rb", "delete_vcjob", verdict)
+    assert rpc.state("rb") == fr.STATE_CLOSED
+
+
+# -- HA: serving QPS headroom in placement -----------------------------
+
+def test_serving_qps_headroom_routing():
+    """A region whose serving fleet already runs at its declared
+    target QPS is a poor home for one more replica group: the
+    measured headroom scales the serving gang's score there, while
+    training gangs (and empty regions) stay neutral."""
+    from volcano_tpu.api import serving as sapi
+    from volcano_tpu.api.podgroup import PodGroup
+
+    clock = Clock()
+    g, router, handles = fleet(
+        {"ra": {"price": 0.5}, "rb": {"price": 1.0}}, clock=clock)
+    ra, _ = handles["ra"]
+    # ra hosts a serving group running exactly AT its target:
+    # 2 replicas x 100 qps target, 200 qps measured -> headroom 0
+    pg = PodGroup(name="chat", namespace="default", min_member=2)
+    pg.annotations[sapi.SLO_P99_MS_ANNOTATION] = "200"
+    pg.annotations[sapi.TARGET_QPS_ANNOTATION] = "100"
+    pg.annotations[sapi.PG_REPLICAS_ANNOTATION] = "2"
+    pg.annotations[sapi.PG_QPS_ANNOTATION] = "200"
+    ra.add_podgroup(pg)
+    router.sync()                    # fold capacity + headroom
+    assert router._serving_headroom["ra"] == pytest.approx(0.0)
+    assert router._serving_headroom["rb"] == pytest.approx(1.0)
+
+    # training: price still rules — ra wins at half the price
+    g.add_vcjob(global_job("train"))
+    # serving-class: ra's zero headroom outweighs its price edge
+    g.add_vcjob(global_job("serve", annotations={
+        sapi.SLO_P99_MS_ANNOTATION: "200"}))
+    router.sync()
+    assert fedapi.admitted_region(
+        g.vcjobs["default/train"]) == "ra"
+    assert fedapi.admitted_region(
+        g.vcjobs["default/serve"]) == "rb"
+
+
+# -- HA: incremental mirror re-sync across a source restart ------------
+
+def test_mirror_delta_resync_across_restart():
+    """A region server restart empties the volatile ship ring, so the
+    mirror's WAL cursor falls off it.  Same lineage (epoch BASE
+    survives the durable restart): the mirror catches up through the
+    DELTA lane — the events since its applied rv, O(churn missed) —
+    instead of re-listing the whole store.  A true lineage break
+    (fresh data dir) still forces the full snapshot bootstrap."""
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.server.durability import DurableStore
+    from volcano_tpu.server.state_server import serve
+
+    d = tempfile.mkdtemp()
+    httpd, st = serve(port=0, durable=DurableStore(d))
+    port = httpd.server_address[1]
+    url = f"http://127.0.0.1:{port}"
+    rc = RemoteCluster(url)
+    m = RegionMirror("ra", url)
+    try:
+        rc.add_node(Node(name="n0", allocatable={TPU: 4}))
+        m.poll()
+        assert m.status()["resyncs"] == 1
+        base = m.epoch.split(".")[0]
+        # churn the mirror does NOT tail before the restart: durable
+        # in the WAL, but the ship ring holding it dies with the
+        # process
+        rc.add_node(Node(name="n1", allocatable={TPU: 4}))
+        rc.add_vcjob(global_job("j1"))
+    finally:
+        rc.close()
+        httpd.shutdown()
+        httpd.server_close()
+        st.durable.close()
+    # same data dir -> same BASE, new boot
+    httpd, st = serve(port=port, durable=DurableStore(d))
+    rc = RemoteCluster(url)
+    try:
+        rc.add_node(Node(name="n2", allocatable={TPU: 4}))
+        m.poll(timeout=3.0)
+        s = m.status()
+        assert s["delta_resyncs"] == 1, s
+        assert s["resyncs"] == 2, s
+        # the delta carried BOTH the missed pre-restart churn and the
+        # post-restart write, and the lineage is unbroken
+        assert set(m.cluster.nodes) == {"n0", "n1", "n2"}
+        assert set(m.cluster.vcjobs) == {"default/j1"}
+        assert m.epoch.split(".")[0] == base
+        # tailing resumes normally off the re-aligned cursor
+        rc.add_node(Node(name="n3", allocatable={TPU: 4}))
+        assert m.poll(timeout=3.0) >= 1
+        assert "n3" in m.cluster.nodes
+        assert m.status()["resyncs"] == 2
+    finally:
+        rc.close()
+        httpd.shutdown()
+        httpd.server_close()
+        st.durable.close()
+    # lineage break: a FRESH dir on the same address mints a new
+    # BASE — the rv space is meaningless, only a full re-list is safe
+    httpd, st = serve(port=port, durable=DurableStore(tempfile.mkdtemp()))
+    rc = RemoteCluster(url)
+    try:
+        rc.add_node(Node(name="z0", allocatable={TPU: 4}))
+        m.poll(timeout=3.0)
+        s = m.status()
+        assert s["resyncs"] == 3 and s["delta_resyncs"] == 1, s
+        assert set(m.cluster.nodes) == {"z0"}
+        assert m.epoch.split(".")[0] != base
+    finally:
+        rc.close()
+        httpd.shutdown()
+        httpd.server_close()
+        st.durable.close()
+
+
+# -- HA: two-router failover with fenced exactly-once cutover ----------
+
+def test_router_failover_two_routers():
+    """The tentpole, in-process: two routers contend for the lease,
+    the leaseholder dies MID-CUTOVER (evacuating-to stamped, nothing
+    moved yet), the standby adopts the half-done migration and lands
+    the gang in the destination exactly once — and the regional
+    plane's fence refuses the dead router's late write."""
+    from volcano_tpu.cache.remote_cluster import RemoteCluster
+    from volcano_tpu.server.durability import DurableStore
+    from volcano_tpu.server.state_server import serve
+
+    # the lease clock is FAKE: expiry is driven, not slept out
+    t = [1000.0]
+    g = FakeCluster()
+    g.lease_now = lambda: t[0]
+
+    servers, stages, routers = {}, {}, []
+    try:
+        for name, price in (("ra", 1.0), ("rb", 0.7)):
+            httpd, st = serve(port=0,
+                              durable=DurableStore(tempfile.mkdtemp()))
+            url = f"http://127.0.0.1:{httpd.server_address[1]}"
+            servers[name] = (httpd, st, url, price)
+            # the regional plane's OWN unfenced client (its local
+            # controllers are not the router; fences bind routers)
+            stage = stages[name] = RemoteCluster(url)
+            for i in range(4):
+                stage.add_node(Node(
+                    name=f"{name}-n{i}",
+                    labels={"cloud.google.com/gke-tpu-accelerator":
+                            "tpu-v5-lite-podslice"},
+                    allocatable={TPU: 4, "cpu": 64}))
+
+        def make_router(holder):
+            r = FederationRouter(g, elect=True, holder=holder,
+                                 start_mirrors=False)
+            for name, (_h, _st, url, price) in servers.items():
+                r.attach_region(
+                    fedapi.region_record(name, url, price=price),
+                    client=RemoteCluster(url, retry_deadline=5.0),
+                    mirror=RegionMirror(name, url))
+            routers.append(r)
+            return r
+
+        def pump(r):
+            for h in r.handles.values():
+                h.mirror.poll(timeout=0.0)
+            r.sync()
+
+        r1, r2 = make_router("r1"), make_router("r2")
+        pump(r1)
+        pump(r2)
+        assert r1.elector.is_leader and r1.elector.term == 1
+        assert not r2.elector.is_leader    # lease held: standby
+
+        # the holder admits: rb wins on price
+        g.add_vcjob(global_job("train"))
+        pump(r1)
+        job = g.vcjobs["default/train"]
+        assert fedapi.admitted_region(job) == "rb"
+        _wait(lambda: "default/train" in stages["rb"].vcjobs,
+              msg="admitted copy on rb")
+        # a standby pass OBSERVES only — nothing moves
+        pump(r2)
+        assert fedapi.admitted_region(
+            g.vcjobs["default/train"]) == "rb"
+
+        # the regional plane runs the gang and drains it for
+        # evacuation to ra; the router stamps evacuating-to ... and
+        # dies before moving anything: the EXACT mid-cutover window
+        from volcano_tpu.api.podgroup import PodGroup
+        copy = stages["rb"].vcjobs["default/train"]
+        copy.phase = JobPhase.RUNNING
+        copy.annotations[RESUME_STEP_ANNOTATION] = "900"
+        stages["rb"].update_vcjob(copy)
+        pg = PodGroup(name="train", namespace="default", min_member=2)
+        pg.annotations[eapi.ELASTIC_EVACUATE_ANNOTATION] = "ra"
+        pg.annotations[eapi.ELASTIC_EVACUATED_ANNOTATION] = "true"
+        stages["rb"].add_podgroup(pg)
+        job = g.vcjobs["default/train"]
+        job.annotations[fedapi.FED_EVACUATING_TO_ANNOTATION] = "ra"
+        g.update_vcjob(job)
+
+        # r1 crashes (never drives again, lease never released);
+        # the ttl expires on the fake clock and r2 adopts
+        t[0] += fedapi.ROUTER_LEASE_TTL_S + 1
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            pump(r2)
+            if fedapi.admitted_region(
+                    g.vcjobs["default/train"]) == "ra":
+                break
+            time.sleep(0.05)
+        pump(r2)                     # fold the post-cutover state
+        assert r2.elector.is_leader and r2.elector.term == 2
+        job = g.vcjobs["default/train"]
+        assert fedapi.admitted_region(job) == "ra"
+        assert fedapi.migration_count(job) == 1
+
+        # exactly once: the gang lives in ra, rb's copy is reaped,
+        # and the destination copy resumes from the drained step
+        ra_view = r2.handles["ra"].mirror.cluster
+        rb_view = r2.handles["rb"].mirror.cluster
+        assert "default/train" in ra_view.vcjobs
+        _wait(lambda: (r2.handles["rb"].mirror.poll(timeout=0.0),
+                       "default/train" not in rb_view.vcjobs)[1],
+              msg="rb residual reaped")
+        new_copy = ra_view.vcjobs["default/train"]
+        assert new_copy.annotations[RESUME_STEP_ANNOTATION] == "900"
+        assert new_copy.annotations[
+            fedapi.FED_MIGRATED_FROM_ANNOTATION] == "rb"
+
+        # the dead router wakes up and flushes its in-flight write:
+        # rb's fence floor (advanced to term 2 at adoption) refuses
+        # it atomically, and the refusal is COUNTED
+        with pytest.raises(ValueError, match="^fenced"):
+            r1.handles["rb"].client.add_vcjob(global_job("late"))
+        fen = stages["rb"].fences()[fedapi.ROUTER_LEASE_NAME]
+        assert fen["term"] >= 2 and fen["refused"] >= 1
+        assert "default/late" not in stages["rb"].vcjobs
+        # ... and its next renew demotes it for good
+        r1.sync()
+        assert not r1.elector.is_leader
+        assert r2.elector.is_leader
+    finally:
+        for r in routers:
+            for h in r.handles.values():
+                h.client.close()
+            r.close()
+        for stage in stages.values():
+            stage.close()
+        for httpd, st, _url, _price in servers.values():
+            httpd.shutdown()
+            httpd.server_close()
+            st.durable.close()
+
+
+def test_bench_federation_ha_smoke_mode():
+    """`bench.py --federation-ha-smoke` runs the failover against
+    REAL router processes: two routers on one global store, SIGKILL
+    the leaseholder mid-cutover, the standby adopts within the MTTR
+    bound and the fence refuses the dead router's late write."""
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--federation-ha-smoke"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=REPO)
+    assert proc.returncode == 0, \
+        proc.stdout[-2000:] + proc.stderr[-2000:]
+    import json
+    line = next(l for l in reversed(proc.stdout.strip().splitlines())
+                if l.startswith("{"))
+    out = json.loads(line)
+    assert out["ok"] is True, out
+    assert out["term_after"] > out["term_before"]
+    assert out["cutover_exactly_once"]
+    assert out["folded_step_survived"]
+    assert out["stale_fence_refused"]
+    assert out["fenced_writes_counted"] >= 1
+    assert out["anchor_untouched"]

@@ -167,9 +167,27 @@ def build_plan(seed: int, duration: float, classes) -> dict:
                                    2)}
         windows["region_kill"] = (region["kill_at"],
                                   region["kill_at"])
+    router = None
+    if "router" in classes:
+        # federation-router replica-set failover: SIGKILL the
+        # leaseholder mid-admission, SIGKILL it again mid-cutover,
+        # then SIGSTOP it (the partition / GC-pause model) late in
+        # the run and replay a write stamped with the deposed term
+        # (drawn AFTER every other class so their plans stay
+        # byte-identical)
+        router = {
+            "kill_admission_at":
+                round(duration * rng.uniform(0.18, 0.28), 2),
+            "kill_cutover_at":
+                round(duration * rng.uniform(0.48, 0.58), 2),
+            "partition_at":
+                round(duration * rng.uniform(0.75, 0.85), 2),
+        }
+        for k, at in router.items():
+            windows["router_" + k[:-3]] = (at, at)
     return {"seed": seed, "rules": rules, "windows": windows,
             "slice_kill_at": slice_kill_at, "replication": repl,
-            "region": region}
+            "region": region, "router": router}
 
 
 def _iann(ann, key, default=0):
@@ -491,6 +509,265 @@ def run_region_kill(seed: int, duration: float, classes,
     return result
 
 
+# the failover MTTR budget for the router class: 2x the whole-region
+# loss MTTR measured in FED_r19.json (~6.7s) — losing ONE router out
+# of a replica set must never cost more than twice losing a region
+ROUTER_MTTR_BOUND_S = 13.4
+
+
+def run_router_failover(seed: int, duration: float, classes,
+                        logdir: str = "") -> dict:
+    """The ``router`` fault class: the federation router replica set
+    under crash + partition fire.  Boots bench.py's 2-region process
+    fleet with TWO router OS processes contending for the term-fenced
+    lease, then fires the seeded schedule: SIGKILL the leaseholder
+    right after a gang enters the global queue, SIGKILL its successor
+    mid-cutover (source drained, evacuating-to stamped), and SIGSTOP
+    the next one so a standby takes over while the old holder still
+    believes it leads.  Invariants:
+
+        no_dual_placement        a gang is never RUNNING in two
+                                 regions at once (sampled at 10Hz
+                                 through every region's live mirror)
+        cutover_exactly_once     the adopted migration lands exactly
+                                 one destination copy, reaps the
+                                 source, and counts ONE migration
+        acked_admissions_durable every acked admission reaches
+                                 Running despite the crashes, and the
+                                 globally folded step floor never
+                                 rewinds
+        stale_fence_refused      a write stamped with the deposed
+                                 holder's term is refused 409 by the
+                                 regional plane and counted on
+                                 /fences
+        failover_mttr            every kill/partition-to-recovery
+                                 interval stays under
+                                 ROUTER_MTTR_BOUND_S
+    """
+    import threading
+
+    import bench
+    from volcano_tpu.api import federation as fedapi
+    from volcano_tpu.api.slicehealth import RESUME_STEP_ANNOTATION
+    classes = set(classes.split(",")) if isinstance(classes, str) \
+        else set(classes)
+    sched = build_plan(seed, duration, classes)
+    plan = sched["router"]
+    # diagnostics go to stderr: bench --federation-ha embeds this run
+    # in-process and its stdout must stay one parseable JSON document
+    print(f"chaos conductor: seed={seed} duration={duration}s "
+          f"classes={sorted(classes)} (federation fleet, 2-router "
+          f"replica set; kills at t+{plan['kill_admission_at']}s / "
+          f"t+{plan['kill_cutover_at']}s, partition at "
+          f"t+{plan['partition_at']}s)", file=sys.stderr, flush=True)
+    violations = []
+
+    def note(inv: str, detail: str):
+        violations.append({"invariant": inv, "detail": detail})
+        print(f"INVARIANT VIOLATION [{inv}]: {detail}", flush=True)
+
+    t0 = time.monotonic()
+    fleet = bench._FederationFleet(
+        (("ra", 2, 1.0), ("rb", 2, 0.7)), ttl=4.0,
+        arbitrage_after=60.0, router_procs=2, lease_ttl=2.0)
+    g = fleet.g
+    dual, stop = [], threading.Event()
+    sampler = bench._fed_dual_sampler(
+        fleet, ("anchor", "j-adm", "roamer"), dual, stop)
+    mttrs = {}
+    terms = []
+    step, floor = 1000, 0
+    fenced_count = 0
+
+    def pump():
+        # acked progress keeps climbing on the survivor gang; the
+        # globally folded floor must never rewind across failovers
+        nonlocal step, floor
+        bench._fed_stamp_and_fold(fleet, "ra", "anchor", step)
+        f = bench._fed_folded_step(g, "anchor")
+        if f < floor:
+            note("acked_admissions_durable",
+                 f"folded step rewound {floor} -> {f}")
+        floor = max(floor, f)
+        step += 500
+
+    def sleep_until(at):
+        while time.monotonic() - t0 < at:
+            pump()
+            time.sleep(0.3)
+
+    try:
+        chaoslib.wait_for(lambda: fleet.leaseholder() is not None,
+                          30, "router lease acquisition")
+        terms.append(fleet.router_term())
+        chaoslib.wait_for(
+            lambda: bench._fed_regions_ready(g, ("ra", "rb")), 30,
+            "region capacity folded before the first submit")
+        g.add_vcjob(bench._fed_job("anchor", 1, locality="ra"))
+        try:
+            chaoslib.wait_for(
+                lambda: bench._fed_running(g, "anchor", "ra"), 60,
+                "locality-routed admission")
+        except AssertionError as e:
+            note("acked_admissions_durable",
+                 f"admission never settled: {e}")
+            raise
+
+        # -- SIGKILL the leaseholder mid-admission -------------------
+        sleep_until(plan["kill_admission_at"])
+        h0 = fleet.leaseholder()
+        g.add_vcjob(bench._fed_job("j-adm", 1, locality="rb"))
+        fleet.kill_router(h0)
+        t_kill = time.monotonic()
+        try:
+            chaoslib.wait_for(
+                lambda: bench._fed_running(g, "j-adm", "rb"), 60,
+                "adoption of the in-flight admission")
+            mttrs["kill_admission"] = round(
+                time.monotonic() - t_kill, 3)
+        except AssertionError:
+            note("acked_admissions_durable",
+                 f"gang never ran after the leaseholder SIGKILL "
+                 f"({bench._fed_view(g, 'j-adm')})")
+        terms.append(fleet.router_term())
+        copies = bench._fed_copy_regions(fleet, "j-adm")
+        if copies != ["rb"]:
+            note("no_dual_placement", f"j-adm copies: {copies}")
+        fleet.spawn_router()        # keep the replica set at 2
+
+        # -- SIGKILL the leaseholder mid-cutover ---------------------
+        sleep_until(plan["kill_cutover_at"])
+        g.add_vcjob(bench._fed_job("roamer", 1, locality="rb"))
+        chaoslib.wait_for(
+            lambda: bench._fed_running(g, "roamer", "rb"), 60,
+            "roamer admission")
+        acked = step
+        bench._fed_stamp_and_fold(fleet, "rb", "roamer", acked)
+        gj = g.vcjobs["default/roamer"]
+        gj.annotations[fedapi.FED_EVACUATE_ANNOTATION] = "ra"
+        g.update_vcjob(gj)
+        chaoslib.wait_for(
+            lambda: g.vcjobs["default/roamer"].annotations.get(
+                fedapi.FED_EVACUATING_TO_ANNOTATION) == "ra", 60,
+            "evacuation start")
+        fleet.kill_router(fleet.leaseholder())
+        t_kill = time.monotonic()
+        try:
+            chaoslib.wait_for(
+                lambda: bench._fed_running(g, "roamer", "ra"), 90,
+                "adopted cutover")
+            mttrs["kill_cutover"] = round(
+                time.monotonic() - t_kill, 3)
+        except AssertionError:
+            note("cutover_exactly_once",
+                 f"cutover never completed "
+                 f"({bench._fed_view(g, 'roamer')})")
+        try:
+            chaoslib.wait_for(
+                lambda: bench._fed_copy_regions(fleet, "roamer") ==
+                ["ra"], 60, "source residual reap")
+        except AssertionError:
+            note("cutover_exactly_once",
+                 f"roamer copies: "
+                 f"{bench._fed_copy_regions(fleet, 'roamer')}")
+        terms.append(fleet.router_term())
+        gj = g.vcjobs["default/roamer"]
+        if fedapi.migration_count(gj) != 1:
+            note("cutover_exactly_once",
+                 f"migrations={fedapi.migration_count(gj)} "
+                 f"(want exactly 1)")
+        racopy = fleet.clients["ra"].vcjobs.get("default/roamer")
+        rstep = int(racopy.annotations.get(
+            RESUME_STEP_ANNOTATION, 0) or 0) if racopy else -1
+        if rstep < acked:
+            note("acked_admissions_durable",
+                 f"cutover resume step {rstep} < acked {acked}")
+        fleet.spawn_router()
+
+        # -- SIGSTOP partition + fenced stale-term write -------------
+        sleep_until(plan["partition_at"])
+        chaoslib.wait_for(lambda: fleet.leaseholder() is not None,
+                          30, "leaseholder before the partition")
+        h2, stale_term = fleet.leaseholder(), fleet.router_term()
+        fleet.sigstop_router(h2)
+        t_stop = time.monotonic()
+        try:
+            chaoslib.wait_for(
+                lambda: fleet.leaseholder() not in (None, h2), 30,
+                "takeover from the partitioned holder")
+            mttrs["partition"] = round(time.monotonic() - t_stop, 3)
+        except AssertionError:
+            note("failover_mttr",
+                 "standby never took over from the SIGSTOP'd holder")
+        new_term = fleet.router_term()
+        terms.append(new_term)
+        rbc = fleet.clients["rb"]
+        try:
+            chaoslib.wait_for(
+                lambda: int(rbc.fences().get(
+                    fedapi.ROUTER_LEASE_NAME, {}).get("term", 0)
+                ) >= new_term, 30, "fence advance")
+        except AssertionError:
+            note("stale_fence_refused",
+                 f"fence floor never reached term {new_term}: "
+                 f"{rbc.fences()}")
+        fleet.sigcont_router(h2)
+        # the partitioned holder's write, replayed deterministically
+        # from the conductor with the deposed term
+        rbc.set_fence(fedapi.ROUTER_LEASE_NAME, stale_term)
+        try:
+            rbc.add_vcjob(bench._fed_job("stale-probe", 1))
+            note("stale_fence_refused",
+                 f"write stamped with deposed term {stale_term} "
+                 f"was ACCEPTED")
+        except ValueError as e:
+            if not str(e).startswith("fenced"):
+                note("stale_fence_refused",
+                     f"refused for the wrong reason: {e}")
+        finally:
+            rbc.set_fence("", 0)
+        fenced_count = int(rbc.fences().get(
+            fedapi.ROUTER_LEASE_NAME, {}).get("refused", 0) or 0)
+        if fenced_count < 1:
+            note("stale_fence_refused",
+                 f"refusal not counted on /fences: {rbc.fences()}")
+
+        # -- settle: run out the clock under a healthy leaseholder ---
+        sleep_until(duration)
+        if not bench._fed_running(g, "anchor", "ra"):
+            note("acked_admissions_durable",
+                 f"anchor left Running: {bench._fed_view(g, 'anchor')}")
+        for name, m in mttrs.items():
+            if m > ROUTER_MTTR_BOUND_S:
+                note("failover_mttr",
+                     f"{name} MTTR {m}s > bound "
+                     f"{ROUTER_MTTR_BOUND_S}s")
+        if dual:
+            note("no_dual_placement", f"{dual[:3]}")
+        if not all(a < b for a, b in zip(terms, terms[1:])):
+            note("stale_fence_refused",
+                 f"lease terms not strictly monotonic: {terms}")
+    finally:
+        stop.set()
+        sampler.join(timeout=2)
+        fleet.shutdown()
+    result = {"seed": seed, "duration_s": duration,
+              "classes": sorted(classes),
+              "windows": sched["windows"],
+              "routers_spawned": fleet._routers_spawned,
+              "lease_terms": terms,
+              "failover_mttr_s": mttrs,
+              "mttr_bound_s": ROUTER_MTTR_BOUND_S,
+              "acked_step_floor": floor,
+              "fenced_writes_counted": fenced_count,
+              "violations": violations, "ok": not violations}
+    print(f"REPRODUCE: python tools/chaos_conductor.py "
+          f"--seed {seed} --duration {duration:g} "
+          f"--classes {','.join(sorted(classes))}",
+          file=sys.stderr, flush=True)
+    return result
+
+
 def run_conductor(seed: int, duration: float,
                   classes=DEFAULT_CLASSES, logdir: str = "",
                   lock_audit: bool = False,
@@ -500,6 +777,10 @@ def run_conductor(seed: int, duration: float,
                   leader_groups: int = 1) -> dict:
     classes = set(classes.split(",")) if isinstance(classes, str) \
         else set(classes)
+    if "router" in classes:
+        # router replica-set failover runs on the federation fleet
+        # with router OS processes — its own scenario, like region
+        return run_router_failover(seed, duration, classes, logdir)
     if "region" in classes:
         # whole-region loss runs on a different topology entirely
         # (the federation fleet: 2 regions behind one global queue),
@@ -1691,7 +1972,7 @@ def main(argv=None) -> int:
     ap.add_argument("--duration", type=float, default=30.0)
     ap.add_argument("--classes", default=DEFAULT_CLASSES,
                     help="comma set of wire,disk,clock,slice,"
-                         "replication,serving,region")
+                         "replication,serving,region,router")
     ap.add_argument("--logdir", default="")
     ap.add_argument("--matrix", type=int, default=0,
                     help="run seeds 1..N and aggregate the "

@@ -41,6 +41,14 @@ catalog with provenance lives in docs/design/static-analysis.md):
                  contract of the process-pool sweep: worker behavior
                  comes from worker-side resolution, never from
                  shipped code.
+  fed-retry      in volcano_tpu/federation/ (except retry.py, which
+                 IS the policy), a retry loop may not sleep a fixed
+                 literal delay: every cross-region wait goes through
+                 federation.retry.backoff_delay (capped exponential,
+                 deterministic jitter — seeded chaos replays exactly)
+                 or the FedRPC breaker.  A fleet of routers/mirrors
+                 hot-looping a constant delay against a struggling
+                 region is a synchronized retry stampede.
 
 Suppressions: ``# vtplint: disable=<rule>[,<rule>] (<reason>)`` on the
 finding's line or the line above.  A suppression WITHOUT a
@@ -57,7 +65,8 @@ import re
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 RULES = ("req-id", "wall-clock", "metric-family", "metric-labels",
-         "append-lock", "except-pass", "process-ship-purity")
+         "append-lock", "except-pass", "process-ship-purity",
+         "fed-retry")
 
 SUPPRESS_RE = re.compile(
     r"#\s*vtplint:\s*disable=([a-z0-9*,_-]+)(?:\s*\(([^)]+)\))?")
@@ -79,6 +88,12 @@ APPEND_METHODS = frozenset({"append", "append_event", "append_shipped"})
 # pickler that refuses callables)
 SHIP_SEAMS = frozenset({"post", "post_bytes"})
 SHIP_SENDS = frozenset({"send", "send_bytes"})
+
+# fed-retry rule scope: the federation tier, minus the shared policy
+# module itself (its constants ARE the delays)
+FED_RETRY_DIR = "volcano_tpu/federation/"
+FED_RETRY_EXEMPT = ("federation/retry.py",)
+SLEEP_METHODS = frozenset({"sleep", "wait"})
 
 EMIT_METHODS = frozenset({"inc", "observe", "set_gauge"})
 READ_METHODS = frozenset({"get_gauge", "get_counter",
@@ -225,6 +240,9 @@ class Linter:
         in_scope_file = rel.endswith(WALL_CLOCK_FILES)
         append_scope = rel.endswith(APPEND_LOCK_FILES)
         is_metrics_impl = rel.endswith("volcano_tpu/metrics.py")
+        fed_scope = FED_RETRY_DIR in rel and \
+            not rel.endswith(FED_RETRY_EXEMPT)
+        fed_flagged: Set[int] = set()
         ship_scope = rel.endswith("actions/procpool.py") or any(
             (isinstance(n, ast.Import)
              and any(a.name.split(".")[0] == "multiprocessing"
@@ -258,6 +276,8 @@ class Linter:
                 yield from check_call(node)
             if isinstance(node, ast.Try):
                 yield from check_try(node)
+            if isinstance(node, (ast.While, ast.For)):
+                yield from check_retry_loop(node)
             for child in ast.iter_child_nodes(node):
                 yield from visit(child)
             if pushed_fn:
@@ -372,6 +392,34 @@ class Linter:
                         "metric-labels", rel, node.lineno,
                         f"label {kw.arg}={val!r} is outside the "
                         f"bounded enum for family {fam!r}")
+
+        def check_retry_loop(node: ast.AST) -> Iterator[Finding]:
+            # fed-retry: a loop that both handles exceptions AND
+            # sleeps a fixed literal delay is a bare retry loop —
+            # the wait must come from the shared backoff policy
+            if not fed_scope:
+                return
+            if not any(isinstance(n, ast.Try) for n in ast.walk(node)):
+                return
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call) or not sub.args:
+                    continue
+                attr = _attr_chain(sub.func).rsplit(".", 1)[-1]
+                if attr not in SLEEP_METHODS:
+                    continue
+                arg = sub.args[0]
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, (int, float)) and \
+                        sub.lineno not in fed_flagged:
+                    fed_flagged.add(sub.lineno)
+                    yield Finding(
+                        "fed-retry", rel, sub.lineno,
+                        f"bare retry loop: fixed {arg.value}s delay "
+                        f"in a federation retry path — use "
+                        f"federation.retry.backoff_delay (capped "
+                        f"exponential, deterministic jitter) or "
+                        f"route the call through FedRPC, so a fleet "
+                        f"of retriers never stampedes in lockstep")
 
         def check_try(node: ast.Try) -> Iterator[Finding]:
             if not _try_does_io(node):

@@ -105,6 +105,16 @@ REGION_TTL_S = 15.0
 # the migration cutover gate
 MIRROR_MAX_AGE_S = 30.0
 
+# -- router HA (leased, crash-adoptive replica set) ---------------------
+# N router processes compete for this term-fenced lease in the GLOBAL
+# store; only the holder mutates.  The same name doubles as the FENCE
+# name on every regional plane: a promoted router advances the
+# regional fence to its term before its first write, so the deposed
+# holder's in-flight cross-region RPCs are atomically refused (409) —
+# the cross-shard-spill refusal discipline applied to routers.
+ROUTER_LEASE_NAME = "federation-router"
+ROUTER_LEASE_TTL_S = 10.0
+
 
 def region_record(name: str, url: str, price: float = 1.0,
                   locality: str = "", mirror_url: str = "",

@@ -230,7 +230,23 @@ FAMILIES: Dict[str, str] = {
     "federation_source_reaps_total": "counter",
     "federation_mirror_records_total": "counter",
     "federation_mirror_resyncs_total": "counter",
+    "federation_mirror_delta_resyncs_total": "counter",
     "federation_mirror_refused_batches_total": "counter",
+    # router HA (federation/ha.py + federation/retry.py + the server
+    # fence): leadership + lease term, adoption passes, the shared
+    # cross-region RPC policy's failure/skip tallies, per-region
+    # breaker state (bounded closed|open|half-open code), serving QPS
+    # headroom folded into routing, and writes refused by the
+    # term fence — region names and lease names are operator config
+    "federation_router_is_leader": "gauge",
+    "federation_router_term": "gauge",
+    "federation_router_adoptions_total": "counter",
+    "federation_router_rpc_failures_total": "counter",
+    "federation_router_rpc_skipped_total": "counter",
+    "federation_router_breaker_opens_total": "counter",
+    "federation_router_breaker_state": "gauge",
+    "federation_region_serving_headroom": "gauge",
+    "fenced_writes_total": "counter",
 }
 
 # -- label schema (enforced by volcano_tpu/analysis + tests/test_lint) --
@@ -369,7 +385,18 @@ FAMILY_LABELS: Dict[str, Dict[str, object]] = {
     "federation_source_reaps_total": {"region": CONFIG},
     "federation_mirror_records_total": {"region": CONFIG},
     "federation_mirror_resyncs_total": {"region": CONFIG},
+    "federation_mirror_delta_resyncs_total": {"region": CONFIG},
     "federation_mirror_refused_batches_total": {"region": CONFIG},
+    # router HA: regions are registry config; `op` is the router's
+    # closed set of mutating RPC verbs (code, not workload); `fence`
+    # is a lease name (operator config, e.g. federation-router)
+    "federation_router_rpc_failures_total": {"region": CONFIG,
+                                             "op": CONFIG},
+    "federation_router_rpc_skipped_total": {"region": CONFIG},
+    "federation_router_breaker_opens_total": {"region": CONFIG},
+    "federation_router_breaker_state": {"region": CONFIG},
+    "federation_region_serving_headroom": {"region": CONFIG},
+    "fenced_writes_total": {"fence": CONFIG},
 }
 
 

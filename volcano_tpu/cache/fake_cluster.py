@@ -11,6 +11,7 @@ machines.  Thread-safe: the scheduler loop and controllers may share it.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from volcano_tpu.api.hypernode import HyperNode
@@ -60,6 +61,16 @@ class FakeCluster(Cluster):
         self.evictions: List[str] = []
         # admission chain applied on vcjob/queue create (webhooks)
         self.admission = admission
+        # leader-election lease CAS + fencing-token floors: the
+        # in-process analogue of StateServer.lease/advance_fence so
+        # elections (router HA, sharded schedulers) unit-test with
+        # zero wire.  lease_now is injectable: tests drive expiry
+        # with a fake clock instead of sleeping out real TTLs.
+        self.lease_now: Callable[[], float] = time.monotonic
+        self._fake_leases: Dict[str, list] = {}  # name->[holder,exp,term]
+        self._lease_terms: Dict[str, int] = {}
+        self._fences: Dict[str, int] = {}
+        self._fenced_counts: Dict[str, int] = {}
         # watchers notified on any mutation (controllers use this)
         self._watchers: List[Callable[[str, object], None]] = []
 
@@ -79,6 +90,11 @@ class FakeCluster(Cluster):
         from volcano_tpu.cache.kinds import KINDS
         self.__dict__.setdefault("commands", [])
         self.__dict__.setdefault("_run_progress", {})
+        self.__dict__.setdefault("lease_now", time.monotonic)
+        self.__dict__.setdefault("_fake_leases", {})
+        self.__dict__.setdefault("_lease_terms", {})
+        self.__dict__.setdefault("_fences", {})
+        self.__dict__.setdefault("_fenced_counts", {})
         for spec in KINDS.values():
             self.__dict__.setdefault(spec.attr, {})
 
@@ -713,6 +729,68 @@ class FakeCluster(Cluster):
 
     def record_event(self, obj_key: str, reason: str, message: str) -> None:
         self.events.append((obj_key, reason, message))
+
+    # -- leases + fencing tokens (StateServer.lease analogue) ----------
+
+    def lease(self, name: str, holder: str, ttl: float = 15.0,
+              release: bool = False, deadline=None) -> dict:
+        """Same CAS + term contract as StateServer.lease: the term
+        bumps on every acquisition that is not a live same-holder
+        renewal, and is never reissued.  deadline is accepted for
+        RemoteCluster signature parity (no wire here to bound)."""
+        now = self.lease_now()
+        with self._lock:
+            cur = self._fake_leases.get(name)
+            if release:
+                if cur and cur[0] == holder:
+                    del self._fake_leases[name]
+                return {"acquired": False, "holder": "", "expires": 0,
+                        "expires_in": 0,
+                        "term": self._lease_terms.get(name, 0)}
+            if cur is None or cur[1] < now or cur[0] == holder:
+                if cur is not None and cur[0] == holder and \
+                        cur[1] >= now:
+                    term = cur[2] or self._lease_terms.get(name, 0)
+                else:
+                    term = self._lease_terms.get(name, 0) + 1
+                    self._lease_terms[name] = term
+                self._fake_leases[name] = [holder, now + ttl, term]
+                return {"acquired": True, "holder": holder,
+                        "expires": now + ttl,
+                        "expires_in": round(ttl, 3), "term": term}
+            return {"acquired": False, "holder": cur[0],
+                    "expires": cur[1],
+                    "expires_in": round(cur[1] - now, 3),
+                    "term": cur[2]}
+
+    def leases(self) -> dict:
+        now = self.lease_now()
+        with self._lock:
+            return {name: {"holder": l[0],
+                           "expires_in": round(l[1] - now, 3),
+                           "term": l[2]}
+                    for name, l in self._fake_leases.items()}
+
+    def set_fence(self, name: str, term: int) -> None:
+        """Signature parity with RemoteCluster.set_fence.  In-process
+        stores don't enforce the fence on writes (no wire boundary to
+        refuse at) — enforcement is proven against real servers."""
+        self._fence = (name, int(term)) if name else None
+
+    def advance_fence(self, name: str, term: int,
+                      deadline=None) -> dict:
+        with self._lock:
+            cur = self._fences.get(name, 0)
+            if int(term) > cur:
+                self._fences[name] = cur = int(term)
+            return {"name": name, "term": cur,
+                    "refused": self._fenced_counts.get(name, 0)}
+
+    def fences(self) -> dict:
+        with self._lock:
+            return {name: {"term": t,
+                           "refused": self._fenced_counts.get(name, 0)}
+                    for name, t in sorted(self._fences.items())}
 
     # -- kubelet simulation -------------------------------------------
 

@@ -53,6 +53,10 @@ RETRY_DEADLINE_S = 30.0
 # sick server's recovery into a retry storm
 WATCH_RESYNC_BUDGET_S = 3.0
 
+# POST routes a client fence never stamps: lease CAS and fence
+# advances carry their terms explicitly, and traces are never fenced
+_UNFENCED_POSTS = frozenset({"/lease", "/fence", "/trace"})
+
 
 def _retry_sleep(delay: float, e: Exception, remain: float) -> float:
     """One backoff sleep under the shared policy: full jitter over the
@@ -130,6 +134,10 @@ class RemoteCluster(Cluster):
         self.timeout = timeout
         self.token = token
         self._retry_deadline = retry_deadline
+        # optional fencing token (set_fence): every mutating request
+        # carries it so the server refuses this client once a newer
+        # tenancy (higher term) has written — the deposed-router guard
+        self._fence: Optional[tuple] = None   # (name, term)
         from volcano_tpu.server.tlsutil import client_ssl_context
         self._ssl_ctx = client_ssl_context(ca_cert, insecure)
         self._mlock = threading.RLock()        # mirror + watchers
@@ -188,6 +196,11 @@ class RemoteCluster(Cluster):
             # _req records, so a retried write that already committed
             # gets its recorded verdict, never a double-apply
             payload = dict(payload, _req_id=uuid.uuid4().hex)
+        if self._fence is not None and method == "POST" and \
+                isinstance(payload, dict) and \
+                path.partition("?")[0] not in _UNFENCED_POSTS:
+            payload = dict(payload, _fence={
+                "name": self._fence[0], "term": self._fence[1]})
         budget = self._retry_deadline if deadline is None else deadline
         t_end = time.monotonic() + budget
         delay = RETRY_BASE_S
@@ -556,8 +569,12 @@ class RemoteCluster(Cluster):
 
     def delete_object(self, kind: str, key: str) -> None:
         from urllib.parse import quote
-        self._request("DELETE",
-                      f"/objects/{kind}?key={quote(key, safe='')}")
+        path = f"/objects/{kind}?key={quote(key, safe='')}"
+        if self._fence is not None:
+            # DELETE has no body: the fence rides as query params
+            path += (f"&fence_name={quote(self._fence[0], safe='')}"
+                     f"&fence_term={self._fence[1]}")
+        self._request("DELETE", path)
         spec = KINDS[kind]
         with self._mlock:
             obj = getattr(self, spec.attr).pop(key, None)
@@ -757,3 +774,30 @@ class RemoteCluster(Cluster):
         return self._request("POST", "/lease", {
             "name": name, "holder": holder, "ttl": ttl,
             "release": release}, deadline=deadline)
+
+    def leases(self) -> dict:
+        """{name: {holder, expires_in, term}} — the election surface
+        `vtpctl routers` and the chaos conductor render."""
+        return self._request("GET", "/leases")
+
+    # -- fencing tokens ------------------------------------------------
+
+    def set_fence(self, name: str, term: int) -> None:
+        """Stamp every subsequent mutation with (name, term): once a
+        newer term has written to the server, this client's writes are
+        atomically refused (409) — the deposed-holder guard.  name=""
+        clears the fence."""
+        self._fence = (name, int(term)) if name else None
+
+    def advance_fence(self, name: str, term: int,
+                      deadline: Optional[float] = None) -> dict:
+        """Raise the server's fence floor explicitly (a promoted
+        holder calls this on every plane BEFORE its first write, so
+        the predecessor's in-flight writes are already refusable)."""
+        # vtplint: disable=req-id (fence advance is monotonic max(): any replay converges)
+        return self._request("POST", "/fence", {
+            "name": name, "term": int(term)}, deadline=deadline)
+
+    def fences(self) -> dict:
+        """{name: {term, refused}} — fence floors + refusal counts."""
+        return self._request("GET", "/fences")

@@ -1174,6 +1174,56 @@ def cmd_regions(cluster, args):
                         "CAP-CHIPS", "IDLE-CHIPS", "HEARTBEAT"]))
 
 
+def cmd_routers(cluster, args):
+    """Router replica-set status from the GLOBAL store: who holds the
+    term-fenced `federation-router` lease (and at what term), plus —
+    per region — the leaseholder's circuit-breaker verdict folded
+    into the registry record and, when the regional plane is
+    reachable, its fence floor and refused-write count (the deposed-
+    router evidence trail)."""
+    from volcano_tpu.api import federation as fedapi
+
+    lease = {}
+    try:
+        lease = (cluster.leases() or {}).get(
+            fedapi.ROUTER_LEASE_NAME) or {}
+    except (AttributeError, OSError, ValueError):
+        pass                    # state-file mode: no lease surface
+    expires = float(lease.get("expires_in", 0) or 0)
+    if lease and expires > 0:
+        print(f"leaseholder: {lease.get('holder')}  "
+              f"term {lease.get('term', 0)}  "
+              f"(expires in {expires:.1f}s)")
+    else:
+        print("leaseholder: NONE (lease vacant — regions run "
+              "autonomously, global admission queues)")
+    rows = []
+    for name, rec in sorted(cluster.regions.items()):
+        fence = refused = "-"
+        url = rec.get("url", "")
+        if url:
+            try:
+                from volcano_tpu.cache.remote_cluster import \
+                    RemoteCluster
+                rc = RemoteCluster(url, retry_deadline=2.0)
+                try:
+                    f = (rc.fences() or {}).get(
+                        fedapi.ROUTER_LEASE_NAME) or {}
+                    fence = str(f.get("term", 0))
+                    refused = str(f.get("refused", 0))
+                finally:
+                    rc.close()
+            except (OSError, ValueError):
+                fence = refused = "unreachable"
+        rows.append([
+            name, rec.get("state", "?"),
+            rec.get("router_breaker", "-"),
+            fence, refused,
+        ])
+    print(_table(rows, ["REGION", "STATE", "BREAKER", "FENCE-TERM",
+                        "FENCED-WRITES"]))
+
+
 def cmd_federate(cluster, args):
     """Federated fleet view from the GLOBAL store alone: every global
     job with its admitted region, router-folded regional phase and
@@ -1287,9 +1337,10 @@ def cmd_server(cluster, args):
     if leases:
         print()
         print(_table(
-            [[n, l["holder"], f"{l['expires_in']:.1f}s"]
+            [[n, l["holder"], str(l.get("term", 0)),
+              f"{l['expires_in']:.1f}s"]
              for n, l in sorted(leases.items())],
-            ["LEASE", "HOLDER", "EXPIRES-IN"]))
+            ["LEASE", "HOLDER", "TERM", "EXPIRES-IN"]))
 
 
 def cmd_tick(cluster, args):
@@ -1560,6 +1611,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remove", default="",
                    help="deregister a region by name")
     p.set_defaults(fn=cmd_regions)
+
+    p = sub.add_parser("routers", help="router replica set: lease "
+                       "term + holder, per-region breaker state and "
+                       "fence floors")
+    p.set_defaults(fn=cmd_routers)
 
     p = sub.add_parser("federate", help="federated fleet view; "
                        "cross-region migration and region drain")

@@ -81,6 +81,7 @@ class RegionMirror:
         self._fresh_ts: Optional[float] = None
         self._bootstrapped = False
         self.resyncs = 0
+        self.delta_resyncs = 0
         self.refused_batches = 0
         self._lock = threading.RLock()
         self._stop = threading.Event()
@@ -125,8 +126,14 @@ class RegionMirror:
             f"/wal?mirror=1&since_seq={self.applied_seq}"
             f"&timeout={timeout:g}", timeout=timeout + 10.0)
         if resp.get("resync"):
-            # fell off the source's ship ring (compaction / heal /
-            # epoch reset): only a fresh snapshot recovers
+            # fell off the source's ship ring (compaction, a restart
+            # emptying the volatile ring, a heal).  A same-lineage
+            # mirror first tries the DELTA lane — the events since
+            # its rv, O(churn missed) instead of O(store); the full
+            # snapshot bootstrap is the fallback for true lineage
+            # breaks (epoch base change / rv fell off the event ring)
+            if self._delta_resync(resp):
+                return 0
             self._bootstrapped = False
             self.bootstrap()
             return 0
@@ -135,6 +142,63 @@ class RegionMirror:
             self._fresh_ts = self._now()
             self.epoch = resp.get("epoch", self.epoch)
         return applied
+
+    def _same_lineage(self, epoch: str) -> bool:
+        """Epochs are BASE.BOOT: the BASE survives durable restarts
+        (same store, new boot), so a delta catch-up across a restart
+        is sound; a BASE change means a different history — only a
+        full bootstrap is safe."""
+        return bool(self.epoch) and bool(epoch) and \
+            self.epoch.split(".")[0] == epoch.split(".")[0]
+
+    def _delta_resync(self, ship_resp: dict) -> bool:
+        """Incremental re-sync off the source's /watch delta lane:
+        ask for the events since our applied rv (timeout=0 returns
+        immediately), fold them in, and re-align the WAL cursor to
+        the seq horizon the ship response advertised.  Returns False
+        — caller falls back to the full snapshot bootstrap — when the
+        lineage broke or our rv fell off the source's event ring."""
+        if self.applied_rv <= 0 or \
+                not self._same_lineage(ship_resp.get("epoch", "")):
+            return False
+        try:
+            resp = self._get(f"/watch?since={self.applied_rv}"
+                             f"&timeout=0", timeout=10.0)
+        except (OSError, ValueError) as e:
+            log.debug("mirror[%s]: delta resync probe failed: %s",
+                      self.name, e)
+            return False
+        if resp.get("resync") or \
+                not self._same_lineage(resp.get("epoch", "")):
+            return False
+        from volcano_tpu.server.durability import apply_event_obj
+        events = resp.get("events") or []
+        rv = int(resp.get("rv", 0))
+        with self._lock:
+            for ev in events:
+                apply_event_obj(self.cluster, ev.get("kind", ""),
+                                codec.decode(ev["obj"]))
+            self.applied_rv = max(self.applied_rv, rv)
+            # bootstrap-equivalent dedup point: the next shipped
+            # batches may overlap records already inside this delta —
+            # the erv <= _snapshot_rv guard in _apply skips them
+            self._snapshot_rv = self.applied_rv
+            # seq horizon captured BEFORE the delta fetch: every
+            # object record at or below it has rv <= the delta's rv
+            # (WAL order == rv order), so nothing between the two
+            # cursors can be missed
+            self.applied_seq = int(ship_resp.get("last_seq",
+                                                 self.applied_seq))
+            self.epoch = resp.get("epoch", self.epoch)
+            self._fresh_ts = self._now()
+        self.resyncs += 1
+        self.delta_resyncs += 1
+        metrics.inc("federation_mirror_delta_resyncs_total",
+                    region=self.name)
+        log.info("mirror[%s]: delta resync applied %d events -> "
+                 "rv=%d seq=%d", self.name, len(events),
+                 self.applied_rv, self.applied_seq)
+        return True
 
     def _apply(self, lines) -> int:
         """Fold one shipped batch: verify EVERY record's CRC and
@@ -216,6 +280,7 @@ class RegionMirror:
                 "age_s": (None if age == float("inf")
                           else round(age, 3)),
                 "resyncs": self.resyncs,
+                "delta_resyncs": self.delta_resyncs,
                 "refused_batches": self.refused_batches}
 
     # -- background tail -----------------------------------------------
@@ -226,23 +291,35 @@ class RegionMirror:
         self._stop.clear()
 
         def _loop():
+            from volcano_tpu.federation.retry import backoff_delay
             from volcano_tpu.server.replication import \
                 ShippedCorruptionError
-            backoff = 0.2
+            failures = 0
             while not self._stop.is_set():
                 try:
                     self.poll(timeout=poll_s)
-                    backoff = 0.2
+                    failures = 0
                 except ShippedCorruptionError as e:
                     # refuse-and-re-request: the durable source serves
-                    # the same records again, clean
+                    # the same records again, clean — but a source
+                    # that KEEPS shipping corrupt batches backs off
+                    # like any other failure
+                    failures += 1
                     log.warning("%s (re-requesting)", e)
-                    self._stop.wait(backoff)
+                    self._stop.wait(backoff_delay(
+                        failures, f"mirror:{self.name}",
+                        base=0.2, cap=5.0))
                 except (OSError, ValueError) as e:
-                    log.debug("mirror[%s]: poll failed: %s",
-                              self.name, e)
-                    self._stop.wait(backoff)
-                    backoff = min(backoff * 2, 5.0)
+                    # the shared federation backoff policy (capped
+                    # exponential, deterministic jitter) — age_s keeps
+                    # growing truthfully while the source is away
+                    failures += 1
+                    delay = backoff_delay(
+                        failures, f"mirror:{self.name}",
+                        base=0.2, cap=5.0)
+                    log.debug("mirror[%s]: poll failed: %s (retry "
+                              "in %.1fs)", self.name, e, delay)
+                    self._stop.wait(delay)
 
         self._thread = threading.Thread(
             target=_loop, name=f"mirror-{self.name}", daemon=True)

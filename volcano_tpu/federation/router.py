@@ -69,7 +69,11 @@ from volcano_tpu.api.resource import TPU
 from volcano_tpu.api.types import (GROUP_NAME_ANNOTATION, JobPhase,
                                    PodGroupPhase)
 from volcano_tpu.api.vcjob import VCJob
+from volcano_tpu.federation.ha import RouterElector
 from volcano_tpu.federation.mirror import MirrorStaleError, RegionMirror
+from volcano_tpu.federation.retry import (FED_RPC_DEADLINE_S, STATE_CODES,
+                                          FedRPC, FedRPCError,
+                                          RouterFencedError)
 
 log = logging.getLogger(__name__)
 
@@ -77,6 +81,12 @@ log = logging.getLogger(__name__)
 GOODPUT_ALPHA = 0.3
 # score boost for a region named in the job's data-locality list
 LOCALITY_BOOST = 2.0
+# serving-aware placement: a SERVING gang's goodput term scales with
+# the destination region's measured QPS headroom (folded from the
+# serving autoscaler's podgroup stats through the mirror), floored so
+# a saturated region is dispreferred, not blacklisted — it may still
+# be the only one fitting the gang
+SERVING_HEADROOM_FLOOR = 0.25
 # resume/progress annotations folded regional -> global every pass,
 # so a region loss never loses acked training progress
 _FOLD_KEYS = ()     # filled below (import-cycle-free)
@@ -106,15 +116,25 @@ def job_chips(job: VCJob) -> float:
 
 
 class RegionHandle:
-    """One attached region: registry record + write client + mirror."""
+    """One attached region: registry record + write client + mirror.
 
-    __slots__ = ("name", "record", "client", "mirror")
+    ``attached_ts`` anchors the mirror WARMUP grace: a router that
+    just attached this handle (fresh process after a failover, or a
+    region that just registered) has a mirror that hasn't completed
+    its first poll, and heartbeat_ts in the registry is whatever the
+    PREVIOUS leaseholder last wrote — both stale by construction, not
+    by region death.  Liveness verdicts are deferred until the handle
+    is older than the region ttl."""
 
-    def __init__(self, name: str, record: dict, client, mirror):
+    __slots__ = ("name", "record", "client", "mirror", "attached_ts")
+
+    def __init__(self, name: str, record: dict, client, mirror,
+                 attached_ts: float = 0.0):
         self.name = name
         self.record = record
         self.client = client
         self.mirror = mirror
+        self.attached_ts = attached_ts
 
 
 class FederationRouter:
@@ -130,12 +150,16 @@ class FederationRouter:
                  client_factory=None, mirror_factory=None,
                  ttl: float = fedapi.REGION_TTL_S,
                  arbitrage_after: float = fedapi.ARBITRAGE_PENDING_S,
-                 start_mirrors: bool = True):
+                 start_mirrors: bool = True, holder: str = "",
+                 elect: bool = False,
+                 lease_ttl: float = fedapi.ROUTER_LEASE_TTL_S,
+                 mirror_poll_s: Optional[float] = None):
         self.cluster = global_cluster
         self.now = now
         self.ttl = ttl
         self.arbitrage_after = arbitrage_after
         self._start_mirrors = start_mirrors
+        self._mirror_poll_s = mirror_poll_s
         self._client_factory = client_factory or self._default_client
         self._mirror_factory = mirror_factory or self._default_mirror
         self.handles: Dict[str, RegionHandle] = {}
@@ -146,21 +170,38 @@ class FederationRouter:
         # in-flight evacuation start ts (timing only; the durable
         # episode state is the evacuating-to annotation)
         self._evac_started: Dict[str, float] = {}
+        # measured serving QPS headroom per region, [0, 1]
+        self._serving_headroom: Dict[str, float] = {}
+        # the ONE cross-region RPC policy: per-region breaker +
+        # deterministic backoff + fence classification
+        self.rpc = FedRPC()
+        # leased replica-set mode: contend for the router lease; only
+        # the holder mutates.  elect=False keeps the legacy embedded
+        # single-router behavior (in-process tests, one-router bench).
+        self.elector: Optional[RouterElector] = RouterElector(
+            global_cluster, holder, ttl=lease_ttl) if elect else None
 
     # -- region attachment ---------------------------------------------
 
     @staticmethod
     def _default_client(rec: dict):
         from volcano_tpu.cache.remote_cluster import RemoteCluster
+        # bounded per-call budget: a dead region costs a slice of one
+        # reconcile pass (then its breaker takes over), not the wire
+        # client's default 30s deadline
         return RemoteCluster(rec["url"], token=rec.get("token", ""),
-                             tolerate_unreachable=True)
+                             tolerate_unreachable=True,
+                             retry_deadline=FED_RPC_DEADLINE_S)
 
     def _default_mirror(self, rec: dict):
         m = RegionMirror(rec["name"],
                          rec.get("mirror_url") or rec["url"],
                          token=rec.get("token", ""))
         if self._start_mirrors:
-            m.start()
+            if self._mirror_poll_s is not None:
+                m.start(poll_s=self._mirror_poll_s)
+            else:
+                m.start()
         return m
 
     def attach_region(self, record: dict, client=None, mirror=None) -> None:
@@ -168,7 +209,8 @@ class FederationRouter:
         name = record["name"]
         h = RegionHandle(name, record,
                          client or self._client_factory(record),
-                         mirror or self._mirror_factory(record))
+                         mirror or self._mirror_factory(record),
+                         attached_ts=self.now())
         self.handles[name] = h
         self.cluster.put_object("region", dict(record), key=name)
 
@@ -177,21 +219,91 @@ class FederationRouter:
             stop = getattr(h.mirror, "stop", None)
             if stop:
                 stop()
+        if self.elector is not None:
+            self.elector.release()
 
     # -- reconcile ------------------------------------------------------
 
     def sync(self) -> None:
         now = self.now()
-        self._refresh_regions(now)
+        leading = True
+        if self.elector is not None:
+            leading = self.elector.renew()
+            metrics.set_gauge("federation_router_is_leader",
+                              1.0 if leading else 0.0)
+            metrics.set_gauge("federation_router_term",
+                              float(self.elector.term))
+        # standby (or lease-less) routers OBSERVE ONLY: keep handles
+        # attached, mirrors warm and goodput learning so adoption is
+        # instant — but never write.  With no leaseholder anywhere,
+        # regions run autonomously and the global queue accumulates.
+        self._refresh_regions(now, mutate=leading)
         self._observe_goodput(now)
-        self._fold_and_requeue(now)
-        self._reap_migrated_residuals(now)
-        self._evacuations(now)
-        self._arbitrage(now)
-        self._admit(now)
+        if leading:
+            if self.elector is not None and \
+                    self.elector.take_promotion():
+                self._adopt(now)
+            try:
+                self._fold_and_requeue(now)
+                self._reap_migrated_residuals(now)
+                self._evacuations(now)
+                self._arbitrage(now)
+                self._admit(now)
+            except RouterFencedError as e:
+                # a regional plane refused our term as stale: a newer
+                # router exists.  Stop mutating mid-pass and
+                # re-contend — never retry a fenced write.
+                log.warning("%s", e)
+                if self.elector is not None:
+                    self.elector.step_down()
         self._gauges()
 
-    def _refresh_regions(self, now: float) -> None:
+    # -- adoption (first pass after winning a term) ---------------------
+
+    def _adopt(self, now: float) -> None:
+        """Make the new term safe, then resume in-flight work.  Fence
+        first: advancing every region's floor to our term atomically
+        refuses the deposed router's stragglers.  The reconstruction
+        itself is the ordinary reconcile pass — the deterministic
+        admission key re-finds half-landed creates, the evacuating-to
+        annotation re-drives half-done cutovers (create-then-delete,
+        idempotent), and _find_admitted_copy guarantees a gang never
+        lands twice.  Only the process-local evacuation TIMING needs
+        re-seeding here."""
+        term = self.elector.term
+        for h in list(self.handles.values()):
+            self._fence_region(h, term)
+        for job in self._global_jobs():
+            if job.annotations.get(
+                    fedapi.FED_EVACUATING_TO_ANNOTATION) and \
+                    job.key not in self._evac_started:
+                self._evac_started[job.key] = now
+        metrics.inc("federation_router_adoptions_total")
+        self.cluster.record_event(
+            "federation-router", "RouterPromoted",
+            f"{self.elector.holder} adopted term {term} "
+            f"({len(self.handles)} regions fenced)")
+
+    def _fence_region(self, h: RegionHandle, term: int) -> None:
+        """Stamp our (name, term) on every future write to this
+        region and push its fence floor up-front.  The push is
+        best-effort: check_fence self-advances on a HIGHER term, so
+        even if it fails here, our first stamped write raises the
+        floor — and the old router is refused from that moment."""
+        set_fence = getattr(h.client, "set_fence", None)
+        if set_fence is not None:
+            set_fence(fedapi.ROUTER_LEASE_NAME, term)
+        adv = getattr(h.client, "advance_fence", None)
+        if adv is None:
+            return
+        try:
+            self.rpc.call(h.name, "advance_fence",
+                          lambda: adv(fedapi.ROUTER_LEASE_NAME, term))
+        except FedRPCError as e:
+            log.warning("fence advance on %s deferred to first "
+                        "write: %s", h.name, e)
+
+    def _refresh_regions(self, now: float, mutate: bool = True) -> None:
         """Fold mirror liveness + capacity into the registry records
         (persisted to the global store so `vtpctl regions` renders the
         fleet from one place)."""
@@ -199,9 +311,13 @@ class FederationRouter:
             if name not in self.handles:
                 # registry entry with no handle yet (submitted via
                 # vtpctl / another router instance): attach lazily
-                self.handles[name] = RegionHandle(
+                h = self.handles[name] = RegionHandle(
                     name, dict(rec), self._client_factory(rec),
-                    self._mirror_factory(rec))
+                    self._mirror_factory(rec), attached_ts=now)
+                if self.elector is not None and self.elector.is_leader:
+                    # regions joining under a live term get fenced on
+                    # arrival, not at the next promotion
+                    self._fence_region(h, self.elector.term)
         for name in [n for n in self.handles
                      if n not in self.cluster.regions]:
             h = self.handles.pop(name)
@@ -224,19 +340,33 @@ class FederationRouter:
                                    rec.get("idle_chips")):
                     rec["capacity_chips"], rec["idle_chips"] = cap, idle
                 changed = True
-            elif not fedapi.region_alive(rec, now, self.ttl) and \
+            elif now - h.attached_ts > self.ttl and \
+                    not fedapi.region_alive(rec, now, self.ttl) and \
                     rec.get("state") != fedapi.REGION_STATE_LOST:
+                # warmup grace: only a handle OLDER than ttl whose
+                # mirror still can't reach the region is a loss — a
+                # freshly promoted router must not declare regions
+                # dead off heartbeats its dead predecessor stopped
+                # writing
                 rec["state"] = fedapi.REGION_STATE_LOST
                 changed = True
                 log.warning("region %s lost (mirror %.1fs stale)",
                             h.name, age)
-                self.cluster.record_event(
-                    f"region/{h.name}", "RegionLost",
-                    f"no heartbeat for {age:.1f}s; requeueing its "
-                    f"gangs globally")
+                if mutate:
+                    self.cluster.record_event(
+                        f"region/{h.name}", "RegionLost",
+                        f"no heartbeat for {age:.1f}s; requeueing its "
+                        f"gangs globally")
+            # fold the breaker state into the registry record so
+            # `vtpctl routers` renders write-path health fleet-wide
+            breaker = self.rpc.state(h.name)
+            if rec.get("router_breaker") != breaker:
+                rec["router_breaker"] = breaker
+                changed = True
             if changed:
                 h.record = rec
-                self.cluster.put_object("region", rec, key=h.name)
+                if mutate:
+                    self.cluster.put_object("region", rec, key=h.name)
                 metrics.set_gauge("federation_region_capacity_chips",
                                   float(rec.get("capacity_chips", 0)),
                                   region=h.name)
@@ -307,18 +437,57 @@ class FederationRouter:
                     self._goodput[key], region=h.name)
         for jk in [k for k in self._progress if k not in live]:
             del self._progress[jk]
+        for h in self.handles.values():
+            self._serving_headroom[h.name] = \
+                self._region_serving_headroom(h)
+            metrics.set_gauge("federation_region_serving_headroom",
+                              self._serving_headroom[h.name],
+                              region=h.name)
 
-    def _goodput_factor(self, h: RegionHandle) -> float:
+    def _region_serving_headroom(self, h: RegionHandle) -> float:
+        """Measured serving QPS headroom in [0, 1]: how much of the
+        region's declared serving capacity (target QPS/replica x
+        reporting replicas, from the autoscaler's folded podgroup
+        stats) is still unused.  1.0 when the region hosts no serving
+        replica groups — training-only regions stay neutral."""
+        from volcano_tpu.api import serving as sapi
+        qps = target = 0.0
+        for pg in h.mirror.cluster.podgroups.values():
+            if not sapi.is_serving(pg):
+                continue
+            qps += sapi.ann_float(pg, sapi.PG_QPS_ANNOTATION)
+            per = sapi.target_qps_per_replica(pg)
+            reps = sapi.ann_float(pg, sapi.PG_REPLICAS_ANNOTATION)
+            target += per * max(1.0, reps)
+        if target <= 0:
+            return 1.0
+        return max(0.0, min(1.0, (target - qps) / target))
+
+    def _goodput_factor(self, h: RegionHandle,
+                        job: Optional[VCJob] = None) -> float:
         """This region's learned rate relative to the fleet mean —
-        1.0 until anything has been learned (cold start is neutral)."""
+        1.0 until anything has been learned (cold start is neutral).
+        For a SERVING gang the term additionally scales with the
+        region's measured QPS headroom: a region whose serving fleet
+        is already at its target QPS makes a poor home for one more
+        replica group, whatever its training goodput says."""
         if not self._goodput:
-            return 1.0
-        gen = self._region_generation(h)
-        mine = self._goodput.get((h.name, gen))
-        if mine is None:
-            return 1.0
-        mean = sum(self._goodput.values()) / len(self._goodput)
-        return mine / mean if mean > 0 else 1.0
+            base = 1.0
+        else:
+            gen = self._region_generation(h)
+            mine = self._goodput.get((h.name, gen))
+            if mine is None:
+                base = 1.0
+            else:
+                mean = sum(self._goodput.values()) / len(self._goodput)
+                base = mine / mean if mean > 0 else 1.0
+        if job is not None:
+            from volcano_tpu.api import serving as sapi
+            if sapi.is_serving(job):
+                head = self._serving_headroom.get(h.name, 1.0)
+                base *= SERVING_HEADROOM_FLOOR + \
+                    (1.0 - SERVING_HEADROOM_FLOOR) * head
+        return base
 
     # -- admission ------------------------------------------------------
 
@@ -331,6 +500,10 @@ class FederationRouter:
         rec = self.cluster.regions.get(h.name, h.record)
         if not fedapi.region_ready(rec, self.now(), self.ttl):
             return 0.0
+        if not self.rpc.available(h.name):
+            # breaker open: we can SEE the region (mirror) but cannot
+            # WRITE to it — placing there would strand the admission
+            return 0.0
         idle = float(rec.get("idle_chips", 0) or 0)
         cap = float(rec.get("capacity_chips", 0) or 0)
         if need > 0 and cap < need:
@@ -342,7 +515,7 @@ class FederationRouter:
         price = max(1e-9, float(rec.get("price", 1.0) or 1.0))
         locality = LOCALITY_BOOST if h.name in \
             fedapi.data_locality(job) else 1.0
-        return locality * self._goodput_factor(h) * fit / price
+        return locality * self._goodput_factor(h, job) * fit / price
 
     def _pick_region(self, job: VCJob, exclude=() ) -> Optional[str]:
         need = job_chips(job)
@@ -429,10 +602,10 @@ class FederationRouter:
             h = self.handles[region]
             copy = self._regional_copy(job, region, key)
             try:
-                h.client.add_vcjob(copy)
-            except OSError as e:
-                log.warning("admission of %s to %s failed on the "
-                            "wire: %s", job.key, region, e)
+                self.rpc.call(region, "add_vcjob",
+                              lambda: h.client.add_vcjob(copy))
+            except FedRPCError as e:
+                log.warning("admission of %s failed: %s", job.key, e)
                 continue
             self._stamp_admitted(job, region, key, now)
             self.cluster.record_event(
@@ -459,8 +632,12 @@ class FederationRouter:
             h = self.handles.get(region)
             rec = self.cluster.regions.get(region,
                                            h.record if h else None)
-            if h is None or not fedapi.region_alive(rec, now,
-                                                    self.ttl):
+            # requeue rides the EXPLICIT lost transition made by
+            # _refresh_regions (which owns the mirror-warmup grace) —
+            # raw heartbeat staleness alone is ambiguous right after
+            # a router failover
+            if h is None or rec is None or \
+                    rec.get("state") == fedapi.REGION_STATE_LOST:
                 self._requeue(job, region, "region lost")
                 continue
             copy = self._copy_of(h, job.key)
@@ -546,10 +723,11 @@ class FederationRouter:
             if better is None:
                 continue
             try:
-                h.client.delete_vcjob(job.key)
-            except OSError as e:
-                log.warning("arbitrage delete of %s in %s failed: %s",
-                            job.key, region, e)
+                self.rpc.call(region, "delete_vcjob",
+                              lambda: h.client.delete_vcjob(job.key))
+            except FedRPCError as e:
+                log.warning("arbitrage delete of %s failed: %s",
+                            job.key, e)
                 continue
             n = fedapi.migration_count(job) + 1
             job.annotations[fedapi.FED_MIGRATIONS_ANNOTATION] = str(n)
@@ -609,10 +787,10 @@ class FederationRouter:
             eapi.RESIZE_EVACUATE
         ann[eapi.ELASTIC_DECIDED_TS_ANNOTATION] = f"{now:.3f}"
         try:
-            h.client.update_podgroup_status(pg)
-        except OSError as e:
-            log.warning("evacuate stamp on %s in %s failed: %s",
-                        job.key, src, e)
+            self.rpc.call(src, "update_podgroup_status",
+                          lambda: h.client.update_podgroup_status(pg))
+        except FedRPCError as e:
+            log.warning("evacuate stamp on %s failed: %s", job.key, e)
             return
         job.annotations[fedapi.FED_EVACUATING_TO_ANNOTATION] = dest
         self.cluster.update_vcjob(job)
@@ -649,16 +827,18 @@ class FederationRouter:
             if c.vcjobs.get(job.key) is None and \
                     c.podgroups.get(job.key) is None and not victims:
                 continue
-            try:
-                if c.vcjobs.get(job.key) is not None:
-                    h.client.delete_vcjob(job.key)
-                if c.podgroups.get(job.key) is not None:
-                    h.client.delete_podgroup(job.key)
+            def _reap(c=c, h=h, key=job.key, victims=victims):
+                if c.vcjobs.get(key) is not None:
+                    h.client.delete_vcjob(key)
+                if c.podgroups.get(key) is not None:
+                    h.client.delete_podgroup(key)
                 for pkey in victims:
                     h.client.delete_pod(pkey)
-            except OSError as e:
-                log.warning("residual reap of %s in %s failed "
-                            "(will retry): %s", job.key, src, e)
+            try:
+                self.rpc.call(src, "reap_residuals", _reap)
+            except FedRPCError as e:
+                log.warning("residual reap of %s failed (next pass "
+                            "retries): %s", job.key, e)
                 continue
             metrics.inc("federation_source_reaps_total", region=src)
             log.info("reaped migration residue of %s in %s "
@@ -707,18 +887,20 @@ class FederationRouter:
             dcopy.annotations.pop(eapi.ELASTIC_EVACUATED_ANNOTATION,
                                   None)
             try:
-                dh.client.add_vcjob(dcopy)
-            except OSError as e:
-                log.warning("cutover create of %s in %s failed: %s",
-                            job.key, dest, e)
+                self.rpc.call(dest, "add_vcjob",
+                              lambda: dh.client.add_vcjob(dcopy))
+            except FedRPCError as e:
+                log.warning("cutover create of %s failed: %s",
+                            job.key, e)
                 return
         # destination accepted: the source copy (and its held pods)
         # can go — ORDER MATTERS, delete only after the create landed
         try:
-            h.client.delete_vcjob(job.key)
-        except OSError as e:
-            log.warning("source delete of %s in %s failed "
-                        "(will retry): %s", job.key, src, e)
+            self.rpc.call(src, "delete_vcjob",
+                          lambda: h.client.delete_vcjob(job.key))
+        except FedRPCError as e:
+            log.warning("source delete of %s failed (residual reap "
+                        "retries): %s", job.key, e)
         ann = job.annotations
         n = fedapi.migration_count(job) + 1
         ann[fedapi.FED_MIGRATIONS_ANNOTATION] = str(n)
@@ -759,6 +941,9 @@ class FederationRouter:
                       if fedapi.admitted_region(j) is None
                       and j.phase is JobPhase.PENDING)
         metrics.set_gauge("federation_pending_jobs", pending)
+        for region, b in self.rpc.breakers.items():
+            metrics.set_gauge("federation_router_breaker_state",
+                              STATE_CODES[b.state], region=region)
 
 
 def main(argv=None) -> int:
@@ -779,6 +964,19 @@ def main(argv=None) -> int:
     ap.add_argument("--arbitrage-s", type=float,
                     default=fedapi.ARBITRAGE_PENDING_S)
     ap.add_argument("--metrics-port", type=int, default=0)
+    ap.add_argument("--holder", default="",
+                    help="router lease identity (default: "
+                         "router-<pid>); N processes with distinct "
+                         "holders form the HA replica set")
+    ap.add_argument("--lease-ttl-s", type=float,
+                    default=fedapi.ROUTER_LEASE_TTL_S,
+                    help="router lease TTL (bounds failover MTTR)")
+    ap.add_argument("--no-elect", action="store_true",
+                    help="legacy single-router mode: mutate without "
+                         "holding the lease (NO fencing)")
+    ap.add_argument("--mirror-poll-s", type=float, default=0.0,
+                    help="mirror tail long-poll ceiling (bench planes "
+                         "compress it below the region TTL)")
     args = ap.parse_args(argv)
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
@@ -786,8 +984,14 @@ def main(argv=None) -> int:
                             tolerate_unreachable=True)
     if args.metrics_port:
         metrics.serve(args.metrics_port)
+    import os
     router = FederationRouter(cluster, ttl=args.ttl_s,
-                              arbitrage_after=args.arbitrage_s)
+                              arbitrage_after=args.arbitrage_s,
+                              holder=args.holder or
+                              f"router-{os.getpid()}",
+                              elect=not args.no_elect,
+                              lease_ttl=args.lease_ttl_s,
+                              mirror_poll_s=args.mirror_poll_s or None)
     try:
         while True:
             try:

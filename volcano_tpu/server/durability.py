@@ -47,7 +47,8 @@ duplicated or gapped record stream after an operator copy-restore
 Record kinds besides store events (only those carry rv — they are the
 watch stream; private records replay in file order):
 
-    {"k": "_lease", "o": {name, holder, expires_wall}} lease CAS
+    {"k": "_lease", "o": {name, holder, expires_wall, term}} lease CAS
+    {"k": "_fence", "o": {"name":.., "term":..}}       fence floor raise
     {"k": "_drain", "o": {"target": key}}              command drain
     {"k": "_req",  "o": {"id":..,"code":..,"resp":..}} idempotency key
     {"k": "_probe"}                                    heal probe
@@ -139,6 +140,13 @@ class Recovery(NamedTuple):
     epoch: str                     # bumped incarnation id "BASE.BOOT"
     replay_records: int
     replay_seconds: float
+    # per-name MONOTONIC lease term counters (fencing tokens): survive
+    # lease expiry/release — a term, once issued, is never reissued,
+    # even across a reboot (or a deposed holder could fence as current)
+    lease_terms: Dict[str, int] = {}
+    # per-name fence floors: the highest term whose writes were ever
+    # accepted — a recovering plane must keep refusing staler terms
+    fences: Dict[str, int] = {}
 
 
 def _fsync_dir(path: str) -> None:
@@ -422,6 +430,8 @@ class DurableStore:
         last_seq = 0
         leases: Dict[str, Tuple[str, float]] = {}
         req_cache: Dict[str, Tuple[int, object]] = {}
+        lease_terms: Dict[str, int] = {}
+        fences: Dict[str, int] = {}
         if doc is not None:
             cluster = FakeCluster()
             decode_stores_into(cluster, doc.get("stores", {}))
@@ -429,6 +439,12 @@ class DurableStore:
             last_seq = int(doc.get("wal_seq", 0))
             for name, rec in (doc.get("leases") or {}).items():
                 leases[name] = (rec["holder"], float(rec["expires_wall"]))
+                if rec.get("term"):
+                    lease_terms[name] = int(rec["term"])
+            for name, t in (doc.get("lease_terms") or {}).items():
+                lease_terms[name] = max(lease_terms.get(name, 0), int(t))
+            for name, t in (doc.get("fences") or {}).items():
+                fences[name] = max(fences.get(name, 0), int(t))
             for rec in (doc.get("req_cache") or []):
                 req_cache[rec["id"]] = (int(rec["code"]), rec["resp"])
         self.snapshot_rv = rv
@@ -494,11 +510,19 @@ class DurableStore:
                     continue            # heal liveness marker, no state
                 if kind == "_lease":
                     o = rec["o"]
+                    if o.get("term"):
+                        lease_terms[o["name"]] = max(
+                            lease_terms.get(o["name"], 0),
+                            int(o["term"]))
                     if o.get("holder"):
                         leases[o["name"]] = (o["holder"],
                                              float(o["expires_wall"]))
                     else:
                         leases.pop(o["name"], None)
+                elif kind == "_fence":
+                    o = rec["o"]
+                    fences[o["name"]] = max(
+                        fences.get(o["name"], 0), int(o.get("term", 0)))
                 elif kind == "_drain":
                     # collected, applied AFTER the loop: a drained
                     # command's add event may appear on either side
@@ -552,7 +576,8 @@ class DurableStore:
         self._open_new_segment()
         self.recovery = Recovery(cluster, rv, list(tail), leases,
                                  req_cache, epoch, replayed,
-                                 self.replay_seconds)
+                                 self.replay_seconds,
+                                 lease_terms=lease_terms, fences=fences)
         return self.recovery
 
     def _open_new_segment(self) -> None:

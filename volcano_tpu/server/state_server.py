@@ -74,12 +74,17 @@ class Lease:
     # expires is a MONOTONIC-clock deadline: a wall-clock jump (NTP
     # step, VM resume) can neither mass-expire live leases nor
     # immortalize a dead holder's (found while making leases durable —
-    # a wall deadline replayed after downtime did both)
-    __slots__ = ("holder", "expires")
+    # a wall deadline replayed after downtime did both).
+    # term is the fencing token: a per-name counter that bumps on
+    # every acquisition that is not a live same-holder renewal, so
+    # two holders can never share a term and a deposed holder's
+    # writes are refusable by comparison alone
+    __slots__ = ("holder", "expires", "term")
 
-    def __init__(self, holder: str, expires: float):
+    def __init__(self, holder: str, expires: float, term: int = 0):
         self.holder = holder
         self.expires = expires
+        self.term = term
 
 
 class StateServer:
@@ -126,6 +131,15 @@ class StateServer:
         self._events: collections.deque = collections.deque(maxlen=EVENT_RING)
         self._rv = 0
         self._leases: Dict[str, Lease] = {}
+        # fencing substrate: per-name monotonic term counters (never
+        # reissued, even after expiry/release — or a deposed holder
+        # could reacquire "its" term) and per-name fence floors (the
+        # highest term whose write this plane ever accepted; staler
+        # writes 409).  Both are journaled and recovered.
+        self._lease_terms: Dict[str, int] = {}
+        self._fences: Dict[str, int] = {}
+        # observability: per-fence-name count of refused stale writes
+        self._fenced_counts: Dict[str, int] = {}
         # idempotency keys: req id -> (code, payload) of the response
         # already committed for that request — a client retrying a
         # mutation whose ack was lost in a crash/partition gets the
@@ -137,13 +151,18 @@ class StateServer:
             self._events.extend(recovery.events)
             # vtplint: disable=wall-clock (disk carries wall expiries; rebased onto monotonic here)
             now_m, now_w = time.monotonic(), time.time()
+            self._lease_terms.update(
+                getattr(recovery, "lease_terms", None) or {})
+            self._fences.update(getattr(recovery, "fences", None) or {})
             for name, (holder, exp_wall) in recovery.leases.items():
                 # rebase the persisted wall expiry onto THIS boot's
                 # monotonic clock: the remaining TTL is honoured, so a
                 # restarted server refuses a second leader inside an
-                # old holder's term
-                self._leases[name] = Lease(holder,
-                                           now_m + (exp_wall - now_w))
+                # old holder's term.  A live lease's term is by
+                # construction the max ever issued for its name.
+                self._leases[name] = Lease(
+                    holder, now_m + (exp_wall - now_w),
+                    term=self._lease_terms.get(name, 0))
             self._req_cache.update(recovery.req_cache)
         # audit trail: wall-clock-stamped mutation records, the
         # apiserver-audit-log analogue the latency exporter scrapes
@@ -411,8 +430,13 @@ class StateServer:
         with self._lock:
             doc["leases"] = {
                 n: {"holder": l.holder,
-                    "expires_wall": now_w + (l.expires - now_m)}
+                    "expires_wall": now_w + (l.expires - now_m),
+                    "term": l.term}
                 for n, l in self._leases.items() if l.expires > now_m}
+            # term counters + fence floors survive compaction even for
+            # names with no live lease — monotonicity is the contract
+            doc["lease_terms"] = dict(self._lease_terms)
+            doc["fences"] = dict(self._fences)
             doc["req_cache"] = [
                 {"id": i, "code": c, "resp": r}
                 for i, (c, r) in self._req_cache.items()]
@@ -507,11 +531,21 @@ class StateServer:
                 self._rv = int(doc.get("rv", 0))
                 self._events.clear()
                 self._leases.clear()
+                self._lease_terms = {
+                    n: int(t) for n, t in
+                    (doc.get("lease_terms") or {}).items()}
+                self._fences = {
+                    n: int(t) for n, t in
+                    (doc.get("fences") or {}).items()}
                 for name, rec in (doc.get("leases") or {}).items():
                     exp_wall = float(rec["expires_wall"])
+                    term = int(rec.get("term", 0))
+                    self._lease_terms[name] = max(
+                        self._lease_terms.get(name, 0), term)
                     if exp_wall > now_w:
                         self._leases[name] = Lease(
-                            rec["holder"], now_m + (exp_wall - now_w))
+                            rec["holder"], now_m + (exp_wall - now_w),
+                            term=term)
                 self._req_cache.clear()
                 for rec in (doc.get("req_cache") or []):
                     self._req_cache[rec["id"]] = (int(rec["code"]),
@@ -601,14 +635,24 @@ class StateServer:
                     continue
                 if kind == "_lease":
                     o = rec["o"]
+                    if o.get("term"):
+                        self._lease_terms[o["name"]] = max(
+                            self._lease_terms.get(o["name"], 0),
+                            int(o["term"]))
                     if o.get("holder"):
                         # vtplint: disable=wall-clock (shipped record carries a wall expiry; rebased onto monotonic here)
                         self._leases[o["name"]] = Lease(
                             o["holder"], time.monotonic() +
                             # vtplint: disable=wall-clock (shipped wall expiry rebased)
-                            (float(o["expires_wall"]) - time.time()))
+                            (float(o["expires_wall"]) - time.time()),
+                            term=int(o.get("term", 0)))
                     else:
                         self._leases.pop(o["name"], None)
+                elif kind == "_fence":
+                    o = rec["o"]
+                    self._fences[o["name"]] = max(
+                        self._fences.get(o["name"], 0),
+                        int(o.get("term", 0)))
                 elif kind == "_req":
                     o = rec["o"]
                     self._req_cache[o["id"]] = (int(o["code"]),
@@ -766,16 +810,18 @@ class StateServer:
     # -- leases (leader election) --------------------------------------
 
     def _wal_lease(self, name: str, holder: str,
-                   expires_wall: float) -> None:
+                   expires_wall: float, term: int = 0) -> None:
         """Journal a lease transition (holder "" = release).  Wall
         expiry on the wire/disk, rebased to the monotonic clock at
         boot: a restarted server honours the remaining TTL and cannot
-        elect a second leader inside an old holder's term."""
+        elect a second leader inside an old holder's term.  The term
+        rides in the record so a replay/ship never regresses the
+        per-name counter."""
         if self.durable is not None:
             # vtplint: disable=append-lock (every caller holds _lock — lease() acquires it around the CAS; the lexical rule cannot see through the call)
             self.durable.append({"k": "_lease", "o": {
                 "name": name, "holder": holder,
-                "expires_wall": expires_wall}})
+                "expires_wall": expires_wall, "term": term}})
 
     def lease(self, name: str, holder: str, ttl: float,
               release: bool = False) -> dict:
@@ -785,23 +831,92 @@ class StateServer:
             if release:
                 if cur and cur.holder == holder:
                     del self._leases[name]
-                    self._wal_lease(name, "", 0.0)
+                    self._wal_lease(name, "", 0.0,
+                                    self._lease_terms.get(name, 0))
                 return {"acquired": False, "holder": "", "expires": 0,
-                        "expires_in": 0}
+                        "expires_in": 0,
+                        "term": self._lease_terms.get(name, 0)}
             if cur is None or cur.expires < now or cur.holder == holder:
-                self._leases[name] = Lease(holder, now + ttl)
+                if cur is not None and cur.holder == holder and \
+                        cur.expires >= now:
+                    # live same-holder renewal: the term is unchanged —
+                    # a fencing token names one continuous tenancy
+                    term = cur.term or self._lease_terms.get(name, 0)
+                else:
+                    # fresh acquisition (new holder, or the same holder
+                    # returning after an expiry during which another
+                    # writer could have been elected): mint a new term
+                    term = self._lease_terms.get(name, 0) + 1
+                    self._lease_terms[name] = term
+                self._leases[name] = Lease(holder, now + ttl, term)
                 # vtplint: disable=wall-clock (the wire/journal carry wall expiries by contract; the live deadline above is monotonic)
-                self._wal_lease(name, holder, time.time() + ttl)
+                self._wal_lease(name, holder, time.time() + ttl, term)
                 # vtplint: disable=wall-clock (wire expiry; expires_in is the authoritative TTL)
                 return {"acquired": True, "holder": holder,
                         # vtplint: disable=wall-clock (wire expiry by contract)
                         "expires": time.time() + ttl,
-                        "expires_in": round(ttl, 3)}
+                        "expires_in": round(ttl, 3), "term": term}
             # vtplint: disable=wall-clock (wire expiry; expires_in is the authoritative TTL)
             return {"acquired": False, "holder": cur.holder,
                     # vtplint: disable=wall-clock (wire expiry by contract)
                     "expires": time.time() + (cur.expires - now),
-                    "expires_in": round(cur.expires - now, 3)}
+                    "expires_in": round(cur.expires - now, 3),
+                    "term": cur.term}
+
+    # -- fencing tokens (deposed-writer refusal) -----------------------
+
+    def advance_fence(self, name: str, term: int) -> dict:
+        """Raise the fence floor for *name* to *term* (monotonic: a
+        lower ask is a no-op, never a regression).  A freshly promoted
+        leaseholder advances the fence on every plane it writes to
+        BEFORE its first mutation, so the deposed holder's in-flight
+        writes are already refusable when they land."""
+        term = int(term)
+        with self._lock:
+            cur = self._fences.get(name, 0)
+            if term > cur:
+                self._fences[name] = cur = term
+                if self.durable is not None:
+                    # vtplint: disable=append-lock (held: this branch runs under self._lock)
+                    self.durable.append({"k": "_fence", "o": {
+                        "name": name, "term": term}})
+            return {"name": name, "term": cur,
+                    "refused": self._fenced_counts.get(name, 0)}
+
+    def check_fence(self, name: str, term: int) -> None:
+        """Refuse a write fenced below the floor (raises ValueError ->
+        409).  A HIGHER term self-advances the floor: the first write
+        of a new tenancy proves the old one dead even if the explicit
+        advance_fence never arrived."""
+        term = int(term)
+        with self._lock:
+            cur = self._fences.get(name, 0)
+            if term < cur:
+                self._fenced_counts[name] = \
+                    self._fenced_counts.get(name, 0) + 1
+                count = self._fenced_counts[name]
+            elif term > cur:
+                self._fences[name] = term
+                if self.durable is not None:
+                    # vtplint: disable=append-lock (held: this branch runs under self._lock)
+                    self.durable.append({"k": "_fence", "o": {
+                        "name": name, "term": term}})
+                return
+            else:
+                return
+        from volcano_tpu import metrics
+        metrics.inc("fenced_writes_total", fence=name)
+        log.warning("fenced write refused: %s term %d < floor %d "
+                    "(%d refused so far)", name, term, cur, count)
+        raise ValueError(
+            f"fenced: {name} term {term} is stale (current fence "
+            f"{cur}); a newer holder owns this tenancy")
+
+    def fence_status(self) -> dict:
+        with self._lock:
+            return {name: {"term": t,
+                           "refused": self._fenced_counts.get(name, 0)}
+                    for name, t in sorted(self._fences.items())}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -1055,8 +1170,13 @@ class _Handler(BaseHTTPRequestHandler):
             with st._lock:
                 return self._json(200, {
                     name: {"holder": l.holder,
-                           "expires_in": round(l.expires - now, 3)}
+                           "expires_in": round(l.expires - now, 3),
+                           "term": l.term}
                     for name, l in st._leases.items()})
+        if url.path == "/fences":
+            # fence floors + refused-write counts (vtpctl routers /
+            # the chaos conductor's stale-fence invariant read this)
+            return self._json(200, st.fence_status())
         if url.path == "/watch":
             # timeout=0 doubles as the DELTA RESYNC lane: the events
             # since a revision, returned immediately — a mirror whose
@@ -1211,6 +1331,18 @@ class _Handler(BaseHTTPRequestHandler):
         # very crash it exists for.
         req_id = body.pop("_req_id", None) if isinstance(body, dict) \
             else None
+        # fence gate, BEFORE the idempotency replay: a deposed
+        # holder's retry must get the 409 even where its first attempt
+        # committed and recorded a verdict — the refusal is about WHO
+        # is writing now, not what the write would do
+        fence = body.pop("_fence", None) if isinstance(body, dict) \
+            else None
+        if isinstance(fence, dict) and fence.get("name"):
+            try:
+                st.check_fence(fence["name"],
+                               int(fence.get("term", 0)))
+            except ValueError as e:
+                return 409, {"error": str(e)}, None
         if req_id:
             hit = st.replay_response(req_id)
             if hit is not None:
@@ -1347,6 +1479,9 @@ class _Handler(BaseHTTPRequestHandler):
                 body["name"], body["holder"],
                 float(body.get("ttl", 15.0)),
                 release=bool(body.get("release")))
+        if path == "/fence":
+            return 200, st.advance_fence(
+                body["name"], int(body.get("term", 0)))
         if path == "/tick":
             cl.tick()
             return 200, {"ok": True}
@@ -1378,9 +1513,22 @@ class _Handler(BaseHTTPRequestHandler):
         kind = url.path[len("/objects/"):]
         if kind not in KINDS:
             return self._json(404, {"error": f"unknown kind {kind}"})
-        key = parse_qs(url.query).get("key", [""])[0]
+        q = parse_qs(url.query)
+        key = q.get("key", [""])[0]
         if not key:
             return self._json(400, {"error": "missing key"})
+        # fence gate (query params — DELETE carries no body): same
+        # deposed-writer refusal as the POST path
+        fname = q.get("fence_name", [""])[0]
+        if fname:
+            try:
+                fterm = int(q.get("fence_term", ["0"])[0])
+            except (TypeError, ValueError):
+                fterm = 0
+            try:
+                self.state.check_fence(fname, fterm)
+            except ValueError as e:
+                return self._json(409, {"error": str(e)})
         self.state.cluster.delete_object(kind, key)
         from volcano_tpu.server.durability import ReadOnlyError
         try:
