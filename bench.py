@@ -3873,6 +3873,209 @@ def federation_smoke() -> int:
     return 0 if ok else 1
 
 
+# -- fleet-wide causal timeline: one episode ID end to end -------------
+
+
+def _timeline_drill(regions, dwell_s=8.0, jname="tj") -> dict:
+    """Follow-the-sun migration reconstructed from ONE episode ID:
+    submit a gang with source locality, let it train through the sun
+    window, drain the source region, wait for the cross-region
+    cutover to land it Running elsewhere — then assert the
+    leaseholder's stitched fleet trace tells the WHOLE story from a
+    single `GET /fleet_trace?episode=`: every fragment a complete
+    span (trace.is_complete_span), router decision + source drain +
+    destination placement + resume all covered, >= 2 hops, and a
+    stitched segment sum that reconciles with the measured
+    submit->running wall within 5%."""
+    import time as _time
+
+    from volcano_tpu import trace as trace_mod
+    from volcano_tpu.api import federation as fedapi
+    from volcano_tpu.api.types import GROUP_NAME_ANNOTATION
+
+    STAMP = 6000
+    src = regions[0][0]
+    fleet = _FederationFleet(regions, ttl=2.0, sync_s=0.2)
+    g = fleet.g
+    key = f"default/{jname}"
+    try:
+        t_submit = _time.time()
+        g.add_vcjob(_fed_job(jname, 1, locality=src))
+        _wire_wait(lambda: _fed_running(g, jname, src), 60,
+                   lambda: f"admission of {jname} into {src} "
+                   f"({_fed_view(g, jname)}) ({fleet.log_tails()})")
+        episode = fedapi.episode_of(g.vcjobs[key]) or ""
+        assert episode.startswith("ep-"), \
+            f"no episode minted at admission: " \
+            f"{g.vcjobs[key].annotations}"
+
+        # the sun window: the gang trains in the source region,
+        # stamping acked steps (the goodput input AND the resume
+        # floor) — long enough that mint/fold lag is noise against
+        # the 5% reconciliation budget
+        t_end = _time.monotonic() + dwell_s
+        step = STAMP
+        while True:
+            _fed_stamp_and_fold(fleet, src, jname, step)
+            left = t_end - _time.monotonic()
+            if left <= 0:
+                break
+            _time.sleep(min(1.0, left))
+            step += 100
+
+        fleet.set_region_state(src, fedapi.REGION_STATE_DRAINING)
+        _wire_wait(lambda: _fed_running(g, jname)
+                   and _fed_view(g, jname)[0] != src, 120,
+                   lambda: f"follow-the-sun migration of {jname} out "
+                   f"of {src} ({_fed_view(g, jname)}) "
+                   f"({fleet.log_tails()})")
+        dest = _fed_view(g, jname)[0]
+
+        # ground truth for the reconciliation: the destination copy's
+        # own `running` phase stamp (wall clock, written by the
+        # destination controller the moment the gang ran) — NOT our
+        # detection time, which trails it by a fold + a poll
+        run_ts = []
+
+        def _dest_running_stamp():
+            c = fleet.clients[dest]
+            stamps = []
+            pg = c.podgroups.get(key)
+            if pg is not None:
+                ts = trace_mod.phase_ts(pg.annotations, "running")
+                if ts is not None:
+                    stamps.append(ts)
+            for pod in list(c.pods.values()):
+                if pod.annotations.get(
+                        GROUP_NAME_ANNOTATION) != jname:
+                    continue
+                ts = trace_mod.phase_ts(pod.annotations, "running")
+                if ts is not None:
+                    stamps.append(ts)
+            if not stamps:
+                return False
+            run_ts[:] = [min(stamps)]
+            return True
+        _wire_wait(_dest_running_stamp, 30,
+                   lambda: f"running stamp on {dest}'s copy "
+                   f"({fleet.log_tails()})")
+        measured_s = run_ts[0] - t_submit
+        assert measured_s > 0, (run_ts, t_submit)
+
+        # ONE episode ID reconstructs the whole story: poll the wire
+        # endpoint until the stitcher folded the final fragments (it
+        # stitches once per leaseholder pass, so the stitched wall
+        # GROWS toward the measured wall and then stops)
+        state = {}
+
+        def _coverage(doc):
+            frags = list((doc.get("root") or {}).get("children", ()))
+            names = [f.get("name", "") for f in frags]
+            dest_lc = [f for f in frags
+                       if f.get("name", "").startswith("lifecycle")
+                       and (f.get("labels") or {}).get("plane")
+                       == f"region-{dest}"]
+            return {
+                "router_decision": any(
+                    n.startswith(("router-cutover", "router-requeue"))
+                    for n in names),
+                "source_drain": any(
+                    n.startswith("elastic-evacuate-drain")
+                    for n in names),
+                "destination_placement": bool(dest_lc),
+                "resume": any(
+                    c.get("name") == "running"
+                    for f in dest_lc
+                    for c in f.get("children", ())),
+            }
+
+        def _stitched():
+            try:
+                doc = g._request(
+                    "GET",
+                    f"/fleet_trace?episode={episode}").get("trace")
+            except OSError:
+                return False
+            if not isinstance(doc, dict):
+                return False
+            root = doc.get("root") or {}
+            frags = list(root.get("children") or ())
+            if not frags or not all(
+                    trace_mod.is_complete_span(s)
+                    for s in [root] + frags):
+                return False
+            wall = float(doc.get("wall_s") or 0.0)
+            if not (all(_coverage(doc).values())
+                    and len(doc.get("hops") or ()) >= 2
+                    and abs(wall - measured_s)
+                    <= 0.05 * measured_s):
+                return False
+            state["doc"] = doc
+            return True
+        _wire_wait(
+            _stitched, 60,
+            lambda: "stitched episode reconciliation (measured="
+            f"{measured_s:.3f}s stitched="
+            f"{(g.fleet_traces.get(episode) or {}).get('wall_s')} "
+            f"coverage={_coverage(g.fleet_traces.get(episode) or {})}"
+            f" hops="
+            f"{(g.fleet_traces.get(episode) or {}).get('hops')})"
+            f" ({fleet.log_tails()})")
+        doc = state["doc"]
+        wall = float(doc["wall_s"])
+        skew_clamps = [
+            {"fragment": f.get("name"),
+             "clamp_s": float(f["labels"]["skew_clamp_s"])}
+            for f in doc["root"]["children"]
+            if (f.get("labels") or {}).get("skew_clamp_s")]
+        return {
+            "regions": len(regions), "hosts": fleet.hosts,
+            "episode": episode,
+            "source": src, "destination": dest,
+            "measured_submit_to_running_s": round(measured_s, 3),
+            "stitched_wall_s": round(wall, 3),
+            "reconcile_pct": round(
+                100.0 * abs(wall - measured_s) / measured_s, 2),
+            "reconciled_within_5pct": True,
+            "all_fragments_complete": True,
+            "coverage": _coverage(doc),
+            "planes": doc["planes"], "hops": doc["hops"],
+            "fragments": len(doc["root"]["children"]),
+            "segments": doc["segments"],
+            "skew_clamps": skew_clamps,
+            "resume_floor_step": step,
+            "router_sync_errors": fleet.sync_errors[-5:],
+        }
+    finally:
+        fleet.shutdown()
+
+
+def bench_timeline() -> dict:
+    """The TIMELINE_r{N}.json artifact: a 3-region fleet, one gang
+    following the sun out of its home region, the whole causal story
+    reconstructed from its single episode ID."""
+    return _timeline_drill(
+        (("ra", 1, 1.0), ("rb", 1, 0.7), ("rc", 1, 0.9)),
+        dwell_s=15.0)
+
+
+def timeline_smoke() -> int:
+    """Tier-1 causal-timeline drill, mirroring --federation-smoke:
+    2 regions, seconds-scale sun window.  Prints one JSON line."""
+    try:
+        out = _timeline_drill((("ra", 1, 1.0), ("rb", 1, 0.7)),
+                              dwell_s=6.0)
+        ok = (out["reconciled_within_5pct"]
+              and out["all_fragments_complete"]
+              and all(out["coverage"].values())
+              and len(out["hops"]) >= 2
+              and not out["router_sync_errors"])
+    except AssertionError as e:
+        out, ok = {"error": str(e)[-900:]}, False
+    print(json.dumps({"metric": "timeline_smoke", "ok": ok, **out}))
+    return 0 if ok else 1
+
+
 # -- federation HA: leased router replica set --------------------------
 
 
@@ -5945,6 +6148,17 @@ if __name__ == "__main__":
         sys.exit(serve_smoke())
     elif "--federation-smoke" in sys.argv:
         sys.exit(federation_smoke())
+    elif "--timeline-smoke" in sys.argv:
+        sys.exit(timeline_smoke())
+    elif "--timeline" in sys.argv:
+        # the fleet-wide causal-tracing row committed as
+        # TIMELINE_r{N}.json: a follow-the-sun migration on a 3-region
+        # fleet reconstructed from ONE episode ID — stitched span tree
+        # complete, router decision + source drain + destination
+        # placement + resume covered, segment sum reconciling with the
+        # measured submit->running wall within 5%
+        print(json.dumps({"metric": "fleet_causal_timeline",
+                          **bench_timeline()}))
     elif "--federation-ha-smoke" in sys.argv:
         sys.exit(federation_ha_smoke())
     elif "--federation-ha" in sys.argv:

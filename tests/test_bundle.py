@@ -15,6 +15,7 @@ from volcano_tpu.bundle import (
     FAMILIES,
     agent_dashboard,
     dashboard_metric_names,
+    federation_dashboard,
     render,
     scheduler_dashboard,
 )
@@ -111,7 +112,8 @@ def test_dashboards_reference_only_exported_families(tmp_path):
     plane exports — validated against a LIVE exposition after real
     scheduling work, so a renamed family fails here, not on the
     operator's wall."""
-    for dash in (scheduler_dashboard(), agent_dashboard()):
+    for dash in (scheduler_dashboard(), agent_dashboard(),
+                 federation_dashboard()):
         names = dashboard_metric_names(dash)
         assert names, "dashboard queries no known families?"
         unknown = names - set(FAMILIES)

@@ -893,3 +893,209 @@ def test_bench_federation_ha_smoke_mode():
     assert out["stale_fence_refused"]
     assert out["fenced_writes_counted"] >= 1
     assert out["anchor_untouched"]
+
+
+# -- fleet-wide causal tracing (ISSUE 20) ------------------------------
+
+def _episodic_podgroup(rc, key, episode, hop, start, step=0.2):
+    """A regional podgroup carrying the episode annotations plus a
+    full lifecycle stamp ladder — what the job controller + scheduler
+    + agents produce on a real plane, condensed for stitcher tests."""
+    from volcano_tpu.api.podgroup import PodGroup
+    ns, _, name = key.partition("/")
+    pg = PodGroup(name=name, namespace=ns, min_member=2)
+    pg.annotations[fedapi.FED_EPISODE_ANNOTATION] = episode
+    pg.annotations[fedapi.FED_EPISODE_HOP_ANNOTATION] = str(hop)
+    ts = start
+    for phase in trace.PHASES:
+        trace.stamp_phase(pg.annotations, phase, ts)
+        ts += step
+    rc.add_podgroup(pg)
+    return pg
+
+
+def test_episode_propagation_end_to_end():
+    """ONE bounded episode ID from global submit to the regional
+    copy: minted deterministically at admission (hop 0, wall t0),
+    inherited by the regional clone, destination stamped at hop+1 on
+    cutover, and hop-bumped on a region-loss requeue — the annotation
+    chain every `GET /traces?episode=` fragment hangs off."""
+    # determinism: a router that crashes between mint and stamp
+    # re-derives the SAME ID; a new attempt is a NEW episode
+    assert fedapi.episode_id("default/j", 0) == \
+        fedapi.episode_id("default/j", 0)
+    assert fedapi.episode_id("default/j", 0) != \
+        fedapi.episode_id("default/j", 1)
+    assert fedapi.episode_id("default/j").startswith("ep-")
+    assert len(fedapi.episode_id("default/j")) == 19
+
+    # admission mints + propagates to the regional copy
+    clock = Clock()
+    g, router, handles = fleet(
+        {"ra": {"price": 0.5}, "rb": {"price": 1.0}}, clock=clock)
+    router.sync()
+    g.add_vcjob(global_job("train"))
+    router.sync()
+    job = g.vcjobs["default/train"]
+    episode = fedapi.episode_of(job)
+    assert episode == fedapi.episode_id("default/train", 0)
+    assert fedapi.episode_hop(job) == 0
+    assert fedapi.episode_ts(job) == clock.t
+    copy = handles["ra"][0].vcjobs["default/train"]
+    assert fedapi.episode_of(copy) == episode
+    # ... and the pods built from the copy inherit it (job controller)
+    from volcano_tpu.controllers.job.controller import JobController
+    rc = handles["ra"][0]
+    jc = JobController()
+    jc.initialize(rc)
+    jc.sync()
+    pods = [p for p in rc.pods.values()
+            if p.annotations.get(fedapi.FED_EPISODE_ANNOTATION)]
+    assert pods, "no pods inherited the episode annotation"
+    assert all(fedapi.episode_of(p) == episode for p in pods)
+
+    # region loss: the requeue keeps the SAME episode, hop += 1
+    copy.phase = JobPhase.RUNNING
+    copy.annotations[LAST_STEP_ANNOTATION] = "1200"
+    copy.annotations[RESUME_STEP_ANNOTATION] = "1200"
+    router.sync()
+    handles["ra"][1].age = 10_000.0
+    clock.t += fedapi.REGION_TTL_S + 10
+    router.sync()
+    job = g.vcjobs["default/train"]
+    assert fedapi.admitted_region(job) == "rb"
+    assert fedapi.episode_of(job) == episode, \
+        "requeue forked the episode"
+    assert fedapi.episode_hop(job) == 1
+
+
+def test_cutover_stamps_destination_at_next_hop():
+    """The migration cutover threads the episode across regions: the
+    destination copy carries the SAME episode at hop+1, so the
+    stitched tree orders the two planes causally."""
+    clock = Clock()
+    g, router, handles = _evacuated_fleet(clock)
+    job = g.vcjobs["default/train"]
+    episode = fedapi.episode_of(job)
+    assert episode, "admission minted no episode"
+    router.sync()
+    job = g.vcjobs["default/train"]
+    assert fedapi.admitted_region(job) == "rb"
+    new_copy = handles["rb"][0].vcjobs["default/train"]
+    assert fedapi.episode_of(new_copy) == episode
+    assert fedapi.episode_hop(new_copy) == fedapi.episode_hop(job)
+    assert fedapi.episode_hop(new_copy) == 1
+
+
+def test_stitch_survives_router_failover_mid_episode():
+    """Mid-episode router failover: r1 admits (its admit fragment
+    lives ONLY in its in-process stitcher), stitches hop 0 durably,
+    then dies.  The promoted standby r2 must ADOPT the stitched tree
+    from the global store — r1's router-plane fragment included, which
+    r2 never observed — and complete it with the hop-1 fragments."""
+    t = [1000.0]
+    g = FakeCluster()
+    g.lease_now = lambda: t[0]
+
+    def clock():
+        return t[0]
+
+    regions = {}
+
+    def make_router(holder):
+        r = FederationRouter(g, elect=True, holder=holder, now=clock,
+                             start_mirrors=False)
+        for name in ("ra", "rb"):
+            rc = regions.setdefault(name, tpu_region(name))
+            r.attach_region(
+                fedapi.region_record(name, f"fake://{name}"),
+                client=rc, mirror=FakeMirror(name, rc))
+        return r
+
+    r1, r2 = make_router("r1"), make_router("r2")
+    try:
+        r1.sync()
+        r2.sync()
+        assert r1.elector.is_leader and not r2.elector.is_leader
+        g.add_vcjob(global_job("train", annotations={
+            fedapi.FED_DATA_LOCALITY_ANNOTATION: "ra"}))
+        r1.sync()
+        job = g.vcjobs["default/train"]
+        episode = fedapi.episode_of(job)
+        assert episode and fedapi.admitted_region(job) == "ra"
+
+        # hop 0 runs in ra; the leaseholder stitches it durably
+        _episodic_podgroup(regions["ra"], "default/train", episode,
+                           hop=0, start=t[0])
+        t[0] += 2.0
+        r1.sync()
+        doc1 = g.fleet_traces[episode]
+        frag_keys1 = {(f.get("labels") or {}).get("fkey")
+                      for f in doc1["root"]["children"]}
+        admit_keys = {k for k in frag_keys1
+                      if k and k.startswith("router|router-admit")}
+        assert admit_keys, \
+            f"no router-plane admit fragment stitched: {frag_keys1}"
+        assert doc1["hops"] == [0]
+
+        # r1 dies mid-episode (never syncs again); its lease expires
+        # on the fake clock and r2 adopts the term
+        t[0] += fedapi.ROUTER_LEASE_TTL_S + 1
+        r2.sync()
+        assert r2.elector.is_leader
+
+        # the episode moves on: hop 1 lands in rb.  r2 must merge
+        # r1's recovered fragments with the new ones.
+        _episodic_podgroup(regions["rb"], "default/train", episode,
+                           hop=1, start=t[0])
+        t[0] += 2.0
+        r2.sync()
+        doc2 = g.fleet_traces[episode]
+        frag_keys2 = {(f.get("labels") or {}).get("fkey")
+                      for f in doc2["root"]["children"]}
+        # adoption: the dead router's fragment survived the failover
+        assert admit_keys <= frag_keys2, \
+            f"standby lost the deposed router's fragments: " \
+            f"{frag_keys2}"
+        # completion: both hops, both region planes, wall grew
+        assert doc2["hops"] == [0, 1]
+        assert {"region-ra", "region-rb"} <= set(doc2["planes"])
+        assert doc2["wall_s"] > doc1["wall_s"]
+        assert trace.is_complete_span(doc2["root"])
+        assert all(trace.is_complete_span(f)
+                   for f in doc2["root"]["children"])
+        # the segment sum telescopes to the stitched wall
+        assert abs(sum(doc2["segments"].values())
+                   - doc2["wall_s"]) < 1e-3
+    finally:
+        r1.close()
+        r2.close()
+
+
+def test_bench_timeline_smoke_mode():
+    """`bench.py --timeline-smoke` reconstructs a REAL follow-the-sun
+    migration (2 regional process planes) from ONE episode ID: the
+    stitched span tree is complete, covers router decision + source
+    drain + destination placement + resume, and its segment sum
+    reconciles with the measured submit->running wall within 5%."""
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--timeline-smoke"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=REPO)
+    assert proc.returncode == 0, \
+        proc.stdout[-2000:] + proc.stderr[-2000:]
+    import json
+    line = next(l for l in reversed(proc.stdout.strip().splitlines())
+                if l.startswith("{"))
+    out = json.loads(line)
+    assert out["ok"] is True, out
+    assert out["episode"].startswith("ep-")
+    assert out["reconcile_pct"] <= 5.0
+    assert all(out["coverage"].values()), out["coverage"]
+    assert len(out["hops"]) >= 2
+    assert out["all_fragments_complete"]

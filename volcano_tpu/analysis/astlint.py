@@ -41,6 +41,17 @@ catalog with provenance lives in docs/design/static-analysis.md):
                  contract of the process-pool sweep: worker behavior
                  comes from worker-side resolution, never from
                  shipped code.
+  episode-propagation
+                 a function POSTing a mutating federation RPC
+                 (add_vcjob / delete_vcjob / update_podgroup_status /
+                 reap_residuals through FedRPC.call) or opening a
+                 controller episode (FailoverEpisode / ResizeEpisode)
+                 must thread the causal episode ID — reference the
+                 episode API (episode_of/ensure_episode/FED_EPISODE*)
+                 or pass episode= — or carry a reasoned waiver.  A
+                 cross-plane hop that drops the ID is invisible to
+                 `GET /fleet_trace?episode=`: the stitched tree holes
+                 exactly where the bug is.
   fed-retry      in volcano_tpu/federation/ (except retry.py, which
                  IS the policy), a retry loop may not sleep a fixed
                  literal delay: every cross-region wait goes through
@@ -66,7 +77,7 @@ from typing import Dict, Iterator, List, Optional, Set, Tuple
 
 RULES = ("req-id", "wall-clock", "metric-family", "metric-labels",
          "append-lock", "except-pass", "process-ship-purity",
-         "fed-retry")
+         "fed-retry", "episode-propagation")
 
 SUPPRESS_RE = re.compile(
     r"#\s*vtplint:\s*disable=([a-z0-9*,_-]+)(?:\s*\(([^)]+)\))?")
@@ -94,6 +105,19 @@ SHIP_SENDS = frozenset({"send", "send_bytes"})
 FED_RETRY_DIR = "volcano_tpu/federation/"
 FED_RETRY_EXEMPT = ("federation/retry.py",)
 SLEEP_METHODS = frozenset({"sleep", "wait"})
+
+# episode-propagation scope: the mutating federation RPC verbs that
+# move a gang between planes (advance_fence is term plumbing, not a
+# causal hop), the controllers' episode state machines, and the names
+# whose presence in the enclosing function counts as threading the ID
+FED_MUTATING_OPS = frozenset({"add_vcjob", "delete_vcjob",
+                              "update_podgroup_status",
+                              "reap_residuals"})
+EPISODE_CTORS = frozenset({"FailoverEpisode", "ResizeEpisode"})
+EPISODE_CTOR_DIR = "volcano_tpu/controllers/"
+EPISODE_API = frozenset({"episode", "episode_id", "episode_of",
+                         "episode_hop", "episode_ts",
+                         "ensure_episode"})
 
 EMIT_METHODS = frozenset({"inc", "observe", "set_gauge"})
 READ_METHODS = frozenset({"get_gauge", "get_counter",
@@ -243,6 +267,7 @@ class Linter:
         fed_scope = FED_RETRY_DIR in rel and \
             not rel.endswith(FED_RETRY_EXEMPT)
         fed_flagged: Set[int] = set()
+        ctor_scope = EPISODE_CTOR_DIR in rel
         ship_scope = rel.endswith("actions/procpool.py") or any(
             (isinstance(n, ast.Import)
              and any(a.name.split(".")[0] == "multiprocessing"
@@ -252,7 +277,22 @@ class Linter:
             for n in ast.walk(tree))
         # ancestor context maintained by an explicit stack walk
         fn_stack: List[str] = []
+        fn_nodes: List[ast.AST] = []
         lock_depth = [0]        # with-a-lock nesting count
+        threads_cache: Dict[int, bool] = {}
+
+        def threads_episode() -> bool:
+            """Does the INNERMOST enclosing function reference the
+            episode API anywhere in its body?  (Module-level code is
+            never a hop — only reconcile/controller functions move
+            gangs.)"""
+            if not fn_nodes:
+                return True
+            fn = fn_nodes[-1]
+            key = id(fn)
+            if key not in threads_cache:
+                threads_cache[key] = _references_episode(fn)
+            return threads_cache[key]
 
         def locky(withitem: ast.withitem) -> bool:
             try:
@@ -267,6 +307,7 @@ class Linter:
             if isinstance(node, (ast.FunctionDef,
                                  ast.AsyncFunctionDef)):
                 fn_stack.append(node.name)
+                fn_nodes.append(node)
                 pushed_fn = True
             if isinstance(node, ast.With) and \
                     any(locky(i) for i in node.items):
@@ -282,6 +323,7 @@ class Linter:
                 yield from visit(child)
             if pushed_fn:
                 fn_stack.pop()
+                fn_nodes.pop()
             if pushed_lock:
                 lock_depth[0] -= 1
 
@@ -337,6 +379,28 @@ class Linter:
                         f"(procpool.post/post_bytes): every cross-"
                         f"process payload must go through the pure "
                         f"pickler that refuses callables")
+
+            # episode-propagation -------------------------------------
+            if fed_scope and attr == "call" and \
+                    "rpc" in chain.split(".") and len(node.args) >= 2:
+                op = _literal_str(node.args[1])
+                if op in FED_MUTATING_OPS and not threads_episode():
+                    yield Finding(
+                        "episode-propagation", rel, node.lineno,
+                        f"mutating federation RPC {op!r} without "
+                        f"threading the causal episode ID "
+                        f"(episode_of/ensure_episode/FED_EPISODE*) — "
+                        f"this hop would be invisible to "
+                        f"GET /fleet_trace?episode=")
+            if ctor_scope and \
+                    chain.rsplit(".", 1)[-1] in EPISODE_CTORS and \
+                    not threads_episode():
+                yield Finding(
+                    "episode-propagation", rel, node.lineno,
+                    f"{chain.rsplit('.', 1)[-1]} opened without "
+                    f"threading the causal episode ID — the "
+                    f"controller's drain/recovery fragment would "
+                    f"detach from the fleet trace")
 
             # metric-family / metric-labels ---------------------------
             if not is_metrics_impl and chain.startswith("metrics."):
@@ -434,6 +498,25 @@ class Linter:
                         f"I/O — classify, count, or log it")
 
         return visit(tree)
+
+
+def _references_episode(fn: ast.AST) -> bool:
+    """Any mention of the episode API in *fn* counts as threading the
+    ID: a read (episode_of), a mint (ensure_episode), the annotation
+    constants (FED_EPISODE*), an `episode=` keyword, or a plain
+    `episode` name/attribute the surrounding code assigned."""
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Name) and (
+                sub.id in EPISODE_API or "FED_EPISODE" in sub.id):
+            return True
+        if isinstance(sub, ast.Attribute) and (
+                sub.attr in EPISODE_API or "FED_EPISODE" in sub.attr):
+            return True
+        if isinstance(sub, ast.keyword) and sub.arg == "episode":
+            return True
+        if isinstance(sub, ast.arg) and sub.arg == "episode":
+            return True
+    return False
 
 
 def _broad(t: Optional[ast.expr]) -> bool:

@@ -84,6 +84,23 @@ ARBITRAGE_PENDING_S = 30.0
 FED_HOME_ANNOTATION = "federation.volcano-tpu.io/home"
 FED_ORIGIN_REGION_ANNOTATION = "federation.volcano-tpu.io/origin-region"
 
+# -- causal episode (one ID from global submit to running pod) ---------
+# minted by the router the first time it sees an unadmitted global
+# job, then carried on EVERY downstream wire write: the regional copy,
+# its podgroup (annotation inheritance in the job controller), its
+# pods, scheduler session root spans, controller episodes, and both
+# sides of a cross-region cutover.  `GET /traces?episode=` on any
+# plane returns that plane's local fragment; the router's stitcher
+# joins them into one /fleet_trace span tree.
+FED_EPISODE_ANNOTATION = "federation.volcano-tpu.io/episode"
+# hop index: 0 at first admission, +1 per cross-region move (requeue,
+# arbitrage, cutover).  Both cutover sides carry the SAME episode with
+# the destination stamped at hop+1 — the create-then-delete pair is
+# distinguishable in the stitched tree.
+FED_EPISODE_HOP_ANNOTATION = "federation.volcano-tpu.io/episode-hop"
+# wall-clock mint timestamp: the stitched tree's t0 (submit-side edge)
+FED_EPISODE_TS_ANNOTATION = "federation.volcano-tpu.io/episode-ts"
+
 # -- region registry (the `region` dict-kind) --------------------------
 # record shape: {"name", "url", "price", "locality", "token",
 #                "heartbeat_ts", "state", "capacity_chips",
@@ -118,12 +135,15 @@ ROUTER_LEASE_TTL_S = 10.0
 
 def region_record(name: str, url: str, price: float = 1.0,
                   locality: str = "", mirror_url: str = "",
-                  token: str = "") -> dict:
-    """A fresh region-registry record (state: ready, heartbeat now)."""
+                  token: str = "", metrics_url: str = "") -> dict:
+    """A fresh region-registry record (state: ready, heartbeat now).
+    ``metrics_url`` is the region's Prometheus exposition endpoint
+    (the regional agent's --metrics-port); when set, the leaseholder
+    router scrapes it into the federation_rollup_* families."""
     return {
         "name": name, "url": url, "price": float(price),
         "locality": locality, "mirror_url": mirror_url or url,
-        "token": token,
+        "token": token, "metrics_url": metrics_url,
         # vtplint: disable=wall-clock (registry records cross processes; wall time is the shared clock)
         "heartbeat_ts": time.time(),
         "state": REGION_STATE_READY,
@@ -187,3 +207,56 @@ def migration_count(obj) -> int:
         return int(_ann(obj).get(FED_MIGRATIONS_ANNOTATION, 0) or 0)
     except (TypeError, ValueError):
         return 0
+
+
+def episode_id(job_key: str, attempt: int = 0) -> str:
+    """Deterministic BOUNDED episode ID for one global job's causal
+    timeline (19 chars, derived like admission_key): a router that
+    crashed between minting and the stamp write re-derives the SAME
+    ID on restart, so the episode never forks.  The ID is an
+    annotation/trace-label value ONLY — never a metric label (it is
+    per-job, i.e. unbounded as a label family)."""
+    h = hashlib.sha256(f"fed-episode:{job_key}:{attempt}".encode())
+    return "ep-" + h.hexdigest()[:16]
+
+
+def episode_of(obj) -> Optional[str]:
+    """The episode ID riding a job/podgroup/pod, if any."""
+    return _ann(obj).get(FED_EPISODE_ANNOTATION) or None
+
+
+def episode_hop(obj) -> int:
+    try:
+        return int(_ann(obj).get(FED_EPISODE_HOP_ANNOTATION, 0) or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+def episode_ts(obj, default: float = 0.0) -> float:
+    """The episode's wall mint timestamp (the stitched tree's t0)."""
+    try:
+        return float(_ann(obj).get(FED_EPISODE_TS_ANNOTATION,
+                                   default) or default)
+    except (TypeError, ValueError):
+        return default
+
+
+def ensure_episode(job, now: Optional[float] = None) -> str:
+    """Mint (idempotently) the episode onto a GLOBAL job's
+    annotations: ID from the mint-time attempt, hop 0, wall t0.
+    Returns the episode ID; the caller persists the job."""
+    ep = episode_of(job)
+    if ep:
+        return ep
+    try:
+        attempt = int(job.annotations.get(FED_ATTEMPT_ANNOTATION, 0)
+                      or 0)
+    except (TypeError, ValueError):
+        attempt = 0
+    ep = episode_id(job.key, attempt)
+    job.annotations[FED_EPISODE_ANNOTATION] = ep
+    job.annotations.setdefault(FED_EPISODE_HOP_ANNOTATION, "0")
+    # vtplint: disable=wall-clock (episode t0 crosses processes; wall time is the shared clock)
+    job.annotations.setdefault(FED_EPISODE_TS_ANNOTATION,
+                               f"{time.time() if now is None else now:.6f}")
+    return ep

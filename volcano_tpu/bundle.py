@@ -247,7 +247,31 @@ FAMILIES: Dict[str, str] = {
     "federation_router_breaker_state": "gauge",
     "federation_region_serving_headroom": "gauge",
     "fenced_writes_total": "counter",
+    # fleet observability (federation/stitch.py + federation/slo.py +
+    # router._observability): stitched-trace tally, observed mirror
+    # staleness, per-region breaker detail (learned region health a
+    # promoted standby adopts), per-region rollups of the bounded
+    # family set (the `family` label is closed over this very schema),
+    # rollup scrape failures, and the multi-window SLO burn-rate
+    # gauges — episode IDs and job keys NEVER label any of these
+    "federation_stitched_traces_total": "counter",
+    "federation_mirror_staleness_seconds": "gauge",
+    "federation_router_breaker_failures": "gauge",
+    "federation_router_breaker_half_opens": "gauge",
+    "federation_router_breaker_opens": "gauge",
+    "federation_router_breaker_last_trip_ts": "gauge",
+    "federation_router_breaker_retry_in_seconds": "gauge",
+    "federation_rollup_scrape_failures_total": "counter",
+    "federation_rollup_sum": "gauge",
+    "federation_rollup_max": "gauge",
+    "federation_rollup_count": "gauge",
+    "slo_burn_rate": "gauge",
 }
+
+# the rollup's `family` label value set IS the family schema: closed
+# by construction, so fleet-wide aggregation can never mint an
+# unbounded label value
+ROLLUP_FAMILY_ENUM = tuple(FAMILIES)
 
 # -- label schema (enforced by volcano_tpu/analysis + tests/test_lint) --
 #
@@ -397,6 +421,30 @@ FAMILY_LABELS: Dict[str, Dict[str, object]] = {
     "federation_router_breaker_state": {"region": CONFIG},
     "federation_region_serving_headroom": {"region": CONFIG},
     "fenced_writes_total": {"fence": CONFIG},
+    # fleet observability: staleness + breaker detail are per-region
+    # (registry config); the rollups add ONLY (family, region) with
+    # `family` closed over the schema itself; the SLO burn labels are
+    # the closed enums owned by federation/slo.py.  Episode IDs are
+    # annotation/trace-label values only — never metric labels.
+    "federation_mirror_staleness_seconds": {"region": CONFIG},
+    "federation_router_breaker_failures": {"region": CONFIG},
+    "federation_router_breaker_half_opens": {"region": CONFIG},
+    "federation_router_breaker_opens": {"region": CONFIG},
+    "federation_router_breaker_last_trip_ts": {"region": CONFIG},
+    "federation_router_breaker_retry_in_seconds": {"region": CONFIG},
+    "federation_rollup_scrape_failures_total": {"region": CONFIG},
+    "federation_rollup_sum": {
+        "family": "enum:volcano_tpu.bundle:ROLLUP_FAMILY_ENUM",
+        "region": CONFIG},
+    "federation_rollup_max": {
+        "family": "enum:volcano_tpu.bundle:ROLLUP_FAMILY_ENUM",
+        "region": CONFIG},
+    "federation_rollup_count": {
+        "family": "enum:volcano_tpu.bundle:ROLLUP_FAMILY_ENUM",
+        "region": CONFIG},
+    "slo_burn_rate": {
+        "slo": "enum:volcano_tpu.federation.slo:SLO_NAMES",
+        "window": "enum:volcano_tpu.federation.slo:SLO_WINDOWS"},
 }
 
 
@@ -596,6 +644,61 @@ def agent_dashboard() -> dict:
     }
 
 
+def federation_dashboard() -> dict:
+    """Fleet rollups + SLO burn over the router-side families: every
+    panel reads the LEASEHOLDER ROUTER's /metrics (the only process
+    that sees all regions), so one Grafana datasource covers the
+    federation without scraping N regional planes."""
+    panels = [
+        # burn > 1.0 sustained = the SLO will be missed; the two
+        # windows make fast-burn pages and slow-burn tickets
+        _panel(1, "SLO burn rate by SLO x window",
+               ["slo_burn_rate"], 0, 0),
+        _panel(2, "Mirror staleness by region",
+               ["federation_mirror_staleness_seconds"], 12, 0,
+               unit="s"),
+        _panel(3, "Region breaker state",
+               ["federation_router_breaker_failures",
+                "federation_router_breaker_opens",
+                "federation_router_breaker_half_opens",
+                "federation_router_breaker_retry_in_seconds"], 0, 8),
+        _panel(4, "Fleet scheduling latency rollup (per region)",
+               ["sum by (region) (federation_rollup_sum{family="
+                "\"e2e_scheduling_latency_seconds\"}) / clamp_min("
+                "sum by (region) (federation_rollup_count{family="
+                "\"e2e_scheduling_latency_seconds\"}), 1e-9)"],
+               12, 8, unit="s"),
+        _panel(5, "Fleet failover MTTR rollup (per region)",
+               ["sum by (region) (federation_rollup_sum{family="
+                "\"failover_mttr_seconds\"}) / clamp_min("
+                "sum by (region) (federation_rollup_count{family="
+                "\"failover_mttr_seconds\"}), 1e-9)"], 0, 16,
+               unit="s"),
+        _panel(6, "Worst serving attainment across fleet",
+               ["min(federation_rollup_max{family="
+                "\"serving_slo_attainment_min\"})"], 12, 16),
+        _panel(7, "Stitched episode traces / scrape failures",
+               ["rate(federation_stitched_traces_total[5m])",
+                "sum by (region) "
+                "(rate(federation_rollup_scrape_failures_total[5m]))"],
+               0, 24),
+        _panel(8, "Federation queue + migration activity",
+               ["federation_pending_jobs",
+                "rate(federation_migrations_total[5m])",
+                "sum by (region) "
+                "(rate(federation_router_rpc_failures_total[5m]))"],
+               12, 24),
+    ]
+    return {
+        "title": "volcano-tpu / federation", "uid": "vtp-federation",
+        "timezone": "browser", "schemaVersion": 39, "version": 1,
+        "refresh": "10s", "panels": panels,
+        "templating": {"list": [{
+            "name": "datasource", "type": "datasource",
+            "query": "prometheus"}]},
+    }
+
+
 def dashboard_metric_names(dash: dict) -> set:
     """Metric families referenced by a dashboard's exprs (validation
     seam: tests cross-check these against FAMILIES and a live
@@ -783,7 +886,8 @@ def render(out_dir: str, topology: str = "sa:v5e-256",
         }]}, indent=2) + "\n")
 
     for fname, dash in (("scheduler.json", scheduler_dashboard()),
-                        ("agents.json", agent_dashboard())):
+                        ("agents.json", agent_dashboard()),
+                        ("federation.json", federation_dashboard())):
         emit(f"grafana/{fname}", json.dumps(dash, indent=2) + "\n")
 
     emit("README.md", BUNDLE_README.format(**values))

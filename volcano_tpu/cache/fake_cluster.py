@@ -55,6 +55,9 @@ class FakeCluster(Cluster):
         self.pvs: Dict[str, dict] = {}            # volumebinding volumes
         self.datasources: Dict[str, dict] = {}    # datadependency/v1alpha1
         self.regions: Dict[str, dict] = {}        # api/federation.py registry
+        self.fleet_traces: Dict[str, dict] = {}   # federation/stitch.py docs
+        self.router_breakers: Dict[str, dict] = {}  # federation/retry.py snaps
+        self.slos: Dict[str, dict] = {}           # federation/slo.py doc
         self.events: List[Tuple[str, str, str]] = []
         self._run_progress: Dict[str, int] = {}   # pod uid -> ticks run
         self.binds: List[Tuple[str, str]] = []    # (pod key, node) history

@@ -69,6 +69,19 @@ KINDS: Dict[str, KindSpec] = {
     # record dict {url, price, locality, heartbeat...}, held by the
     # GLOBAL store and reconciled by the federation router
     "region": KindSpec("regions", None),
+    # stitched cross-plane episode trace (federation/stitch.py):
+    # episode ID -> the latest stitched span-tree doc, written by the
+    # leaseholder router into the GLOBAL store so `GET /fleet_trace`
+    # and a promoted standby both read the same durable artifact
+    "fleet_trace": KindSpec("fleet_traces", None),
+    # router circuit-breaker snapshots (federation/retry.py): region
+    # name -> {state, failures, opens, retry_in_s, last_trip_ts},
+    # written on trip/close so a promoted standby adopts learned
+    # region health instead of re-probing from closed
+    "router_breaker": KindSpec("router_breakers", None),
+    # fleet SLO snapshot (federation/slo.py): "global" -> burn-rate /
+    # attainment doc the router recomputes each pass (vtpctl slo)
+    "slo": KindSpec("slos", None),
     "service": KindSpec("services", None),
     "config_map": KindSpec("config_maps", None),
     "secret": KindSpec("secrets", None),

@@ -1147,7 +1147,7 @@ def cmd_regions(cluster, args):
             sys.exit("--add wants NAME=URL")
         rec = fedapi.region_record(
             name, url, price=args.price, locality=args.locality,
-            mirror_url=args.mirror_url)
+            mirror_url=args.mirror_url, metrics_url=args.metrics_url)
         cluster.put_object("region", rec, key=name)
         print(f"region {name} registered at {url}")
         return
@@ -1162,6 +1162,7 @@ def cmd_regions(cluster, args):
             age = now - float(rec.get("heartbeat_ts", 0) or 0)
         except (TypeError, ValueError):
             age = float("inf")
+        stale = rec.get("mirror_staleness_s")
         rows.append([
             name, rec.get("state", "?"), rec.get("url", ""),
             f"{float(rec.get('price', 1.0) or 1.0):g}",
@@ -1169,9 +1170,11 @@ def cmd_regions(cluster, args):
             f"{float(rec.get('capacity_chips', 0) or 0):g}",
             f"{float(rec.get('idle_chips', 0) or 0):g}",
             f"{age:.0f}s" if age < 1e6 else "never",
+            "-" if stale is None else f"{float(stale):.1f}s",
         ])
     print(_table(rows, ["REGION", "STATE", "URL", "PRICE", "LOCALITY",
-                        "CAP-CHIPS", "IDLE-CHIPS", "HEARTBEAT"]))
+                        "CAP-CHIPS", "IDLE-CHIPS", "HEARTBEAT",
+                        "STALENESS"]))
 
 
 def cmd_routers(cluster, args):
@@ -1222,6 +1225,84 @@ def cmd_routers(cluster, args):
         ])
     print(_table(rows, ["REGION", "STATE", "BREAKER", "FENCE-TERM",
                         "FENCED-WRITES"]))
+
+
+def cmd_timeline(cluster, args):
+    """The federated causal timeline of ONE job: resolve its episode
+    ID from the global job's annotations, fetch the stitched cross-
+    plane span tree (`fleet_trace` dict-kind, written by the
+    leaseholder router's stitcher), and render it as a waterfall plus
+    the per-hop wait/active segment breakdown."""
+    from volcano_tpu import trace
+    from volcano_tpu.api import federation as fedapi
+
+    episode = args.episode
+    if not episode:
+        key = f"{args.namespace}/{args.name}"
+        job = cluster.vcjobs.get(key)
+        if job is None:
+            sys.exit(f"no global job {key}")
+        episode = fedapi.episode_of(job)
+        if not episode:
+            sys.exit(f"{key} carries no episode annotation (pre-"
+                     f"episode job, or not yet admitted by a router)")
+    doc = None
+    request = getattr(cluster, "_request", None)
+    if request is not None:
+        try:
+            payload = request("GET", f"/fleet_trace?episode={episode}")
+            doc = payload.get("trace")
+        except Exception as e:  # noqa: BLE001 — fall back to mirror
+            print(f"(/fleet_trace unavailable: {e})", file=sys.stderr)
+    if doc is None:
+        doc = getattr(cluster, "fleet_traces", {}).get(episode)
+    if not doc:
+        sys.exit(f"no stitched trace for episode {episode} yet "
+                 f"(the leaseholder router stitches once per pass)")
+    print(f"episode {episode}  wall {doc.get('wall_s', 0.0):.3f}s  "
+          f"planes {', '.join(doc.get('planes', []))}  "
+          f"hops {doc.get('hops', [])}")
+    if doc.get("jobs"):
+        print(f"jobs: {', '.join(doc['jobs'])}")
+    print()
+    for line in trace.render_waterfall(doc.get("root", {})):
+        print(line)
+    segments = doc.get("segments") or {}
+    if segments:
+        print()
+        print(_table(
+            [[seg, f"{v * 1e3:.1f}ms"]
+             for seg, v in sorted(segments.items())],
+            ["SEGMENT", "DURATION"]))
+
+
+def cmd_slo(cluster, args):
+    """Fleet SLO burn rates from the durable doc the leaseholder
+    router writes each observability pass (`slo` dict-kind, key
+    `global`): per SLO x window the burn rate (budget spend speed;
+    sustained > 1.0 means the SLO will be missed), the good-poll
+    fraction and the sample count."""
+    doc = getattr(cluster, "slos", {}).get("global")
+    if not doc:
+        sys.exit("no SLO doc on the global store yet (the leaseholder "
+                 "router writes it once regions expose /metrics)")
+    import time as _time
+    age = _time.time() - float(doc.get("ts", 0) or 0)
+    if 0 <= age < 1e6:
+        print(f"as of {age:.0f}s ago")
+    rows = []
+    for slo, rec in sorted((doc.get("slos") or {}).items()):
+        for window, w in sorted((rec.get("windows") or {}).items()):
+            good = w.get("good_frac")
+            rows.append([
+                slo, f"{rec.get('target', 0):g}",
+                f"{rec.get('budget', 0):g}", window,
+                f"{w.get('burn', 0.0):.2f}",
+                "-" if good is None else f"{good:.3f}",
+                w.get("polls", 0),
+            ])
+    print(_table(rows, ["SLO", "TARGET", "BUDGET", "WINDOW", "BURN",
+                        "GOOD-FRAC", "POLLS"]))
 
 
 def cmd_federate(cluster, args):
@@ -1601,13 +1682,34 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds between the two write-QPS samples")
     p.set_defaults(fn=cmd_shards)
 
+    p = sub.add_parser("timeline", help="federated causal timeline: "
+                       "the stitched cross-plane span tree of one "
+                       "episode (router admit -> regional placement "
+                       "-> cutover -> resume), waterfall + per-hop "
+                       "segments")
+    p.add_argument("name", nargs="?", default="",
+                   help="global job name (resolves its episode)")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--episode", default="",
+                   help="episode ID directly (skips job lookup)")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("slo", help="fleet SLO burn rates: per SLO x "
+                       "window budget-spend speed from the router's "
+                       "durable burn doc")
+    p.set_defaults(fn=cmd_slo)
+
     p = sub.add_parser("regions", help="federation region registry: "
-                       "liveness, price, capacity per regional plane")
+                       "liveness, price, capacity, mirror staleness "
+                       "per regional plane")
     p.add_argument("--add", default="",
                    help="register a region: NAME=URL")
     p.add_argument("--price", type=float, default=1.0)
     p.add_argument("--locality", default="")
     p.add_argument("--mirror-url", default="")
+    p.add_argument("--metrics-url", default="",
+                   help="region /metrics base URL (enables the "
+                        "router's rollup + SLO scrape)")
     p.add_argument("--remove", default="",
                    help="deregister a region by name")
     p.set_defaults(fn=cmd_regions)

@@ -47,6 +47,7 @@ import time
 from typing import Dict, Optional
 
 from volcano_tpu.api import elastic as eapi
+from volcano_tpu.api import federation as fedapi
 from volcano_tpu.api.resource import TPU
 from volcano_tpu.api.types import GROUP_NAME_ANNOTATION, JobAction, JobPhase, TaskStatus
 from volcano_tpu.controllers.framework import Controller, register_controller
@@ -63,18 +64,21 @@ class ResizeEpisode:
     __slots__ = ("pg_key", "job_key", "kind", "from_slices",
                  "to_slices", "decided_ts", "drained_ts", "resumed_ts",
                  "target_replicas", "decision_version", "restarted",
-                 "scale_tasks", "stall_rounds")
+                 "scale_tasks", "stall_rounds", "episode")
 
     def __init__(self, pg_key: str, job_key: str, kind: str,
                  from_slices: int, to_slices: int, decided_ts: float,
                  target_replicas: int, decision_version: int,
-                 restarted: bool, scale_tasks=()):
+                 restarted: bool, scale_tasks=(), episode: str = ""):
         self.pg_key = pg_key
         self.job_key = job_key
         self.kind = kind
         self.from_slices = from_slices
         self.to_slices = to_slices
         self.decided_ts = decided_ts
+        # federated causal episode riding the gang (empty for purely
+        # regional resizes): drain/resume fragments publish under it
+        self.episode = episode
         self.drained_ts: Optional[float] = None
         self.resumed_ts: Optional[float] = None
         self.target_replicas = target_replicas
@@ -154,7 +158,8 @@ class ElasticController(Controller):
                 # existed: version-1 makes the drained check pass once
                 # the bump is visible
                 max(0, job.version - 1), True,
-                scale_tasks=[t.name for t in tasks])
+                scale_tasks=[t.name for t in tasks],
+                episode=fedapi.episode_of(pg) or "")
             log.info("elastic: adopted in-flight %s of %s from a "
                      "previous controller process", kind, pg.key)
 
@@ -319,7 +324,8 @@ class ElasticController(Controller):
         self._episodes[pg.key] = ResizeEpisode(
             pg.key, job.key, kind, cur, desired, now,
             sum(t.replicas for t in tasks), job.version, running,
-            scale_tasks=[t.name for t in tasks])
+            scale_tasks=[t.name for t in tasks],
+            episode=fedapi.episode_of(pg) or "")
 
     @staticmethod
     def _int_ann(obj, key: str, default=None):
@@ -421,7 +427,13 @@ class ElasticController(Controller):
                         self.cluster.record_event(
                             ep.pg_key, "ElasticEvacuated",
                             f"drained in {now - ep.decided_ts:.3f}s; "
-                            f"held for cross-region cutover")
+                            f"held for cross-region cutover "
+                            f"(episode {ep.episode or 'none'})")
+                        # the drain is this plane's whole slice of a
+                        # cross-region migration: publish it as an
+                        # episode fragment for the fleet stitcher
+                        self._publish_fragment(
+                            ep, "elastic-evacuate-drain", now)
                         del self._episodes[ep.pg_key]
                         continue
             if ep.drained_ts is not None and ep.resumed_ts is None:
@@ -485,4 +497,26 @@ class ElasticController(Controller):
             ep.pg_key, "ElasticResized",
             f"{ep.kind} {ep.from_slices} -> {ep.to_slices} slice(s) "
             f"resumed in {total:.3f}s")
+        self._publish_fragment(ep, f"elastic-{ep.kind}", now)
         del self._episodes[ep.pg_key]
+
+    def _publish_fragment(self, ep: ResizeEpisode, name: str,
+                          now: float) -> None:
+        """This controller's slice of a federated causal episode,
+        pushed to the local plane's trace ring (no-op for purely
+        regional resizes or in-process clusters)."""
+        if not ep.episode:
+            return
+        from volcano_tpu import trace
+        children = []
+        if ep.drained_ts is not None:
+            children.append(("drain", ep.decided_ts, ep.drained_ts))
+            if ep.resumed_ts is not None:
+                children.append(("resume", ep.drained_ts,
+                                 ep.resumed_ts))
+        pg = self.cluster.podgroups.get(ep.pg_key)
+        trace.publish(self.cluster, trace.fragment_doc(
+            name, "controllers", ep.episode, ep.decided_ts, now,
+            hop=fedapi.episode_hop(pg) if pg is not None else 0,
+            jobs=(ep.job_key,), labels={"kind": ep.kind},
+            children=children))

@@ -78,11 +78,12 @@ class FailoverEpisode:
 
     __slots__ = ("slice_name", "nodes", "job_keys", "pg_keys",
                  "declared_ts", "detect_s", "drain_ts", "resched_ts",
-                 "resume_ts")
+                 "resume_ts", "episode")
 
     def __init__(self, slice_name: str, nodes: List[str],
                  job_keys: List[str], pg_keys: List[str],
-                 declared_ts: float, detect_s: float):
+                 declared_ts: float, detect_s: float,
+                 episode: str = ""):
         self.slice_name = slice_name
         self.nodes = list(nodes)
         self.job_keys = list(job_keys)
@@ -92,6 +93,10 @@ class FailoverEpisode:
         self.drain_ts: Optional[float] = None
         self.resched_ts: Optional[float] = None
         self.resume_ts: Optional[float] = None
+        # federated causal episode riding the drained gang(s), if any
+        # (comma-joined when a slice carried several): recovery
+        # fragments publish to the trace ring under it
+        self.episode = episode
 
 
 @register_controller("failover")
@@ -236,9 +241,14 @@ class FailoverController(Controller):
         if job_keys or pg_keys:
             # nothing resident = nothing to walk through drain/resume
             # (the quarantine alone is the whole recovery)
+            from volcano_tpu import trace
+            from volcano_tpu.api import federation as fedapi
+            episode = trace.episode_label(
+                fedapi.episode_of(self.cluster.podgroups.get(k))
+                for k in pg_keys)
             self._episodes[slice_name] = FailoverEpisode(
                 slice_name, [n.name for n in nodes], job_keys,
-                pg_keys, now, detect_s)
+                pg_keys, now, detect_s, episode=episode)
 
     def _job_for(self, pg_key: str, pods):
         job = self.cluster.vcjobs.get(pg_key)
@@ -456,6 +466,25 @@ class FailoverController(Controller):
             ep.slice_name, "FailoverComplete",
             f"gang(s) {','.join(ep.pg_keys) or '-'} resumed; MTTR "
             f"{mttr:.3f}s (detect {ep.detect_s:.3f}s)")
+        if ep.episode:
+            # this plane's recovery slice of a federated causal
+            # episode (one fragment per episode riding the slice)
+            from volcano_tpu import trace
+            children = [("detect", ep.declared_ts - ep.detect_s,
+                         ep.declared_ts)]
+            if ep.drain_ts is not None:
+                children.append(("drain", ep.declared_ts, ep.drain_ts))
+            if ep.drain_ts is not None and ep.resched_ts is not None:
+                children.append(("reschedule", ep.drain_ts,
+                                 ep.resched_ts))
+                children.append(("resume", ep.resched_ts, now))
+            for one in ep.episode.split(","):
+                trace.publish(self.cluster, trace.fragment_doc(
+                    "failover-recovery", "controllers", one,
+                    ep.declared_ts - ep.detect_s, now,
+                    jobs=tuple(ep.job_keys),
+                    labels={"slice": ep.slice_name},
+                    children=children))
         del self._episodes[ep.slice_name]
 
     # -- quarantine lifecycle ------------------------------------------

@@ -330,6 +330,14 @@ class JobController(Controller):
         pod.phase = TaskStatus.PENDING
         pod.node_name = ""
         pod.annotations[GROUP_NAME_ANNOTATION] = job.name
+        # federated causal episode: the router's regional copy carries
+        # the episode ID + hop; pods inherit both so the "running pod"
+        # end of the episode is annotated like every other hop
+        from volcano_tpu.api import federation as fedapi
+        for ann in (fedapi.FED_EPISODE_ANNOTATION,
+                    fedapi.FED_EPISODE_HOP_ANNOTATION):
+            if job.annotations.get(ann):
+                pod.annotations[ann] = job.annotations[ann]
         from volcano_tpu import features
         if features.enabled("SchedulingGatesQueueAdmission"):
             # pods start gated; the scheduler lifts the gate once the

@@ -201,6 +201,14 @@ class ServingController(Controller):
         ann[eapi.ELASTIC_DECIDED_TS_ANNOTATION] = f"{now:.3f}"
         detail = (f"scale-{kind} {cur}->{desired} ({why}: "
                   f"qps={qps:.1f} p99={p99:.1f}ms)")
+        # federated causal episode: a serving drain of a router-placed
+        # gang is one hop of its episode — thread the ID through the
+        # decision record so the elastic executor's fragment and this
+        # decision correlate in /traces?episode=
+        from volcano_tpu.api import federation as fedapi
+        episode = fedapi.episode_of(pg)
+        if episode:
+            detail += f" episode={episode}"
         ann[sapi.PG_LAST_DECISION_ANNOTATION] = detail
         ann[sapi.PG_LAST_DECISION_TS_ANNOTATION] = f"{now:.3f}"
         self.cluster.update_podgroup_status(pg)

@@ -279,6 +279,11 @@ class RegionMirror:
                 "epoch": self.epoch,
                 "age_s": (None if age == float("inf")
                           else round(age, 3)),
+                # operator-facing alias of age_s: the value the router
+                # exports as federation_mirror_staleness_seconds and
+                # folds into the region registry (`vtpctl regions`)
+                "staleness_s": (None if age == float("inf")
+                                else round(age, 3)),
                 "resyncs": self.resyncs,
                 "delta_resyncs": self.delta_resyncs,
                 "refused_batches": self.refused_batches}

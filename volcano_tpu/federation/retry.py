@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import time
 import zlib
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
 from volcano_tpu import metrics
 
@@ -107,8 +107,9 @@ class RegionBreaker:
     probe -> closed | open.  Single-writer discipline: the router's
     reconcile pass is the only caller."""
 
-    __slots__ = ("region", "state", "failures", "opens",
-                 "_retry_at", "threshold", "base", "cap")
+    __slots__ = ("region", "state", "failures", "opens", "half_opens",
+                 "last_trip_ts", "_retry_at", "threshold", "base",
+                 "cap")
 
     def __init__(self, region: str, threshold: int = BREAKER_THRESHOLD,
                  base: float = BREAKER_COOLDOWN_BASE_S,
@@ -117,6 +118,8 @@ class RegionBreaker:
         self.state = STATE_CLOSED
         self.failures = 0           # consecutive transient failures
         self.opens = 0              # times opened (drives the cooldown)
+        self.half_opens = 0         # cooldown expiries -> probe admitted
+        self.last_trip_ts = 0.0     # WALL ts of the last open (0=never)
         self._retry_at = 0.0        # open -> half-open deadline
         self.threshold = threshold
         self.base = base
@@ -130,6 +133,7 @@ class RegionBreaker:
             if now < self._retry_at:
                 return False
             self.state = STATE_HALF_OPEN
+            self.half_opens += 1
         return True
 
     def record_success(self) -> None:
@@ -153,14 +157,60 @@ class RegionBreaker:
         return max(0.0, self._retry_at - now) \
             if self.state == STATE_OPEN else 0.0
 
+    def snapshot(self, now: float) -> dict:
+        """Durable state for the global store (router_breaker kind):
+        the open->half-open deadline ships as a RELATIVE cooldown
+        (monotonic clocks do not cross processes)."""
+        return {"region": self.region, "state": self.state,
+                "failures": self.failures, "opens": self.opens,
+                "half_opens": self.half_opens,
+                "last_trip_ts": self.last_trip_ts,
+                "retry_in_s": round(self.retry_in(now), 3)}
+
+    def restore(self, snap: dict, now: float) -> None:
+        """Adopt a previous holder's learned region health (promoted
+        standby): state machine position, counters, and the remaining
+        cooldown re-anchored to OUR monotonic clock.  Conservative by
+        construction — at worst the full snapshotted cooldown is
+        served again, never a hot loop into a sick region."""
+        if not isinstance(snap, dict):
+            return
+        state = snap.get("state")
+        if state not in BREAKER_STATES:
+            return
+        self.state = state
+        for attr in ("failures", "opens", "half_opens"):
+            try:
+                setattr(self, attr, max(0, int(snap.get(attr, 0) or 0)))
+            except (TypeError, ValueError):
+                pass
+        try:
+            self.last_trip_ts = float(snap.get("last_trip_ts", 0) or 0)
+        except (TypeError, ValueError):
+            self.last_trip_ts = 0.0
+        try:
+            retry_in = max(0.0, float(snap.get("retry_in_s", 0) or 0))
+        except (TypeError, ValueError):
+            retry_in = 0.0
+        self._retry_at = now + retry_in if self.state == STATE_OPEN \
+            else 0.0
+
 
 class FedRPC:
     """The shared seam: breaker gate + classification + counters for
     every mutating cross-region call."""
 
-    def __init__(self, now: Callable[[], float] = time.monotonic):
+    def __init__(self, now: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time):
         self._now = now
+        self._wall = wall
         self.breakers: Dict[str, RegionBreaker] = {}
+        # trip/close seam (router persistence): called as
+        # on_transition(region, breaker, "open"|"close") AFTER the
+        # state change — exceptions are the callback's problem, the
+        # RPC verdict already stands
+        self.on_transition: Optional[
+            Callable[[str, RegionBreaker, str], None]] = None
 
     def breaker(self, region: str) -> RegionBreaker:
         b = self.breakers.get(region)
@@ -204,15 +254,34 @@ class FedRPC:
             metrics.inc("federation_router_rpc_failures_total",
                         region=region, op=op)
             if opened:
+                b.last_trip_ts = self._wall()
                 metrics.inc("federation_router_breaker_opens_total",
                             region=region)
+                self._fire(region, b, "open")
             metrics.set_gauge("federation_router_breaker_state",
                               STATE_CODES[b.state], region=region)
             raise FedRPCError(region, op, str(e)) from e
+        was_tripped = b.state != STATE_CLOSED
         b.record_success()
+        if was_tripped:
+            self._fire(region, b, "close")
         metrics.set_gauge("federation_router_breaker_state",
                           STATE_CODES[b.state], region=region)
         return out
+
+    def _fire(self, region: str, b: RegionBreaker, event: str) -> None:
+        if self.on_transition is None:
+            return
+        try:
+            self.on_transition(region, b, event)
+        except Exception:  # noqa: BLE001 — persistence is advisory
+            pass
+
+    def snapshot(self, region: str) -> dict:
+        return self.breaker(region).snapshot(self._now())
+
+    def restore(self, region: str, snap: dict) -> None:
+        self.breaker(region).restore(snap, self._now())
 
     def states(self) -> Dict[str, str]:
         return {r: b.state for r, b in sorted(self.breakers.items())}

@@ -61,7 +61,7 @@ import logging
 import time
 from typing import Callable, Dict, Optional
 
-from volcano_tpu import metrics
+from volcano_tpu import metrics, trace
 from volcano_tpu.api import elastic as eapi
 from volcano_tpu.api import federation as fedapi
 from volcano_tpu.api.goodput import generation_of
@@ -71,9 +71,11 @@ from volcano_tpu.api.types import (GROUP_NAME_ANNOTATION, JobPhase,
 from volcano_tpu.api.vcjob import VCJob
 from volcano_tpu.federation.ha import RouterElector
 from volcano_tpu.federation.mirror import MirrorStaleError, RegionMirror
+from volcano_tpu.federation import slo as slomod
 from volcano_tpu.federation.retry import (FED_RPC_DEADLINE_S, STATE_CODES,
                                           FedRPC, FedRPCError,
                                           RouterFencedError)
+from volcano_tpu.federation.stitch import EpisodeStitcher
 
 log = logging.getLogger(__name__)
 
@@ -173,8 +175,18 @@ class FederationRouter:
         # measured serving QPS headroom per region, [0, 1]
         self._serving_headroom: Dict[str, float] = {}
         # the ONE cross-region RPC policy: per-region breaker +
-        # deterministic backoff + fence classification
+        # deterministic backoff + fence classification; breaker
+        # trips/closes persist to the global store so a promoted
+        # standby adopts learned region health
         self.rpc = FedRPC()
+        self.rpc.on_transition = self._breaker_transition
+        # observability plane: cross-region episode stitching + fleet
+        # metric rollups + SLO burn-rate tracking (leaseholder-only)
+        self.stitcher = EpisodeStitcher(global_cluster)
+        self.slo = slomod.SLOTracker(now=now)
+        # injectable for in-process tests (default: urllib scrape of
+        # the region record's metrics_url)
+        self._rollup_fetch = slomod.fetch_metrics_text
         # leased replica-set mode: contend for the router lease; only
         # the holder mutates.  elect=False keeps the legacy embedded
         # single-router behavior (in-process tests, one-router bench).
@@ -256,6 +268,12 @@ class FederationRouter:
                 log.warning("%s", e)
                 if self.elector is not None:
                     self.elector.step_down()
+            else:
+                try:
+                    self._observability(now)
+                except Exception:  # noqa: BLE001 — telemetry never
+                    # blocks placement
+                    log.exception("observability pass failed")
         self._gauges()
 
     # -- adoption (first pass after winning a term) ---------------------
@@ -273,6 +291,13 @@ class FederationRouter:
         term = self.elector.term
         for h in list(self.handles.values()):
             self._fence_region(h, term)
+        # adopt the deposed holder's learned region health: breakers
+        # resume from the persisted state machine position instead of
+        # re-probing a known-sick region from closed
+        for region, snap in dict(getattr(
+                self.cluster, "router_breakers", {})).items():
+            if region in self.handles:
+                self.rpc.restore(region, snap)
         for job in self._global_jobs():
             if job.annotations.get(
                     fedapi.FED_EVACUATING_TO_ANNOTATION) and \
@@ -303,6 +328,60 @@ class FederationRouter:
             log.warning("fence advance on %s deferred to first "
                         "write: %s", h.name, e)
 
+    # -- breaker persistence (trip/close seam) --------------------------
+
+    def _breaker_transition(self, region: str, breaker,
+                            event: str) -> None:
+        """Snapshot the breaker into the global store on every trip
+        and close, so a promoted standby adopts learned region health
+        instead of hot-probing a region its predecessor already knew
+        was sick.  Leaseholder-only: a standby's breakers are local
+        observations, not fleet truth."""
+        if self.elector is not None and not self.elector.is_leader:
+            return
+        snap = self.rpc.snapshot(region)
+        snap["event"] = event
+        snap["updated_ts"] = self.now()
+        self.cluster.put_object("router_breaker", snap, key=region)
+
+    # -- observability: stitching + rollups + SLO burn ------------------
+
+    def _publish_fragment(self, frag: dict) -> None:
+        """Router-plane episode fragments feed the in-process
+        stitcher AND the global trace ring (wire mode) — either path
+        alone lets a promoted standby reconstruct the stitch."""
+        self.stitcher.add_fragment(frag)
+        trace.publish(self.cluster, frag)
+
+    def _observability(self, now: float) -> None:
+        """Leaseholder-only telemetry pass: stitch every in-flight
+        episode's cross-plane fragments into the durable fleet trace,
+        fold each ready region's metric exposition into the bounded
+        federation_rollup_* families, and advance the multi-window
+        SLO burn-rate gauges."""
+        self.stitcher.collect(self.handles, now)
+        region_samples: Dict[str, list] = {}
+        for h in self.handles.values():
+            rec = self.cluster.regions.get(h.name, h.record)
+            url = rec.get("metrics_url") or ""
+            if not url or not fedapi.region_ready(rec, now, self.ttl):
+                continue
+            try:
+                text = self._rollup_fetch(url, rec.get("token", ""))
+            except Exception:  # noqa: BLE001 — a dark scrape skips
+                # the region this pass; breakers govern writes, not
+                # reads
+                metrics.inc("federation_rollup_scrape_failures_total",
+                            region=h.name)
+                continue
+            region_samples[h.name] = slomod.parse_samples(text)
+        for name, samples in region_samples.items():
+            for fam, labels, value in slomod.rollup(name, samples):
+                metrics.set_gauge(fam, value, **labels)
+        self.slo.ingest(region_samples, now)
+        doc = self.slo.export(now)
+        self.cluster.put_object("slo", doc, key="global")
+
     def _refresh_regions(self, now: float, mutate: bool = True) -> None:
         """Fold mirror liveness + capacity into the registry records
         (persisted to the global store so `vtpctl regions` renders the
@@ -328,6 +407,14 @@ class FederationRouter:
             rec = dict(self.cluster.regions.get(h.name, h.record))
             age = h.mirror.age_s()
             changed = False
+            # observed mirror lag, capped so a never-polled mirror
+            # reads as "very stale", not infinity
+            stale = round(min(age, 10.0 * max(self.ttl, 1.0)), 3)
+            metrics.set_gauge("federation_mirror_staleness_seconds",
+                              stale, region=h.name)
+            if rec.get("mirror_staleness_s") != stale:
+                rec["mirror_staleness_s"] = stale
+                changed = True
             if age <= self.ttl:
                 # a fresh mirror poll IS the heartbeat: the region's
                 # server answered with (or confirmed) its WAL horizon
@@ -599,6 +686,10 @@ class FederationRouter:
             region = self._pick_region(job)
             if region is None:
                 continue            # nothing ready/fitting: stay queued
+            # mint (or re-derive) the causal episode ID BEFORE the
+            # clone, so the regional copy — and through it the
+            # podgroup and every pod — carries it on creation
+            episode = fedapi.ensure_episode(job, now)
             h = self.handles[region]
             copy = self._regional_copy(job, region, key)
             try:
@@ -610,8 +701,19 @@ class FederationRouter:
             self._stamp_admitted(job, region, key, now)
             self.cluster.record_event(
                 job.key, "FederationAdmitted",
-                f"admitted to region {region} (key {key})")
+                f"admitted to region {region} (key {key}, "
+                f"episode {episode})")
             metrics.inc("federation_admissions_total", region=region)
+            hop = fedapi.episode_hop(job)
+            # hop 0's admit span starts at the episode mint (global
+            # queue wait is part of the causal story); re-admissions
+            # at later hops are point decisions
+            start = fedapi.episode_ts(job, now) if hop == 0 else now
+            self._publish_fragment(
+                trace.fragment_doc(
+                    f"router-admit {job.key}", "router", episode,
+                    min(start, now), now, hop=hop, jobs=(job.key,),
+                    labels={"region": region}))
 
     # -- phase folding + region-loss requeue ---------------------------
 
@@ -676,6 +778,13 @@ class FederationRouter:
             ann[fedapi.FED_MIGRATED_FROM_ANNOTATION] = region
         ann[fedapi.FED_ATTEMPT_ANNOTATION] = \
             str(self._attempt(job) + 1)
+        episode = fedapi.episode_of(job)
+        hop = fedapi.episode_hop(job)
+        if episode:
+            # a requeue is a cross-region move: the next admission
+            # lands at the next hop of the SAME episode
+            hop += 1
+            ann[fedapi.FED_EPISODE_HOP_ANNOTATION] = str(hop)
         self.cluster.update_vcjob(job)
         self.cluster.record_event(
             job.key, "FederationRequeued",
@@ -683,6 +792,13 @@ class FederationRouter:
         metrics.inc("federation_requeues_total",
                     region=region or "unknown")
         self._evac_started.pop(job.key, None)
+        if episode:
+            t = self.now()
+            self._publish_fragment(
+                trace.fragment_doc(
+                    f"router-requeue {job.key}", "router", episode,
+                    t, t, hop=hop, jobs=(job.key,),
+                    labels={"from": region or "?", "why": why[:64]}))
 
     # -- pending-gang burst arbitrage ----------------------------------
 
@@ -723,6 +839,7 @@ class FederationRouter:
             if better is None:
                 continue
             try:
+                # vtplint: disable=episode-propagation (the hop bump and requeue fragment ride _requeue below, which stamps the episode)
                 self.rpc.call(region, "delete_vcjob",
                               lambda: h.client.delete_vcjob(job.key))
             except FedRPCError as e:
@@ -786,6 +903,15 @@ class FederationRouter:
         ann[eapi.ELASTIC_RESIZE_REASON_ANNOTATION] = \
             eapi.RESIZE_EVACUATE
         ann[eapi.ELASTIC_DECIDED_TS_ANNOTATION] = f"{now:.3f}"
+        episode = fedapi.episode_of(job)
+        if episode:
+            # stamp the episode onto the SOURCE podgroup: the
+            # regional elastic controller's drain fragment then joins
+            # this causal timeline (jobs admitted before the episode
+            # scheme get it retro-stamped here)
+            ann[fedapi.FED_EPISODE_ANNOTATION] = episode
+            ann[fedapi.FED_EPISODE_HOP_ANNOTATION] = \
+                str(fedapi.episode_hop(job))
         try:
             self.rpc.call(src, "update_podgroup_status",
                           lambda: h.client.update_podgroup_status(pg))
@@ -842,7 +968,8 @@ class FederationRouter:
                 continue
             metrics.inc("federation_source_reaps_total", region=src)
             log.info("reaped migration residue of %s in %s "
-                     "(%d pods)", job.key, src, len(victims))
+                     "(%d pods, episode %s)", job.key, src,
+                     len(victims), fedapi.episode_of(job) or "-")
 
     def _drive_cutover(self, job: VCJob, src: str, dest: str,
                        now: float) -> None:
@@ -881,6 +1008,13 @@ class FederationRouter:
             resume = {k: v for k in _fold_keys()
                       if (v := copy.annotations.get(k)) is not None}
             resume[fedapi.FED_MIGRATED_FROM_ANNOTATION] = src
+            episode = fedapi.episode_of(job)
+            if episode:
+                # both cutover sides carry the SAME episode; the
+                # destination copy lands at the next hop
+                resume[fedapi.FED_EPISODE_ANNOTATION] = episode
+                resume[fedapi.FED_EPISODE_HOP_ANNOTATION] = \
+                    str(fedapi.episode_hop(job) + 1)
             dcopy = self._regional_copy(job, dest, key, extra=resume)
             dcopy.annotations.pop(eapi.ELASTIC_EVACUATE_ANNOTATION,
                                   None)
@@ -912,6 +1046,10 @@ class FederationRouter:
         ann[fedapi.FED_ADMISSION_KEY_ANNOTATION] = key
         ann.pop(fedapi.FED_EVACUATE_ANNOTATION, None)
         ann.pop(fedapi.FED_EVACUATING_TO_ANNOTATION, None)
+        episode = fedapi.episode_of(job)
+        old_hop = fedapi.episode_hop(job)
+        if episode:
+            ann[fedapi.FED_EPISODE_HOP_ANNOTATION] = str(old_hop + 1)
         self.cluster.update_vcjob(job)
         started = self._evac_started.pop(job.key, None)
         if started is not None:
@@ -921,6 +1059,16 @@ class FederationRouter:
             job.key, "FederationMigrated",
             f"cut over {src} -> {dest} (migration #{n})")
         metrics.inc("federation_migrations_total", kind="running")
+        if episode:
+            # the cutover span (decision -> source drained -> dest
+            # created) belongs to the SOURCE hop's timeline; the
+            # destination's own fragments start the next hop
+            self._publish_fragment(
+                trace.fragment_doc(
+                    f"router-cutover {job.key}", "router", episode,
+                    started if started is not None else now, now,
+                    hop=old_hop, jobs=(job.key,),
+                    labels={"from": src, "to": dest}))
 
     # -- census ---------------------------------------------------------
 
@@ -944,6 +1092,19 @@ class FederationRouter:
         for region, b in self.rpc.breakers.items():
             metrics.set_gauge("federation_router_breaker_state",
                               STATE_CODES[b.state], region=region)
+            snap = self.rpc.snapshot(region)
+            for fam, field in (
+                    ("federation_router_breaker_failures",
+                     "failures"),
+                    ("federation_router_breaker_opens", "opens"),
+                    ("federation_router_breaker_half_opens",
+                     "half_opens"),
+                    ("federation_router_breaker_last_trip_ts",
+                     "last_trip_ts"),
+                    ("federation_router_breaker_retry_in_seconds",
+                     "retry_in_s")):
+                metrics.set_gauge(fam, float(snap[field]),
+                                  region=region)
 
 
 def main(argv=None) -> int:

@@ -90,6 +90,33 @@ class Scheduler:
             with trace.span("open_session", kind="action"):
                 ssn = open_session(self.cache, self.conf)
             root.labels["session"] = ssn.uid
+            # federated causal episodes riding this session's gangs
+            # (podgroup annotation inherited from the router's
+            # regional copy): the label makes this session a
+            # /traces?episode= fragment the fleet stitcher can pull.
+            # Only NOT-YET-RUNNING gangs qualify — once the gang runs,
+            # later cycles are steady-state housekeeping, and labeling
+            # them would extend the stitched episode wall past the
+            # actual submit->running interval forever
+            from volcano_tpu.api import federation as fedapi
+            from volcano_tpu.api.types import PodGroupPhase
+            episodic = [j.podgroup for j in ssn.jobs.values()
+                        if j.podgroup is not None
+                        and j.podgroup.phase in (PodGroupPhase.PENDING,
+                                                 PodGroupPhase.INQUEUE)
+                        and fedapi.episode_of(j.podgroup)]
+            eps = trace.episode_label(
+                fedapi.episode_of(pg) for pg in episodic)
+            if eps:
+                root.labels["episode"] = eps
+                # the hop must be stamped HERE, off the gang's own
+                # annotation: the stitcher's fallback is the global
+                # job's CURRENT hop, which after a cutover would drag
+                # this region's old admission-time sessions into the
+                # destination's hop group and clamp-shift the whole
+                # group forward past the real wall time
+                root.labels["hop"] = str(min(
+                    fedapi.episode_hop(pg) for pg in episodic))
             for name in self.conf.actions:
                 action = get_action(name)
                 if action is None:

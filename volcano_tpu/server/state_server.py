@@ -747,12 +747,16 @@ class StateServer:
         with self._lock:
             self._traces.append(dict(doc, epoch=self.epoch))
 
-    def traces(self, job: str = "", limit: int = 0) -> List[dict]:
+    def traces(self, job: str = "", limit: int = 0,
+               episode: str = "") -> List[dict]:
         from volcano_tpu import trace as trace_mod
         with self._lock:
             out = list(self._traces)
         if job:
             out = [t for t in out if trace_mod.matches_job(t, job)]
+        if episode:
+            out = [t for t in out
+                   if trace_mod.matches_episode(t, episode)]
         if limit:
             out = out[-limit:]
         return out
@@ -1213,10 +1217,28 @@ class _Handler(BaseHTTPRequestHandler):
             # server restart
             q = parse_qs(url.query)
             job = q.get("job", [""])[0]
+            episode = q.get("episode", [""])[0]
             limit = int(q.get("limit", ["0"])[0])
             return self._json(200, {
                 "epoch": st.epoch,
-                "traces": st.traces(job=job, limit=limit)})
+                "traces": st.traces(job=job, limit=limit,
+                                    episode=episode)})
+        if url.path == "/fleet_trace":
+            # the stitched cross-plane span tree for one causal
+            # episode (written by the leaseholder router's stitcher
+            # into the fleet_trace dict-kind; durable, so a promoted
+            # standby serves the same artifact)
+            q = parse_qs(url.query)
+            episode = q.get("episode", [""])[0]
+            if not episode:
+                return self._json(400, {"error": "missing episode"})
+            with st.cluster._lock:
+                doc = getattr(st.cluster, "fleet_traces",
+                              {}).get(episode)
+            if doc is None:
+                return self._json(404, {
+                    "error": f"no stitched trace for {episode!r}"})
+            return self._json(200, {"episode": episode, "trace": doc})
         if url.path == "/audit":
             q = parse_qs(url.query)
             since = int(q.get("since", ["0"])[0])

@@ -495,13 +495,18 @@ def end_session(root: Span, jobs_pending: Optional[List[str]] = None
     dur = root.duration
     unsched = int(root.labels.get("unschedulable_jobs", "0"))
     errored = "error" in root.labels
+    # federated episodes are rare (in-flight cross-region gangs) but
+    # every fragment is load-bearing for the /fleet_trace stitch: a
+    # sampled-away placement session would leave a hole in the
+    # cross-plane tree, so episode-labelled sessions are always kept
+    episodic = bool(root.labels.get("episode"))
     keys = sorted(set(jobs_pending or []))
     with _lock:
         _seq += 1
         seq = _seq
         slow = dur >= _p95(_durations) and len(_durations) >= 16
         _durations.append(dur)
-        keep = errored or unsched > 0 or slow \
+        keep = errored or unsched > 0 or slow or episodic \
             or seq % SAMPLE_EVERY == 1
         if not keep:
             return None
@@ -513,7 +518,8 @@ def end_session(root: Span, jobs_pending: Optional[List[str]] = None
         doc = {"seq": seq, "kept_because":
                ("error" if errored else
                 "unschedulable" if unsched else
-                "slow" if slow else "sampled"),
+                "slow" if slow else
+                "episode" if episodic else "sampled"),
                "jobs": keys[:MAX_DOC_JOBS],
                "pending": pending,
                "root": root.to_dict()}
@@ -524,13 +530,17 @@ def end_session(root: Span, jobs_pending: Optional[List[str]] = None
     return doc
 
 
-def recent_traces(limit: int = 0, job: str = "") -> List[dict]:
+def recent_traces(limit: int = 0, job: str = "",
+                  episode: str = "") -> List[dict]:
     """Newest-last kept traces; job filters to traces that touched or
-    pended the given job key."""
+    pended the given job key, episode to this plane's fragments of
+    one federated causal episode."""
     with _lock:
         out = list(_ring)
     if job:
         out = [t for t in out if matches_job(t, job)]
+    if episode:
+        out = [t for t in out if matches_episode(t, episode)]
     if limit:
         out = out[-limit:]
     return out
@@ -561,6 +571,58 @@ def _mentions_job(span_doc: Optional[dict], job: str) -> bool:
         return True
     return any(_mentions_job(c, job)
                for c in span_doc.get("children", ()))
+
+
+def matches_episode(trace_doc: dict, episode: str) -> bool:
+    """Is this doc a local fragment of the given causal episode?  A
+    session root may carry several episodes (comma-joined label) —
+    one scheduling cycle can place gangs from distinct episodes."""
+    if not episode:
+        return False
+    if trace_doc.get("episode") == episode:
+        return True
+    return _mentions_episode(trace_doc.get("root"), episode)
+
+
+def _mentions_episode(span_doc: Optional[dict], episode: str) -> bool:
+    if not span_doc:
+        return False
+    raw = span_doc.get("labels", {}).get("episode", "")
+    if episode in [e.strip() for e in raw.split(",") if e.strip()]:
+        return True
+    return any(_mentions_episode(c, episode)
+               for c in span_doc.get("children", ()))
+
+
+def episode_label(episodes) -> str:
+    """The bounded session-root `episode` label value: sorted unique
+    comma join, capped — labels ride every trace doc, so one cycle
+    placing many federated gangs must not grow an unbounded string."""
+    eps = sorted({e for e in episodes if e})
+    return ",".join(eps[:8])
+
+
+def fragment_doc(name: str, plane: str, episode: str, start: float,
+                 end: float, hop: int = 0, jobs=(), labels=None,
+                 children=()) -> dict:
+    """A complete single-plane episode fragment in ring-doc shape —
+    how the router and controllers (which run no scheduler session)
+    contribute their slice of a causal episode to /traces.  Children
+    are (name, start, end) triples; everything is closed at build
+    time so the state server's is_complete_span gate always passes."""
+    lbl = {"plane": plane, "episode": episode, "hop": str(int(hop))}
+    lbl.update(labels or {})
+    end = max(end, start)
+    root = {"name": name, "kind": "fragment", "labels": lbl,
+            "start": start, "dur": end - start}
+    kids = []
+    for cname, cs, ce in children:
+        kids.append({"name": cname, "kind": "span", "labels": {},
+                     "start": cs, "dur": max(0.0, ce - cs)})
+    if kids:
+        root["children"] = kids
+    return {"seq": 0, "kept_because": "episode", "episode": episode,
+            "jobs": sorted(set(jobs)), "pending": {}, "root": root}
 
 
 def publish(cluster, doc: Optional[dict]) -> None:
